@@ -1,0 +1,32 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of PaddlePaddle (reference mounted
+at /root/reference — see SURVEY.md), built on JAX/XLA/Pallas/pjit idioms:
+functional core, GSPMD parallelism, Pallas hot kernels. The top-level
+namespace mirrors ``paddle.*``: tensor functions live here, layers under
+``nn``, optimizers under ``optimizer``, parallelism under ``distributed``.
+"""
+
+from .core import dtype as _dtype_ns
+from .core.dtype import (bool_, uint8, int8, int16, int32, int64, float16,
+                         bfloat16, float32, float64, complex64, complex128)
+from .core.flags import set_flags, get_flags
+from .core.rng import seed
+
+from . import amp
+from . import autograd
+from . import nn
+from . import optimizer
+from . import ops
+from . import tensor
+
+# paddle-style: every tensor function is also a top-level symbol
+from .tensor import *  # noqa: F401,F403
+from .tensor import Tensor
+
+from .nn.layer import set_default_dtype, get_default_dtype
+
+from .framework import save, load, set_device, get_device, is_compiled_with_cuda, \
+    is_compiled_with_tpu, device_count, no_grad, jit
+
+__version__ = "0.1.0"

@@ -1,0 +1,54 @@
+"""Dtype surface.
+
+Paddle-shaped dtype names mapped onto jnp dtypes (reference:
+paddle/phi/common/data_type.h; python surface python/paddle/framework/dtype.py).
+bfloat16 is the native TPU compute dtype; float16 is kept for API parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128,
+    # paddle aliases
+    "fp16": float16, "bf16": bfloat16, "fp32": float32, "fp64": float64,
+}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a string/np/jnp dtype to a jnp dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype}")
+        return _NAME_TO_DTYPE[dtype]
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.complexfloating)

@@ -1,0 +1,121 @@
+"""Framework-level utilities: device control, save/load, jit.
+
+Reference: python/paddle/device/ (set_device), python/paddle/framework/io.py
+(save:721, load:960), python/paddle/jit/api.py (to_static:171).
+
+``jit.to_static`` maps onto jax.jit: the reference's SOT/AST graph capture is
+replaced by JAX tracing (every op here is already trace-friendly), so the
+decorator only manages static args and an optional AOT-lowered export.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import no_grad  # re-export
+
+
+_CURRENT_DEVICE = None
+
+
+def set_device(device: str):
+    """'tpu' | 'cpu' | 'tpu:N' (mirrors paddle.set_device)."""
+    global _CURRENT_DEVICE
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platform = {"gpu": "gpu", "tpu": "tpu", "cpu": "cpu", "xpu": "tpu"}.get(name)
+    if platform is None:
+        raise ValueError(f"unknown device {device}")
+    devs = jax.devices(platform)
+    _CURRENT_DEVICE = devs[idx]
+    jax.config.update("jax_default_device", _CURRENT_DEVICE)
+    return _CURRENT_DEVICE
+
+
+def get_device() -> str:
+    d = _CURRENT_DEVICE or jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+# -- save / load (reference: python/paddle/framework/io.py:721,960) ----------
+
+def _to_numpy_tree(obj):
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, obj)
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """Pickle-based save of (nested) state dicts; jax Arrays stored as numpy.
+    The orbax-backed sharded checkpoint lives in paddle_tpu.checkpoint."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return jax.tree.map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
+
+
+# -- jit (reference: python/paddle/jit/api.py:171 to_static) -----------------
+
+class _JitNamespace:
+    @staticmethod
+    def to_static(function=None, input_spec=None, full_graph: bool = True,
+                  backend=None, static_argnums=None):
+        """Compile a function (or Layer.forward bound method) with jax.jit."""
+        def deco(fn):
+            if hasattr(fn, "functional"):  # a Layer: jit its functional view
+                layer = fn
+                pure = layer.functional()
+                jitted = jax.jit(pure)
+                def call(*args, **kwargs):
+                    return jitted(layer.raw_state(), *args, **kwargs)
+                call.__wrapped_layer__ = layer
+                return call
+            return jax.jit(fn, static_argnums=static_argnums)
+        if function is None:
+            return deco
+        return deco(function)
+
+    @staticmethod
+    def save(layer, path: str, input_spec=None):
+        """Export: save state dict + (optionally) AOT-lowered HLO text.
+        Reference analogue: paddle.jit.save (serialized inference program)."""
+        save(getattr(layer, "state_dict", lambda: layer)(), path + ".pdparams")
+        if input_spec is not None and hasattr(layer, "functional"):
+            pure = layer.functional()
+            lowered = jax.jit(pure).lower(layer.raw_state(), *input_spec)
+            with open(path + ".hlo.txt", "w") as f:
+                f.write(lowered.as_text())
+
+    @staticmethod
+    def load(path: str):
+        return load(path + ".pdparams")
+
+
+jit = _JitNamespace()

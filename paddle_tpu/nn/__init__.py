@@ -1,0 +1,18 @@
+"""paddle_tpu.nn — module system + layers.
+
+Reference: python/paddle/nn/ (Layer base at nn/layer/layers.py; layer zoo
+under nn/layer/). See layer.py for the functional-bridge design that replaces
+the eager autograd engine.
+"""
+
+from . import functional
+from . import initializer
+from .layer import (Layer, Parameter, Buffer, Sequential, LayerList, LayerDict,
+                    set_default_dtype, get_default_dtype)
+from .common import (
+    Linear, Embedding, Dropout, LayerNorm, RMSNorm, BatchNorm, BatchNorm2D,
+    GroupNorm, Conv2D, Conv2DTranspose, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
+    Flatten, ReLU, GELU, SiLU, Sigmoid, Tanh, Softmax, LeakyReLU, Hardswish,
+    Hardsigmoid, Mish, CrossEntropyLoss, MSELoss, L1Loss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, NLLLoss,
+)

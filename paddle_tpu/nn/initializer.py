@@ -1,0 +1,137 @@
+"""Parameter initializers.
+
+Reference: python/paddle/nn/initializer/ (Constant, Normal, TruncatedNormal,
+Uniform, XavierNormal/Uniform, KaimingNormal/Uniform, Assign). Initializers
+draw from the global RNG tracker (core/rng.py) so model construction is
+reproducible via ``paddle_tpu.seed``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import rng_tracker, GLOBAL_STREAM
+
+
+def _key():
+    tr = rng_tracker()
+    if not tr.has(GLOBAL_STREAM):
+        tr.add(GLOBAL_STREAM, 0)
+    return tr.next_key(GLOBAL_STREAM)
+
+
+def _fan_in_out(shape: Sequence[int]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c/groups, *k]: fan = channels * receptive field
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(self.value, dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        x = jax.random.truncated_normal(_key(), -2.0, 2.0, shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=self.low, maxval=self.high)
+        return x.astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32) * std
+        return x.astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope: float = 0.0, nonlinearity: str = "leaky_relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fan_in_out(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope: float = 0.0, nonlinearity: str = "leaky_relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fan_in_out(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        std = gain / math.sqrt(fan_in)
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32) * std
+        return x.astype(dtype)

@@ -1,0 +1,13 @@
+"""paddle_tpu.ops — the op library.
+
+TPU-native replacement for the reference's PHI kernel library
+(reference: paddle/phi/kernels/ — 415K LoC of CUDA/C++). Here most ops are
+jnp/lax compositions that XLA fuses; the hot set (flash attention, fused
+norms, rope, MoE dispatch) has Pallas TPU kernels under ops/pallas/ selected
+at dispatch time (ops/registry.py) — the analogue of PHI's KernelFactory
+(backend,dtype)-keyed dispatch (paddle/phi/core/kernel_factory.h:314) reduced
+to the one decision XLA doesn't make for us: hand-written kernel vs compiler.
+"""
+
+from . import attention, norm, rope
+from .registry import dispatch, register_kernel, backend_kind

@@ -1,0 +1,45 @@
+"""Minimal kernel dispatch registry.
+
+The reference dispatches every op through KernelFactory on
+(backend, layout, dtype) — paddle/phi/core/kernel_factory.h:314. On TPU, XLA
+owns device/dtype dispatch, so the registry keeps only the residual decision:
+per-op choice between a hand-written Pallas kernel and the XLA composition
+fallback, overridable via FLAGS_use_pallas_kernels (core/flags.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Tuple
+
+import jax
+
+from ..core.flags import flag
+
+_KERNELS: Dict[Tuple[str, str], Callable] = {}
+
+
+@functools.lru_cache(maxsize=None)
+def backend_kind() -> str:
+    """'tpu' | 'gpu' | 'cpu' based on the default jax backend."""
+    return jax.default_backend()
+
+
+def register_kernel(op: str, backend: str):
+    """Register an implementation for op on backend ('tpu'|'cpu'|'any')."""
+    def deco(fn):
+        _KERNELS[(op, backend)] = fn
+        return fn
+    return deco
+
+
+def dispatch(op: str) -> Callable:
+    """Pick the best registered impl: pallas/tpu first when enabled."""
+    if flag("use_pallas_kernels"):
+        k = _KERNELS.get((op, backend_kind()))
+        if k is not None:
+            return k
+    k = _KERNELS.get((op, "any"))
+    if k is None:
+        raise KeyError(f"No kernel registered for op {op!r}")
+    return k

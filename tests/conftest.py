@@ -1,0 +1,41 @@
+"""Test configuration: virtual 8-device CPU mesh.
+
+Mirrors the reference's "multi-node without a cluster" strategy (SURVEY.md §4:
+fake_cpu_device / single-host multi-process) using XLA's host-platform device
+partitioning — the idiomatic JAX way to test sharding without TPU hardware.
+
+The environment may pin JAX_PLATFORMS=axon (tunneled TPU); tests must not
+touch it — force the CPU platform BEFORE any backend is initialized, both via
+env (fresh interpreter) and jax.config (already-imported jax).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.device_count() == 8, f"need 8 virtual cpu devices, got {jax.device_count()}"
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    import paddle_tpu
+    paddle_tpu.seed(42)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    """2x4 (dp, tp) mesh over the 8 virtual CPU devices."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    with Mesh(devs, ("dp", "tp")) as m:
+        yield m

@@ -1,0 +1,105 @@
+"""Layer system tests (reference test model: test/legacy_test op/layer tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class MLP(nn.Layer):
+    def __init__(self, din=8, dh=16, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_parameter_registration():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert m.fc1.weight.shape == (8, 16)
+
+
+def test_state_dict_roundtrip():
+    m = MLP()
+    sd = m.state_dict()
+    m2 = MLP()
+    m2.set_state_dict(sd)
+    x = jnp.ones((2, 8))
+    np.testing.assert_allclose(np.asarray(m(x)), np.asarray(m2(x)), rtol=1e-6)
+
+
+def test_functional_call_grad():
+    m = MLP()
+    x = jnp.ones((2, 8))
+    params = m.raw_parameters()
+
+    def loss_fn(p):
+        return m.functional_call(p, x).sum()
+
+    g = jax.grad(loss_fn)(params)
+    assert set(g.keys()) == set(params.keys())
+    assert g["fc1.weight"].shape == (8, 16)
+    # grads flow
+    assert float(jnp.abs(g["fc2.bias"]).sum()) > 0
+
+
+def test_functional_call_under_jit():
+    m = MLP()
+    x = jnp.ones((2, 8))
+    params = m.raw_parameters()
+    f = jax.jit(lambda p, x: m.functional_call(p, x))
+    out = f(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(m(x)), rtol=1e-6)
+    # stored params untouched by binding
+    assert m._parameters is not None
+
+
+def test_train_eval_mode_dropout():
+    paddle_tpu.seed(0)
+    drop = nn.Dropout(0.5)
+    x = jnp.ones((4, 100))
+    y = drop(x)
+    assert float(jnp.sum(y == 0)) > 0
+    drop.eval()
+    y2 = drop(x)
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = jnp.ones((3, 4))
+    assert seq(x).shape == (3, 2)
+    ll = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_to_dtype_cast():
+    m = MLP()
+    m.to(dtype="bfloat16")
+    assert m.fc1.weight.dtype == jnp.bfloat16
+
+
+def test_buffers():
+    bn = nn.BatchNorm2D(4)
+    sd = bn.state_dict()
+    assert "_mean" in sd and "_variance" in sd
+
+
+def test_hooks():
+    m = MLP()
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(out.shape))
+    m(jnp.ones((2, 8)))
+    assert calls == [(2, 4)]
+    h.remove()
+    m(jnp.ones((2, 8)))
+    assert len(calls) == 1

@@ -1,0 +1,214 @@
+"""Op correctness tests vs numpy references — the OpTest pattern
+(reference: test/legacy_test/op_test.py:420 — numpy forward reference +
+numeric gradient check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.ops import norm as norm_ops
+from paddle_tpu.ops import rope as rope_ops
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f at x (fp64 for stability)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_layer_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    w = np.random.RandomState(1).rand(6).astype(np.float32)
+    b = np.random.RandomState(2).rand(6).astype(np.float32)
+    out = norm_ops.layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    w = np.ones(6, np.float32) * 1.5
+    out = norm_ops.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-6)
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_grad_numeric():
+    x0 = np.random.RandomState(3).randn(2, 4).astype(np.float64)
+
+    def f_np(x):
+        return float(np.sum(x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)))
+
+    g_num = numeric_grad(f_np, x0)
+    g_jax = jax.grad(lambda x: norm_ops.rms_norm(x, None, 1e-6).sum())(
+        jnp.asarray(x0, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g_jax), g_num, rtol=1e-3, atol=1e-3)
+
+
+def test_sdpa_matches_naive():
+    rs = np.random.RandomState(0)
+    q = rs.randn(2, 5, 3, 8).astype(np.float32)
+    k = rs.randn(2, 5, 3, 8).astype(np.float32)
+    v = rs.randn(2, 5, 3, 8).astype(np.float32)
+    out = attn_ops.flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                   causal=True)
+    # naive reference
+    scale = 1 / np.sqrt(8)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = np.tril(np.ones((5, 5), bool))
+    logits = np.where(mask[None, None], logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sdpa_gqa():
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(1, 4, 8, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 4, 2, 16).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 4, 2, 16).astype(np.float32))
+    out = attn_ops.flash_attention(q, k, v, causal=True)
+    assert out.shape == (1, 4, 8, 16)
+
+
+def test_rope_rotation_norm_preserving():
+    cos, sin = rope_ops.rope_freqs(8, 16)
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
+    q2, k2 = rope_ops.apply_rotary_pos_emb(q, k, cos, sin)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q2)),
+                               np.linalg.norm(np.asarray(q)), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(q2[:, 0]), np.asarray(q[:, 0]), atol=1e-6)
+
+
+def test_rope_relative_property():
+    """RoPE inner products depend only on relative positions."""
+    d = 8
+    cos, sin = rope_ops.rope_freqs(d, 32)
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(1, 32, 1, d).astype(np.float32))
+    b = jnp.asarray(rs.randn(1, 32, 1, d).astype(np.float32))
+    # broadcast the same vector at every position
+    a = jnp.broadcast_to(a[:, :1], a.shape)
+    b = jnp.broadcast_to(b[:, :1], b.shape)
+    ar, br = rope_ops.apply_rotary_pos_emb(a, b, cos, sin)
+    dots = np.einsum("bshd,bthd->bst", np.asarray(ar), np.asarray(br))[0]
+    # same relative offsets should give same dot products
+    np.testing.assert_allclose(dots[0, 3], dots[5, 8], rtol=1e-4)
+    np.testing.assert_allclose(dots[2, 7], dots[10, 15], rtol=1e-4)
+
+
+def test_cross_entropy_matches_numpy():
+    rs = np.random.RandomState(0)
+    logits = rs.randn(6, 10).astype(np.float32)
+    labels = rs.randint(0, 10, (6,))
+    out = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    # numpy ref
+    m = logits.max(-1, keepdims=True)
+    logp = logits - m - np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    ref = -logp[np.arange(6), labels].mean()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+    labels = jnp.asarray(np.array([1, -100, 3, -100]))
+    out = F.cross_entropy(logits, labels, ignore_index=-100)
+    ref = F.cross_entropy(logits[jnp.asarray([0, 2])], labels[jnp.asarray([0, 2])])
+    np.testing.assert_allclose(float(out), float(ref), rtol=1e-6)
+
+
+def test_conv2d_matches_torch_style_ref():
+    # small hand-checkable conv
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    w = np.ones((1, 1, 2, 2), np.float32)
+    out = F.conv2d(jnp.asarray(x), jnp.asarray(w), stride=1, padding=0)
+    ref = np.array([[[[10, 14, 18], [26, 30, 34], [42, 46, 50]]]], np.float32)
+    np.testing.assert_allclose(np.asarray(out), ref)
+
+
+def test_conv2d_vs_scipy_random():
+    import torch  # cpu torch is available as an oracle
+    import torch.nn.functional as TF
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    w = rs.randn(5, 3, 3, 3).astype(np.float32)
+    b = rs.randn(5).astype(np.float32)
+    out = F.conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                   stride=2, padding=1)
+    ref = TF.conv2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+                    stride=2, padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 5, 5).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32)
+    out = F.conv2d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2, padding=1)
+    ref = TF.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pooling_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    out = F.max_pool2d(jnp.asarray(x), 2, 2)
+    ref = TF.max_pool2d(torch.from_numpy(x), 2, 2).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref)
+    # paddle's default exclusive=True == torch count_include_pad=False
+    out = F.avg_pool2d(jnp.asarray(x), 3, 2, 1)
+    ref = TF.avg_pool2d(torch.from_numpy(x), 3, 2, 1,
+                        count_include_pad=False).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_activations_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    x = np.linspace(-3, 3, 50, dtype=np.float32)
+    xt = torch.from_numpy(x)
+    pairs = [
+        (F.gelu(jnp.asarray(x)), TF.gelu(xt).numpy()),
+        (F.silu(jnp.asarray(x)), TF.silu(xt).numpy()),
+        (F.hardswish(jnp.asarray(x)), TF.hardswish(xt).numpy()),
+        (F.mish(jnp.asarray(x)), TF.mish(xt).numpy()),
+        (F.softplus(jnp.asarray(x)), TF.softplus(xt).numpy()),
+        (F.elu(jnp.asarray(x)), TF.elu(xt).numpy()),
+    ]
+    for got, ref in pairs:
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_surface():
+    import paddle_tpu as P
+    x = P.arange(12, dtype="float32").reshape((3, 4))
+    assert P.matmul(x, x, transpose_y=True).shape == (3, 3)
+    assert P.concat([x, x], axis=0).shape == (6, 4)
+    v, i = P.topk(x, 2, axis=-1)
+    assert v.shape == (3, 2)
+    np.testing.assert_array_equal(np.asarray(i[:, 0]), [3, 3, 3])
+    s = P.split(x, [1, -1], axis=1)
+    assert s[0].shape == (3, 1) and s[1].shape == (3, 3)

@@ -1,0 +1,88 @@
+"""Regression tests for code-review findings on the v0 foundation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.nn.initializer import _fan_in_out
+
+
+def test_conv_fan_in_out():
+    # [out_c, in_c, kh, kw] = [64, 32, 3, 3] -> fan_in = 32*9, fan_out = 64*9
+    assert _fan_in_out([64, 32, 3, 3]) == (288, 576)
+    assert _fan_in_out([8, 16]) == (8, 16)
+
+
+def test_pylayer_grad_flows():
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return 2 * x * g
+
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    g = jax.grad(lambda x: Sq.apply(x).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0, 6.0])
+    # jit too
+    g2 = jax.jit(jax.grad(lambda x: Sq.apply(x).sum()))(x)
+    np.testing.assert_allclose(np.asarray(g2), [2.0, 4.0, 6.0])
+
+
+def test_conv1d_nlc_layout():
+    x = jnp.ones((2, 8, 4))  # N L C
+    w = jnp.ones((5, 4, 3))  # out in k
+    out = F.conv1d(x, w, data_format="NLC", padding=1)
+    assert out.shape == (2, 8, 5)
+    ref = F.conv1d(jnp.swapaxes(x, 1, 2), w, data_format="NCL", padding=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.swapaxes(ref, 1, 2)))
+
+
+def test_pad_nhwc_flat():
+    out = F.pad(jnp.zeros((1, 4, 4, 3)), [1, 1, 2, 2], data_format="NHWC")
+    assert out.shape == (1, 8, 6, 3)
+    out = F.pad(jnp.zeros((1, 3, 4, 4)), [1, 1, 2, 2], data_format="NCHW")
+    assert out.shape == (1, 3, 8, 6)
+
+
+def test_multinomial_batched():
+    probs = jnp.ones((4, 10)) / 10
+    s = pt.multinomial(probs, num_samples=3, replacement=True)
+    assert s.shape == (4, 3)
+    assert int(jnp.max(s)) < 10 and int(jnp.min(s)) >= 0
+
+
+def test_scaler_no_double_unscale():
+    from paddle_tpu.amp import GradScaler
+    import paddle_tpu.optimizer as opt
+
+    m = nn.Linear(2, 1, bias_attr=False)
+    o = opt.SGD(learning_rate=1.0, parameters=m)
+    s = GradScaler(init_loss_scaling=1024.0)
+    g_scaled = {"weight": jnp.full((2, 1), 1024.0)}  # true grad = 1.0
+    w0 = np.asarray(m.weight).copy()
+    g = s.unscale_(g_scaled)      # user unscales to clip
+    s.step(o, g)                  # must NOT unscale again
+    s.update()
+    w1 = np.asarray(m.weight)
+    np.testing.assert_allclose(w0 - w1, np.ones((2, 1)), rtol=1e-5)
+
+
+def test_auto_cast_custom_lists():
+    from paddle_tpu.amp.auto_cast import maybe_cast_inputs
+    x = jnp.ones((2, 2), jnp.float32)
+    with pt.amp.auto_cast(custom_black_list={"linear"}):
+        (y,) = maybe_cast_inputs("linear", x)
+        assert y.dtype == jnp.float32  # blacklisted: no cast
+    with pt.amp.auto_cast(custom_white_list={"my_op"}):
+        (y,) = maybe_cast_inputs("my_op", x)
+        assert y.dtype == jnp.bfloat16
