@@ -1,0 +1,87 @@
+"""Benchmark entry: prints ONE JSON line with the headline metric.
+
+Run by the driver on real TPU hardware at the end of each round:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: Llama pretraining tokens/sec/chip (the BASELINE.json north-star
+metric); vs_baseline = achieved MFU / 0.40 target MFU (the reference
+publishes no absolute numbers — BASELINE.md).
+
+Model size auto-scales to the backend: a ~1B-param Llama on a real TPU chip,
+a tiny config on CPU smoke runs.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.trainer import Trainer, device_peak_flops
+
+    pt.seed(0)
+    if on_tpu:
+        # ~0.5B params — fits one v5e chip (16GB) in bf16 with adam fp32 state
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4608, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch_size, seq_len, steps, warmup = 8, 2048, 10, 3
+    else:
+        cfg = LlamaConfig.tiny()
+        batch_size, seq_len, steps, warmup = 4, 128, 6, 2
+
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01, parameters=model)
+    tr = Trainer(model, opt)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (batch_size, seq_len + 1))
+    batch = {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}
+
+    for _ in range(warmup):
+        loss = tr.train_step(batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = tr.train_step(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens = batch_size * seq_len * steps
+    tps_chip = tokens / dt / n_chips
+    mfu = tps_chip * model.flops_per_token(seq_len) / device_peak_flops()
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "backend": backend,
+            "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+            "n_chips": n_chips,
+            "params": model.num_params(),
+            "batch_size": batch_size,
+            "seq_len": seq_len,
+            "mfu": round(mfu, 4),
+            "final_loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

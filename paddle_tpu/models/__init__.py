@@ -1,1 +1,4 @@
-"""placeholder — populated in later milestones."""
+"""paddle_tpu.models — model zoo for the BASELINE.json capability configs."""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaDecoderLayer, LlamaAttention, LlamaMLP)

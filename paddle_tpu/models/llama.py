@@ -1,0 +1,258 @@
+"""Llama-family decoder-only transformer.
+
+Capability target (BASELINE.json): Llama-3 8B/70B pretraining recipes.
+Reference model analogue: PaddleNLP's Llama on the reference's fused kernels
+(fused_rms_norm, fused_rope, flash_attention —
+python/paddle/incubate/nn/functional/, phi/kernels/fusion/gpu/).
+
+TPU-first design decisions:
+- bf16 activations, fp32 norm statistics; big fused matmuls for the MXU
+  (QKV fused into one projection, gate+up fused).
+- GSPMD sharding annotations on every Parameter (Megatron layout: column
+  parallel over "tp" for qkv/gate/up, row parallel for o/down; embeddings
+  vocab-sharded; all params additionally sharded over "fsdp" for ZeRO-3).
+  The same module runs 1-chip (annotations ignored) or on any mesh.
+- static-shape causal flash attention via ops.attention (Pallas on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops import rope as rope_ops
+from ..ops import norm as norm_ops
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+    # recompute (activation checkpointing) granularity: "none"|"full"
+    recompute: str = "none"
+    # sequence parallel: shard activations along seq dim over "sep"
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if self.recompute not in ("none", "full"):
+            raise ValueError(f"recompute must be 'none'|'full', got "
+                             f"{self.recompute!r}")
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must be divisible by num_attention_heads")
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError("num_attention_heads must be a multiple of "
+                             "num_key_value_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=8,
+                           max_position_embeddings=8192, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def llama3_70b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=8192,
+                           intermediate_size=28672, num_hidden_layers=80,
+                           num_attention_heads=64, num_key_value_heads=8,
+                           max_position_embeddings=8192, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, max_position_embeddings=256, **kw)
+
+
+def _normal(std):
+    return I.Normal(0.0, std)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        d, hd = cfg.hidden_size, cfg.head_dim
+        n_h, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
+        std = cfg.initializer_range
+        # fused QKV: [d, (n_h + 2*n_kv) * hd], column-parallel over tp
+        self.qkv_proj = self.create_parameter(
+            [d, (n_h + 2 * n_kv) * hd], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("fsdp", "tp"))
+        # output proj: row-parallel over tp
+        self.o_proj = self.create_parameter(
+            [n_h * hd, d], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("tp", "fsdp"))
+
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+        cfg = self.cfg
+        b, s, d = x.shape
+        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
+        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
+        q = q.reshape(b, s, n_h, hd)
+        k = k.reshape(b, s, n_kv, hd)
+        v = v.reshape(b, s, n_kv, hd)
+        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, position_ids)
+        if cfg.use_flash_attention:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True,
+                                                 training=self.training)
+        else:
+            from ..ops.attention import _sdpa_xla
+            out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        return jnp.matmul(out, self.o_proj.astype(x.dtype))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        d, m = cfg.hidden_size, cfg.intermediate_size
+        std = cfg.initializer_range
+        # fused gate+up: column-parallel; down: row-parallel
+        self.gate_up_proj = self.create_parameter(
+            [d, 2 * m], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("fsdp", "tp"))
+        self.down_proj = self.create_parameter(
+            [m, d], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("tp", "fsdp"))
+
+    def forward(self, x):
+        gu = jnp.matmul(x, self.gate_up_proj.astype(x.dtype))
+        g, u = jnp.split(gu, 2, axis=-1)
+        return jnp.matmul(F.silu(g) * u, self.down_proj.astype(x.dtype))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                          dtype="float32")
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps, dtype="float32")
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids,
+                               attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps, dtype="float32")
+        cos, sin = rope_ops.rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def _seq_shard(self, x):
+        """GSPMD sequence parallelism: constrain activations to be sharded
+        along seq over 'sep' (reference analogue: SegmentParallel sep axis +
+        sequence_parallel_utils scatter/gather, SURVEY.md §5 long-context)."""
+        if not self.cfg.sequence_parallel:
+            return x
+        from ..parallel.mesh import current_mesh
+        from jax.sharding import PartitionSpec, NamedSharding
+        hm = current_mesh()
+        if hm is None or hm.axis_size("sep") <= 1:
+            return x
+        sh = NamedSharding(hm.mesh, PartitionSpec(("dp", "fsdp"), "sep", None))
+        return jax.lax.with_sharding_constraint(x, sh)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = self.rope_cos, self.rope_sin
+        if position_ids is None:
+            # default positions 0..s-1: pre-slice so broadcasting is static
+            s = input_ids.shape[1]
+            cos, sin = cos[:s], sin[:s]
+        x = self._seq_shard(x)
+        if self.cfg.recompute == "full":
+            ckpt = jax.checkpoint(
+                lambda layer, h: layer(h, cos, sin, position_ids, attn_mask),
+                static_argnums=(0,))
+            for layer in self.layers:
+                x = self._seq_shard(ckpt(layer, x))
+        else:
+            for layer in self.layers:
+                x = self._seq_shard(layer(x, cos, sin, position_ids, attn_mask))
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = self.create_parameter(
+                [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
+                initializer=_normal(cfg.initializer_range),
+                sharding=("fsdp", "tp"))
+        else:
+            self.add_parameter("lm_head", None)
+
+    def logits(self, hidden):
+        w = (jnp.swapaxes(self.model.embed_tokens, 0, 1)
+             if self.cfg.tie_word_embeddings else self.lm_head)
+        return jnp.matmul(hidden, w.astype(hidden.dtype))
+
+    def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
+        hidden = self.model(input_ids, position_ids, attn_mask)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
+                               ignore_index=-100)
+        return loss, logits
+
+    # -- size accounting (MFU calculator input) -----------------------------
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape)) for _, p in self.named_parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Model fwd+bwd FLOPs per token (PaLM appendix-B convention:
+        6*N_matmul + attention term 12*L*H*Q*T). The embedding gather is not
+        a matmul, so the table is excluded from N unless tied (tied weights
+        ARE the lm_head matmul). Reference analogue:
+        python/paddle/utils/flops.py per-op tables."""
+        cfg = self.cfg
+        n = self.num_params()
+        if not cfg.tie_word_embeddings:
+            n -= cfg.vocab_size * cfg.hidden_size  # gather-only table
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn

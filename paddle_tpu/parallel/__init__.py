@@ -1,1 +1,6 @@
-"""placeholder — populated in later milestones."""
+"""paddle_tpu.parallel — GSPMD parallelism (reference analogue:
+python/paddle/distributed/ — fleet topology, auto_parallel api, collectives)."""
+
+from .mesh import HybridMesh, current_mesh, init_parallel_env, AXES_ORDER
+from .api import (shard_tensor, reshard, shard_layer, shard_optimizer_state,
+                  param_spec_tree, Shard, Replicate, Partial, Placement)

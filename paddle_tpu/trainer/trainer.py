@@ -1,0 +1,171 @@
+"""Training loop with built-in throughput/MFU accounting.
+
+Reference analogue: the hapi Model.fit loop (python/paddle/hapi/model.py:1756)
++ fleet's hybrid training step (SURVEY.md §3.3), redesigned around one jitted
+functional step: params/opt-state are donated pytrees, the loss fn comes from
+the Layer functional bridge, randomness enters as a key argument, and the LR
+is a scalar argument (scheduler stays host-side, never retraces).
+
+MFU = achieved_flops / peak_flops, with model FLOPs from
+``model.flops_per_token`` (PaLM convention) and per-chip peak from a small
+device table — the calculator the reference lacks (BASELINE.md requires it
+from day one).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import rng_tracker
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+
+# bf16 peak TFLOP/s per chip
+PEAK_FLOPS = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,   # v5e
+    "tpu v5e": 197e12,
+    "tpu v5": 459e12,        # v5p
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,   # v6e (trillium)
+    "cpu": 1e12,             # nominal, for smoke runs
+}
+
+
+def device_peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return PEAK_FLOPS.get(d.platform, 1e12)
+
+
+@dataclass
+class TrainMetrics:
+    step: int
+    loss: float
+    step_time_s: float
+    tokens_per_sec: float
+    tokens_per_sec_per_chip: float
+    mfu: float
+    lr: float
+
+    def as_dict(self):
+        return self.__dict__.copy()
+
+
+class Trainer:
+    """Single-program trainer: works 1-chip or over a mesh (pass sharded
+    params/opt-state; the jitted step inherits their shardings via GSPMD)."""
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_key: Optional[str] = None, donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self._named = dict(model.named_parameters())
+        self.params = model.raw_parameters()
+        self.opt_state = optimizer.init_state(self.params)
+        self._step_fn = None
+        self._donate = donate
+        self._step = 0
+        self._peak = device_peak_flops()
+
+    # -- step function -------------------------------------------------------
+
+    def _build_step(self):
+        model, opt = self.model, self.optimizer
+
+        def step_fn(params, opt_state, batch, lr, key):
+            def loss_fn(p):
+                with rng_tracker().scope(key):
+                    out = model.functional_call(p, **batch)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt_state = opt.apply_gradients(params, grads,
+                                                            opt_state, lr=lr)
+            return new_params, new_opt_state, loss
+
+        donate = (0, 1) if self._donate else ()
+        self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+    def train_step(self, batch: Dict[str, jax.Array]) -> float:
+        """One optimization step. ``batch`` maps forward kwarg names to
+        arrays (e.g. {"input_ids": ..., "labels": ...})."""
+        if self._step_fn is None:
+            self._build_step()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = jax.random.key(self._step)
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, batch, lr, key)
+        self._step += 1
+        if self._donate:
+            # donation invalidates the previous param buffers, which the
+            # Layer's Parameters still reference — rebind them to the new
+            # arrays so imperative model use never touches deleted buffers
+            self.sync_model()
+        sched = self.optimizer.lr_scheduler
+        if sched is not None:
+            sched.step()
+        return loss
+
+    # -- full loop with metrics ---------------------------------------------
+
+    def fit(self, data: Iterable[Dict[str, jax.Array]], steps: int,
+            log_every: int = 10, on_metrics: Optional[Callable] = None,
+            seq_len: Optional[int] = None):
+        it = iter(data)
+        history = []
+        t_last = time.perf_counter()
+        tokens_since = 0
+        loss = None
+        for _ in range(steps):
+            batch = next(it)
+            ids = batch.get("input_ids")
+            ntok = int(ids.shape[0] * ids.shape[1]) if ids is not None else 0
+            loss = self.train_step(batch)
+            tokens_since += ntok
+            if self._step % log_every == 0:
+                loss_v = float(loss)  # blocks; amortized over log_every
+                now = time.perf_counter()
+                dt = now - t_last
+                tps = tokens_since / dt if dt > 0 else 0.0
+                n_dev = jax.device_count()
+                sl = seq_len or (ids.shape[1] if ids is not None else 1)
+                fpt = (self.model.flops_per_token(sl)
+                       if hasattr(self.model, "flops_per_token") else 0.0)
+                mfu = (tps / n_dev) * fpt / self._peak if fpt else 0.0
+                m = TrainMetrics(step=self._step, loss=loss_v,
+                                 step_time_s=dt / log_every,
+                                 tokens_per_sec=tps,
+                                 tokens_per_sec_per_chip=tps / n_dev,
+                                 mfu=mfu, lr=self.optimizer.get_lr())
+                history.append(m)
+                if on_metrics:
+                    on_metrics(m)
+                t_last = time.perf_counter()
+                tokens_since = 0
+        # write trained params back into the Layer (imperative view);
+        # train_step already does this when donation is on
+        self.sync_model()
+        return history
+
+    def sync_model(self):
+        for k, v in self.params.items():
+            self._named[k].value = v
+
+    def state_dict(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "step": self._step}
+
+    def set_state_dict(self, sd):
+        self.params = sd["params"]
+        self.opt_state = sd["opt_state"]
+        self._step = sd["step"]

@@ -1,0 +1,131 @@
+"""Llama model + trainer end-to-end tests (the v0 milestone slice:
+SURVEY.md §7 stage 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.optimizer import AdamW, ClipGradByGlobalNorm
+from paddle_tpu.optimizer.lr import LinearWarmup
+from paddle_tpu.trainer import Trainer
+
+
+def tiny_model():
+    pt.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def fake_batch(cfg, b=2, s=32, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, cfg.vocab_size, (b, s + 1))
+    return {"input_ids": jnp.asarray(ids[:, :-1]),
+            "labels": jnp.asarray(ids[:, 1:])}
+
+
+def test_forward_shapes():
+    m = tiny_model()
+    cfg = m.cfg
+    batch = fake_batch(cfg)
+    logits = m(batch["input_ids"])
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    loss, _ = m(batch["input_ids"], labels=batch["labels"])
+    assert loss.shape == ()
+    assert np.isfinite(float(loss))
+    # random init loss should be near ln(vocab)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    m = tiny_model().eval()
+    batch = fake_batch(m.cfg)
+    ids = batch["input_ids"]
+    logits1 = m(ids)
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 1) % m.cfg.vocab_size)
+    logits2 = m(ids2)
+    np.testing.assert_allclose(np.asarray(logits1[:, :-1]),
+                               np.asarray(logits2[:, :-1]), atol=1e-5)
+    assert not np.allclose(np.asarray(logits1[:, -1]), np.asarray(logits2[:, -1]))
+
+
+def test_recompute_matches_no_recompute():
+    pt.seed(0)
+    m1 = LlamaForCausalLM(LlamaConfig.tiny(recompute="none"))
+    pt.seed(0)
+    m2 = LlamaForCausalLM(LlamaConfig.tiny(recompute="full"))
+    batch = fake_batch(m1.cfg)
+    p1, p2 = m1.raw_parameters(), m2.raw_parameters()
+
+    def loss1(p):
+        return m1.functional_call(p, batch["input_ids"], labels=batch["labels"])[0]
+
+    def loss2(p):
+        return m2.functional_call(p, batch["input_ids"], labels=batch["labels"])[0]
+
+    l1, g1 = jax.value_and_grad(loss1)(p1)
+    l2, g2 = jax.value_and_grad(loss2)(p2)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_train_loop_loss_decreases():
+    m = tiny_model()
+    sched = LinearWarmup(1e-3, warmup_steps=5, start_lr=0.0, end_lr=1e-3)
+    opt = AdamW(learning_rate=sched, parameters=m, weight_decay=0.01,
+                grad_clip=ClipGradByGlobalNorm(1.0))
+    tr = Trainer(m, opt)
+    batch = fake_batch(m.cfg)  # overfit one batch
+
+    losses = []
+    for i in range(30):
+        losses.append(float(tr.train_step(batch)))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_trainer_fit_metrics():
+    m = tiny_model()
+    opt = AdamW(learning_rate=1e-3, parameters=m)
+    tr = Trainer(m, opt)
+    batch = fake_batch(m.cfg)
+    hist = tr.fit(iter(lambda: batch, None), steps=10, log_every=5)
+    assert len(hist) == 2
+    assert hist[-1].tokens_per_sec > 0
+    assert hist[-1].mfu >= 0
+    # trained params synced back into the Layer
+    loss_after = float(m(batch["input_ids"], labels=batch["labels"])[0])
+    np.testing.assert_allclose(loss_after, hist[-1].loss, rtol=0.5)
+
+
+def test_gqa_heads():
+    cfg = LlamaConfig.tiny()
+    assert cfg.num_key_value_heads < cfg.num_attention_heads
+    m = LlamaForCausalLM(cfg)
+    qkv = dict(m.named_parameters())["model.layers.0.self_attn.qkv_proj"]
+    expected = (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
+    assert qkv.shape == (cfg.hidden_size, expected)
+
+
+def test_flops_accounting():
+    m = tiny_model()
+    n = m.num_params()
+    assert n > 0
+    # embedding gather excluded from the 6N matmul count (untied)
+    n_matmul = n - m.cfg.vocab_size * m.cfg.hidden_size
+    f = m.flops_per_token(128)
+    assert f > 6 * n_matmul
+    assert f == 6 * n_matmul + 12 * m.cfg.num_hidden_layers * m.cfg.hidden_size * 128
+
+
+def test_tied_embeddings():
+    pt.seed(0)
+    cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+    m = LlamaForCausalLM(cfg)
+    assert "lm_head" not in dict(m.named_parameters())
+    logits = m(fake_batch(cfg)["input_ids"])
+    assert logits.shape[-1] == cfg.vocab_size
