@@ -11,3 +11,10 @@ to the one decision XLA doesn't make for us: hand-written kernel vs compiler.
 
 from . import attention, norm, rope
 from .registry import dispatch, register_kernel, backend_kind
+
+# Pallas TPU kernels register themselves for backend "tpu" on import; the
+# XLA compositions above remain the "any" fallback and the test oracle.
+try:
+    from .pallas import flash_attention as _pallas_flash_attention  # noqa: F401
+except ImportError:  # pragma: no cover — jaxlib without pallas
+    pass

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer: gate → count_by_gate → MoEScatter(global_scatter all-to-all) →
+per-expert FFN loop → MoEGather), gates under moe/gate/{naive,gshard,switch}
+_gate.py, kernels paddle/fluid/operators/collective/global_scatter_op.cu.
+
+TPU-native redesign (SURVEY.md A.2 translation): instead of index-select +
+ragged all-to-all + a python loop over experts, tokens are dispatched into a
+dense [experts, capacity, d] layout with one-hot combine/dispatch tensors
+(GShard formulation) and the experts run as ONE batched einsum on the MXU.
+Expert weights are sharded over the ("dp","fsdp") submesh (expert parallel
+reuses the data-parallel devices, as the reference reuses comm groups); the
+all-to-all appears in the compiled program from GSPMD's resharding between
+token-sharded and expert-sharded layouts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from .mesh import current_mesh
+
+
+def top_k_gating(gate_logits, k: int, capacity: int,
+                 jitter_eps: float = 0.0, key=None):
+    """GShard top-k gating with capacity. Returns (dispatch [t,e,c] bool,
+    combine [t,e,c] float, aux_loss scalar).
+
+    Reference: gshard_gate.py / switch_gate.py (k=1) + limit_by_capacity
+    (moe/utils.py:74)."""
+    t, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # [t,e]
+
+    # aux load-balancing loss (GShard eq.4): e * sum_e(mean_t(gates) * mean_t(frac))
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    aux_loss = jnp.sum(me * ce) * e
+
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    remaining = probs
+    # running per-expert fill count across the k choices
+    fill = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [t]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)          # [t,e]
+        # position of each token within its chosen expert's capacity
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1 + fill) * onehot  # [t,e]
+        pos = jnp.sum(pos_in_expert, axis=-1)                     # [t]
+        fits = pos < capacity
+        gate_val = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        pos_oh = jax.nn.one_hot(jnp.where(fits, pos, capacity), capacity,
+                                dtype=jnp.float32)                # [t,c]
+        contrib = (onehot.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :])
+        combine = combine + gate_val[:, None, None] * contrib * fits[:, None, None]
+        dispatch = dispatch | (contrib > 0) & fits[:, None, None]
+        fill = fill + jnp.sum(onehot * fits[:, None].astype(jnp.int32), axis=0)
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+    if k > 1:
+        # renormalize combine weights over the (non-dropped) selected experts;
+        # k=1 (switch) keeps the raw gate prob as the multiplier so the router
+        # receives gradient through the task loss (Switch-Transformer semantics)
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(Layer):
+    """Experts as batched weights [E, ...] — one einsum, not a python loop."""
+
+    def __init__(self, num_experts: int, hidden_size: int, ffn_size: int,
+                 dtype=None):
+        super().__init__()
+        std = 0.02
+        self.w_gate_up = self.create_parameter(
+            [num_experts, hidden_size, 2 * ffn_size], dtype=dtype,
+            initializer=I.Normal(0.0, std), sharding=(("dp", "fsdp"), None, "tp"))
+        self.w_down = self.create_parameter(
+            [num_experts, ffn_size, hidden_size], dtype=dtype,
+            initializer=I.Normal(0.0, std), sharding=(("dp", "fsdp"), "tp", None))
+
+    def forward(self, x):
+        # x: [e, c, d] -> [e, c, d]
+        gu = jnp.einsum("ecd,edf->ecf", x, self.w_gate_up.astype(x.dtype))
+        g, u = jnp.split(gu, 2, axis=-1)
+        h = F.silu(g) * u
+        return jnp.einsum("ecf,efd->ecd", h, self.w_down.astype(x.dtype))
+
+
+class MoELayer(Layer):
+    """Top-k routed MoE block (reference: MoELayer, moe_layer.py:263).
+
+    forward(x: [b, s, d]) -> (out [b, s, d], aux_loss scalar)
+    """
+
+    def __init__(self, hidden_size: int, ffn_size: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25, dtype=None,
+                 gate: str = "gshard"):
+        super().__init__()
+        if top_k > num_experts:
+            raise ValueError(f"top_k={top_k} > num_experts={num_experts}")
+        self.num_experts = num_experts
+        self.top_k = 1 if gate == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.gate_weight = self.create_parameter(
+            [hidden_size, num_experts], dtype="float32",
+            initializer=I.Normal(0.0, 0.02))
+        self.experts = MoEMLP(num_experts, hidden_size, ffn_size, dtype=dtype)
+
+    def forward(self, x):
+        b, s, d = x.shape
+        t = b * s
+        e = self.num_experts
+        capacity = int(math.ceil(t * self.top_k / e * self.capacity_factor))
+        flat = x.reshape(t, d)
+        logits = jnp.matmul(flat.astype(jnp.float32), self.gate_weight)
+        dispatch, combine, aux = top_k_gating(logits, self.top_k, capacity)
+        # dispatch tokens into the dense expert layout (einsum → MXU; the
+        # reference's global_scatter all-to-all comes from GSPMD resharding)
+        xe = jnp.einsum("td,tec->ecd", flat, dispatch.astype(flat.dtype))
+        ye = self.experts(xe)
+        out = jnp.einsum("ecd,tec->td", ye, combine.astype(ye.dtype))
+        return out.reshape(b, s, d), aux

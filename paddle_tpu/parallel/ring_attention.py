@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over sequence-sharded K/V.
+
+Long-context requirement (SURVEY.md §5): the reference snapshot has no ring
+attention (verified absent; FA2 + Megatron SP only) but the TPU build treats
+"scale sequence length" as first-class. Design: shard_map over the "sep"
+axis; each device holds q/k/v shards [b, s/n, h, d]; K/V shards rotate
+around the ring with jax.lax.ppermute (ICI neighbor exchange) while each
+device folds every block into its local online-softmax state (running max /
+denominator — the flash-attention recurrence at ring scale). lax.scan keeps
+the loop compiled; ppermute inside scan is differentiable, so the backward
+pass is derived by JAX (it replays the ring in reverse via ppermute
+transpose).
+
+Causal masking is by *global* position: block j of K/V vs block i of Q is
+fully visible when j < i, fully masked when j > i, diagonal when i == j.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One q-block vs one kv-block, returning (unnormalized acc, m, l).
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask broadcastable [sq, sk]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [b,h,sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                                   # [b,h,sq]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)      # [b,sq,h,d]
+    return acc, m, l
+
+
+def _merge(state, acc, m, l):
+    """Fold a new block's (acc, m, l) into the running online-softmax state."""
+    acc0, m0, l0 = state
+    m_new = jnp.maximum(m0, m)
+    a0 = jnp.exp(m0 - m_new)
+    a1 = jnp.exp(m - m_new)
+    acc_new = acc0 * a0.transpose(0, 2, 1)[..., None] + acc * a1.transpose(0, 2, 1)[..., None]
+    l_new = l0 * a0 + l * a1
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
+                   scale: Optional[float] = None, mesh=None):
+    """Exact attention with K/V rotating over the ``axis`` ring.
+
+    q/k/v: [b, s, h, d] GLOBAL arrays sharded (or shardable) along s over
+    ``axis``. Returns [b, s, h, d] with the same sharding.
+    """
+    hm = current_mesh() if mesh is None else mesh
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if hm is None or hm.axis_size(axis) <= 1:
+        from ..ops.attention import _sdpa_xla
+        return _sdpa_xla(q, k, v, causal=causal, scale=scale)
+
+    n = hm.axis_size(axis)
+    mesh_ = hm.mesh
+
+    def local_fn(q_l, k_l, v_l):
+        # q_l/k_l/v_l: [b, s/n, h, d]
+        my = jax.lax.axis_index(axis)
+        b, sl, h, _ = q_l.shape
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+        diag_mask = cols <= rows                         # intra-block causal
+        perm = [(i, (i + 1) % n) for i in range(n)]      # rotate kv rightward
+
+        # initial carry must be marked device-varying over the ring axis so
+        # the scan carry type matches after the ppermute steps
+        vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        acc0 = vary(jnp.zeros((b, sl, h, d), jnp.float32))
+        m0 = vary(jnp.full((b, h, sl), NEG_INF, jnp.float32))
+        l0 = vary(jnp.zeros((b, h, sl), jnp.float32))
+
+        def step(carry, t):
+            acc, m, l, k_cur, v_cur = carry
+            # k_cur originated on device (my - t) mod n
+            src = (my - t) % n
+            if causal:
+                # block fully visible if src < my; masked if src > my
+                visible = src < my
+                is_diag = src == my
+                base = jnp.where(is_diag, diag_mask,
+                                 jnp.broadcast_to(visible, diag_mask.shape))
+                a, bm, bl = _block_attn(q_l, k_cur, v_cur, scale, base)
+                # suppress fully-masked blocks (src > my): m=-inf handles it
+            else:
+                a, bm, bl = _block_attn(q_l, k_cur, v_cur, scale, None)
+            acc, m, l = _merge((acc, m, l), a, bm, bl)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (acc, m, l, k_nxt, v_nxt), None
+
+        (acc, m, l, _, _), _ = jax.lax.scan(
+            step, (acc0, m0, l0, k_l, v_l), jnp.arange(n))
+        l_t = l.transpose(0, 2, 1)[..., None]            # [b,sl,h,1]
+        safe = jnp.where(l_t == 0.0, 1.0, l_t)
+        return (acc / safe).astype(q_l.dtype)
+
+    # manual only over the ring axis; dp/fsdp batch shardings stay auto
+    fn = shard_map(local_fn, mesh=mesh_, axis_names=frozenset({axis}),
+                   in_specs=(P(None, axis, None, None),) * 3,
+                   out_specs=P(None, axis, None, None))
+    return fn(q, k, v)

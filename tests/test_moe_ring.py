@@ -1,0 +1,132 @@
+"""MoE layer + ring attention tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.ops.attention import _sdpa_xla
+from paddle_tpu.parallel import HybridMesh, shard_layer, shard_tensor
+from paddle_tpu.parallel.moe import MoELayer, top_k_gating
+from paddle_tpu.parallel.ring_attention import ring_attention
+
+
+# -- gating -----------------------------------------------------------------
+
+def test_top_k_gating_dispatch_consistency():
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(16, 4).astype(np.float32))
+    dispatch, combine, aux = top_k_gating(logits, k=2, capacity=8)
+    d = np.asarray(dispatch)
+    c = np.asarray(combine)
+    # each token dispatched to <= 2 slots; combine mass on dispatched slots only
+    assert d.sum(axis=(1, 2)).max() <= 2
+    assert ((c > 0) <= d).all()
+    # no capacity slot is used twice per expert
+    assert d.sum(axis=0).max() <= 1
+    # combine weights per token sum to ~1 (renormalized) when not dropped
+    sums = c.sum(axis=(1, 2))
+    assert np.all((sums < 1 + 1e-5))
+    assert float(aux) > 0
+
+
+def test_capacity_drops_tokens():
+    # all tokens prefer expert 0; tiny capacity forces drops
+    logits = jnp.asarray(np.full((16, 4), [10.0, 0, 0, 0], np.float32))
+    dispatch, combine, _ = top_k_gating(logits, k=1, capacity=4)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 4  # only capacity tokens kept on expert 0
+
+
+def test_moe_layer_forward_and_grad():
+    pt.seed(0)
+    moe = MoELayer(hidden_size=16, ffn_size=32, num_experts=4, top_k=2,
+                   capacity_factor=2.0)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16).astype(np.float32))
+    out, aux = moe(x)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+    params = moe.raw_parameters()
+
+    def loss(p):
+        o, a = moe.functional_call(p, x)
+        return jnp.sum(o ** 2) + 0.01 * a
+
+    g = jax.grad(loss)(params)
+    for k, v in g.items():
+        assert np.isfinite(np.asarray(v)).all(), k
+    # expert weights get gradient
+    assert float(jnp.abs(g["experts.w_gate_up"]).sum()) > 0
+    assert float(jnp.abs(g["gate_weight"]).sum()) > 0
+
+
+def test_moe_expert_parallel_matches_single_device():
+    pt.seed(0)
+    moe = MoELayer(hidden_size=16, ffn_size=32, num_experts=8, top_k=2,
+                   capacity_factor=2.0)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 8, 16).astype(np.float32))
+    out_ref, aux_ref = moe(x)
+
+    hm = HybridMesh.build(dp=2, fsdp=4)  # experts shard over dp x fsdp = 8
+    with hm:
+        shard_layer(moe)
+        w = dict(moe.named_parameters())["experts.w_gate_up"].value
+        assert w.sharding.spec[0] in (("dp", "fsdp"), "dp", "fsdp"), w.sharding
+        xs = shard_tensor(x, spec=P("dp", None, None))
+        out, aux = jax.jit(lambda x: moe(x))(xs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+# -- ring attention ---------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    rs = np.random.RandomState(0)
+    b, s, h, d = 2, 64, 2, 16
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    ref = _sdpa_xla(q, k, v, causal=causal)
+
+    hm = HybridMesh.build(sep=8)
+    with hm:
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=causal))(
+            q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    rs = np.random.RandomState(0)
+    b, s, h, d = 1, 32, 2, 8
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_sdpa_xla(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    with hm:
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, causal=True) ** 2)
+        g = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, r, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_ring_attention_no_mesh_fallback():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
+    out = ring_attention(q, q, q, causal=True)
+    ref = _sdpa_xla(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
