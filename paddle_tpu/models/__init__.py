@@ -1,4 +1,5 @@
 """paddle_tpu.models — model zoo for the BASELINE.json capability configs."""
 
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
-                    LlamaDecoderLayer, LlamaAttention, LlamaMLP)
+                    LlamaDecoderLayer, LlamaAttention, LlamaMLP,
+                    LlamaForCausalLMPipe)

@@ -256,3 +256,80 @@ class LlamaForCausalLM(nn.Layer):
             n -= cfg.vocab_size * cfg.hidden_size  # gather-only table
         attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
         return 6 * n + attn
+
+
+class LlamaForCausalLMPipe(nn.Layer):
+    """Pipeline-parallel Llama.
+
+    Reference analogue: PaddleNLP's ``LlamaForCausalLMPipe`` built on the
+    fleet PipelineLayer/LayerDesc machinery (reference:
+    fleet/meta_parallel/parallel_layers/pp_layers.py:237 + 1F1B runtime
+    pipeline_parallel.py:440). TPU redesign: the decoder body is a
+    ``PipelineStack`` — stage-stacked weights sharded over the "pp" mesh
+    axis, microbatches advanced by XLA CollectivePermute (see
+    parallel/pipeline.py); embedding / final norm / lm_head run
+    GSPMD-replicated over "pp", which expresses the reference's
+    SharedLayerDesc embedding tie with zero extra machinery.
+    """
+
+    def __init__(self, cfg: LlamaConfig, num_stages: int = 1,
+                 num_microbatches: int = 1):
+        super().__init__()
+        from ..parallel.pipeline import PipelineStack
+        self.cfg = cfg
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
+        self.decoder = PipelineStack(lambda: LlamaDecoderLayer(cfg),
+                                     num_layers=cfg.num_hidden_layers,
+                                     num_stages=num_stages,
+                                     num_microbatches=num_microbatches,
+                                     remat=(cfg.recompute == "full"))
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps, dtype="float32")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = self.create_parameter(
+                [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
+                initializer=_normal(cfg.initializer_range),
+                sharding=("fsdp", "tp"))
+        else:
+            self.add_parameter("lm_head", None)
+        cos, sin = rope_ops.rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = self.rope_cos[:s], self.rope_sin[:s]
+        x = self.decoder(x, cos, sin)
+        hidden = self.norm(x)
+        w = (jnp.swapaxes(self.embed_tokens, 0, 1)
+             if cfg.tie_word_embeddings else self.lm_head)
+        logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
+                               ignore_index=-100)
+        return loss, logits
+
+    def load_from_unpipelined(self, model: "LlamaForCausalLM") -> None:
+        """Copy weights from a LlamaForCausalLM (stacking per-layer params) —
+        the Pipe-partition converter (reference analogue:
+        fleet/utils/pp_parallel_adaptor.py)."""
+        cfg = self.cfg
+        own = dict(self.named_parameters())
+        own["embed_tokens"].value = model.model.embed_tokens
+        self.norm.set_state_dict(model.model.norm.state_dict())
+        if not cfg.tie_word_embeddings:
+            own["lm_head"].value = model.lm_head
+        src = dict(model.named_parameters())
+        for leaf in self.decoder._leaf_names:
+            stacked = jnp.stack(
+                [src[f"model.layers.{i}.{leaf}"].value
+                 for i in range(cfg.num_hidden_layers)])
+            pname = "decoder.stack__" + leaf.replace(".", "__")
+            own[pname].value = stacked

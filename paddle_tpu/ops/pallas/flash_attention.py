@@ -37,6 +37,14 @@ except ImportError:  # pragma: no cover
 
 from ..registry import register_kernel
 
+
+def _tpu_params(*semantics):
+    """Megacore: mark independent grid dims parallel; only the innermost
+    (k/q accumulation) dim is sequential ("arbitrary")."""
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics))
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30  # large-negative instead of -inf: avoids inf-inf=nan in exp
@@ -129,6 +137,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((b, h, sq), jnp.float32)],
         scratch_shapes=scratch,
+        compiler_params=_tpu_params("parallel", "parallel", "parallel",
+                                    "arbitrary"),
         interpret=interpret,
     )(q, k, v)
     return out, lse
@@ -254,6 +264,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_tpu_params("parallel", "parallel", "parallel",
+                                    "arbitrary"),
         interpret=interpret,
     )(q, k, v, dout, lse, delta)[0]
 
@@ -274,6 +286,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
                    jax.ShapeDtypeStruct((b, sk, h, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_tpu_params("parallel", "parallel", "parallel",
+                                    "arbitrary"),
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 

@@ -37,7 +37,15 @@ def top_k_gating(gate_logits, k: int, capacity: int,
     Reference: gshard_gate.py / switch_gate.py (k=1) + limit_by_capacity
     (moe/utils.py:74)."""
     t, e = gate_logits.shape
-    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # [t,e]
+    gate_logits = gate_logits.astype(jnp.float32)
+    if jitter_eps > 0.0 and key is not None:
+        # GShard routing jitter (reference: gshard_gate.py noise on logits):
+        # multiplicative uniform noise in [1-eps, 1+eps] for load-balance
+        # exploration; disabled (deterministic) when no key is passed.
+        noise = jax.random.uniform(key, gate_logits.shape, jnp.float32,
+                                   1.0 - jitter_eps, 1.0 + jitter_eps)
+        gate_logits = gate_logits * noise
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # [t,e]
 
     # aux load-balancing loss (GShard eq.4): e * sum_e(mean_t(gates) * mean_t(frac))
     top1 = jnp.argmax(probs, axis=-1)
