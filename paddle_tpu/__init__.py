@@ -15,6 +15,7 @@ from .core.rng import seed
 
 from . import amp
 from . import autograd
+from . import distributed
 from . import io
 from . import nn
 from . import optimizer
