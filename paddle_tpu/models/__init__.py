@@ -3,3 +3,8 @@
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaDecoderLayer, LlamaAttention, LlamaMLP,
                     LlamaForCausalLMPipe)
+from .moe_lm import MoEConfig, MoEForCausalLM, MoEDecoderLayer
+from .ernie import ErnieConfig, ErnieForCausalLM
+from .dit import DiTConfig, DiT, DiTBlock, timestep_embedding
+from .vision import (ResNet, resnet18, resnet50, OCRRecConfig, OCRRecModel,
+                     OCRDetModel, DBHead)

@@ -1,0 +1,166 @@
+"""Model-family tests (BASELINE.json capability configs): forward shapes,
+loss finiteness, one gradient step, and mesh placement."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.models import (MoEConfig, MoEForCausalLM, ErnieConfig,
+                               ErnieForCausalLM, DiTConfig, DiT,
+                               resnet18, OCRRecConfig, OCRRecModel,
+                               OCRDetModel)
+from paddle_tpu.parallel import HybridMesh, shard_layer, shard_tensor
+
+
+def _lm_batch(vocab, b=2, s=17, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, vocab, (b, s))
+    return jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+
+def test_moe_lm_forward_and_grad():
+    pt.seed(0)
+    cfg = MoEConfig.tiny()
+    model = MoEForCausalLM(cfg)
+    inp, lab = _lm_batch(cfg.vocab_size)
+    loss, logits = model(inp, lab)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(float(loss))
+
+    def f(p):
+        l, _ = model.functional_call(p, inp, lab)
+        return l
+
+    g = jax.grad(f)(model.raw_parameters())
+    # routed experts and the gate both receive gradient
+    gw = g["layers.1.moe.gate_weight"]
+    ge = g["layers.1.moe.experts.w_gate_up"]
+    assert float(jnp.abs(gw).sum()) > 0
+    assert float(jnp.abs(ge).sum()) > 0
+    # activated-param accounting is less than total
+    assert model.num_activated_params() < model.num_params()
+
+
+def test_moe_presets():
+    c1 = MoEConfig.deepseek_moe_16b()
+    assert c1.num_experts == 64 and c1.num_shared_experts == 2
+    c2 = MoEConfig.qwen2_moe_a14b()
+    assert c2.shared_expert_gate
+    c3 = ErnieConfig.ernie45_moe()
+    assert isinstance(c3, MoEConfig)
+
+
+def test_ernie_forward_and_step():
+    pt.seed(0)
+    cfg = ErnieConfig.tiny()
+    model = ErnieForCausalLM(cfg)
+    inp, lab = _lm_batch(cfg.vocab_size)
+    loss, logits = model(inp, lab)
+    assert np.isfinite(float(loss))
+    # tied embeddings: logits = hidden @ embed^T, no separate head param
+    assert dict(model.named_parameters()).get("lm_head") is None
+
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.trainer import Trainer
+    tr = Trainer(model, AdamW(learning_rate=3e-3, parameters=model),
+                 donate=False)
+    batch = {"input_ids": inp, "labels": lab}
+    l0 = float(tr.train_step(batch))
+    for _ in range(4):
+        l1 = float(tr.train_step(batch))
+    assert l1 < l0
+
+
+def test_dit_forward_and_loss():
+    pt.seed(0)
+    cfg = DiTConfig.tiny()
+    model = DiT(cfg)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 4, 8, 8).astype(np.float32))
+    t = jnp.asarray([1, 500])
+    y = jnp.asarray([0, 3])
+    out = model(x, t, y)
+    assert out.shape == (2, 8, 8, 8)  # out_channels = 2*in (learn_sigma)
+    noise = jnp.asarray(rs.randn(2, 4, 8, 8).astype(np.float32))
+    loss = model.loss(x, t, y, noise)
+    assert np.isfinite(float(loss))
+    # adaLN-zero: with zero-init modulation the final proj is zero → output 0
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+    # at init only final_proj can receive gradient (everything downstream of
+    # the zero projection is cut off — the -Zero design); training a few
+    # steps opens the path and the loss drops
+    def loss_fn(p):
+        pred = model.functional_call(p, x, t, y)
+        return jnp.mean((pred[:, :4] - noise) ** 2)
+
+    g = jax.grad(loss_fn)(model.raw_parameters())
+    assert float(jnp.abs(g["final_proj"]).sum()) > 0
+    params = model.raw_parameters()
+    l0 = float(loss_fn(params))
+    for _ in range(8):
+        grads = jax.grad(loss_fn)(params)
+        params = {k: v - 0.05 * grads[k] for k, v in params.items()}
+    assert float(loss_fn(params)) < l0
+    # after steps, gradient reaches the block modulation weights
+    g2 = jax.grad(loss_fn)(params)
+    assert float(jnp.abs(g2["blocks.0.ada_w"]).sum()) > 0
+
+
+def test_resnet_classification():
+    pt.seed(0)
+    model = resnet18(num_classes=10)
+    x = jnp.ones((2, 3, 32, 32))
+    out = model(x)
+    assert out.shape == (2, 10)
+    feats = model.features(x)
+    assert len(feats) == 4
+    assert feats[0].shape[1] == 64 and feats[3].shape[1] == 512
+
+
+def test_ocr_rec_ctc():
+    pt.seed(0)
+    cfg = OCRRecConfig.tiny()
+    model = OCRRecModel(cfg)
+    rs = np.random.RandomState(0)
+    img = jnp.asarray(rs.randn(2, 3, 32, 64).astype(np.float32))
+    logits = model(img)
+    assert logits.shape == (2, 16, cfg.num_classes)  # w/4 time steps
+    labels = jnp.asarray(rs.randint(1, cfg.num_classes, (2, 8)))
+    lengths = jnp.asarray([8, 5])
+    loss = model.ctc_loss(logits, labels, lengths)
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: model.functional_call(p, img).sum())(
+        model.raw_parameters())
+    assert float(jnp.abs(g["head.weight"]).sum()) > 0
+
+
+def test_ocr_det_db():
+    pt.seed(0)
+    model = OCRDetModel(backbone_depth=18)
+    img = jnp.ones((1, 3, 64, 64))
+    p, t, binary = model(img)
+    assert p.shape == (1, 1, 64, 64)
+    assert float(p.min()) >= 0 and float(p.max()) <= 1
+
+
+def test_moe_lm_on_mesh():
+    """MoE model trains sharded: experts over (dp,fsdp), dense over tp."""
+    pt.seed(0)
+    cfg = MoEConfig.tiny()
+    model = MoEForCausalLM(cfg)
+    hm = HybridMesh.build(dp=2, fsdp=2, tp=2, devices=jax.devices()[:8])
+    with hm:
+        shard_layer(model)
+        w = dict(model.named_parameters())["layers.1.moe.experts.w_gate_up"]
+        assert "dp" in str(w.value.sharding.spec)
+        inp, lab = _lm_batch(cfg.vocab_size, b=4)
+        from paddle_tpu.optimizer import AdamW
+        from paddle_tpu.trainer import Trainer
+        tr = Trainer(model, AdamW(learning_rate=1e-3, parameters=model),
+                     donate=False)
+        batch = {"input_ids": shard_tensor(inp, spec=P(("dp", "fsdp"), None)),
+                 "labels": shard_tensor(lab, spec=P(("dp", "fsdp"), None))}
+        assert np.isfinite(float(tr.train_step(batch)))
