@@ -29,6 +29,11 @@ from .tensor import Tensor
 from .nn.layer import set_default_dtype, get_default_dtype
 
 from .framework import save, load, set_device, get_device, is_compiled_with_cuda, \
-    is_compiled_with_tpu, device_count, no_grad, jit
+    is_compiled_with_tpu, device_count, no_grad
+from . import jit
+from . import static
+from . import metric
+from . import hapi
+from .hapi import Model
 
 __version__ = "0.1.0"

@@ -81,41 +81,4 @@ def load(path: str, return_numpy: bool = False) -> Any:
         lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
 
 
-# -- jit (reference: python/paddle/jit/api.py:171 to_static) -----------------
-
-class _JitNamespace:
-    @staticmethod
-    def to_static(function=None, input_spec=None, full_graph: bool = True,
-                  backend=None, static_argnums=None):
-        """Compile a function (or Layer.forward bound method) with jax.jit."""
-        def deco(fn):
-            if hasattr(fn, "functional"):  # a Layer: jit its functional view
-                layer = fn
-                pure = layer.functional()
-                jitted = jax.jit(pure)
-                def call(*args, **kwargs):
-                    return jitted(layer.raw_state(), *args, **kwargs)
-                call.__wrapped_layer__ = layer
-                return call
-            return jax.jit(fn, static_argnums=static_argnums)
-        if function is None:
-            return deco
-        return deco(function)
-
-    @staticmethod
-    def save(layer, path: str, input_spec=None):
-        """Export: save state dict + (optionally) AOT-lowered HLO text.
-        Reference analogue: paddle.jit.save (serialized inference program)."""
-        save(getattr(layer, "state_dict", lambda: layer)(), path + ".pdparams")
-        if input_spec is not None and hasattr(layer, "functional"):
-            pure = layer.functional()
-            lowered = jax.jit(pure).lower(layer.raw_state(), *input_spec)
-            with open(path + ".hlo.txt", "w") as f:
-                f.write(lowered.as_text())
-
-    @staticmethod
-    def load(path: str):
-        return load(path + ".pdparams")
-
-
-jit = _JitNamespace()
+# jit lives in paddle_tpu/jit/ (to_static + StableHLO export save/load)

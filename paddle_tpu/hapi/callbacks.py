@@ -1,0 +1,186 @@
+"""Callbacks for hapi.Model.fit (reference: python/paddle/hapi/callbacks.py:
+Callback protocol, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+LRScheduler)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "History"]
+
+
+class Callback:
+    """Hook points mirror the reference's Callback."""
+
+    def __init__(self):
+        self.model = None
+        self.params: Dict = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params: Dict):
+        self.params = params
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback], model=None, params=None):
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            if model is not None:
+                cb.set_model(model)
+            if params is not None:
+                cb.set_params(params)
+
+    def _call(self, name, *args, **kwargs):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: self._call(name, *a, **k)
+        raise AttributeError(name)
+
+
+class History(Callback):
+    """Records logs per epoch (implicit callback, like keras/hapi)."""
+
+    def on_train_begin(self, logs=None):
+        self.history: Dict[str, List] = {}
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ProgBarLogger(Callback):
+    """Prints step/epoch progress with loss, metrics, and ips
+    (reference: ProgBarLogger; ips reporting from profiler/timer.py)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.perf_counter()
+        self._samples = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._samples += logs.get("batch_size", 0)
+        if self.verbose and step % self.log_freq == 0:
+            dt = time.perf_counter() - self._t0
+            ips = self._samples / dt if dt > 0 else 0.0
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in logs.items()
+                               if isinstance(v, (int, float)) and k != "batch_size")
+            print(f"Epoch {self._epoch} step {step}: {items} - {ips:.1f} samples/s",
+                  file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"Epoch {epoch} done: {items}", file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save of model+optimizer (reference: ModelCheckpoint)."""
+
+    def __init__(self, save_dir: str, save_freq: int = 1):
+        super().__init__()
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference: EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0,
+                 baseline: Optional[float] = None, save_best_model: bool = False):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.save_best_model = save_best_model
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = self.baseline if self.baseline is not None else (
+            float("inf") if self.mode == "min" else -float("inf"))
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            import warnings
+            warnings.warn(
+                f"EarlyStopping monitor '{self.monitor}' not found in logs "
+                f"(available: {sorted((logs or {}).keys())}); doing nothing",
+                stacklevel=2)
+            return
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                if self.model is not None:
+                    self.model.stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    """Steps the optimizer's LR scheduler per epoch or per batch
+    (reference: callbacks.LRScheduler)."""
+
+    def __init__(self, by_step: bool = False):
+        super().__init__()
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "lr_scheduler", None) if opt else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()

@@ -1,0 +1,205 @@
+"""paddle_tpu.jit — dygraph-to-static capture, AOT export, save/load.
+
+Reference: python/paddle/jit/api.py (to_static:171, save/load via
+translated_layer.py). The reference captures Python into a static Program by
+AST transform or SOT bytecode tracing; here every op is already functionally
+traceable, so ``to_static`` is JAX tracing + XLA compilation, and
+``jit.save`` is true AOT deployment: the traced computation is serialized as
+portable StableHLO (``jax.export``) together with the parameters, and
+``jit.load`` returns a ``TranslatedLayer`` that executes WITHOUT the original
+Python model code — the analogue of loading a saved inference program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jexport
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "save", "load",
+           "TranslatedLayer", "enable_to_static", "ignore_module"]
+
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(flag: bool) -> None:
+    """Globally toggle to_static (reference: paddle.jit.enable_to_static)."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
+
+
+def ignore_module(modules) -> None:
+    """No-op shim: JAX tracing needs no bytecode-level skip list."""
+
+
+class InputSpec:
+    """Shape/dtype spec for export tracing (reference:
+    python/paddle/static/input.py InputSpec). ``None`` dims become symbolic
+    dimensions in the exported artifact (dynamic batch)."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_struct(self, scope=None):
+        from paddle_tpu.core.dtype import convert_dtype
+        dims = []
+        sym_names = []
+        for i, d in enumerate(self.shape):
+            if d is None:
+                sym_names.append(f"d{i}")
+                dims.append(None)
+            else:
+                dims.append(d)
+        if sym_names:
+            scope = scope or jexport.SymbolicScope()
+            syms = jexport.symbolic_shape(
+                ",".join(sym_names), scope=scope)
+            it = iter(syms)
+            dims = [next(it) if d is None else d for d in dims]
+        return jax.ShapeDtypeStruct(tuple(dims), convert_dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _layer_pure(layer):
+    """(pure_fn, params) view of a Layer; pure_fn(params, *args)."""
+    pure = layer.functional()
+    return pure, layer.raw_state()
+
+
+def to_static(function=None, input_spec=None, full_graph: bool = True,
+              backend=None, static_argnums=None):
+    """Compile a function or Layer with jax.jit (reference: jit/api.py:171).
+
+    On a Layer, returns a callable that closes over the layer's state and
+    re-reads it each call (mutations to parameters are visible, matching the
+    reference's dygraph-parameter semantics)."""
+
+    def deco(fn):
+        if not _TO_STATIC_ENABLED:
+            return fn
+        if hasattr(fn, "functional"):
+            layer = fn
+            pure, _ = _layer_pure(layer)
+            jitted = jax.jit(pure)
+
+            def call(*args, **kwargs):
+                return jitted(layer.raw_state(), *args, **kwargs)
+
+            call.__wrapped_layer__ = layer
+            call.__jitted__ = jitted
+            return call
+        jitted = jax.jit(fn, static_argnums=static_argnums)
+        jitted.__input_spec__ = input_spec
+        return jitted
+
+    if function is None:
+        return deco
+    return deco(function)
+
+
+def not_to_static(fn: Callable) -> Callable:
+    """Mark a function to stay eager (reference: paddle.jit.not_to_static)."""
+    fn.__not_to_static__ = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load: portable StableHLO artifacts
+# ---------------------------------------------------------------------------
+
+def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
+         **kwargs) -> None:
+    """Serialize computation + params for code-free reload.
+
+    Produces (reference shape: jit.save's .pdmodel/.pdiparams pair):
+      path.pdexport  — serialized StableHLO (jax.export bytes)
+      path.pdparams  — pickled numpy state dict
+      path.pdmeta    — json manifest
+    """
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    if hasattr(layer_or_fn, "functional"):
+        pure, params = _layer_pure(layer_or_fn)
+        state = {"params": jax.tree.map(np.asarray, params)}
+        fn = pure
+        with_params = True
+    else:
+        fn = getattr(layer_or_fn, "__wrapped__", layer_or_fn)
+        state = {}
+        with_params = False
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec to trace the export")
+    scope = jexport.SymbolicScope()
+    arg_structs = [s.to_shape_struct(scope) if isinstance(s, InputSpec) else s
+                   for s in input_spec]
+
+    if with_params:
+        param_structs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), state["params"])
+        exported = jexport.export(jax.jit(fn))(param_structs, *arg_structs)
+    else:
+        exported = jexport.export(jax.jit(fn))(*arg_structs)
+
+    with open(path + ".pdexport", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    with open(path + ".pdmeta", "w") as f:
+        json.dump({"with_params": with_params,
+                   "n_inputs": len(input_spec),
+                   "format": "paddle_tpu.jit.v1"}, f)
+
+
+class TranslatedLayer:
+    """A loaded, code-free executable (reference:
+    python/paddle/jit/translated_layer.py TranslatedLayer): wraps a
+    deserialized StableHLO module + its parameters."""
+
+    def __init__(self, exported, params, with_params: bool):
+        self._exported = exported
+        self._params = params
+        self._with_params = with_params
+
+    def __call__(self, *args):
+        args = tuple(jnp.asarray(a) for a in args)
+        if self._with_params:
+            return self._exported.call(self._params, *args)
+        return self._exported.call(*args)
+
+    forward = __call__
+
+    def state_dict(self):
+        return self._params
+
+    @property
+    def input_specs(self):
+        return self._exported.in_avals
+
+    def as_text(self) -> str:
+        return self._exported.mlir_module()
+
+
+def load(path: str) -> TranslatedLayer:
+    """Load a jit.save artifact; executes without the original model code."""
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    with open(path + ".pdexport", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    params = jax.tree.map(jnp.asarray, state.get("params", {}))
+    return TranslatedLayer(exported, params, meta["with_params"])

@@ -1,0 +1,193 @@
+"""paddle_tpu.static — static-graph-shaped facade over JAX tracing.
+
+Reference: python/paddle/static (Program at base/framework.py:5736, Executor
+at base/executor.py:1152). The reference builds an explicit ProgramDesc/PIR
+program and runs it through interpreters; on TPU the program IS the jaxpr and
+the interpreter IS XLA, so this module keeps only the API *shape*: a
+``Program`` records a traced function, an ``Executor`` compiles and runs it.
+Useful for porting reference-style code; new code should use jit directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..jit import InputSpec
+
+__all__ = ["InputSpec", "Program", "Executor", "default_main_program",
+           "program_guard", "data", "CompiledProgram", "name_scope"]
+
+
+class Program:
+    """A deferred computation: feed names -> traced function -> fetch list.
+
+    Built either by ``program_guard`` + ``data()`` + op calls (the ops run
+    lazily at Executor.run trace time) or directly from a function.
+    """
+
+    def __init__(self):
+        self._feed_specs: Dict[str, InputSpec] = {}
+        self._builders = []          # list of (fetch_name, fn(feed_dict)->val)
+        self._fn: Optional[Callable] = None
+
+    # -- functional construction ------------------------------------------
+    @classmethod
+    def from_function(cls, fn: Callable, input_spec: Sequence[InputSpec]):
+        p = cls()
+        p._fn = fn
+        for i, s in enumerate(input_spec):
+            p._feed_specs[s.name or f"x{i}"] = s
+        return p
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        import copy
+        return copy.copy(self)
+
+    @property
+    def feed_names(self):
+        return list(self._feed_specs)
+
+    def _trace(self, fetch_builders):
+        """Compose the recorded graph body into one callable over feeds."""
+        def run_all(feeds: Dict[str, jax.Array]):
+            env = dict(feeds)
+            outs = []
+            for name, builder in fetch_builders:
+                env[name] = builder(env)
+                outs.append(env[name])
+            return outs
+        return run_all
+
+
+class _LazyVar:
+    """Symbolic handle returned by ``static.data`` inside a program_guard.
+    Ops on it are recorded, then replayed at run() trace time."""
+
+    __array_priority__ = 200
+    _serial = 0
+
+    def __init__(self, program: Program, build: Callable, name: str):
+        self._program = program
+        self._build = build
+        # unique name: the Executor caches compiled fetch sets by name, so
+        # two distinct expressions must never share one
+        _LazyVar._serial += 1
+        self.name = f"{name}#{_LazyVar._serial}"
+
+    @staticmethod
+    def _lift(v):
+        if isinstance(v, _LazyVar):
+            return v._build
+        return lambda env: v
+
+    def _binop(self, other, op, name):
+        ob = self._lift(other)
+        sb = self._build
+        oname = other.name if isinstance(other, _LazyVar) else repr(other)
+        return _LazyVar(self._program, lambda env: op(sb(env), ob(env)),
+                        f"({self.name}.{name}.{oname})")
+
+    def __add__(self, o): return self._binop(o, lambda a, b: a + b, "add")
+    def __radd__(self, o): return self.__add__(o)
+    def __sub__(self, o): return self._binop(o, lambda a, b: a - b, "sub")
+    def __mul__(self, o): return self._binop(o, lambda a, b: a * b, "mul")
+    def __rmul__(self, o): return self.__mul__(o)
+    def __truediv__(self, o): return self._binop(o, lambda a, b: a / b, "div")
+    def __matmul__(self, o): return self._binop(o, jnp.matmul, "matmul")
+
+    def apply(self, fn: Callable, name: str = "apply"):
+        sb = self._build
+        return _LazyVar(self._program, lambda env: fn(sb(env)),
+                        f"{self.name}.{name}")
+
+
+_default_program = Program()
+_program_stack = []
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _default_program
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+
+    def __enter__(self):
+        _program_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32") -> _LazyVar:
+    """Declare a feed slot in the current program (reference: static.data)."""
+    prog = default_main_program()
+    prog._feed_specs[name] = InputSpec(shape, dtype, name)
+    return _LazyVar(prog, lambda env: env[name], name)
+
+
+def name_scope(prefix: str):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class CompiledProgram:
+    """Kept for API parity; compilation happens inside Executor.run."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """Compile-and-run front end (reference: base/executor.py:1152).
+
+    ``run(program, feed={...}, fetch_list=[vars])`` jits the recorded graph
+    once per (program, fetch set) and replays it on subsequent calls — the
+    analogue of the reference's _ExecutorCache + StandaloneExecutor.
+    """
+
+    def __init__(self, place: Optional[str] = None):
+        self.place = place
+        self._cache: Dict[int, Callable] = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        import numpy as np
+        program = program.program if isinstance(program, CompiledProgram) else program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        if program._fn is not None:
+            args = [jnp.asarray(feed[n]) for n in program.feed_names]
+            key = id(program)
+            if key not in self._cache:
+                self._cache[key] = jax.jit(program._fn)
+            outs = self._cache[key](*args)
+            outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        else:
+            builders = [(getattr(v, "name", f"fetch{i}"), v._build)
+                        for i, v in enumerate(fetch_list)]
+            key = (id(program), tuple(n for n, _ in builders))
+            if key not in self._cache:
+                run_all = program._trace(builders)
+                self._cache[key] = jax.jit(
+                    lambda env: run_all(env))
+            env = {k: jnp.asarray(v) for k, v in feed.items()}
+            outs = self._cache[key](env)
+
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        self._cache.clear()
