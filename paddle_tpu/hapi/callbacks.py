@@ -122,7 +122,8 @@ class EarlyStopping(Callback):
 
     def __init__(self, monitor: str = "loss", mode: str = "min",
                  patience: int = 0, min_delta: float = 0.0,
-                 baseline: Optional[float] = None, save_best_model: bool = False):
+                 baseline: Optional[float] = None, save_best_model: bool = False,
+                 save_dir: Optional[str] = None):
         super().__init__()
         self.monitor = monitor
         self.patience = patience
@@ -131,6 +132,7 @@ class EarlyStopping(Callback):
         assert mode in ("min", "max")
         self.mode = mode
         self.save_best_model = save_best_model
+        self.save_dir = save_dir
 
     def on_train_begin(self, logs=None):
         self.wait = 0
@@ -155,6 +157,8 @@ class EarlyStopping(Callback):
         if self._improved(cur):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.model is not None:
+                self.model.save(os.path.join(self.save_dir or ".", "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:

@@ -74,6 +74,30 @@ def _worker_fetch(indices):
                       _WORKER_STATE["collate_fn"])
 
 
+def _shm_worker_loop(ring_name, index_queue, dataset, collate_fn):
+    """Worker-process loop for the native shared-memory transport: pop
+    (seq, indices) work items, fetch+collate, push pickled batches into the
+    ShmRing (reference: the mmap-allocator path of dataloader_iter.py:358)."""
+    import pickle
+    from paddle_tpu.native import ShmRing
+    ring = ShmRing.open(ring_name)
+    try:
+        while True:
+            item = index_queue.get()
+            if item is None:
+                ring.push(pickle.dumps(("__worker_done__", None)), timeout=600)
+                return
+            seq, indices = item
+            try:
+                batch = _fetch_map(dataset, indices, collate_fn)
+                payload = pickle.dumps((seq, batch), protocol=4)
+            except BaseException as e:  # surface in the parent
+                payload = pickle.dumps((seq, e), protocol=4)
+            ring.push(payload, timeout=600)
+    finally:
+        ring._h = None  # opener must never shm_unlink; the parent owns it
+
+
 class _PrefetchIterator:
     """Pulls batches from an executor pipeline with bounded depth."""
 
@@ -123,6 +147,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.prefetch_to_device = prefetch_to_device or sharding is not None
         self.sharding = sharding
@@ -180,6 +205,14 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield _fetch_map(self.dataset, indices, self.collate_fn)
             return
+        if self.use_shared_memory:
+            try:
+                from paddle_tpu import native
+                if native.is_available():
+                    yield from self._iter_batches_shm()
+                    return
+            except Exception:
+                pass  # fall through to the portable executor path
         # worker pool: submit index lists, consume in order with prefetch
         if self.multiprocessing_context is not None:
             import multiprocessing as mp
@@ -204,6 +237,89 @@ class DataLoader:
                 submits, self.num_workers * self.prefetch_factor)
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
+
+    def _iter_batches_shm(self):
+        """Multi-process fetch over the native shared-memory ring: workers
+        pickle batches straight into a process-shared ring buffer instead of
+        the multiprocessing pipe, and the parent re-orders by sequence
+        number. Mirrors the reference's shared-memory DataLoader fast path."""
+        import pickle
+        import multiprocessing as mp
+        from paddle_tpu.native import ShmRing
+
+        ctx = mp.get_context(self.multiprocessing_context or "spawn")
+        ring = ShmRing(capacity=128 << 20)
+        index_queue = ctx.Queue()
+        procs = [ctx.Process(target=_shm_worker_loop,
+                             args=(ring.name, index_queue, self.dataset,
+                                   self.collate_fn), daemon=True)
+                 for _ in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        try:
+            total = 0
+            depth = self.num_workers * self.prefetch_factor
+            sampler_it = iter(self.batch_sampler)
+            in_flight = 0
+            for _ in range(depth):
+                try:
+                    index_queue.put((total, next(sampler_it)))
+                    total += 1
+                    in_flight += 1
+                except StopIteration:
+                    break
+            next_seq = 0
+            done_workers = 0
+            stash = {}
+            while in_flight > 0 or stash:
+                while next_seq in stash:
+                    item = stash.pop(next_seq)
+                    next_seq += 1
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+                if in_flight == 0:
+                    continue
+                payload = None
+                while payload is None:
+                    try:
+                        payload = ring.pop(timeout=5)
+                        if payload is None:  # ring closed & drained
+                            raise RuntimeError(
+                                "DataLoader shared-memory ring closed with "
+                                f"{in_flight} batches still pending")
+                    except TimeoutError:
+                        # a worker that crashed (unclean exit) takes its
+                        # in-flight batch with it — even one such death means
+                        # the missing seq will never arrive
+                        dead = [p for p in procs
+                                if not p.is_alive() and p.exitcode not in (0, None)]
+                        if dead or not any(p.is_alive() for p in procs):
+                            codes = [p.exitcode for p in procs]
+                            raise RuntimeError(
+                                "DataLoader shared-memory worker(s) died "
+                                f"unexpectedly (exit codes {codes}) with "
+                                f"{in_flight} batches still pending") from None
+                seq, item = pickle.loads(payload)
+                if seq == "__worker_done__":
+                    done_workers += 1
+                    continue
+                in_flight -= 1
+                stash[seq] = item
+                try:
+                    index_queue.put((total, next(sampler_it)))
+                    total += 1
+                    in_flight += 1
+                except StopIteration:
+                    pass
+        finally:
+            for _ in procs:
+                index_queue.put(None)
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+            ring.destroy()
 
     def __iter__(self):
         host = self._iter_batches_host()

@@ -1,0 +1,245 @@
+"""Native host runtime (csrc/pt_native.cc via ctypes) tests.
+
+Covers the C++ TCPStore rendezvous semantics (reference tcp_store.h:121),
+the cross-process ShmRing transport, the parallel batch-assembly ops, and
+the HostPool stats allocator.
+"""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.native as nat
+
+pytestmark = pytest.mark.skipif(
+    not nat.is_available(), reason=f"native lib unavailable: {nat.build_error()}")
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+
+def test_store_set_get_add():
+    master = nat.TCPStore(is_master=True, timeout=10)
+    client = nat.TCPStore(port=master.port, timeout=10)
+    master.set("k", b"hello")
+    assert client.get("k") == b"hello"
+    assert client.try_get("missing") is None
+    assert client.add("ctr", 5) == 5
+    assert master.add("ctr", 2) == 7
+    assert client.num_keys() == 2
+    assert client.delete("k")
+    assert client.try_get("k") is None
+    client.close()
+    master.close()
+
+
+def test_store_wait_blocks_until_set():
+    master = nat.TCPStore(is_master=True, timeout=10)
+    client = nat.TCPStore(port=master.port, timeout=10)
+    result = {}
+
+    def waiter():
+        t0 = time.time()
+        client.wait("late_key", timeout=10)
+        result["waited"] = time.time() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    master.set("late_key", b"x")
+    t.join(timeout=10)
+    assert "waited" in result and result["waited"] >= 0.15
+    with pytest.raises(TimeoutError):
+        client.get("never", timeout=0.2)
+    client.close()
+    master.close()
+
+
+def test_store_large_value_and_barrier():
+    master = nat.TCPStore(is_master=True, timeout=10, world_size=2)
+    client = nat.TCPStore(port=master.port, timeout=10, world_size=2)
+    blob = bytes(np.random.RandomState(0).randint(0, 256, 1 << 20, dtype=np.uint8))
+    master.set("big", blob)
+    assert client.get("big") == blob
+
+    done = []
+
+    def rank1():
+        client.barrier("b0", world_size=2, timeout=10)
+        done.append(1)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    time.sleep(0.1)
+    master.barrier("b0", world_size=2, timeout=10)
+    t.join(timeout=10)
+    assert done == [1]
+    client.close()
+    master.close()
+
+
+def _store_child(port, q):
+    client = nat.TCPStore(port=port, timeout=20)
+    client.set("from_child", b"child_data")
+    v = client.get("from_parent", timeout=20)
+    q.put(v)
+    client.close()
+
+
+def test_store_cross_process():
+    master = nat.TCPStore(is_master=True, timeout=20)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_store_child, args=(master.port, q))
+    p.start()
+    assert master.get("from_child", timeout=20) == b"child_data"
+    master.set("from_parent", b"parent_data")
+    assert q.get(timeout=20) == b"parent_data"
+    p.join(timeout=20)
+    assert p.exitcode == 0
+    master.close()
+
+
+# ---------------------------------------------------------------------------
+# ShmRing
+# ---------------------------------------------------------------------------
+
+def test_shmring_roundtrip_and_wraparound():
+    ring = nat.ShmRing(capacity=1 << 16)
+    rs = np.random.RandomState(0)
+    msgs = [bytes(rs.randint(0, 256, rs.randint(1, 20000), dtype=np.uint8))
+            for _ in range(50)]
+    consumer = nat.ShmRing.open(ring.name)
+    got = []
+
+    def consume():
+        while True:
+            m = consumer.pop(timeout=10)
+            if m is None:
+                return
+            got.append(m)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for m in msgs:
+        ring.push(m, timeout=10)
+    ring.close()
+    t.join(timeout=30)
+    assert got == msgs
+    consumer._h = None  # opener must not shm_unlink; owner does
+    ring.destroy()
+
+
+def test_shmring_too_large_message():
+    ring = nat.ShmRing(capacity=1 << 12)
+    with pytest.raises(ValueError):
+        ring.push(b"x" * (1 << 13))
+    ring.destroy()
+
+
+def _ring_producer(name):
+    ring = nat.ShmRing.open(name)
+    for i in range(100):
+        ring.push(f"msg-{i}".encode(), timeout=20)
+    ring.push(b"__END__", timeout=20)
+    ring._h = None
+
+
+def test_shmring_cross_process():
+    ring = nat.ShmRing(capacity=1 << 14)
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_ring_producer, args=(ring.name,))
+    p.start()
+    out = []
+    while True:
+        m = ring.pop(timeout=30)
+        if m == b"__END__":
+            break
+        out.append(m.decode())
+    p.join(timeout=20)
+    assert p.exitcode == 0
+    assert out == [f"msg-{i}" for i in range(100)]
+    ring.destroy()
+
+
+# ---------------------------------------------------------------------------
+# host ops
+# ---------------------------------------------------------------------------
+
+def test_normalize_images_matches_numpy():
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    mean = [0.485, 0.456, 0.406]
+    std = [0.229, 0.224, 0.225]
+    out = nat.normalize_images(img, mean, std)
+    ref = (img.astype(np.float32) / 255.0 - np.float32(mean)) / np.float32(std)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+    assert out.dtype == np.float32
+
+
+def test_pad_sequences():
+    seqs = [[1, 2, 3], [4], [5, 6, 7, 8, 9], []]
+    out = nat.pad_sequences(seqs, pad_value=-1)
+    assert out.shape == (4, 5)
+    np.testing.assert_array_equal(out[0], [1, 2, 3, -1, -1])
+    np.testing.assert_array_equal(out[1], [4, -1, -1, -1, -1])
+    np.testing.assert_array_equal(out[3], [-1] * 5)
+    # truncation at explicit max_len
+    out2 = nat.pad_sequences(seqs, max_len=2, pad_value=0)
+    np.testing.assert_array_equal(out2[2], [5, 6])
+
+
+def test_gather_rows():
+    rs = np.random.RandomState(0)
+    table = rs.randn(100, 16).astype(np.float32)
+    idx = rs.randint(0, 100, 57)
+    np.testing.assert_array_equal(nat.gather_rows(table, idx), table[idx])
+
+
+# ---------------------------------------------------------------------------
+# HostPool
+# ---------------------------------------------------------------------------
+
+def test_hostpool_stats_and_reuse():
+    pool = nat.HostPool()
+    a = pool.alloc((1024,), np.float32)  # 4096 B bucket
+    a[:] = 1.0
+    s1 = pool.stats()
+    assert s1["current"] >= 4096 and s1["alloc_count"] == 1
+    pool.free(a)
+    assert pool.stats()["current"] == 0
+    b = pool.alloc((1024,), np.float32)  # must come from the free list
+    s2 = pool.stats()
+    assert s2["reserved"] == s1["reserved"]  # no new system allocation
+    assert s2["peak"] == s1["peak"]
+    pool.free(b)
+    pool.trim()
+    assert pool.stats()["reserved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# DataLoader over the native shm transport
+# ---------------------------------------------------------------------------
+
+class _SquareDataset:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * i], dtype=np.int64)
+
+
+def test_dataloader_shm_transport():
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(_SquareDataset(), batch_size=5, num_workers=2,
+                    use_shared_memory=True, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 8
+    all_rows = np.concatenate(batches)
+    assert all_rows.shape == (37, 2)
+    np.testing.assert_array_equal(all_rows[:, 0], np.arange(37))
+    np.testing.assert_array_equal(all_rows[:, 1], np.arange(37) ** 2)
