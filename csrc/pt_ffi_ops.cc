@@ -1,0 +1,84 @@
+// XLA FFI custom-call handlers (CPU) — the native custom-op path.
+//
+// TPU-native counterpart of the reference's out-of-tree custom operator
+// machinery (paddle/fluid/framework/custom_operator.cc, paddle/phi/api/ext/,
+// python/paddle/utils/cpp_extension/): a user-compiled C++ library whose
+// kernels are invoked from inside an XLA program via the typed FFI ABI,
+// registered at runtime from Python (paddle_tpu/utils/cpp_extension.py via
+// jax.ffi.register_ffi_target).
+//
+// Ops here are reference implementations proving the path end-to-end; on
+// TPU the same math runs through Pallas/XLA-fused lax code. The symbols are
+// looked up with dlsym by the Python loader, so keep them extern-visible.
+
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// ---------------------------------------------------------------------------
+// rms_norm(x, w, eps): y = x / sqrt(mean(x^2, -1) + eps) * w
+// (fused_rms_norm surface: reference
+//  python/paddle/incubate/nn/functional/fused_rms_norm.py)
+// ---------------------------------------------------------------------------
+
+static ffi::Error RmsNormImpl(float eps, ffi::Buffer<ffi::F32> x,
+                              ffi::Buffer<ffi::F32> w,
+                              ffi::ResultBuffer<ffi::F32> y) {
+  auto dims = x.dimensions();
+  if (dims.size() == 0) return ffi::Error::InvalidArgument("rms_norm: rank 0");
+  int64_t d = dims.back();
+  int64_t rows = 1;
+  for (size_t i = 0; i + 1 < dims.size(); ++i) rows *= dims[i];
+  if (w.element_count() != d)
+    return ffi::Error::InvalidArgument("rms_norm: weight/last-dim mismatch");
+  const float* xp = x.typed_data();
+  const float* wp = w.typed_data();
+  float* yp = y->typed_data();
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* row = xp + r * d;
+    float ss = 0.f;
+    for (int64_t i = 0; i < d; ++i) ss += row[i] * row[i];
+    float scale = 1.0f / std::sqrt(ss / static_cast<float>(d) + eps);
+    float* out = yp + r * d;
+    for (int64_t i = 0; i < d; ++i) out[i] = row[i] * scale * wp[i];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    pt_ffi_rms_norm, RmsNormImpl,
+    ffi::Ffi::Bind()
+        .Attr<float>("eps")
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// ---------------------------------------------------------------------------
+// swiglu(gate, up): y = silu(gate) * up  — the LLM MLP activation
+// (reference: paddle/phi/kernels/fusion/gpu/fused_bias_act_kernel.cu swiglu path)
+// ---------------------------------------------------------------------------
+
+static ffi::Error SwigluImpl(ffi::Buffer<ffi::F32> gate,
+                             ffi::Buffer<ffi::F32> up,
+                             ffi::ResultBuffer<ffi::F32> y) {
+  if (gate.element_count() != up.element_count())
+    return ffi::Error::InvalidArgument("swiglu: shape mismatch");
+  const float* g = gate.typed_data();
+  const float* u = up.typed_data();
+  float* out = y->typed_data();
+  int64_t n = gate.element_count();
+  for (int64_t i = 0; i < n; ++i) {
+    float s = g[i] / (1.0f + std::exp(-g[i]));
+    out[i] = s * u[i];
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(pt_ffi_swiglu, SwigluImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

@@ -1,0 +1,72 @@
+"""XLA FFI custom-call C++ op path (csrc/pt_ffi_ops.cc via
+paddle_tpu.utils.cpp_extension — the custom-op extension equivalent of
+python/paddle/utils/cpp_extension/)."""
+
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _toolchain():
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True, timeout=30)
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = [
+    pytest.mark.skipif(not _toolchain(), reason="no g++"),
+    pytest.mark.skipif(jax.default_backend() != "cpu",
+                       reason="builtin FFI handlers registered for cpu"),
+]
+
+
+def test_ffi_rms_norm_matches_reference_and_jits():
+    from paddle_tpu.utils.cpp_extension import ffi_rms_norm
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 7, 16).astype(np.float32))
+    w = jnp.asarray(rs.randn(16).astype(np.float32))
+    y = jax.jit(lambda a, b: ffi_rms_norm(a, b, eps=1e-5))(x, w)
+    ref = x / jnp.sqrt(jnp.mean(x ** 2, -1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_ffi_swiglu():
+    from paddle_tpu.utils.cpp_extension import ffi_swiglu
+    rs = np.random.RandomState(1)
+    g = jnp.asarray(rs.randn(32).astype(np.float32))
+    u = jnp.asarray(rs.randn(32).astype(np.float32))
+    out = jax.jit(ffi_swiglu)(g, u)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jax.nn.silu(g) * u),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_load_user_extension(tmp_path):
+    # a user writes their own FFI op out-of-tree and loads it
+    src = tmp_path / "my_op.cc"
+    src.write_text("""
+#include "xla/ffi/api/ffi.h"
+namespace ffi = xla::ffi;
+static ffi::Error ScaleImpl(float k, ffi::Buffer<ffi::F32> x,
+                            ffi::ResultBuffer<ffi::F32> y) {
+  const float* xp = x.typed_data();
+  float* yp = y->typed_data();
+  for (int64_t i = 0; i < x.element_count(); ++i) yp[i] = xp[i] * k;
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(my_scale, ScaleImpl,
+    ffi::Ffi::Bind().Attr<float>("k").Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+""")
+    from paddle_tpu.utils.cpp_extension import load
+    mod = load("my_ext", [str(src)], build_directory=str(tmp_path),
+               register=["my_scale"])
+    x = jnp.arange(5, dtype=jnp.float32)
+    out = mod.call("my_ext.my_scale", jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   x, k=np.float32(3.0))
+    np.testing.assert_allclose(np.asarray(out), np.arange(5) * 3.0)
