@@ -33,6 +33,8 @@ from .framework import save, load, set_device, get_device, is_compiled_with_cuda
 from . import jit
 from . import static
 from . import metric
+from . import device
+from . import profiler
 from . import hapi
 from .hapi import Model
 
