@@ -34,6 +34,11 @@ from . import jit
 from . import static
 from . import metric
 from . import device
+from . import fft
+from . import sparse
+from . import distribution
+from . import vision
+from . import text
 from . import profiler
 from . import hapi
 from .hapi import Model

@@ -40,6 +40,8 @@ class ConvBNLayer(nn.Layer):
         x = self.bn(self.conv(x))
         if self.act == "relu":
             x = F.relu(x)
+        elif self.act == "relu6":
+            x = F.relu6(x)
         elif self.act == "hardswish":
             x = F.hardswish(x)
         return x

@@ -10,7 +10,8 @@ from . import initializer
 from .layer import (Layer, Parameter, Buffer, Sequential, LayerList, LayerDict,
                     set_default_dtype, get_default_dtype)
 from .common import (
-    Linear, Embedding, Dropout, LayerNorm, RMSNorm, BatchNorm, BatchNorm2D,
+    Linear, Embedding, Dropout, LayerNorm, RMSNorm, BatchNorm, BatchNorm1D,
+    BatchNorm2D, BatchNorm3D, SyncBatchNorm,
     GroupNorm, Conv2D, Conv2DTranspose, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
     Flatten, ReLU, GELU, SiLU, Sigmoid, Tanh, Softmax, LeakyReLU, Hardswish,
     Hardsigmoid, Mish, CrossEntropyLoss, MSELoss, L1Loss, BCEWithLogitsLoss,

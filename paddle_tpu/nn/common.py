@@ -402,3 +402,49 @@ class NLLLoss(Layer):
     def forward(self, log_probs, labels):
         return F.nll_loss(log_probs, labels, self.loss_weight, self.ignore_index,
                           self.reduction)
+
+
+class BatchNorm1D(BatchNorm2D):
+    """BN over [N, C] or [N, C, L] (reference: nn.BatchNorm1D). The shared
+    functional core normalizes over all non-channel dims, so only the
+    accepted ranks differ from 2D."""
+
+    def forward(self, x):
+        if x.ndim not in (2, 3):
+            raise ValueError(f"BatchNorm1D expects rank 2 or 3, got {x.ndim}")
+        return super().forward(x)
+
+
+class BatchNorm3D(BatchNorm2D):
+    def forward(self, x):
+        if x.ndim != 5:
+            raise ValueError(f"BatchNorm3D expects rank 5, got {x.ndim}")
+        return super().forward(x)
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica BN (reference: nn.SyncBatchNorm backed by collective
+    kernels). Under GSPMD the batch axis is sharded and XLA computes the
+    jnp.mean/var reductions over the *global* batch automatically, so the
+    plain BN math is already synchronized; kept as a distinct class for
+    convert_sync_batchnorm parity.
+
+    reference: python/paddle/nn/layer/norm.py SyncBatchNorm
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively swap BatchNorm*D sublayers for SyncBatchNorm."""
+        if isinstance(layer, BatchNorm2D) and not isinstance(layer, SyncBatchNorm):
+            new = cls(layer.num_features, momentum=layer.momentum,
+                      epsilon=layer.epsilon, data_format=layer.data_format)
+            if layer.weight is not None:
+                new.weight.value = layer.weight.value
+            if layer.bias is not None:
+                new.bias.value = layer.bias.value
+            new._buffers["_mean"].value = layer._buffers["_mean"].value
+            new._buffers["_variance"].value = layer._buffers["_variance"].value
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer

@@ -1,0 +1,18 @@
+"""paddle_tpu.vision — vision domain library (reference: python/paddle/vision/).
+
+Subpackages: transforms (host-side preprocessing with native C++ normalize
+fast path), datasets (local-file readers + hermetic fake data), models
+(classification backbones; OCR det/rec live in paddle_tpu.models.vision).
+"""
+
+from . import transforms
+from . import datasets
+from . import models
+from .models import (LeNet, VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1,
+                     MobileNetV2, mobilenet_v1, mobilenet_v2, ResNet,
+                     resnet18, resnet34, resnet50, resnet101, SqueezeNet,
+                     squeezenet1_0)
+from .datasets import (MNIST, FashionMNIST, Cifar10, Cifar100,
+                       FakeImageDataset, DatasetFolder, ImageFolder)
+
+__all__ = ["transforms", "datasets", "models"]

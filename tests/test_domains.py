@@ -1,0 +1,278 @@
+"""Domain libraries: fft, sparse, distribution, vision, text."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fft, sparse, distribution as dist, text
+from paddle_tpu import vision
+
+
+# ---------------------------------------------------------------------------
+# fft
+# ---------------------------------------------------------------------------
+
+def test_fft_roundtrip_and_norms():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 16).astype(np.float32)
+    X = fft.fft(x, norm="ortho")
+    back = fft.ifft(X, norm="ortho")
+    np.testing.assert_allclose(np.asarray(back.real), x, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fft.rfft(x)),
+                               np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        fft.fft(x, norm="bogus")
+
+
+def test_fft2_shift_freq():
+    rs = np.random.RandomState(1)
+    x = rs.randn(8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fft.fft2(x)), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fft.fftshift(fft.fftfreq(8))),
+                               np.fft.fftshift(np.fft.fftfreq(8)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip_and_ops():
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 3] = -1.0
+    idx = np.asarray([[0, 2], [1, 3]])
+    vals = np.asarray([2.0, -1.0], np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, shape=(4, 5))
+    assert sparse.is_sparse_coo(s)
+    assert sparse.nnz(s) == 2
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), dense)
+    # add two sparse without densify
+    s2 = sparse.add(s, s)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s2)), dense * 2)
+    # unary keeps the pattern
+    r = sparse.relu(s)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(r)),
+                               np.maximum(dense, 0))
+
+
+def test_sparse_matmul_and_masked():
+    rs = np.random.RandomState(0)
+    d = rs.randn(4, 4).astype(np.float32)
+    d[d < 0.3] = 0  # sparsify
+    s = sparse.to_sparse_coo(d)
+    y = rs.randn(4, 3).astype(np.float32)
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(np.asarray(out), d @ y, rtol=1e-4, atol=1e-4)
+    # SDDMM: sample x@y at the mask pattern
+    mask = sparse.to_sparse_coo(np.asarray(d != 0, np.float32))
+    mm = sparse.masked_matmul(d, y @ y.T @ np.eye(4, dtype=np.float32)[:3],
+                              mask) if False else None
+    a = rs.randn(4, 6).astype(np.float32)
+    b = rs.randn(6, 4).astype(np.float32)
+    got = sparse.masked_matmul(a, b, mask)
+    ref = (a @ b) * (d != 0)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(got)), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_csr():
+    dense = np.asarray([[1, 0, 2], [0, 0, 3]], np.float32)
+    s = sparse.to_sparse_csr(dense)
+    assert sparse.is_sparse_csr(s)
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s)), dense)
+    s2 = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 2], [1., 2., 3.],
+                                  shape=(2, 3))
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(s2)), dense)
+
+
+# ---------------------------------------------------------------------------
+# distribution
+# ---------------------------------------------------------------------------
+
+def test_normal_moments_logprob_kl():
+    pt.seed(0)
+    n = dist.Normal(1.0, 2.0)
+    s = n.sample((20000,))
+    assert abs(float(s.mean()) - 1.0) < 0.1
+    assert abs(float(s.std()) - 2.0) < 0.1
+    from scipy.stats import norm as scipy_norm
+    np.testing.assert_allclose(float(n.log_prob(jnp.asarray(0.5))),
+                               scipy_norm.logpdf(0.5, 1.0, 2.0), rtol=1e-5)
+    q = dist.Normal(0.0, 1.0)
+    kl = dist.kl_divergence(n, q)
+    # closed form: log(s2/s1)... check against formula
+    expect = np.log(1 / 2) + (4 + 1) / 2 - 0.5
+    np.testing.assert_allclose(float(kl), expect, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    pt.seed(0)
+    c = dist.Categorical(logits=jnp.log(jnp.asarray([0.2, 0.3, 0.5])))
+    s = c.sample((20000,))
+    freq = np.bincount(np.asarray(s), minlength=3) / 20000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    np.testing.assert_allclose(float(c.entropy()),
+                               -(0.2 * np.log(0.2) + 0.3 * np.log(0.3)
+                                 + 0.5 * np.log(0.5)), rtol=1e-5)
+    b = dist.Bernoulli(probs=0.7)
+    np.testing.assert_allclose(float(b.log_prob(1.0)), np.log(0.7), rtol=1e-5)
+    with pytest.raises(ValueError):
+        dist.Bernoulli(probs=0.5, logits=0.0)
+
+
+@pytest.mark.parametrize("d,mean_tol", [
+    (lambda: dist.Beta(2.0, 3.0), 0.05),
+    (lambda: dist.Exponential(2.0), 0.05),
+    (lambda: dist.Gamma(3.0, 2.0), 0.1),
+    (lambda: dist.Gumbel(0.0, 1.0), 0.05),
+    (lambda: dist.Laplace(1.0, 0.5), 0.05),
+    (lambda: dist.LogNormal(0.0, 0.25), 0.05),
+    (lambda: dist.Poisson(4.0), 0.1),
+])
+def test_distribution_sample_mean(d, mean_tol):
+    pt.seed(0)
+    di = d()
+    s = di.sample((20000,))
+    np.testing.assert_allclose(float(jnp.mean(s)), float(di.mean),
+                               atol=mean_tol * 3, rtol=0.05)
+
+
+def test_dirichlet_multinomial():
+    pt.seed(0)
+    dr = dist.Dirichlet(jnp.asarray([2.0, 3.0, 5.0]))
+    s = dr.sample((5000,))
+    np.testing.assert_allclose(np.asarray(s.mean(0)), np.asarray(dr.mean),
+                               atol=0.02)
+    m = dist.Multinomial(10, jnp.asarray([0.2, 0.8]))
+    smp = m.sample((100,))
+    assert smp.shape == (100, 2)
+    np.testing.assert_allclose(np.asarray(smp.sum(-1)), 10)
+
+
+def test_kl_registry_unregistered():
+    with pytest.raises(NotImplementedError):
+        dist.kl_divergence(dist.Normal(0, 1), dist.Beta(1, 1))
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+def test_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (40, 60, 3), dtype=np.uint8)
+    tf = T.Compose([T.Resize(32), T.CenterCrop(32),
+                    T.ToTensor(),
+                    T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.1 <= out.min() and out.max() <= 1.1
+
+
+def test_transforms_native_normalize_matches_python():
+    from paddle_tpu.vision.transforms import normalize
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (8, 8, 3), dtype=np.uint8)
+    mean = [120.0, 110.0, 100.0]
+    std = [60.0, 61.0, 62.0]
+    fast = normalize(img, mean, std, data_format="HWC")
+    ref = (img.astype(np.float32) - np.float32(mean)) / np.float32(std)
+    np.testing.assert_allclose(fast, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_random_transforms_run():
+    from paddle_tpu.vision import transforms as T
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (33, 47, 3), dtype=np.uint8)
+    tf = T.Compose([T.RandomResizedCrop(24), T.RandomHorizontalFlip(),
+                    T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.RandomErasing(1.0)])
+    out = tf(img)
+    assert np.asarray(out).shape == (24, 24, 3)
+
+
+def test_fake_datasets_and_loader():
+    ds = vision.MNIST(backend="fake")
+    img, label = ds[3]
+    assert img.shape == (28, 28, 1) and 0 <= int(label) < 10
+    c = vision.Cifar10(backend="fake")
+    img, label = c[0]
+    assert img.shape == (32, 32, 3)
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(vision.FakeImageDataset(32, (3, 8, 8)), batch_size=8)
+    xb, yb = next(iter(dl))
+    assert xb.shape == (8, 3, 8, 8)
+
+
+def test_dataset_folder(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(np.full((8, 8, 3), 100, np.uint8)).save(
+                d / f"{i}.png")
+    ds = vision.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, label = ds[0]
+    assert int(label) == 0
+
+
+def test_vision_models_forward():
+    pt.seed(0)
+    x = jnp.zeros((2, 1, 28, 28), jnp.float32)
+    out = vision.LeNet(num_classes=10)(x)
+    assert out.shape == (2, 10)
+    x3 = jnp.zeros((1, 3, 32, 32), jnp.float32)
+    m = vision.MobileNetV2(scale=0.35, num_classes=7)
+    m.eval()
+    assert m(x3).shape == (1, 7)
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+
+def test_viterbi_decode_against_brute_force():
+    rs = np.random.RandomState(0)
+    B, T, N = 2, 4, 3
+    pot = rs.randn(B, T, N).astype(np.float32)
+    trans = rs.randn(N, N).astype(np.float32)
+    score, path = text.viterbi_decode(pot, trans, include_bos_eos_tag=False)
+    # brute force
+    import itertools
+    for b in range(B):
+        best, best_p = -1e9, None
+        for p in itertools.product(range(N), repeat=T):
+            s = pot[b, 0, p[0]] + sum(
+                trans[p[t - 1], p[t]] + pot[b, t, p[t]] for t in range(1, T))
+            if s > best:
+                best, best_p = s, p
+        np.testing.assert_allclose(float(score[b]), best, rtol=1e-4)
+        assert tuple(np.asarray(path[b])) == best_p
+
+
+def test_crf_log_likelihood_is_normalized():
+    rs = np.random.RandomState(0)
+    B, T, N = 1, 3, 2
+    pot = rs.randn(B, T, N).astype(np.float32)
+    trans = rs.randn(N, N).astype(np.float32)
+    import itertools
+    lls = []
+    for labels in itertools.product(range(N), repeat=T):
+        ll = text.crf_log_likelihood(pot, trans,
+                                     np.asarray([labels], np.int32))
+        lls.append(float(ll[0]))
+    np.testing.assert_allclose(np.exp(lls).sum(), 1.0, rtol=1e-4)
+
+
+def test_edit_distance():
+    d = text.edit_distance([[1, 2, 3]], [[1, 3]], normalized=False)
+    assert float(d[0]) == 1.0
+    dn = text.edit_distance([[1, 2, 3, 4]], [[1, 2]], normalized=True)
+    assert float(dn[0]) == 1.0
