@@ -38,6 +38,7 @@ from . import fft
 from . import sparse
 from . import distribution
 from . import vision
+from . import quantization
 from . import text
 from . import profiler
 from . import hapi

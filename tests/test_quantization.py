@@ -1,0 +1,114 @@
+"""Quantization: observers, fake quant + STE, QAT swap, PTQ calibrate/convert,
+int8 matmul accuracy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    AbsmaxObserver, MovingAverageAbsmaxObserver, PercentileObserver,
+    FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax, fake_quant,
+    QuantConfig, QAT, PTQ, QuantedLinear, Int8Linear,
+    quantize_linear, dequantize_linear, int8_matmul)
+
+
+def test_observers():
+    obs = AbsmaxObserver()
+    obs.observe(jnp.asarray([1.0, -3.0]))
+    obs.observe(jnp.asarray([2.0]))
+    np.testing.assert_allclose(obs.scale(), 3.0 / 127, rtol=1e-6)
+
+    ema = MovingAverageAbsmaxObserver(moving_rate=0.5)
+    ema.observe(jnp.asarray([2.0]))
+    ema.observe(jnp.asarray([4.0]))
+    np.testing.assert_allclose(ema.scale(), 3.0 / 127, rtol=1e-6)
+
+    pct = PercentileObserver(percentile=50.0)
+    pct.observe(jnp.linspace(0, 1.0, 1000))
+    assert 0.3 / 127 < pct.scale() < 0.7 / 127
+
+
+def test_fake_quant_ste_gradient():
+    x = jnp.asarray([0.11, -0.52, 0.9])
+    scale = 0.9 / 127
+    y = fake_quant(x, scale)
+    # values land on the int grid
+    np.testing.assert_allclose(np.asarray(y / scale),
+                               np.round(np.asarray(y / scale)), atol=1e-4)
+    # straight-through: gradient of sum(fake_quant(x)) == 1
+    g = jax.grad(lambda v: fake_quant(v, scale).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_quantize_dequantize_roundtrip():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(32).astype(np.float32))
+    scale = float(jnp.max(jnp.abs(x))) / 127
+    q = quantize_linear(x, scale)
+    assert q.dtype == jnp.int8
+    back = dequantize_linear(q, scale)
+    assert float(jnp.max(jnp.abs(back - x))) <= scale * 0.5 + 1e-6
+
+
+def test_int8_matmul_close_to_fp32():
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 64).astype(np.float32)
+    w = rs.randn(64, 32).astype(np.float32)
+    xs = np.abs(x).max() / 127
+    ws = np.abs(w).max(0) / 127
+    xq = quantize_linear(jnp.asarray(x), xs)
+    wq = quantize_linear(jnp.asarray(w), jnp.asarray(ws)[None, :])
+    out = int8_matmul(xq, wq, xs, jnp.asarray(ws))
+    ref = x @ w
+    rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
+    assert rel < 0.02, rel
+
+
+def test_qat_swap_and_train_step():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = QAT(QuantConfig().add_type_config(nn.Linear))
+    qmodel = q.quantize(model)
+    assert isinstance(qmodel[0], QuantedLinear)
+    assert isinstance(qmodel[2], QuantedLinear)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    out = qmodel(x)
+    assert out.shape == (4, 4)
+    # gradients flow through STE
+    from paddle_tpu.autograd import layer_grad
+    loss, grads = layer_grad(qmodel, lambda o: (o ** 2).mean(), x)
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+
+def test_qat_type_config_selectivity():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 1))
+    cfg = QuantConfig()  # no default
+    cfg.add_type_config(nn.Linear)
+    q = QAT(cfg)
+    qm = q.quantize(model)
+    assert isinstance(qm[0], QuantedLinear)
+    assert isinstance(qm[1], nn.Conv2D)  # untouched
+
+
+def test_ptq_calibrate_convert():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    rs = np.random.RandomState(0)
+    calib = [rs.randn(4, 16).astype(np.float32) for _ in range(4)]
+    ref_out = model(jnp.asarray(calib[0]))
+
+    ptq = PTQ()
+    qm = ptq.quantize(model, inplace=False)
+    for batch in calib:
+        qm(jnp.asarray(batch))
+    converted = ptq.convert(qm)
+    assert isinstance(converted[0], Int8Linear)
+    out = converted(jnp.asarray(calib[0]))
+    rel = float(jnp.abs(out - ref_out).max() / (jnp.abs(ref_out).max() + 1e-9))
+    assert rel < 0.05, rel
