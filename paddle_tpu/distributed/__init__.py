@@ -43,3 +43,7 @@ __all__ = [
     "recompute", "recompute_sequential", "recompute_hybrid",
     "recompute_wrapper",
 ]
+
+from . import launch  # noqa: E402
+from . import elastic  # noqa: E402
+from . import auto_tuner  # noqa: E402

@@ -1,0 +1,172 @@
+"""Launcher (pod/container spawn + env), elastic manager, auto-tuner."""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+import paddle_tpu.native as nat
+from paddle_tpu.distributed.launch import LaunchConfig, launch, build_pod
+from paddle_tpu.distributed.auto_tuner import (
+    TunerConfig, AutoTuner, default_candidates, prune_by_memory,
+    estimate_memory_gb, Recorder)
+
+
+# ---------------------------------------------------------------------------
+# launch
+# ---------------------------------------------------------------------------
+
+def test_build_pod_env():
+    cfg = LaunchConfig(nproc_per_node=3, log_dir="/tmp/ptl")
+    pod = build_pod(cfg, "train.py", ["--foo"])
+    assert len(pod.containers) == 3
+    envs = [c.env for c in pod.containers]
+    assert [e["PADDLE_TRAINER_ID"] for e in envs] == ["0", "1", "2"]
+    assert all(e["PADDLE_TRAINERS_NUM"] == "3" for e in envs)
+    eps = envs[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 3 and envs[1]["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+    assert envs[0]["JAX_PROCESS_ID"] == "0"
+    assert pod.containers[0].cmd[-2:] == ["train.py", "--foo"]
+
+
+def test_launch_runs_workers_and_collects_logs(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        print(f"hello from rank {rank}")
+        sys.exit(0)
+    """))
+    cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"))
+    code = launch(cfg, str(script))
+    assert code == 0
+    logs = sorted(os.listdir(tmp_path / "log"))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    assert "hello from rank 0" in (tmp_path / "log" / "workerlog.0").read_text()
+
+
+def test_launch_failure_and_restart(tmp_path):
+    # worker fails on first attempt, succeeds after marker file exists
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "ran_once"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = {str(repr(str(marker)))}
+        if not os.path.exists(m):
+            open(m, "w").write("x")
+            sys.exit(3)
+        sys.exit(0)
+    """))
+    cfg = LaunchConfig(nproc_per_node=1, log_dir=str(tmp_path / "log"),
+                       max_restarts=2)
+    assert launch(cfg, str(script)) == 0
+    cfg0 = LaunchConfig(nproc_per_node=1, log_dir=str(tmp_path / "log2"),
+                        max_restarts=0)
+    os.remove(marker)
+    assert launch(cfg0, str(script)) == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not nat.is_available(), reason="native lib unavailable")
+def test_elastic_membership_and_watch():
+    from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
+                                                ElasticLevel)
+    master = ElasticManager(np=2, heartbeat_interval=0.1,
+                            heartbeat_timeout=5.0, node_id="n0")
+    worker = ElasticManager(f"127.0.0.1:{master.port}", np=2,
+                            heartbeat_interval=0.1, heartbeat_timeout=5.0,
+                            node_id="n1")
+    master.register()
+    assert master.watch() == ElasticStatus.RESTART  # only 1 of 2 alive
+    worker.register()
+    time.sleep(0.3)
+    assert sorted(master.alive_nodes()) == ["n0", "n1"]
+    assert master.watch() == ElasticStatus.HOLD
+    worker.exit()
+    master.exit()
+
+
+@pytest.mark.skipif(not nat.is_available(), reason="native lib unavailable")
+def test_elastic_run_restarts_until_success():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    mgr = ElasticManager(np=1, max_restarts=3)
+    calls = []
+
+    def train(restart_ordinal):
+        calls.append(restart_ordinal)
+        if restart_ordinal < 2:
+            raise RuntimeError("simulated preemption")
+
+    assert mgr.run(train) is True
+    assert calls == [0, 1, 2]
+    mgr.exit()
+
+
+# ---------------------------------------------------------------------------
+# auto-tuner
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(num_devices=8, model_params_b=0.5, hidden_size=1024,
+                num_layers=8, seq_len=2048, global_batch_size=32,
+                vocab_size=32000, hbm_gb_per_device=16.0)
+    base.update(kw)
+    return TunerConfig(**base)
+
+
+def test_candidates_respect_constraints():
+    cfg = _cfg()
+    cands = default_candidates(cfg)
+    assert cands
+    for c in cands:
+        assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                * c["sharding_degree"]) == 8
+        assert cfg.num_layers % c["pp_degree"] == 0
+        replicas = c["dp_degree"] * c["sharding_degree"]
+        assert cfg.global_batch_size % (replicas * c["micro_batch_size"]) == 0
+        if c["pp_degree"] > 1:
+            assert c["accumulate_steps"] >= c["pp_degree"]
+
+
+def test_memory_prune_monotonic():
+    cfg = _cfg()
+    c_small = dict(dp_degree=1, mp_degree=2, pp_degree=2, sharding_degree=2,
+                   micro_batch_size=1, use_recompute=True, accumulate_steps=8)
+    c_big = dict(c_small, micro_batch_size=4, use_recompute=False,
+                 accumulate_steps=2)
+    assert estimate_memory_gb(cfg, c_big) > estimate_memory_gb(cfg, c_small)
+    tight = _cfg(hbm_gb_per_device=0.001)
+    assert prune_by_memory(tight, default_candidates(tight)) == []
+
+
+def test_tuner_finds_best_and_records_failures(tmp_path):
+    cfg = _cfg()
+    tuner = AutoTuner(cfg)
+    assert tuner.candidates, "pruning removed everything"
+
+    def run_fn(c):
+        if c["mp_degree"] == 4:
+            raise MemoryError("simulated OOM")
+        # synthetic metric: prefer dp=8 pure data parallel
+        return 1000 * c["dp_degree"] - 50 * c["pp_degree"]
+
+    best = tuner.tune(run_fn, log_path=str(tmp_path / "hist.json"))
+    assert best is not None
+    assert best["mp_degree"] != 4
+    hist = json.load(open(tmp_path / "hist.json"))
+    assert any(h["error"] for h in hist["history"]) or all(
+        c["mp_degree"] != 4 for c in tuner.candidates)
+    metrics = [h["metric"] for h in hist["history"] if h["metric"]]
+    assert hist["best"]["metric"] == max(metrics)
+
+
+def test_recorder_best_none_when_all_failed():
+    r = Recorder()
+    r.add({"a": 1}, None, error="boom")
+    assert r.best() is None
