@@ -39,6 +39,7 @@ from . import sparse
 from . import distribution
 from . import vision
 from . import quantization
+from . import incubate
 from . import text
 from . import profiler
 from . import hapi

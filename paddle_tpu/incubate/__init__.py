@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — incubating APIs (reference: python/paddle/incubate/).
+
+Hosts the fused-op functional surface (incubate.nn.functional) mirroring the
+reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
+"""
+
+from . import nn
+
+__all__ = ["nn"]

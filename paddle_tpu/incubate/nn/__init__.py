@@ -1,0 +1,5 @@
+"""paddle_tpu.incubate.nn (reference: python/paddle/incubate/nn/)."""
+
+from . import functional
+
+__all__ = ["functional"]

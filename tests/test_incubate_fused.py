@@ -1,0 +1,188 @@
+"""Incubate fused ops: fused norms w/ residual, matmul+bias, bias_act,
+masked MHA decode cache, paged/block KV-cache attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _ref_attn(q, k, v, length):
+    """naive single-query attention over first `length` cache entries."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = np.einsum("hd,thd->ht", q, k[:length]) * scale
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("ht,thd->hd", p, v[:length])
+
+
+def test_fused_rms_norm_matches_composition():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 16).astype(np.float32))
+    w = jnp.asarray(rs.randn(16).astype(np.float32))
+    bias = jnp.asarray(rs.randn(16).astype(np.float32))
+    res = jnp.asarray(rs.randn(4, 16).astype(np.float32))
+    out, res_out = IF.fused_rms_norm(x, w, epsilon=1e-6, bias=bias, residual=res)
+    pre = x + bias + res
+    ref = pre / jnp.sqrt(jnp.mean(pre ** 2, -1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res_out), np.asarray(pre), rtol=1e-6)
+    # single-output form
+    out2 = IF.fused_rms_norm(x, w)
+    assert out2.shape == x.shape
+
+
+def test_fused_layer_norm_residual():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    res = jnp.asarray(rs.randn(2, 8).astype(np.float32))
+    out, res_out = IF.fused_layer_norm(x, residual=res)
+    pre = np.asarray(x + res)
+    mu = pre.mean(-1, keepdims=True)
+    sd = pre.std(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), (pre - mu) / np.sqrt(sd**2 + 1e-5),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_matmul_bias_transposes():
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(5, 4).astype(np.float32)
+    b = rs.randn(5).astype(np.float32)
+    out = IF.fused_matmul_bias(jnp.asarray(x), jnp.asarray(y), jnp.asarray(b),
+                               transpose_y=True)
+    np.testing.assert_allclose(np.asarray(out), x @ y.T + b, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_bias_act_variants():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 8).astype(np.float32))
+    b = jnp.asarray(rs.randn(8).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(IF.fused_bias_act(x, b, "relu")),
+                               np.maximum(np.asarray(x + b), 0), rtol=1e-6)
+    sw = IF.fused_bias_act(x, b, "swiglu")
+    g, u = np.split(np.asarray(x + b), 2, axis=-1)
+    np.testing.assert_allclose(np.asarray(sw), g / (1 + np.exp(-g)) * u,
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError):
+        IF.fused_bias_act(x, None, "nope")
+
+
+def test_masked_multihead_attention_decode_matches_naive():
+    rs = np.random.RandomState(0)
+    B, H, D, T_max = 2, 4, 8, 16
+    cache = np.zeros((2, B, H, T_max, D), np.float32)
+    lens = np.asarray([3, 7], np.int32)
+    for b in range(B):
+        cache[:, b, :, :lens[b]] = rs.randn(2, H, lens[b], D)
+    x = rs.randn(B, 3 * H * D).astype(np.float32)
+
+    out, new_cache = IF.masked_multihead_attention(
+        jnp.asarray(x), jnp.asarray(cache), seq_lens=jnp.asarray(lens),
+        num_head=H, head_dim=D)
+    assert out.shape == (B, H * D)
+    qkv = x.reshape(B, 3, H, D)
+    for b in range(B):
+        L = int(lens[b]) + 1
+        k_full = np.concatenate(
+            [cache[0, b].transpose(1, 0, 2)[:lens[b]],
+             qkv[b, 1][None]], axis=0)
+        v_full = np.concatenate(
+            [cache[1, b].transpose(1, 0, 2)[:lens[b]],
+             qkv[b, 2][None]], axis=0)
+        ref = _ref_attn(qkv[b, 0], k_full, v_full, L)
+        np.testing.assert_allclose(np.asarray(out[b]).reshape(H, D), ref,
+                                   rtol=1e-4, atol=1e-4)
+    # cache got the new token written
+    nc = np.asarray(new_cache)
+    np.testing.assert_allclose(nc[0, 0, :, lens[0]], qkv[0, 1], rtol=1e-6)
+
+
+def test_block_multihead_attention_matches_dense():
+    """Paged attention over a shuffled block pool must equal dense attention."""
+    rs = np.random.RandomState(0)
+    B, H, D = 2, 4, 8
+    block_size, max_blocks, num_blocks = 4, 4, 32
+    lens = np.asarray([5, 11], np.int32)   # tokens already cached
+    key_cache = np.zeros((num_blocks, block_size, H, D), np.float32)
+    value_cache = np.zeros((num_blocks, block_size, H, D), np.float32)
+    # non-trivial block table: arbitrary pool blocks per sequence
+    block_tables = np.asarray([[7, 3, 19, -1], [22, 9, 1, 14]], np.int32)
+    dense_k = rs.randn(B, max_blocks * block_size, H, D).astype(np.float32)
+    dense_v = rs.randn(B, max_blocks * block_size, H, D).astype(np.float32)
+    for b in range(B):
+        for lb in range(max_blocks):
+            pb = block_tables[b, lb]
+            if pb < 0:
+                continue
+            sl = slice(lb * block_size, (lb + 1) * block_size)
+            key_cache[pb] = dense_k[b, sl]
+            value_cache[pb] = dense_v[b, sl]
+
+    qkv = rs.randn(B, 3 * H * D).astype(np.float32)
+    out, kc, vc = IF.block_multihead_attention(
+        jnp.asarray(qkv), jnp.asarray(key_cache), jnp.asarray(value_cache),
+        jnp.asarray(lens), jnp.asarray(block_tables), num_heads=H, head_dim=D)
+
+    q = qkv.reshape(B, 3, H, D)
+    for b in range(B):
+        L = int(lens[b]) + 1
+        k_full = dense_k[b].copy()
+        v_full = dense_v[b].copy()
+        k_full[lens[b]] = q[b, 1]
+        v_full[lens[b]] = q[b, 2]
+        ref = _ref_attn(q[b, 0], k_full, v_full, L)
+        np.testing.assert_allclose(np.asarray(out[b]).reshape(H, D), ref,
+                                   rtol=1e-4, atol=1e-4)
+    # new token landed in the right physical block slot
+    b = 0
+    pb = block_tables[b, lens[b] // block_size]
+    np.testing.assert_allclose(np.asarray(kc)[pb, lens[b] % block_size],
+                               q[b, 1], rtol=1e-6)
+
+
+def test_block_attention_multi_step_decode():
+    """Three consecutive decode steps stay consistent with a dense cache."""
+    rs = np.random.RandomState(1)
+    B, H, D = 1, 2, 4
+    block_size, max_blocks, num_blocks = 2, 4, 8
+    key_cache = jnp.zeros((num_blocks, block_size, H, D), jnp.float32)
+    value_cache = jnp.zeros((num_blocks, block_size, H, D), jnp.float32)
+    block_tables = jnp.asarray([[5, 2, 7, 0]], jnp.int32)
+    dense_k = np.zeros((max_blocks * block_size, H, D), np.float32)
+    dense_v = np.zeros_like(dense_k)
+    for step in range(3):
+        qkv = rs.randn(B, 3 * H * D).astype(np.float32)
+        lens = jnp.asarray([step], jnp.int32)
+        out, key_cache, value_cache = IF.block_multihead_attention(
+            jnp.asarray(qkv), key_cache, value_cache, lens, block_tables,
+            num_heads=H, head_dim=D)
+        q3 = qkv.reshape(3, H, D)
+        dense_k[step] = q3[1]
+        dense_v[step] = q3[2]
+        ref = _ref_attn(q3[0], dense_k, dense_v, step + 1)
+        np.testing.assert_allclose(np.asarray(out).reshape(H, D), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_variable_length_attention_masks_out_of_range():
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 2, 6, 4
+    q = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+    out = IF.variable_length_memory_efficient_attention(
+        q, k, v, seq_lens=[3, 6], kv_seq_lens=[3, 6])
+    # padded query rows are zeroed
+    np.testing.assert_allclose(np.asarray(out[0, :, 3:]), 0.0)
+    # batch 1 with full length equals plain softmax attention
+    scale = 1.0 / np.sqrt(D)
+    logits = np.einsum("hsd,htd->hst", np.asarray(q[1]), np.asarray(k[1])) * scale
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("hst,htd->hsd", p, np.asarray(v[1]))
+    np.testing.assert_allclose(np.asarray(out[1]), ref, rtol=1e-4, atol=1e-4)
