@@ -156,3 +156,5 @@ def latest_step(root: str) -> Optional[int]:
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_until_finished",
            "save_training_state", "load_training_state", "latest_step"]
+
+from . import auto_checkpoint  # noqa: E402  (TrainEpochRange, LocalFS)
