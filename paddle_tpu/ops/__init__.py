@@ -16,5 +16,6 @@ from .registry import dispatch, register_kernel, backend_kind
 # XLA compositions above remain the "any" fallback and the test oracle.
 try:
     from .pallas import flash_attention as _pallas_flash_attention  # noqa: F401
+    from .pallas import fused_norm as _pallas_fused_norm  # noqa: F401
 except ImportError:  # pragma: no cover — jaxlib without pallas
     pass

@@ -1,0 +1,77 @@
+"""Pallas fused RMSNorm vs XLA reference (interpret mode on CPU): forward
+values, custom_vjp gradients (dx, dw), fallback behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import fused_norm as FN
+from paddle_tpu.ops.norm import _rms_norm_xla
+
+pytestmark = pytest.mark.skipif(not FN._HAS_PLTPU,
+                                reason="pallas tpu frontend unavailable")
+
+
+def _mk(r=512, d=128, dtype=np.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(r, d).astype(dtype))
+    w = jnp.asarray(rs.randn(d).astype(dtype))
+    return x, w
+
+
+def test_forward_matches_xla():
+    x, w = _mk()
+    out = FN.rms_norm_pallas(x, w, 1e-6, interpret=True)
+    ref = _rms_norm_xla(x, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_forward_3d_and_blocking():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, 128, 256).astype(np.float32))
+    w = jnp.asarray(rs.randn(256).astype(np.float32))
+    out = FN.rms_norm_pallas(x, w, 1e-6, block_r=64, interpret=True)
+    ref = _rms_norm_xla(x, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_gradients_match_xla():
+    x, w = _mk(r=256, d=128)
+
+    def loss_pallas(x, w):
+        return (FN.rms_norm_pallas(x, w, 1e-6, interpret=True) ** 2).sum()
+
+    def loss_xla(x, w):
+        return (_rms_norm_xla(x, w, 1e-6) ** 2).sum()
+
+    gx_p, gw_p = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(loss_xla, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_r), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_r), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_bf16_forward():
+    x, w = _mk(dtype=np.float32)
+    xb, wb = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+    out = FN.rms_norm_pallas(xb, wb, 1e-6, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _rms_norm_xla(xb, wb, 1e-6)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_fallback_on_ragged_shape():
+    # D=100 not 128-aligned → must route to XLA, still correct
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 100).astype(np.float32))
+    w = jnp.asarray(rs.randn(100).astype(np.float32))
+    out = FN.rms_norm_pallas(x, w, 1e-6, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_rms_norm_xla(x, w, 1e-6)),
+                               rtol=1e-5, atol=1e-5)
