@@ -41,6 +41,9 @@ from . import vision
 from . import quantization
 from . import incubate
 from . import text
+from . import audio
+from . import geometric
+from . import utils
 from . import profiler
 from . import hapi
 from .hapi import Model

@@ -8,3 +8,5 @@ bf16 while keeping real dynamic loss scaling for fp16 API parity.
 
 from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list
 from .grad_scaler import GradScaler, AmpScaler
+
+from . import debugging  # noqa: E402  (TensorCheckerConfig, check_numerics)

@@ -1,0 +1,100 @@
+"""paddle_tpu.geometric — graph-NN message passing (reference:
+python/paddle/geometric/: message_passing/send_recv.py send_u_recv /
+send_ue_recv, math.py segment_sum/mean/max/min, sampling/neighbors.py).
+
+TPU-native: segment ops map to jax.ops.segment_* (XLA scatter-reduce);
+gather/scatter message passing is dense-indexable so it jits and shards.
+Neighbor sampling is host-side (data-dependent shapes don't belong in jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "sample_neighbors"]
+
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(data, segment_ids, num_segments=n)
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None,
+                 name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                              segment_ids, num_segments=n)
+    return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_max(data, segment_ids, num_segments=n)
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_min(data, segment_ids, num_segments=n)
+
+
+_REDUCERS = {"sum": segment_sum, "add": segment_sum, "mean": segment_mean,
+             "max": segment_max, "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather x at src, reduce onto dst (reference:
+    message_passing/send_recv.py send_u_recv)."""
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    msgs = x[src_index]
+    return fn(msgs, dst_index, num_segments=out_size or x.shape[0])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Node⊕edge message then reduce (reference send_ue_recv):
+    message = x[src] (+|*|-|/) y[edge]."""
+    msgs = x[src_index]
+    ops = {"add": jnp.add, "mul": jnp.multiply, "sub": jnp.subtract,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op must be one of {sorted(ops)}")
+    msgs = ops[message_op](msgs, y)
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return fn(msgs, dst_index, num_segments=out_size or x.shape[0])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     seed: Optional[int] = None):
+    """Uniform neighbor sampling over CSC graph storage (reference:
+    geometric/sampling/neighbors.py). Host-side numpy — output shapes are
+    data-dependent. Returns (edge_src, edge_dst, sample_index)."""
+    rs = np.random.RandomState(seed)
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    srcs, dsts = [], []
+    for node in np.asarray(input_nodes):
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        neigh = row[beg:end]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rs.choice(neigh, size=sample_size, replace=False)
+        srcs.extend(int(v) for v in neigh)
+        dsts.extend([int(node)] * len(neigh))
+    uniq = np.unique(np.concatenate([np.asarray(input_nodes),
+                                     np.asarray(srcs, np.int64)])
+                     if srcs else np.asarray(input_nodes))
+    return (np.asarray(srcs, np.int64), np.asarray(dsts, np.int64), uniq)

@@ -1,0 +1,66 @@
+"""Small utilities: dlpack interop, unique_name (reference:
+python/paddle/utils/{dlpack.py,unique_name.py})."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+
+
+# -- dlpack (reference: utils/dlpack.py to_dlpack/from_dlpack) --------------
+
+def to_dlpack(x):
+    """jax array → dlpack capsule-compatible object (zero copy on device)."""
+    return jax.dlpack.to_dlpack(x) if hasattr(jax.dlpack, "to_dlpack") else x
+
+
+def from_dlpack(capsule):
+    """dlpack → jax array. Accepts any __dlpack__-bearing object (torch,
+    numpy, cupy) per the array-api interchange protocol."""
+    return jax.dlpack.from_dlpack(capsule)
+
+
+# -- unique_name (reference: utils/unique_name.py generate/guard/switch) ----
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key: str) -> str:
+        with self._lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _UniqueNameGenerator()
+_gen_stack = [_generator]
+
+
+def generate(key: str) -> str:
+    return _gen_stack[-1](key)
+
+
+class guard:
+    """Scoped fresh namespace (reference unique_name.guard)."""
+
+    def __init__(self, new_generator=None):
+        self._gen = _UniqueNameGenerator()
+
+    def __enter__(self):
+        _gen_stack.append(self._gen)
+        return self._gen
+
+    def __exit__(self, *exc):
+        _gen_stack.pop()
+        return False
+
+
+def switch(new_generator=None):
+    gen = new_generator or _UniqueNameGenerator()
+    old = _gen_stack[-1]
+    _gen_stack[-1] = gen
+    return old

@@ -1,0 +1,212 @@
+"""utils (flops/download/dlpack/unique_name), amp.debugging, audio features,
+geometric message passing."""
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import audio, geometric
+from paddle_tpu.utils import flops, transformer_flops_per_token
+from paddle_tpu.utils.download import get_path_from_url, DownloadError
+from paddle_tpu.utils.misc import (to_dlpack, from_dlpack, generate, guard)
+import paddle_tpu.amp.debugging as dbg
+
+
+# ---------------------------------------------------------------------------
+# utils
+# ---------------------------------------------------------------------------
+
+def test_flops_table():
+    assert flops("matmul", {"X": [4, 8], "Y": [8, 16]}) == 2 * 4 * 8 * 16
+    assert flops("matmul", {"X": [2, 4, 8], "Y": [8, 16]},
+                 {"transpose_y": False}) == 2 * 2 * 4 * 8 * 16
+    c = flops("conv2d", {"Input": [1, 3, 8, 8], "Filter": [16, 3, 3, 3]},
+              {"strides": [1, 1], "paddings": [1, 1]})
+    assert c == 2 * 1 * 16 * 8 * 8 * 3 * 3 * 3
+    assert flops("relu", {"X": [4, 4]}) == 16
+    assert flops("unknown_op") == 0
+    # 6N dominates for big models
+    f = transformer_flops_per_token(8e9, 32, 4096, 4096)
+    assert f > 6 * 8e9
+
+
+def test_download_cache_and_mirror(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HOME", str(tmp_path / "home"))
+    url = "https://example.com/weights/model.pdparams"
+    with pytest.raises(DownloadError):
+        get_path_from_url(url)
+    # mirror resolution
+    mirror = tmp_path / "mirror"
+    mirror.mkdir()
+    (mirror / "model.pdparams").write_bytes(b"W" * 100)
+    monkeypatch.setenv("PADDLE_TPU_MIRROR", str(mirror))
+    p = get_path_from_url(url)
+    assert os.path.exists(p)
+    # now cached — works without the mirror
+    monkeypatch.delenv("PADDLE_TPU_MIRROR")
+    assert get_path_from_url(url) == p
+
+
+def test_dlpack_import():
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    y = from_dlpack(src)  # numpy → jax via __dlpack__ protocol
+    np.testing.assert_array_equal(np.asarray(y), src)
+    t = __import__("torch").arange(4)
+    y2 = from_dlpack(t)   # torch (cpu) → jax
+    np.testing.assert_array_equal(np.asarray(y2), t.numpy())
+
+
+def test_unique_name():
+    a, b = generate("fc"), generate("fc")
+    assert a != b and a.startswith("fc_")
+    with guard():
+        assert generate("fc") == "fc_0"
+
+
+# ---------------------------------------------------------------------------
+# amp.debugging
+# ---------------------------------------------------------------------------
+
+def test_check_numerics_raises_eager():
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(jnp.asarray([1.0, jnp.nan]), "op", "x")
+    out = dbg.check_numerics(jnp.asarray([1.0, 2.0]), "op", "x")
+    np.testing.assert_array_equal(np.asarray(out), [1.0, 2.0])
+    # int tensors pass through untouched
+    dbg.check_numerics(jnp.asarray([1, 2]), "op", "ids")
+
+
+def test_check_numerics_traced_does_not_crash():
+    @jax.jit
+    def f(x):
+        return dbg.check_numerics(x * 2, "mul", "y")
+    np.testing.assert_array_equal(np.asarray(f(jnp.ones(3))), 2.0)
+
+
+def test_collect_operator_stats(capsys):
+    with dbg.collect_operator_stats() as stats:
+        dbg.record_op_dtype(jnp.bfloat16)
+        dbg.record_op_dtype(jnp.float32)
+        dbg.record_op_dtype(jnp.bfloat16)
+    out = capsys.readouterr().out
+    assert "bfloat16" in out
+    assert stats.counts["bfloat16"] == 2
+
+
+def test_compare_accuracy(tmp_path):
+    a = {"w": np.ones(4, np.float32), "b": np.zeros(2, np.float32)}
+    b = {"w": np.ones(4, np.float32) * 1.001, "b": np.zeros(2, np.float32)}
+    np.savez(tmp_path / "a.npz", **a)
+    np.savez(tmp_path / "b.npz", **b)
+    rows = dbg.compare_accuracy(str(tmp_path / "a.npz"),
+                                str(tmp_path / "b.npz"),
+                                str(tmp_path / "cmp.csv"))
+    assert len(rows) == 2
+    w_row = [r for r in rows if r[0] == "w"][0]
+    assert abs(w_row[4] - 0.001) < 1e-5
+    assert os.path.exists(tmp_path / "cmp.csv")
+
+
+# ---------------------------------------------------------------------------
+# audio
+# ---------------------------------------------------------------------------
+
+def test_windows_match_scipy_conventions():
+    w = audio.functional.get_window("hann", 8)
+    # periodic hann: w[0] == 0, symmetric around n/2
+    assert float(w[0]) == 0.0
+    np.testing.assert_allclose(float(w[4]), 1.0, atol=1e-6)
+    with pytest.raises(ValueError):
+        audio.functional.get_window("nope", 8)
+
+
+def test_mel_conversion_roundtrip():
+    f = jnp.asarray([100.0, 440.0, 4000.0])
+    np.testing.assert_allclose(
+        np.asarray(audio.functional.mel_to_hz(audio.functional.hz_to_mel(f))),
+        np.asarray(f), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(audio.functional.mel_to_hz(
+            audio.functional.hz_to_mel(f, htk=True), htk=True)),
+        np.asarray(f), rtol=1e-4)
+
+
+def test_stft_parsevalish_and_shapes():
+    sr, n_fft, hop = 16000, 256, 64
+    t = jnp.arange(sr // 10) / sr
+    x = jnp.sin(2 * math.pi * 1000 * t)          # 1 kHz tone
+    spec = audio.functional.stft(x, n_fft=n_fft, hop_length=hop)
+    assert spec.shape[0] == n_fft // 2 + 1
+    mag = jnp.abs(spec) ** 2
+    # energy concentrates at the 1 kHz bin
+    peak_bin = int(jnp.argmax(mag.mean(axis=-1)))
+    expect_bin = round(1000 * n_fft / sr)
+    assert abs(peak_bin - expect_bin) <= 1
+
+
+def test_feature_layers_shapes():
+    pt.seed(0)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(x)
+    assert spec.shape[0] == 2 and spec.shape[1] == 129
+    mel = audio.MelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+    assert mel.shape[1] == 32
+    logmel = audio.LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+    assert jnp.isfinite(logmel).all()
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+    assert mfcc.shape[1] == 13
+
+
+# ---------------------------------------------------------------------------
+# geometric
+# ---------------------------------------------------------------------------
+
+def test_segment_ops():
+    data = jnp.asarray([[1.0], [2.0], [3.0], [4.0]])
+    ids = jnp.asarray([0, 0, 1, 1])
+    np.testing.assert_allclose(np.asarray(geometric.segment_sum(data, ids)),
+                               [[3.0], [7.0]])
+    np.testing.assert_allclose(np.asarray(geometric.segment_mean(data, ids)),
+                               [[1.5], [3.5]])
+    np.testing.assert_allclose(np.asarray(geometric.segment_max(data, ids)),
+                               [[2.0], [4.0]])
+    np.testing.assert_allclose(np.asarray(geometric.segment_min(data, ids)),
+                               [[1.0], [3.0]])
+
+
+def test_send_u_recv():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    src = jnp.asarray([0, 1, 2, 0])
+    dst = jnp.asarray([1, 2, 1, 0])
+    out = geometric.send_u_recv(x, src, dst, "sum")
+    np.testing.assert_allclose(np.asarray(out),
+                               [[1.0, 2.0], [6.0, 8.0], [3.0, 4.0]])
+    with pytest.raises(ValueError):
+        geometric.send_u_recv(x, src, dst, "bogus")
+
+
+def test_send_ue_recv_and_grad():
+    x = jnp.asarray([[1.0], [2.0], [3.0]])
+    e = jnp.asarray([[10.0], [20.0], [30.0]])
+    src = jnp.asarray([0, 1, 2])
+    dst = jnp.asarray([1, 1, 0])
+    out = geometric.send_ue_recv(x, e, src, dst, "mul", "sum")
+    np.testing.assert_allclose(np.asarray(out), [[90.0], [50.0], [0.0]])
+    g = jax.grad(lambda x: geometric.send_u_recv(x, src, dst, "sum").sum())(x)
+    assert g.shape == x.shape
+
+
+def test_sample_neighbors():
+    # CSC: node0 ← {1,2}, node1 ← {0}, node2 ← {0,1}
+    row = np.asarray([1, 2, 0, 0, 1])
+    colptr = np.asarray([0, 2, 3, 5])
+    src, dst, uniq = geometric.sample_neighbors(row, colptr, [0, 2],
+                                                sample_size=1, seed=0)
+    assert len(src) == 2 and len(dst) == 2
+    assert set(dst) == {0, 2}
+    assert all(u in uniq for u in [0, 2])
