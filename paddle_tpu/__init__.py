@@ -40,6 +40,7 @@ from . import distribution
 from . import vision
 from . import quantization
 from . import incubate
+from . import inference
 from . import text
 from . import audio
 from . import geometric

@@ -1,0 +1,99 @@
+"""LLM generation loop over the KV-cache decode path (reference analogue:
+PaddleNLP's generation utils driving the fused/block attention kernels;
+in-repo kernels masked_multihead_attention / block_multi_head_attention).
+
+TPU-native: prefill compiles once for the padded prompt length, the decode
+step compiles once (static cache shape, dynamic position index), and the
+token loop runs on host while all math stays on device. Sampling strategies:
+greedy, temperature, top-k, top-p — each a pure function over logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+
+def _sample_logits(logits, cfg: GenerationConfig, key):
+    """[b, vocab] → [b] next tokens."""
+    if not cfg.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; always keep the best
+        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, generation_config: GenerationConfig = None,
+             **kwargs) -> jnp.ndarray:
+    """Autoregressive generation for models exposing
+    ``model.prefill(ids, max_len)`` / ``model.decode_step(tok, pos, caches)``
+    (LlamaModel contract) with a ``logits(hidden)`` head on the wrapper.
+
+    Returns [b, prompt + max_new_tokens] token ids (prompt included,
+    reference generate() convention).
+    """
+    cfg = generation_config or GenerationConfig(**kwargs)
+    input_ids = jnp.asarray(input_ids)
+    b, prompt_len = input_ids.shape
+    max_len = prompt_len + cfg.max_new_tokens
+
+    core = getattr(model, "model", model)   # LlamaForCausalLM → LlamaModel
+    head = model.logits if hasattr(model, "logits") else (lambda h: h)
+
+    hidden, caches = core.prefill(input_ids, max_len)
+    logits = head(hidden[:, -1, :])
+    key = jax.random.PRNGKey(cfg.seed)
+
+    decode = getattr(model, "_compiled_decode", None)
+    if decode is None:
+        def _step(tok, pos, caches):
+            h, caches = core.decode_step(tok, pos, caches)
+            return head(h[:, 0, :]), caches
+        decode = _step
+
+    tokens = [input_ids]
+    finished = jnp.zeros((b,), bool)
+    for i in range(cfg.max_new_tokens):
+        key, sub = jax.random.split(key)
+        next_tok = _sample_logits(logits.astype(jnp.float32), cfg, sub)
+        if cfg.eos_token_id is not None:
+            next_tok = jnp.where(finished, cfg.pad_token_id, next_tok)
+            finished = finished | (next_tok == cfg.eos_token_id)
+        tokens.append(next_tok[:, None])
+        if cfg.eos_token_id is not None and bool(finished.all()):
+            pad = jnp.full((b, cfg.max_new_tokens - i - 1), cfg.pad_token_id,
+                           input_ids.dtype)
+            if pad.shape[1]:
+                tokens.append(pad)
+            break
+        if i < cfg.max_new_tokens - 1:
+            pos = jnp.full((b,), prompt_len + i, jnp.int32)
+            logits, caches = decode(next_tok, pos, caches)
+    return jnp.concatenate(tokens, axis=1)

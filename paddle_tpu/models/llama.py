@@ -126,6 +126,63 @@ class LlamaAttention(nn.Layer):
         out = out.reshape(b, s, n_h * hd)
         return jnp.matmul(out, self.o_proj.astype(x.dtype))
 
+    # -- KV-cache inference paths ------------------------------------------
+
+    def prefill(self, x, cos, sin, max_len: int):
+        """Full-sequence forward that also materializes a dense KV cache
+        [b, max_len, n_kv, hd] holding the prompt's keys/values (inference
+        analogue of the reference's fused multi-transformer prefill)."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
+        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
+        q = q.reshape(b, s, n_h, hd)
+        k = k.reshape(b, s, n_kv, hd)
+        v = v.reshape(b, s, n_kv, hd)
+        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos[:s], sin[:s])
+        from ..ops.attention import _sdpa_xla
+        out = _sdpa_xla(q, k, v, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+        k_cache = jnp.zeros((b, max_len, n_kv, hd), k.dtype).at[:, :s].set(k)
+        v_cache = jnp.zeros((b, max_len, n_kv, hd), v.dtype).at[:, :s].set(v)
+        return out, (k_cache, v_cache)
+
+    def decode(self, x, cos, sin, pos, kv_cache):
+        """One-token step: x [b, 1, d], pos [b] current position; scatters
+        the new k/v into the cache and attends over positions <= pos
+        (dense-cache decode, reference masked_multihead_attention shape)."""
+        cfg = self.cfg
+        b = x.shape[0]
+        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        k_cache, v_cache = kv_cache
+        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
+        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
+        q = q.reshape(b, 1, n_h, hd)
+        k = k.reshape(b, 1, n_kv, hd)
+        v = v.reshape(b, 1, n_kv, hd)
+        pos_ids = pos.reshape(b, 1)
+        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, pos_ids)
+        b_idx = jnp.arange(b)
+        k_cache = k_cache.at[b_idx, pos].set(k[:, 0])
+        v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
+        if n_kv != n_h:
+            rep = n_h // n_kv
+            k_full = jnp.repeat(k_cache, rep, axis=2)
+            v_full = jnp.repeat(v_cache, rep, axis=2)
+        else:
+            k_full, v_full = k_cache, v_cache
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum("bhd,bthd->bht", q[:, 0].astype(jnp.float32),
+                            k_full.astype(jnp.float32)) * scale
+        t_idx = jnp.arange(k_cache.shape[1])[None, None, :]
+        logits = jnp.where(t_idx <= pos[:, None, None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bht,bthd->bhd", p, v_full.astype(jnp.float32))
+        out = out.astype(x.dtype).reshape(b, 1, n_h * hd)
+        return jnp.matmul(out, self.o_proj.astype(x.dtype)), (k_cache, v_cache)
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -161,6 +218,18 @@ class LlamaDecoderLayer(nn.Layer):
         h = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids,
                                attn_mask)
         return h + self.mlp(self.post_attention_layernorm(h))
+
+    def prefill(self, x, cos, sin, max_len: int):
+        a, cache = self.self_attn.prefill(self.input_layernorm(x), cos, sin,
+                                          max_len)
+        h = x + a
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
+
+    def decode(self, x, cos, sin, pos, kv_cache):
+        a, cache = self.self_attn.decode(self.input_layernorm(x), cos, sin,
+                                         pos, kv_cache)
+        h = x + a
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
 
 
 class LlamaModel(nn.Layer):
@@ -210,6 +279,27 @@ class LlamaModel(nn.Layer):
             for layer in self.layers:
                 x = self._seq_shard(layer(x, cos, sin, position_ids, attn_mask))
         return self.norm(x)
+
+    # -- KV-cache inference paths ------------------------------------------
+
+    def prefill(self, input_ids, max_len: int):
+        """Prompt pass returning (hidden, caches): caches is a list of
+        per-layer (k_cache, v_cache) sized to max_len."""
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        caches = []
+        for layer in self.layers:
+            x, cache = layer.prefill(x, self.rope_cos, self.rope_sin, max_len)
+            caches.append(cache)
+        return self.norm(x), caches
+
+    def decode_step(self, token_ids, pos, caches):
+        """token_ids [b] → (hidden [b, 1, d], caches) one position forward."""
+        x = jnp.take(self.embed_tokens, token_ids[:, None], axis=0)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.decode(x, self.rope_cos, self.rope_sin, pos, cache)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
