@@ -41,6 +41,7 @@ from . import vision
 from . import quantization
 from . import incubate
 from . import inference
+from . import linalg
 from . import text
 from . import audio
 from . import geometric

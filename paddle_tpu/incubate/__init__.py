@@ -5,5 +5,6 @@ reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
 """
 
 from . import nn
+from . import asp
 
-__all__ = ["nn"]
+__all__ = ["nn", "asp"]

@@ -1,0 +1,99 @@
+"""paddle_tpu.linalg — linear-algebra namespace (reference:
+python/paddle/linalg.py re-exporting tensor/linalg.py). Dense decompositions
+lower to XLA's native QR/SVD/Eig kernels."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import (norm, matrix_power, cholesky, inverse as inv, pinv,
+                     solve, svd, qr, eigh, det, slogdet, matrix_rank)
+
+__all__ = [
+    "norm", "matrix_power", "cholesky", "inv", "pinv", "solve", "svd", "qr",
+    "eigh", "det", "slogdet", "matrix_rank", "eig", "eigvals", "eigvalsh",
+    "lstsq", "lu", "triangular_solve", "cholesky_solve", "multi_dot", "cov",
+    "corrcoef", "matmul", "cross", "dot", "householder_product",
+]
+
+inverse = inv
+
+
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
+    import jax.scipy.linalg as jsl
+    lu_mat, piv = jsl.lu_factor(x)
+    if get_infos:
+        return lu_mat, piv, jnp.zeros((), jnp.int32)
+    return lu_mat, piv
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(x, y, lower=not upper, trans=int(transpose),
+                                unit_diagonal=unitriangular)
+
+
+def cholesky_solve(x, y, upper: bool = False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.cho_solve((y, not upper), x)
+
+
+def multi_dot(arrays, name=None):
+    return jnp.linalg.multi_dot(arrays)
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar: bool = True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def cross(x, y, axis: int = 9, name=None):
+    axis = -1 if axis == 9 else axis
+    return jnp.cross(x, y, axis=axis)
+
+
+def dot(x, y, name=None):
+    return jnp.dot(x, y)
+
+
+def householder_product(x, tau, name=None):
+    """Q from householder reflectors (geqrf convention)."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros((i,), x.dtype), jnp.ones((1,), x.dtype),
+                             x[..., i + 1:, i]])
+        q = q - tau[..., i] * (q @ v[:, None]) @ v[None, :]
+    return q[..., :, :n] if m >= n else q

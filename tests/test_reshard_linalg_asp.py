@@ -1,0 +1,164 @@
+"""Reshard-function registry (placement-pair transitions incl. Partial
+collectives), linalg namespace, ASP 2:4 sparsity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import linalg
+from paddle_tpu.parallel.api import Shard, Replicate, Partial
+from paddle_tpu.parallel.reshard import (choose_reshard_function,
+                                         reshard_with_registry,
+                                         SToRReshardFunction,
+                                         PToRReshardFunction)
+from paddle_tpu.incubate import asp
+
+
+def _mesh2d():
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    return Mesh(devs, ("x", "y"))
+
+
+# ---------------------------------------------------------------------------
+# reshard registry
+# ---------------------------------------------------------------------------
+
+def test_registry_selection():
+    assert isinstance(choose_reshard_function(Shard(0), Replicate()),
+                      SToRReshardFunction)
+    assert isinstance(choose_reshard_function(Partial(), Replicate()),
+                      PToRReshardFunction)
+    with pytest.raises(NotImplementedError):
+        choose_reshard_function(Partial(), Partial())
+
+
+def test_s_to_r_and_r_to_s():
+    mesh = _mesh2d()
+    x = jnp.arange(16.0).reshape(4, 4)
+    out = reshard_with_registry(x, mesh, [Shard(0), Replicate()],
+                                [Replicate(), Replicate()])
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert out.sharding.spec == P(None, None) or out.sharding.spec == P()
+    out2 = reshard_with_registry(x, mesh, [Replicate(), Replicate()],
+                                 [Shard(0), Shard(1)])
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x))
+    assert "x" in str(out2.sharding.spec) and "y" in str(out2.sharding.spec)
+
+
+def test_s_to_s_all_to_all():
+    mesh = _mesh2d()
+    x = jnp.arange(16.0).reshape(4, 4)
+    out = reshard_with_registry(x, mesh, [Shard(0), Replicate()],
+                                [Shard(1), Replicate()])
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    spec = out.sharding.spec
+    assert spec[0] in (None,) and spec[1] == "x"
+
+
+def test_p_to_r_allreduce():
+    """Partial values across the axis must sum on reshard to Replicate."""
+    mesh = _mesh2d()
+    x = jnp.ones((4, 4))
+    out = reshard_with_registry(x, mesh, [Partial(), Replicate()],
+                                [Replicate(), Replicate()])
+    # each of the 2 shards along x held ones → psum = 2
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones((4, 4)))
+
+
+def test_r_to_p_then_p_to_r_roundtrip():
+    mesh = _mesh2d()
+    x = jnp.arange(8.0).reshape(2, 4)
+    p = reshard_with_registry(x, mesh, [Replicate(), Replicate()],
+                              [Partial(), Replicate()])
+    back = reshard_with_registry(p, mesh, [Partial(), Replicate()],
+                                 [Replicate(), Replicate()])
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_p_to_s_reduce_scatter():
+    mesh = _mesh2d()
+    x = jnp.ones((4, 4))
+    out = reshard_with_registry(x, mesh, [Partial(), Replicate()],
+                                [Shard(0), Replicate()])
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones((4, 4)))
+    assert out.sharding.spec[0] == "x"
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def test_linalg_decompositions():
+    rs = np.random.RandomState(0)
+    a = rs.randn(6, 4).astype(np.float32)
+    u, s, vh = linalg.svd(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(u @ jnp.diag(s) @ vh), a, atol=1e-4)
+    q, r = linalg.qr(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(q @ r), a, atol=1e-4)
+    spd = a.T @ a + 4 * np.eye(4, dtype=np.float32)
+    l = linalg.cholesky(jnp.asarray(spd))
+    np.testing.assert_allclose(np.asarray(l @ l.T), spd, atol=1e-3)
+    np.testing.assert_allclose(float(linalg.det(jnp.asarray(spd))),
+                               np.linalg.det(spd), rtol=1e-3)
+
+
+def test_linalg_solvers():
+    rs = np.random.RandomState(1)
+    a = rs.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    b = rs.randn(4, 2).astype(np.float32)
+    x = linalg.solve(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(a @ x), b, atol=1e-3)
+    sol, _, _, _ = linalg.lstsq(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(sol), np.asarray(x), atol=1e-3)
+    ut = jnp.asarray(np.triu(a))
+    y = linalg.triangular_solve(ut, jnp.asarray(b), upper=True)
+    np.testing.assert_allclose(np.asarray(ut @ y), b, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(linalg.multi_dot([jnp.asarray(a), jnp.asarray(a), x])),
+        a @ a @ np.asarray(x), rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# ASP
+# ---------------------------------------------------------------------------
+
+def test_create_mask_2_4():
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(8, 16).astype(np.float32))
+    mask = asp.create_mask(w)
+    assert asp.check_sparsity(np.asarray(w * mask))
+    assert abs(asp.calculate_density(np.asarray(mask)) - 0.5) < 1e-6
+    # keeps the largest-magnitude entries
+    g = np.abs(np.asarray(w)).reshape(8, 4, 4)
+    kept = np.asarray(mask).reshape(8, 4, 4).astype(bool)
+    for i in range(8):
+        for j in range(4):
+            topk = set(np.argsort(-g[i, j])[:2])
+            assert set(np.where(kept[i, j])[0]) == topk
+    with pytest.raises(ValueError):
+        asp.create_mask(jnp.ones((4, 6)))
+
+
+def test_prune_model_and_sticky_masks():
+    pt.seed(0)
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.autograd import layer_grad
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    helper = asp.ASPHelper(model)
+    helper.prune()
+    w0 = np.asarray(model[0].weight)
+    assert asp.check_sparsity(w0.T) or asp.check_sparsity(w0)
+    o = asp.decorate(opt.SGD(learning_rate=0.1, parameters=model),
+                     model=model)
+    o.helper = helper
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    for _ in range(3):
+        loss, grads = layer_grad(model, lambda out: (out ** 2).mean(), x)
+        o.step(grads)
+    # sparsity pattern survived training steps
+    w_after = np.asarray(model[0].weight)
+    mask = np.asarray(helper.masks["0.weight"])
+    np.testing.assert_array_equal(w_after * (1 - mask), 0.0)
