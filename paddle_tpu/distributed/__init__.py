@@ -47,3 +47,4 @@ __all__ = [
 from . import launch  # noqa: E402
 from . import elastic  # noqa: E402
 from . import auto_tuner  # noqa: E402
+from . import rpc  # noqa: E402

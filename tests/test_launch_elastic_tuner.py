@@ -170,3 +170,65 @@ def test_recorder_best_none_when_all_failed():
     r = Recorder()
     r.add({"a": 1}, None, error="boom")
     assert r.best() is None
+
+
+# ---------------------------------------------------------------------------
+# rpc
+# ---------------------------------------------------------------------------
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+@pytest.mark.skipif(not nat.is_available(), reason="native lib unavailable")
+def test_rpc_single_process_loopback():
+    from paddle_tpu.distributed import rpc
+    rpc.init_rpc("worker0", rank=0, world_size=1)
+    try:
+        info = rpc.get_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        assert rpc.rpc_sync("worker0", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("worker0", _add, args=(10, 20))
+        assert fut.result(timeout=30) == 30
+        with pytest.raises(ValueError, match="remote failure"):
+            rpc.rpc_sync("worker0", _boom)
+        assert len(rpc.get_all_worker_infos()) == 1
+    finally:
+        rpc.shutdown()
+
+
+def _rpc_child(master_port, q):
+    from paddle_tpu.distributed import rpc
+    rpc.init_rpc("w1", rank=1, world_size=2,
+                 master_endpoint=f"127.0.0.1:{master_port}")
+    # call back into the parent worker
+    q.put(rpc.rpc_sync("w0", _add, args=(7, 8)))
+    rpc.shutdown()
+
+
+@pytest.mark.skipif(not nat.is_available(), reason="native lib unavailable")
+def test_rpc_cross_process():
+    import multiprocessing as mp
+    from paddle_tpu import native
+    from paddle_tpu.distributed import rpc
+    # pre-bind a store port for the job
+    probe = native.TCPStore(is_master=True)
+    port = probe.port
+    probe.close()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_rpc_child, args=(port, q))
+    p.start()
+    rpc.init_rpc("w0", rank=0, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        assert q.get(timeout=60) == 15
+        assert rpc.rpc_sync("w1", _add, args=(1, 1)) == 2
+    finally:
+        rpc.shutdown()
+        p.join(timeout=30)
+    assert p.exitcode == 0
