@@ -8,3 +8,4 @@ from .ernie import ErnieConfig, ErnieForCausalLM
 from .dit import DiTConfig, DiT, DiTBlock, timestep_embedding
 from .vision import (ResNet, resnet18, resnet50, OCRRecConfig, OCRRecModel,
                      OCRDetModel, DBHead)
+from . import diffusion  # noqa: E402  (DDPM/DDIM/rectified-flow schedulers)
