@@ -698,3 +698,266 @@ def kthvalue(x, k, axis=-1, keepdim=False):
         val = jnp.expand_dims(val, axis)
         ind = jnp.expand_dims(ind, axis)
     return val, ind
+
+
+# -- breadth batch 2 (reference: python/paddle/tensor/{math,manipulation,
+#    search,stat}.py — long-tail op surface) --------------------------------
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
+                           side=side)
+    return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                        keepdims=keepdim, method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                           keepdims=keepdim, method=interpolation)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(jnp.asarray(y), jnp.asarray(x), axis=axis)
+    return jnp.trapezoid(jnp.asarray(y), dx=dx if dx is not None else 1.0,
+                         axis=axis)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    h, edges = jnp.histogramdd(jnp.asarray(x), bins=bins, range=ranges,
+                               density=density, weights=weights)
+    return h, edges
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is not None:
+        raise NotImplementedError("unique_consecutive over an axis: flatten "
+                                  "first (host-side ragged output)")
+    flat = arr.reshape(-1)
+    # data-dependent output size — host-side like the reference's CPU path
+    import numpy as _np
+    a = _np.asarray(flat)
+    if a.size == 0:
+        outs = [jnp.asarray(a)]
+        if return_inverse:
+            outs.append(jnp.asarray([], jnp.int64))
+        if return_counts:
+            outs.append(jnp.asarray([], jnp.int64))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    change = _np.concatenate([[True], a[1:] != a[:-1]])
+    uniq = a[change]
+    outs = [jnp.asarray(uniq)]
+    if return_inverse:
+        outs.append(jnp.asarray(_np.cumsum(change) - 1, jnp.int64))
+    if return_counts:
+        idx = _np.flatnonzero(change)
+        outs.append(jnp.asarray(_np.diff(_np.append(idx, a.size)), jnp.int64))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = jnp.asarray(x)
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    import builtins
+    x = jnp.asarray(x)
+    n = builtins.min(x.shape[axis1], x.shape[axis2])  # min() op shadows builtin
+    i = jnp.arange(n - builtins.abs(offset))
+    rows = i if offset >= 0 else i - offset
+    cols = i + offset if offset >= 0 else i
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    moved = moved.at[rows, cols].set(y)
+    return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    import builtins
+    x = jnp.asarray(x)
+    idx = [builtins.slice(None)] * x.ndim  # module-level slice() op shadows it
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, arr, axis=axis)
+    # index of the running argmax
+    eq = arr == vals
+    pos = jnp.arange(arr.shape[axis]).reshape(
+        [-1 if i == (axis % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, pos, -1),
+                                   axis=axis)
+    return vals, idx.astype(dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, arr, axis=axis)
+    eq = arr == vals
+    pos = jnp.arange(arr.shape[axis]).reshape(
+        [-1 if i == (axis % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, pos, -1),
+                                   axis=axis)
+    return vals, idx.astype(dtype)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    arr = jnp.asarray(x, dtype=dtype)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, arr, axis=axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    arr = jnp.asarray(x)
+    moved = jnp.moveaxis(arr, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12),
+                      1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(jnp.asarray(x))
+    return m, e.astype(jnp.int32)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    return x + jnp.asarray(weight) * (y - x)
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(jnp.asarray(x), jnp.asarray(y))
+
+
+def nextafter(x, y, name=None):
+    return jnp.nextafter(jnp.asarray(x), jnp.asarray(y))
+
+
+def copysign(x, y, name=None):
+    return jnp.copysign(jnp.asarray(x), jnp.asarray(y))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(jnp.asarray(x), jnp.asarray(y))
+
+
+def hypot(x, y, name=None):
+    return jnp.hypot(jnp.asarray(x), jnp.asarray(y))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools as _it
+    import numpy as _np
+    a = _np.asarray(x).reshape(-1)
+    gen = (_it.combinations_with_replacement(range(a.size), r)
+           if with_replacement else _it.combinations(range(a.size), r))
+    idx = _np.asarray(list(gen), dtype=_np.int64).reshape(-1, r)
+    return jnp.asarray(a)[idx]
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis (reference Tensor.unfold)."""
+    arr = jnp.asarray(x)
+    n = (arr.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]      # [n, size]
+    out = jnp.take(arr, idx.reshape(-1), axis=axis)
+    shape = list(arr.shape)
+    shape[axis:axis + 1] = [n, size]
+    out = out.reshape(shape)
+    # paddle puts the window dim last
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.block_diag(*[jnp.asarray(a) for a in inputs])
+
+
+def cartesian_prod(x, name=None):
+    arrs = [jnp.asarray(a).reshape(-1) for a in x]
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    arr = jnp.asarray(x)
+    n = arr.shape[-1] + abs(offset)
+    out_shape = arr.shape[:-1] + (n, n)
+    out = jnp.zeros(out_shape, arr.dtype)
+    i = jnp.arange(arr.shape[-1])
+    rows = i if offset >= 0 else i - offset
+    cols = i + offset if offset >= 0 else i
+    out = out.at[..., rows, cols].set(arr)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out

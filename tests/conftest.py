@@ -14,6 +14,10 @@ import os
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the sitecustomize hook registers the axon PJRT plugin whenever this is
+# set; when the TPU tunnel is wedged, even plugin *registration* blocks for
+# minutes — drop it entirely, tests are CPU-only (spawned workers inherit)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 

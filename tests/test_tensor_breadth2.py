@@ -1,0 +1,140 @@
+"""Long-tail tensor ops (breadth batch 2) vs numpy references."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+RS = np.random.RandomState(3)
+
+
+def test_searchsorted_bucketize():
+    seq = jnp.asarray([1.0, 3.0, 5.0, 7.0])
+    vals = jnp.asarray([0.0, 3.0, 6.0, 9.0])
+    np.testing.assert_array_equal(np.asarray(pt.searchsorted(seq, vals)),
+                                  np.searchsorted([1, 3, 5, 7], [0, 3, 6, 9]))
+    np.testing.assert_array_equal(
+        np.asarray(pt.searchsorted(seq, vals, right=True)),
+        np.searchsorted([1, 3, 5, 7], [0, 3, 6, 9], side="right"))
+    np.testing.assert_array_equal(np.asarray(pt.bucketize(vals, seq)),
+                                  np.searchsorted([1, 3, 5, 7], [0, 3, 6, 9]))
+
+
+def test_quantile_family():
+    x = RS.randn(5, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pt.quantile(jnp.asarray(x), 0.5)),
+                               np.quantile(x, 0.5), rtol=1e-5)
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    np.testing.assert_allclose(
+        np.asarray(pt.nanquantile(jnp.asarray(xn), 0.25, axis=1)),
+        np.nanquantile(xn, 0.25, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.nanmedian(jnp.asarray(xn))),
+                               np.nanmedian(xn), rtol=1e-5)
+
+
+def test_cummax_cummin_logcumsumexp():
+    x = jnp.asarray([3.0, 1.0, 4.0, 1.0, 5.0])
+    v, i = pt.cummax(x)
+    np.testing.assert_array_equal(np.asarray(v), [3, 3, 4, 4, 5])
+    np.testing.assert_array_equal(np.asarray(i), [0, 0, 2, 2, 4])
+    v2, i2 = pt.cummin(x)
+    np.testing.assert_array_equal(np.asarray(v2), [3, 1, 1, 1, 1])
+    # tie convention (paddle/torch): latest index attaining the running min
+    np.testing.assert_array_equal(np.asarray(i2), [0, 1, 1, 3, 3])
+    arr = RS.randn(6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pt.logcumsumexp(jnp.asarray(arr))),
+        np.log(np.cumsum(np.exp(arr))), rtol=1e-4)
+    # 2d over axis
+    m = RS.randn(3, 4).astype(np.float32)
+    vv, ii = pt.cummax(jnp.asarray(m), axis=1)
+    np.testing.assert_allclose(np.asarray(vv), np.maximum.accumulate(m, 1))
+
+
+def test_scatter_family():
+    x = jnp.zeros((3, 4))
+    out = pt.select_scatter(x, jnp.ones(4), axis=0, index=1)
+    np.testing.assert_array_equal(np.asarray(out[1]), 1.0)
+    d = pt.diagonal_scatter(jnp.zeros((3, 3)), jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_array_equal(np.asarray(d), np.diag([1.0, 2.0, 3.0]))
+    ip = pt.index_put(jnp.zeros(5), (jnp.asarray([1, 3]),),
+                      jnp.asarray([7.0, 8.0]))
+    np.testing.assert_array_equal(np.asarray(ip), [0, 7, 0, 8, 0])
+    ip2 = pt.index_put(jnp.zeros(3), (jnp.asarray([0, 0]),),
+                       jnp.asarray([1.0, 1.0]), accumulate=True)
+    assert float(ip2[0]) == 2.0
+
+
+def test_unique_consecutive():
+    u, inv, cnt = pt.unique_consecutive(
+        jnp.asarray([1, 1, 2, 2, 2, 3, 1]), return_inverse=True,
+        return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u), [1, 2, 3, 1])
+    np.testing.assert_array_equal(np.asarray(cnt), [2, 3, 1, 1])
+    np.testing.assert_array_equal(np.asarray(inv), [0, 0, 1, 1, 1, 2, 3])
+
+
+def test_elementwise_pairs():
+    x = RS.randn(8).astype(np.float32)
+    y = RS.randn(8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pt.logaddexp(jnp.asarray(x),
+                                                       jnp.asarray(y))),
+                               np.logaddexp(x, y), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.hypot(jnp.asarray(x),
+                                                   jnp.asarray(y))),
+                               np.hypot(x, y), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pt.copysign(jnp.asarray(x),
+                                                      jnp.asarray(y))),
+                               np.copysign(x, y))
+    np.testing.assert_allclose(np.asarray(pt.lerp(jnp.asarray(x),
+                                                  jnp.asarray(y), 0.3)),
+                               x + 0.3 * (y - x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pt.heaviside(jnp.asarray(x),
+                                                       jnp.asarray(y))),
+                               np.heaviside(x, y))
+    m, e = pt.frexp(jnp.asarray([8.0, 0.5]))
+    np.testing.assert_allclose(np.asarray(m) * 2.0 ** np.asarray(e),
+                               [8.0, 0.5])
+
+
+def test_structure_builders():
+    np.testing.assert_allclose(np.asarray(pt.vander(jnp.asarray([1.0, 2.0]),
+                                                    n=3)),
+                               np.vander([1.0, 2.0], 3))
+    bd = pt.block_diag([jnp.ones((1, 1)), 2 * jnp.ones((2, 2))])
+    assert bd.shape == (3, 3) and float(bd[0, 0]) == 1 and float(bd[2, 2]) == 2
+    cp = pt.cartesian_prod([jnp.asarray([1, 2]), jnp.asarray([3, 4, 5])])
+    assert cp.shape == (6, 2)
+    de = pt.diag_embed(jnp.asarray([[1.0, 2.0]]))
+    assert de.shape == (1, 2, 2) and float(de[0, 1, 1]) == 2.0
+    comb = pt.combinations(jnp.asarray([1, 2, 3]), r=2)
+    np.testing.assert_array_equal(np.asarray(comb), [[1, 2], [1, 3], [2, 3]])
+
+
+def test_unfold_and_tensordot():
+    out = pt.unfold(jnp.arange(7.0), 0, 3, 2)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [[0, 1, 2], [2, 3, 4], [4, 5, 6]])
+    a = RS.randn(3, 4).astype(np.float32)
+    b = RS.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pt.tensordot(jnp.asarray(a), jnp.asarray(b), axes=1)),
+        np.tensordot(a, b, axes=1), rtol=1e-5)
+
+
+def test_stats_and_misc():
+    x = RS.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pt.cov(jnp.asarray(x))),
+                               np.cov(x), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pt.corrcoef(jnp.asarray(x))),
+                               np.corrcoef(x), rtol=1e-4, atol=1e-6)
+    assert int(pt.count_nonzero(jnp.asarray([[0, 1], [2, 0]]))) == 2
+    np.testing.assert_allclose(
+        float(pt.trapezoid(jnp.asarray([1.0, 2.0, 3.0]))), 4.0)
+    r = pt.renorm(jnp.asarray(x), p=2, axis=0, max_norm=1.0)
+    norms = np.linalg.norm(np.asarray(r).reshape(4, -1), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+    a1 = pt.atleast_1d(jnp.asarray(3.0))
+    assert a1.shape == (1,)
