@@ -49,5 +49,6 @@ from . import utils
 from . import profiler
 from . import hapi
 from .hapi import Model
+from .hapi.summary import summary
 
 __version__ = "0.1.0"

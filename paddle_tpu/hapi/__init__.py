@@ -12,3 +12,5 @@ from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping,
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRSchedulerCallback", "History"]
+
+from .summary import summary  # noqa: E402

@@ -1,0 +1,73 @@
+"""paddle.summary equivalent (reference: python/paddle/hapi/model_summary.py
+summary(net, input_size) — per-layer table with output shapes and params)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None) -> dict:
+    """Print a per-layer table (name, type, output shape, #params) by running
+    one abstract forward with hooks. Returns {'total_params': n,
+    'trainable_params': n}."""
+    rows = []
+    handles = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            shape = tuple(getattr(out, "shape", ())) if out is not None else ()
+            n_params = sum(int(np.prod(p.shape))
+                           for p in layer._parameters.values()
+                           if p is not None)
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, shape, n_params))
+            return outputs
+        return hook
+
+    for name, sub in net.named_sublayers():
+        handles.append(sub.register_forward_post_hook(make_hook(name)))
+
+    try:
+        if input is not None:
+            x = input
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, (list, tuple)) and \
+                isinstance(input_size[0], (list, tuple)) else [input_size]
+            dts = dtypes or ["float32"] * len(sizes)
+            x = [jnp.zeros(tuple(int(d) for d in s), dt)
+                 for s, dt in zip(sizes, dts)]
+            x = x[0] if len(x) == 1 else x
+        args = x if isinstance(x, (list, tuple)) else [x]
+        was_training = net.training
+        net.eval()
+        net(*args)
+        if was_training:
+            net.train()
+    finally:
+        for h in handles:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape))
+                    for _, p in net.named_parameters()
+                    if getattr(p, "trainable", True))
+    w_name = max([len(r[0]) for r in rows] + [10])
+    lines = [f"{'Layer':<{w_name}}  {'Type':<20} {'Output Shape':<20} "
+             f"{'Params':>12}",
+             "-" * (w_name + 56)]
+    for name, typ, shape, n in rows:
+        lines.append(f"{name:<{w_name}}  {typ:<20} {str(shape):<20} {n:>12,}")
+    lines.append("-" * (w_name + 56))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -132,7 +132,9 @@ def data(name: str, shape: Sequence[Optional[int]], dtype="float32") -> _LazyVar
     """Declare a feed slot in the current program (reference: static.data)."""
     prog = default_main_program()
     prog._feed_specs[name] = InputSpec(shape, dtype, name)
-    return _LazyVar(prog, lambda env: env[name], name)
+    var = _LazyVar(prog, lambda env: env[name], name)
+    var._feed_name = name  # autodiff needs the raw feed key, not the
+    return var             # uniquified display name
 
 
 def name_scope(prefix: str):
@@ -191,3 +193,56 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# static-graph autodiff (reference: python/paddle/base/backward.py —
+# append_backward:1974 builds grad ops into the program; gradients:2713)
+# ---------------------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic gradients of ``targets`` w.r.t. ``inputs`` as new lazy vars
+    in the same program. TPU-native: instead of per-op GradOpMaker rewrites,
+    the whole traced builder goes through jax.grad when the fetch executes."""
+    tgt_list = targets if isinstance(targets, (list, tuple)) else [targets]
+    in_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = tgt_list[0]._program
+
+    def make(inp):
+        if not isinstance(inp, _LazyVar):
+            raise TypeError("inputs must be program vars (e.g. static.data)")
+
+        def build(env):
+            name = getattr(inp, "_feed_name", inp.name)
+
+            def scalar_loss(x):
+                env2 = dict(env)
+                env2[name] = x
+                total = None
+                for t in tgt_list:
+                    v = jnp.sum(t._build(env2))
+                    total = v if total is None else total + v
+                return total
+
+            return jax.grad(scalar_loss)(jnp.asarray(env[name]))
+
+        return _LazyVar(prog, build, f"{inp.name}@GRAD")
+
+    outs = [make(i) for i in in_list]
+    return outs if isinstance(inputs, (list, tuple)) else outs[0]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: base/backward.py append_backward — returns
+    [(param_var, grad_var)] pairs; here parameters are the program's feed
+    vars (static params feed through the same slots)."""
+    prog = loss._program
+    if parameter_list is None:
+        parameter_list = []
+        for n in prog.feed_names:
+            v = _LazyVar(prog, (lambda env, n=n: env[n]), n)
+            v._feed_name = n
+            parameter_list.append(v)
+    grads = gradients([loss], list(parameter_list))
+    return list(zip(parameter_list, grads))

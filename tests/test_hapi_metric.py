@@ -120,3 +120,15 @@ def test_auc_perfect_and_random():
     auc.reset()
     auc.update(np.array([0.6, 0.6, 0.6, 0.6]), np.array([1, 0, 1, 0]))
     assert abs(auc.accumulate() - 0.5) < 0.26
+
+
+def test_standalone_summary(capsys):
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = pt.summary(net, input_size=(2, 8))
+    out = capsys.readouterr().out
+    assert "Linear" in out and "Total params" in out
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]

@@ -112,3 +112,29 @@ def test_enable_to_static_toggle():
         assert g is f
     finally:
         pt.jit.enable_to_static(True)
+
+
+def test_static_gradients_and_append_backward():
+    """Static autodiff parity (reference base/backward.py append_backward)."""
+    import numpy as np
+    from paddle_tpu import static
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3], "float32")
+        y = static.data("y", [3], "float32")
+        loss = (x * y + x).apply(lambda v: v.sum(), "sum")
+        (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        xv = np.asarray([1.0, 2.0, 3.0], np.float32)
+        yv = np.asarray([4.0, 5.0, 6.0], np.float32)
+        out = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss, gx])
+        np.testing.assert_allclose(out[0], (xv * yv + xv).sum(), rtol=1e-6)
+        np.testing.assert_allclose(out[1], yv + 1.0, rtol=1e-6)  # d/dx = y+1
+
+        pairs = static.append_backward(loss)
+        names = [p._feed_name for p, _ in pairs]
+        assert set(names) == {"x", "y"}
+        g_all = exe.run(prog, feed={"x": xv, "y": yv},
+                        fetch_list=[g for _, g in pairs])
+        np.testing.assert_allclose(g_all[names.index("x")], yv + 1.0)
+        np.testing.assert_allclose(g_all[names.index("y")], xv)
