@@ -97,3 +97,61 @@ def generate(model, input_ids, generation_config: GenerationConfig = None,
             pos = jnp.full((b,), prompt_len + i, jnp.int32)
             logits, caches = decode(next_tok, pos, caches)
     return jnp.concatenate(tokens, axis=1)
+
+
+def generate_scan(model, input_ids, generation_config: GenerationConfig = None,
+                  **kwargs) -> jnp.ndarray:
+    """Fully-compiled generation: the whole decode loop is ONE lax.scan
+    inside jit — no host↔device roundtrip per token (the Python-loop
+    ``generate`` dispatches one device call per step). Finished sequences
+    keep emitting pad; output matches ``generate`` for greedy decoding.
+
+    TPU notes: static cache shapes (prompt padded into max_len at prefill),
+    dynamic position via the scan carry — everything XLA needs to keep the
+    decode step as a single resident program.
+    """
+    cfg = generation_config or GenerationConfig(**kwargs)
+    input_ids = jnp.asarray(input_ids)
+    b, prompt_len = input_ids.shape
+    max_len = prompt_len + cfg.max_new_tokens
+    core = getattr(model, "model", model)
+    head = model.logits if hasattr(model, "logits") else (lambda h: h)
+    eos = cfg.eos_token_id
+
+    params = model.raw_parameters() if hasattr(model, "raw_parameters") else {}
+
+    def run(params, input_ids, key):
+        # run under the layer's functional bridge so params are traced inputs
+        with model._bind(params) if hasattr(model, "_bind") else \
+                _nullcontext():
+            hidden, caches = core.prefill(input_ids, max_len)
+            logits0 = head(hidden[:, -1, :])
+
+            def step(carry, i):
+                logits, caches, key, finished = carry
+                key, sub = jax.random.split(key)
+                tok = _sample_logits(logits.astype(jnp.float32), cfg, sub)
+                if eos is not None:
+                    tok = jnp.where(finished, cfg.pad_token_id, tok)
+                    finished = finished | (tok == eos)
+                pos = jnp.full((b,), prompt_len + i, jnp.int32)
+                h, caches = core.decode_step(tok, pos, caches)
+                new_logits = head(h[:, 0, :])
+                return (new_logits, caches, key, finished), tok
+
+            finished0 = jnp.zeros((b,), bool)
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (logits0, caches, key, finished0),
+                jnp.arange(cfg.max_new_tokens))
+        return jnp.concatenate([input_ids, toks.T], axis=1)
+
+    compiled = jax.jit(run)
+    return compiled(params, input_ids, jax.random.PRNGKey(cfg.seed))
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

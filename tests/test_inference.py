@@ -139,3 +139,30 @@ def test_sampling_modes_run_and_eos_stops():
     # everything after batch-wide finish is pad
     if (np.asarray(out2[1, 3]) == eos).all():
         assert (np.asarray(out2[:, 4:]) == -1).all()
+
+
+def test_generate_scan_matches_python_loop():
+    """The fully-jitted scan decode must reproduce the per-step greedy loop."""
+    from paddle_tpu.inference.generation import generate_scan
+    cfg, m = _tiny()
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 4)))
+    gcfg = GenerationConfig(max_new_tokens=5)
+    ref = generate(m, ids, gcfg)
+    fast = generate_scan(m, ids, gcfg)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(ref))
+
+
+def test_generate_scan_eos_padding():
+    from paddle_tpu.inference.generation import generate_scan
+    cfg, m = _tiny()
+    rs = np.random.RandomState(1)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, 3)))
+    first = generate(m, ids, GenerationConfig(max_new_tokens=1))
+    eos = int(first[0, 3])
+    out = generate_scan(m, ids, GenerationConfig(max_new_tokens=4,
+                                                 eos_token_id=eos,
+                                                 pad_token_id=-7))
+    row = np.asarray(out[0, 3:])
+    assert row[0] == eos
+    assert (row[1:] == -7).all()
