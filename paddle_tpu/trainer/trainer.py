@@ -66,7 +66,8 @@ class Trainer:
     params/opt-state; the jitted step inherits their shardings via GSPMD)."""
 
     def __init__(self, model: Layer, optimizer: Optimizer,
-                 loss_key: Optional[str] = None, donate: bool = True):
+                 loss_key: Optional[str] = None, donate: bool = True,
+                 accumulate_steps: int = 1):
         self.model = model
         self.optimizer = optimizer
         self._named = dict(model.named_parameters())
@@ -76,19 +77,45 @@ class Trainer:
         self._donate = donate
         self._step = 0
         self._peak = device_peak_flops()
+        self.accumulate_steps = max(1, int(accumulate_steps))
 
     # -- step function -------------------------------------------------------
 
     def _build_step(self):
         model, opt = self.model, self.optimizer
 
-        def step_fn(params, opt_state, batch, lr, key):
+        accum = self.accumulate_steps
+
+        def loss_of(params, batch, key):
             def loss_fn(p):
                 with rng_tracker().scope(key):
                     out = model.functional_call(p, **batch)
                 loss = out[0] if isinstance(out, tuple) else out
                 return loss
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return jax.value_and_grad(loss_fn)(params)
+
+        def step_fn(params, opt_state, batch, lr, key):
+            if accum == 1:
+                loss, grads = loss_of(params, batch, key)
+            else:
+                # gradient accumulation (reference: GradientMerge pass /
+                # accumulate_steps): batch arrays carry a leading microbatch
+                # dim [A, ...]; one lax.scan accumulates grads in-place —
+                # a single compiled program, activations of only one
+                # microbatch live at a time
+                keys = jax.random.split(key, accum)
+
+                def body(carry, inp):
+                    g_acc, l_acc = carry
+                    mb, k = inp
+                    l, g = loss_of(params, mb, k)
+                    return (jax.tree.map(jnp.add, g_acc, g), l_acc + l), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    body, (zeros, 0.0), (batch, keys))
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = loss_sum / accum
             new_params, new_opt_state = opt.apply_gradients(params, grads,
                                                             opt_state, lr=lr)
             return new_params, new_opt_state, loss

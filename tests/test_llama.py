@@ -129,3 +129,40 @@ def test_tied_embeddings():
     assert "lm_head" not in dict(m.named_parameters())
     logits = m(fake_batch(cfg)["input_ids"])
     assert logits.shape[-1] == cfg.vocab_size
+
+
+def test_gradient_accumulation_matches_big_batch():
+    """accumulate_steps=2 over half-batches must equal one full-batch step
+    (SGD: averaged grads are linear)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.trainer import Trainer
+
+    def make():
+        pt.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        return m
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 256, (4, 17))
+    full = {"input_ids": jnp.asarray(ids[:, :-1]),
+            "labels": jnp.asarray(ids[:, 1:])}
+    micro = {"input_ids": jnp.asarray(ids[:, :-1]).reshape(2, 2, 16),
+             "labels": jnp.asarray(ids[:, 1:]).reshape(2, 2, 16)}
+
+    m1 = make()
+    t1 = Trainer(m1, SGD(learning_rate=0.1, parameters=m1), donate=False)
+    l1 = t1.train_step(full)
+
+    m2 = make()
+    t2 = Trainer(m2, SGD(learning_rate=0.1, parameters=m2), donate=False,
+                 accumulate_steps=2)
+    l2 = t2.train_step(micro)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    k = "model.layers.0.self_attn.qkv_proj"
+    np.testing.assert_allclose(np.asarray(t1.params[k]),
+                               np.asarray(t2.params[k]), rtol=1e-5, atol=1e-6)
