@@ -12,8 +12,14 @@ from .layer import (Layer, Parameter, Buffer, Sequential, LayerList, LayerDict,
 from .common import (
     Linear, Embedding, Dropout, LayerNorm, RMSNorm, BatchNorm, BatchNorm1D,
     BatchNorm2D, BatchNorm3D, SyncBatchNorm,
-    GroupNorm, Conv2D, Conv2DTranspose, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
+    GroupNorm, Conv1D, Conv2D, Conv3D, Conv2DTranspose, PixelShuffle, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
     Flatten, ReLU, GELU, SiLU, Sigmoid, Tanh, Softmax, LeakyReLU, Hardswish,
     Hardsigmoid, Mish, CrossEntropyLoss, MSELoss, L1Loss, BCEWithLogitsLoss,
     SmoothL1Loss, KLDivLoss, NLLLoss,
 )
+
+from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN,
+                  LSTM, GRU)
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)

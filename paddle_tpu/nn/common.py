@@ -448,3 +448,68 @@ class SyncBatchNorm(BatchNorm2D):
         for name, sub in list(layer._sub_layers.items()):
             layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
         return layer
+
+
+class Conv1D(Layer):
+    """reference: nn.Conv1D (weight [out, in/groups, k])."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCL", dtype=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k], dtype=dtype,
+            initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, initializer=I.Uniform(-bound, bound))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(Layer):
+    """reference: nn.Conv3D (weight [out, in/groups, kd, kh, kw])."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCDHW", dtype=None):
+        super().__init__()
+        k = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] * k[2] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k], dtype=dtype,
+            initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, initializer=I.Uniform(-bound, bound))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)

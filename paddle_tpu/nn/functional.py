@@ -579,3 +579,59 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
         dimension_numbers=lax.conv_dimension_numbers(
             x.shape, (c * k[0] * k[1], c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")))
     return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    """weight [out_c, in_c/groups, kd, kh, kw] (reference conv3d)."""
+    from ..amp.auto_cast import maybe_cast_inputs
+    x, weight, bias = maybe_cast_inputs("conv3d", x, weight, bias)
+    stride = _norm_tuple(stride, 3)
+    dilation = _norm_tuple(dilation, 3)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, 3)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1, -1, 1, 1, 1] if data_format == "NCDHW" else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    """[N, C*r^2, H, W] → [N, C, H*r, W*r] (reference pixel_shuffle)."""
+    r = upscale_factor
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if c % (r * r):
+        raise ValueError(f"channels {c} not divisible by {r}^2")
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    out = x.reshape(n, c // (r * r), h * r, w * r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    out = x.reshape(n, c * r * r, h // r, w // r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out

@@ -1,0 +1,225 @@
+"""RNN/LSTM/GRU (scan-based) and Transformer stack, Conv1D/3D, pixel shuffle.
+
+Correctness oracles: torch.nn reference implementations (CPU torch is baked
+into the image) with weights copied across — the strongest available parity
+check for recurrent math.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# cells vs torch
+# ---------------------------------------------------------------------------
+
+def _copy_cell_weights(cell, t_cell):
+    import torch
+    with torch.no_grad():
+        t_cell.weight_ih.copy_(torch.tensor(_np(cell.weight_ih).T))
+        t_cell.weight_hh.copy_(torch.tensor(_np(cell.weight_hh).T))
+        t_cell.bias_ih.copy_(torch.tensor(_np(cell.bias_ih)))
+        t_cell.bias_hh.copy_(torch.tensor(_np(cell.bias_hh)))
+
+
+def test_lstm_cell_matches_torch():
+    import torch
+    pt.seed(0)
+    cell = nn.LSTMCell(6, 8)
+    t_cell = torch.nn.LSTMCell(6, 8)
+    _copy_cell_weights(cell, t_cell)
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 6).astype(np.float32)
+    h, (h2, c2) = cell(jnp.asarray(x))
+    th, tc = t_cell(torch.tensor(x))
+    np.testing.assert_allclose(_np(h2), th.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(c2), tc.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_gru_cell_matches_torch():
+    import torch
+    pt.seed(0)
+    cell = nn.GRUCell(5, 7)
+    t_cell = torch.nn.GRUCell(5, 7)
+    _copy_cell_weights(cell, t_cell)
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 5).astype(np.float32)
+    h, _ = cell(jnp.asarray(x))
+    th = t_cell(torch.tensor(x))
+    np.testing.assert_allclose(_np(h), th.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_lstm_sequence_matches_torch():
+    import torch
+    pt.seed(0)
+    lstm = nn.LSTM(4, 6, num_layers=1)
+    t_lstm = torch.nn.LSTM(4, 6, num_layers=1, batch_first=True)
+    cell = lstm.layers_f[0].cell
+    with torch.no_grad():
+        t_lstm.weight_ih_l0.copy_(torch.tensor(_np(cell.weight_ih).T))
+        t_lstm.weight_hh_l0.copy_(torch.tensor(_np(cell.weight_hh).T))
+        t_lstm.bias_ih_l0.copy_(torch.tensor(_np(cell.bias_ih)))
+        t_lstm.bias_hh_l0.copy_(torch.tensor(_np(cell.bias_hh)))
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 5, 4).astype(np.float32)
+    out, finals = lstm(jnp.asarray(x))
+    t_out, _ = t_lstm(torch.tensor(x))
+    np.testing.assert_allclose(_np(out), t_out.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_bidirectional_gru_shapes_and_grad():
+    pt.seed(0)
+    gru = nn.GRU(4, 6, num_layers=2, direction="bidirect")
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 7, 4).astype(np.float32))
+    out, finals = gru(x)
+    assert out.shape == (3, 7, 12)
+    assert len(finals) == 2  # per layer (fwd, bwd) states
+    from paddle_tpu.autograd import layer_grad
+    loss, grads = layer_grad(gru, lambda o: (o[0] ** 2).mean(), x)
+    assert all(np.isfinite(_np(g)).all() for g in jax.tree.leaves(grads))
+
+
+def test_simple_rnn_reverse():
+    pt.seed(0)
+    cell = nn.SimpleRNNCell(3, 4)
+    fwd = nn.RNN(cell)
+    rev = nn.RNN(cell, is_reverse=True)
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 5, 3).astype(np.float32))
+    of, _ = fwd(x)
+    orv, _ = rev(x[:, ::-1])
+    np.testing.assert_allclose(_np(of), _np(orv[:, ::-1]), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# transformer
+# ---------------------------------------------------------------------------
+
+def test_mha_self_attention_reference():
+    pt.seed(0)
+    mha = nn.MultiHeadAttention(16, 4)
+    mha.eval()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == (2, 5, 16)
+    # manual reference with the same projections
+    q = _np(mha.q_proj(x)).reshape(2, 5, 4, 4)
+    k = _np(mha.k_proj(x)).reshape(2, 5, 4, 4)
+    v = _np(mha.v_proj(x)).reshape(2, 5, 4, 4)
+    logits = np.einsum("bshd,bthd->bhst", q, k) / 2.0
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhst,bthd->bshd", p, v).reshape(2, 5, 16)
+    ref = _np(mha.out_proj(jnp.asarray(ref)))
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_mha_incremental_cache():
+    pt.seed(0)
+    mha = nn.MultiHeadAttention(8, 2)
+    mha.eval()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(1, 4, 8).astype(np.float32))
+    full = mha(x)  # no mask: every query sees all 4 keys — not causal, so
+    # compare only the LAST step of incremental decode (it sees all keys)
+    cache = mha.gen_cache(x)
+    for t in range(4):
+        out_t, cache = mha(x[:, t:t + 1], cache=cache)
+    np.testing.assert_allclose(_np(out_t[:, 0]), _np(full[:, -1]), rtol=1e-4,
+                               atol=1e-4)
+    assert cache[0].shape[1] == 4
+
+
+def test_transformer_end_to_end():
+    pt.seed(0)
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    model.eval()
+    rs = np.random.RandomState(0)
+    src = jnp.asarray(rs.randn(2, 6, 16).astype(np.float32))
+    tgt = jnp.asarray(rs.randn(2, 4, 16).astype(np.float32))
+    mask = nn.Transformer.generate_square_subsequent_mask(4)
+    out = model(src, tgt, tgt_mask=mask)
+    assert out.shape == (2, 4, 16)
+    assert bool(jnp.isfinite(out).all())
+    # distinct layers: encoder layers must not share parameters
+    p0 = model.encoder.layers[0].linear1.weight
+    p1 = model.encoder.layers[1].linear1.weight
+    assert not np.allclose(_np(p0), _np(p1))
+
+
+def test_causal_mask_blocks_future():
+    pt.seed(0)
+    layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+    layer.eval()
+    rs = np.random.RandomState(0)
+    tgt = rs.randn(1, 4, 8).astype(np.float32)
+    mem = jnp.asarray(rs.randn(1, 3, 8).astype(np.float32))
+    mask = nn.Transformer.generate_square_subsequent_mask(4)
+    out1 = layer(jnp.asarray(tgt), mem, tgt_mask=mask)
+    tgt2 = tgt.copy()
+    tgt2[0, -1] += 10.0  # mutate the last position only
+    out2 = layer(jnp.asarray(tgt2), mem, tgt_mask=mask)
+    # earlier positions can't see position 3 → unchanged
+    np.testing.assert_allclose(_np(out1[:, :3]), _np(out2[:, :3]), rtol=1e-4,
+                               atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# conv1d/3d, pixel shuffle
+# ---------------------------------------------------------------------------
+
+def test_conv1d_matches_torch():
+    import torch
+    pt.seed(0)
+    conv = nn.Conv1D(3, 5, 3, padding=1)
+    t_conv = torch.nn.Conv1d(3, 5, 3, padding=1)
+    with torch.no_grad():
+        t_conv.weight.copy_(torch.tensor(_np(conv.weight)))
+        t_conv.bias.copy_(torch.tensor(_np(conv.bias)))
+    x = np.random.RandomState(0).randn(2, 3, 9).astype(np.float32)
+    np.testing.assert_allclose(_np(conv(jnp.asarray(x))),
+                               t_conv(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_matches_torch():
+    import torch
+    pt.seed(0)
+    conv = nn.Conv3D(2, 4, 3, padding=1, stride=2)
+    t_conv = torch.nn.Conv3d(2, 4, 3, padding=1, stride=2)
+    with torch.no_grad():
+        t_conv.weight.copy_(torch.tensor(_np(conv.weight)))
+        t_conv.bias.copy_(torch.tensor(_np(conv.bias)))
+    x = np.random.RandomState(0).randn(1, 2, 6, 6, 6).astype(np.float32)
+    np.testing.assert_allclose(_np(conv(jnp.asarray(x))),
+                               t_conv(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pixel_shuffle_roundtrip():
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 8, 3, 3).astype(np.float32))
+    up = F.pixel_shuffle(x, 2)
+    assert up.shape == (2, 2, 6, 6)
+    back = F.pixel_unshuffle(up, 2)
+    np.testing.assert_allclose(_np(back), _np(x), rtol=1e-6)
+    # torch parity
+    import torch
+    t = torch.pixel_shuffle(torch.tensor(np.asarray(x)), 2)
+    np.testing.assert_allclose(_np(up), t.numpy(), rtol=1e-6)
