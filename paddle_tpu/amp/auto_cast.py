@@ -25,7 +25,7 @@ from ..core import dtype as _dt
 
 # op-name lists for introspection/parity; the functional layer consults
 # membership through maybe_cast_inputs call sites.
-WHITE_LIST = {"conv2d", "einsum", "matmul", "matmul_v2", "mul", "linear",
+WHITE_LIST = {"conv1d", "conv2d", "conv3d", "einsum", "matmul", "matmul_v2", "mul", "linear",
               "attention", "fused_rope", "bmm"}
 BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "rms_norm",
               "group_norm", "batch_norm", "exp", "log", "mean", "sum", "cumsum"}

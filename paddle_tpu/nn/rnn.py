@@ -111,9 +111,24 @@ class GRUCell(_CellBase):
         return jnp.zeros((batch, self.hidden_size), dtype)
 
 
+def _reverse_sequence(x_tbd, sequence_length):
+    """Reverse each sequence within its own length (tf.reverse_sequence):
+    x is [T, B, D]; padding positions stay in place."""
+    T = x_tbd.shape[0]
+    t = jnp.arange(T)[:, None]                       # [T, 1]
+    lens = jnp.asarray(sequence_length)[None, :]     # [1, B]
+    src = jnp.where(t < lens, lens - 1 - t, t)       # [T, B]
+    return jnp.take_along_axis(x_tbd, src[:, :, None], axis=0)
+
+
 class RNN(Layer):
     """Sequence wrapper running a cell over time with lax.scan
-    (reference: nn.RNN). Returns (outputs, final_states)."""
+    (reference: nn.RNN). Returns (outputs, final_states).
+
+    ``sequence_length`` masks padded timesteps: the state freezes at each
+    sequence's true end (final states match the reference), padded outputs
+    are zeros, and is_reverse reverses each sequence within its own length.
+    """
 
     def __init__(self, cell, is_reverse: bool = False,
                  time_major: bool = False):
@@ -125,17 +140,30 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)  # [T,B,D]
         if self.is_reverse:
-            x = x[::-1]
+            x = (_reverse_sequence(x, sequence_length)
+                 if sequence_length is not None else x[::-1])
         batch = x.shape[1]
         state = (initial_states if initial_states is not None
                  else self.cell.init_state(batch, x.dtype))
-        def step(carry, x_t):
-            out, new_state = self.cell(x_t, carry)
-            return new_state, out
+        seq_len = (jnp.asarray(sequence_length)
+                   if sequence_length is not None else None)
 
-        final_state, outs = jax.lax.scan(step, state, x)
+        def step(carry, inp):
+            prev_state, t = carry
+            x_t = inp
+            out, new_state = self.cell(x_t, prev_state)
+            if seq_len is not None:
+                active = (t < seq_len)[:, None]
+                new_state = jax.tree.map(
+                    lambda n, p: jnp.where(active, n, p), new_state,
+                    prev_state)
+                out = jnp.where(active, out, jnp.zeros_like(out))
+            return (new_state, t + 1), out
+
+        (final_state, _), outs = jax.lax.scan(step, (state, jnp.int32(0)), x)
         if self.is_reverse:
-            outs = outs[::-1]
+            outs = (_reverse_sequence(outs, sequence_length)
+                    if sequence_length is not None else outs[::-1])
         if not self.time_major:
             outs = jnp.swapaxes(outs, 0, 1)
         return outs, final_state
@@ -157,6 +185,7 @@ class _MultiLayerRNN(Layer):
         self.num_layers = num_layers
         self.time_major = time_major
         self.hidden_size = hidden_size
+        self.dropout = dropout
         layers_f, layers_b = [], []
         in_size = input_size
         for _ in range(num_layers):
@@ -176,14 +205,24 @@ class _MultiLayerRNN(Layer):
         x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
         finals = []
         for li in range(self.num_layers):
-            out_f, st_f = self.layers_f[li](x)
+            init = initial_states[li] if initial_states is not None else None
             if self.bidirectional:
-                out_b, st_b = self.layers_b[li](x)
+                init_f, init_b = init if init is not None else (None, None)
+                out_f, st_f = self.layers_f[li](
+                    x, initial_states=init_f, sequence_length=sequence_length)
+                out_b, st_b = self.layers_b[li](
+                    x, initial_states=init_b, sequence_length=sequence_length)
                 x = jnp.concatenate([out_f, out_b], axis=-1)
                 finals.append((st_f, st_b))
             else:
-                x = out_f
+                x, st_f = self.layers_f[li](
+                    x, initial_states=init, sequence_length=sequence_length)
                 finals.append(st_f)
+            if self.dropout > 0 and self.training and li < self.num_layers - 1:
+                # inter-layer dropout (reference: the dropout arg of
+                # SimpleRNN/LSTM/GRU applies between stacked layers)
+                from . import functional as F
+                x = F.dropout(x, p=self.dropout, training=True)
         outs = x if self.time_major else jnp.swapaxes(x, 0, 1)
         return outs, finals
 

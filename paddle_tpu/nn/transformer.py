@@ -10,6 +10,7 @@ follows the (k, v) tuple convention so generation loops can carry it.
 
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 import jax
@@ -24,9 +25,21 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "TransformerDecoder", "Transformer"]
 
 
+#: incremental self-attn cache / precomputed cross-attn K,V (reference:
+#: MultiHeadAttention.Cache / .StaticCache in transformer.py)
+Cache = collections.namedtuple("Cache", ["k", "v"])
+StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+
 class MultiHeadAttention(Layer):
     """reference: transformer.py MultiHeadAttention. Supports self- and
-    cross-attention; ``cache=(k, v)`` appends incremental decoding state."""
+    cross-attention. ``cache=Cache(k, v)`` appends incremental decoding
+    state; ``cache=StaticCache(k, v)`` reuses precomputed encoder-memory
+    projections (cross attention never recomputes them per step).
+    ``need_weights=True`` returns (out, weights)."""
+
+    Cache = Cache
+    StaticCache = StaticCache
 
     def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
                  kdim: Optional[int] = None, vdim: Optional[int] = None,
@@ -52,26 +65,51 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
         q = self._split(self.q_proj(query))
-        k = self._split(self.k_proj(key))
-        v = self._split(self.v_proj(value))
-        if cache is not None:
-            k = jnp.concatenate([cache[0], k], axis=1)
-            v = jnp.concatenate([cache[1], v], axis=1)
-            new_cache = (k, v)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=False,
-            dropout_p=self.dropout, training=self.training)
+        new_cache = None
+        if isinstance(cache, StaticCache):
+            k, v = cache.k, cache.v          # memory K/V computed once
+            new_cache = cache
+        else:
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value))
+            if cache is not None:
+                k = jnp.concatenate([cache[0], k], axis=1)
+                v = jnp.concatenate([cache[1], v], axis=1)
+                new_cache = Cache(k, v)
+        if self.need_weights:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
+            logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) * scale
+            if attn_mask is not None:
+                logits = logits + attn_mask
+            weights = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhst,bthd->bshd", weights,
+                             v.astype(jnp.float32)).astype(q.dtype)
+        else:
+            weights = None
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=False,
+                dropout_p=self.dropout, training=self.training)
         b, s, _, _ = out.shape
         out = self.out_proj(out.reshape(b, s, self.embed_dim))
+        outs = (out,)
+        if self.need_weights:
+            outs = outs + (weights,)
         if cache is not None:
-            return out, new_cache
-        return out
+            outs = outs + (new_cache,)
+        return outs[0] if len(outs) == 1 else outs
 
     def gen_cache(self, key, value=None, type=None):
-        """Empty incremental cache (reference gen_cache)."""
+        """Cache builders (reference gen_cache): ``type=StaticCache``
+        precomputes K/V projections of the given memory; default returns an
+        empty incremental Cache."""
+        if type is StaticCache or type == "static":
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value if value is not None else key))
+            return StaticCache(k, v)
         b = key.shape[0]
         z = jnp.zeros((b, 0, self.num_heads, self.head_dim), key.dtype)
-        return (z, z)
+        return Cache(z, z)
 
 
 class TransformerEncoderLayer(Layer):
@@ -81,6 +119,11 @@ class TransformerEncoderLayer(Layer):
                  act_dropout: Optional[float] = None,
                  normalize_before: bool = False, dtype=None):
         super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before, dtype=dtype)
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(
             d_model, nhead, dropout=attn_dropout if attn_dropout is not None
@@ -104,7 +147,7 @@ class TransformerEncoderLayer(Layer):
         residual = x
         y = self.norm2(x) if self.normalize_before else x
         y = self.linear2(self.dropout2(self.activation(self.linear1(y))))
-        y = residual + y
+        y = residual + self.dropout1(y)  # residual dropout on the FFN output
         if not self.normalize_before:
             y = self.norm2(y)
         return y
@@ -117,10 +160,11 @@ class TransformerEncoder(Layer):
                                                          Layer):
             layers = [encoder_layer_fn() for _ in range(num_layers)]
         else:
-            # reference passes an instance; clone structurally
-            import copy
-            layers = [encoder_layer_fn] + [copy.deepcopy(encoder_layer_fn)
-                                           for _ in range(num_layers - 1)]
+            # reference semantics: clones are RE-CONSTRUCTED with fresh
+            # init (deepcopy would give every layer identical weights)
+            proto = encoder_layer_fn
+            layers = [proto] + [type(proto)(**proto._config)
+                                for _ in range(num_layers - 1)]
         self.layers = LayerList(layers)
         self.norm = norm
 
@@ -138,6 +182,10 @@ class TransformerDecoderLayer(Layer):
                  dropout: float = 0.1, activation: str = "relu",
                  normalize_before: bool = False, dtype=None):
         super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation,
+                            normalize_before=normalize_before, dtype=dtype)
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
                                             dtype=dtype)
@@ -156,27 +204,40 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         x = self.norm1(tgt) if self.normalize_before else tgt
         if cache is not None:
-            sa, new_cache = self.self_attn(x, attn_mask=tgt_mask, cache=cache)
+            self_cache, static_cache = cache
+            sa, new_self_cache = self.self_attn(x, attn_mask=tgt_mask,
+                                                cache=self_cache)
         else:
+            static_cache = None
             sa = self.self_attn(x, attn_mask=tgt_mask)
         x = residual + self.dropout(sa)
         if not self.normalize_before:
             x = self.norm1(x)
         residual = x
         y = self.norm2(x) if self.normalize_before else x
-        y = residual + self.dropout(self.cross_attn(y, memory, memory,
-                                                    attn_mask=memory_mask))
+        if static_cache is not None:
+            ca, _ = self.cross_attn(y, memory, memory, attn_mask=memory_mask,
+                                    cache=static_cache)
+        else:
+            ca = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        y = residual + self.dropout(ca)
         if not self.normalize_before:
             y = self.norm2(y)
         residual = y
         z = self.norm3(y) if self.normalize_before else y
-        z = residual + self.linear2(self.dropout(self.activation(
-            self.linear1(z))))
+        z = residual + self.dropout(self.linear2(self.dropout(self.activation(
+            self.linear1(z)))))
         if not self.normalize_before:
             z = self.norm3(z)
         if cache is not None:
-            return z, new_cache
+            return z, (new_self_cache, static_cache)
         return z
+
+    def gen_cache(self, memory):
+        """(incremental self-attn Cache, precomputed cross-attn StaticCache)
+        — the reference TransformerDecoderLayer.gen_cache pair."""
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory, type=StaticCache))
 
 
 class TransformerDecoder(Layer):
