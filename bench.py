@@ -7,28 +7,95 @@ Metric: Llama pretraining tokens/sec/chip (the BASELINE.json north-star
 metric); vs_baseline = achieved MFU / 0.40 target MFU (the reference
 publishes no absolute numbers — BASELINE.md).
 
-Model size auto-scales to the backend: a ~1B-param Llama on a real TPU chip,
-a tiny config on CPU smoke runs.
+Hardened per round-1 verdict (BENCH_r01 was rc=1 with no artifact):
+
+- TPU availability is probed in a SUBPROCESS under a timeout, because the
+  tunneled TPU plugin can hang indefinitely inside backend init (not just
+  fail) — an in-process attempt would wedge the whole bench. The probe is
+  retried with backoff.
+- If the probe never succeeds we switch this process to the CPU backend
+  (jax.config.update wins over the site hook's forced "axon,cpu") and still
+  emit a JSON line carrying an "error" field describing the degradation.
+- Every failure path still prints one parseable JSON line (reference
+  posture: tools/ci_op_benchmark.sh perf-gating culture — a wedged runner
+  must produce a diagnosable record, not a stack trace).
+
+Model size auto-scales to the backend: a ~0.5B-param Llama on a real TPU
+chip, a tiny config on CPU smoke runs.
 """
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
+import traceback
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+PROBE_CODE = ("import jax; d=jax.devices(); "
+              "from paddle_tpu.ops.registry import device_is_tpu; "
+              "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
 
 
-def main():
-    backend = jax.default_backend()
-    on_tpu = backend not in ("cpu",)
+def _probe_tpu(attempts=2, timeout=240.0, sleep=20.0):
+    """Check (in a subprocess) that the default backend is real TPU.
+
+    Returns (ok, note). The probe child runs in its own session and the
+    whole process group is killed on timeout — a wedged tunnel plugin that
+    forked helpers holding our pipes must not hang the bench. The child
+    must print TPU_OK: a child that silently fell back to CPU does not
+    count as TPU available.
+    """
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return False, "PT_BENCH_FORCE_CPU set"
+    note = None
+    for i in range(attempts):
+        p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True, start_new_session=True,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode == 0 and "TPU_OK" in out:
+                return True, None
+            note = (f"probe attempt {i + 1}/{attempts} rc={p.returncode} "
+                    f"platform={out.strip()[-40:] or '?'}: "
+                    f"{(err or '').strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            # drain with a short grace so communicate can't block on pipes
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+            note = (f"probe attempt {i + 1}/{attempts} hung "
+                    f">{timeout:.0f}s (TPU tunnel wedged?)")
+        sys.stderr.write(note + "\n")
+        if i < attempts - 1:
+            time.sleep(sleep)
+    return False, note
+
+
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def _run(error_note):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     import paddle_tpu as pt
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
     from paddle_tpu.trainer import Trainer, device_peak_flops
 
+    from paddle_tpu.ops.registry import device_is_tpu
+    backend = jax.default_backend()
+    on_tpu = device_is_tpu(jax.devices()[0])
     pt.seed(0)
     if on_tpu:
         # ~0.5B params — fits one v5e chip (16GB) in bf16 with adam fp32 state
@@ -65,7 +132,7 @@ def main():
     tps_chip = tokens / dt / n_chips
     mfu = tps_chip * model.flops_per_token(seq_len) / device_peak_flops()
 
-    print(json.dumps({
+    payload = {
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tps_chip, 2),
         "unit": "tokens/s/chip",
@@ -77,10 +144,35 @@ def main():
             "params": model.num_params(),
             "batch_size": batch_size,
             "seq_len": seq_len,
+            "steps": steps,
+            "step_time_s": round(dt / steps, 4),
             "mfu": round(mfu, 4),
             "final_loss": float(loss),
         },
-    }))
+    }
+    if error_note:
+        payload["error"] = error_note
+    _emit(payload)
+
+
+def main():
+    tpu_ok, note = _probe_tpu()
+    error_note = None
+    if not tpu_ok:
+        error_note = f"TPU unavailable, CPU fallback: {note}"
+        # config.update beats the site hook's forced jax_platforms=axon,cpu;
+        # must run before any backend initialization in this process
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        _run(error_note)
+    except Exception as e:
+        _emit({"metric": "llama_pretrain_tokens_per_sec_per_chip",
+               "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0,
+               "error": f"bench run failed ({error_note or 'tpu'}): "
+                        f"{type(e).__name__}: {str(e)[:300]}",
+               "traceback": traceback.format_exc()[-1500:]})
+        sys.exit(0)  # the JSON line IS the artifact
 
 
 if __name__ == "__main__":

@@ -52,7 +52,8 @@ def is_compiled_with_cuda() -> bool:
 
 
 def is_compiled_with_tpu() -> bool:
-    return any(d.platform == "tpu" for d in jax.devices())
+    from .ops.registry import device_is_tpu
+    return any(device_is_tpu(d) for d in jax.devices())
 
 
 # -- save / load (reference: python/paddle/framework/io.py:721,960) ----------

@@ -19,10 +19,28 @@ from ..core.flags import flag
 _KERNELS: Dict[Tuple[str, str], Callable] = {}
 
 
+def device_is_tpu(d) -> bool:
+    """True if a jax Device is TPU hardware, including tunneled plugins
+    that register under their own platform name (e.g. "axon") — detected
+    via the device kind ("TPU v5e", ...). The single source of truth for
+    is-this-a-TPU; framework.is_compiled_with_tpu and bench use it too."""
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    platform = (getattr(d, "platform", "") or "").lower()
+    return "tpu" in kind or "tpu" in platform
+
+
 @functools.lru_cache(maxsize=None)
 def backend_kind() -> str:
     """'tpu' | 'gpu' | 'cpu' based on the default jax backend."""
-    return jax.default_backend()
+    backend = jax.default_backend()
+    if backend in ("cpu", "gpu", "tpu"):
+        return backend
+    try:
+        if device_is_tpu(jax.devices()[0]):
+            return "tpu"
+    except Exception:
+        pass
+    return backend
 
 
 def register_kernel(op: str, backend: str):
