@@ -363,12 +363,17 @@ class LlamaForCausalLMPipe(nn.Layer):
     """
 
     def __init__(self, cfg: LlamaConfig, num_stages: int = 1,
-                 num_microbatches: int = 1):
+                 num_microbatches: int = 1, pp_schedule: str = "gpipe",
+                 num_chunks: int = 1):
         super().__init__()
         from ..parallel.pipeline import PipelineStack
+        if pp_schedule not in PipelineStack.SCHEDULES:
+            raise ValueError(f"pp_schedule must be one of "
+                             f"{PipelineStack.SCHEDULES}, got {pp_schedule!r}")
         self.cfg = cfg
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
+        self.pp_schedule = pp_schedule
         self.embed_tokens = self.create_parameter(
             [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
             initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
@@ -376,7 +381,11 @@ class LlamaForCausalLMPipe(nn.Layer):
                                      num_layers=cfg.num_hidden_layers,
                                      num_stages=num_stages,
                                      num_microbatches=num_microbatches,
-                                     remat=(cfg.recompute == "full"))
+                                     remat=(cfg.recompute == "full"),
+                                     schedule=("interleaved"
+                                               if pp_schedule == "interleaved"
+                                               else "gpipe"),
+                                     num_chunks=num_chunks)
         self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps, dtype="float32")
         if not cfg.tie_word_embeddings:
             self.lm_head = self.create_parameter(
@@ -406,6 +415,71 @@ class LlamaForCausalLMPipe(nn.Layer):
                                ignore_index=-100)
         return loss, logits
 
+    def loss_and_grads(self, params, input_ids, labels):
+        """Fused 1F1B forward+backward over the pipeline (reference:
+        pipeline_parallel.py:440 forward_backward_pipeline). Returns
+        (mean_loss, grads) with grads matching ``params``' tree exactly —
+        the Trainer uses this in place of jax.value_and_grad when
+        pp_schedule == "1f1b", giving the 1F1B activation profile
+        (ring of <= 2*num_stages-1 microbatch inputs per stage instead of
+        all num_microbatches)."""
+        from ..parallel.pipeline import microbatch, unmicrobatch
+        from ..parallel.schedules import pipeline_1f1b
+        cfg = self.cfg
+        M, S = self.num_microbatches, self.num_stages
+        s_len = input_ids.shape[1]
+        cos, sin = self.rope_cos[:s_len], self.rope_sin[:s_len]
+        tied = cfg.tie_word_embeddings
+
+        prefix = "decoder.stack__"
+        stacked = {leaf: params[prefix + leaf.replace(".", "__")]
+                   for leaf in self.decoder._leaf_names}
+        staged = self.decoder.stage_trees(stacked)
+
+        head_params = {"norm_w": params["norm.weight"]}
+        if tied:
+            head_params["embed"] = params["embed_tokens"]
+        else:
+            head_params["lm_head"] = params["lm_head"]
+
+        def embed_fn(table):
+            return jnp.take(table, input_ids, axis=0)
+        x, embed_vjp = jax.vjp(embed_fn, params["embed_tokens"])
+        x_mb = microbatch(x, M)
+        t_mb = microbatch(labels, M)
+
+        stage = self.decoder.stage_fn(cos, sin)
+
+        def loss_head_fn(hp, h, tgt):
+            hidden = F.rms_norm(h, hp["norm_w"], cfg.rms_norm_eps)
+            w = (jnp.swapaxes(hp["embed"], 0, 1) if tied else hp["lm_head"])
+            logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+            # (token-summed loss, valid count): pipeline_1f1b normalizes by
+            # the GLOBAL count so unevenly-padded microbatches reproduce the
+            # unpipelined token-weighted mean exactly
+            mean = F.cross_entropy(logits.astype(jnp.float32), tgt,
+                                   ignore_index=-100)
+            cnt = jnp.sum(tgt != -100).astype(jnp.float32)
+            return mean * jnp.maximum(cnt, 1.0), cnt
+
+        loss, g_stack, g_head, dx = pipeline_1f1b(
+            stage, staged, x_mb, t_mb, loss_head_fn, head_params,
+            num_stages=S, remat=self.decoder.remat, return_dx=True,
+            weighted_loss=True)
+
+        (d_emb_in,) = embed_vjp(unmicrobatch(dx).astype(x.dtype))
+        grads = {}
+        for leaf in self.decoder._leaf_names:
+            key = prefix + leaf.replace(".", "__")
+            grads[key] = g_stack[leaf].reshape(params[key].shape)
+        grads["embed_tokens"] = (g_head["embed"] + d_emb_in if tied
+                                 else d_emb_in)
+        grads["norm.weight"] = g_head["norm_w"]
+        if not tied:
+            grads["lm_head"] = g_head["lm_head"]
+        grads = {k: grads[k] for k in params}  # preserve tree order
+        return loss, grads
+
     def load_from_unpipelined(self, model: "LlamaForCausalLM") -> None:
         """Copy weights from a LlamaForCausalLM (stacking per-layer params) —
         the Pipe-partition converter (reference analogue:
@@ -422,4 +496,4 @@ class LlamaForCausalLMPipe(nn.Layer):
                 [src[f"model.layers.{i}.{leaf}"].value
                  for i in range(cfg.num_hidden_layers)])
             pname = "decoder.stack__" + leaf.replace(".", "__")
-            own[pname].value = stacked
+            own[pname].value = self.decoder.pack_leaf(stacked)

@@ -222,17 +222,35 @@ class PipelineStack(Layer):
     (pp_layers.py:237) — here partitioning is a reshape [L] -> [S, L/S].
     """
 
+    SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
     def __init__(self, make_layer: Callable[[], Layer], num_layers: int,
                  num_stages: int = 1, num_microbatches: int = 1,
-                 remat: bool = True):
+                 remat: bool = True, schedule: str = "gpipe",
+                 num_chunks: int = 1):
         super().__init__()
-        if num_layers % max(num_stages, 1):
+        if schedule not in self.SCHEDULES:
+            raise ValueError(f"schedule must be one of {self.SCHEDULES}, "
+                             f"got {schedule!r}")
+        if schedule == "interleaved" and num_chunks < 2:
+            raise ValueError("interleaved schedule needs num_chunks >= 2")
+        if schedule != "interleaved":
+            num_chunks = 1
+        if num_layers % max(num_stages * num_chunks, 1):
             raise ValueError(f"num_layers={num_layers} must be divisible by "
-                             f"num_stages={num_stages}")
+                             f"num_stages*num_chunks="
+                             f"{num_stages * num_chunks}")
+        if (schedule == "interleaved" and num_stages > 1
+                and num_microbatches % num_stages):
+            raise ValueError(f"interleaved schedule needs num_microbatches="
+                             f"{num_microbatches} divisible by num_stages="
+                             f"{num_stages}")
         self.num_layers = num_layers
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.remat = remat
+        self.schedule = schedule
+        self.num_chunks = num_chunks
         # template held OUT of the registration tree (plain __dict__ slot):
         # it is only the per-slice compute fn; the real weights live in the
         # stacked Parameters below, so the template's own values are dropped
@@ -256,11 +274,35 @@ class PipelineStack(Layer):
             tp = template_params[name]
             base_shard = tuple(tp.sharding) if tp.sharding else (None,) * (stacked.ndim - 1)
             pname = "stack__" + name.replace(".", "__")
-            param = Parameter(stacked, trainable=True,
-                              sharding=("pp",) + tuple(base_shard), name=pname)
+            param = Parameter(self.pack_leaf(stacked), trainable=True,
+                              sharding=self._storage_sharding(base_shard),
+                              name=pname)
             self.add_parameter(pname, param)
 
+    def pack_leaf(self, stacked):
+        """[L, ...] layer-stacked leaf -> storage layout. Interleaved stores
+        [V, S, k, ...] so the "pp" shard axis (dim 1) matches the Megatron
+        chunk placement (virtual stage v*S+s = layers [(v*S+s)*k, ...)) —
+        a flat [L] leaf sharded contiguously over pp cannot express it."""
+        if self.schedule != "interleaved":
+            return stacked
+        V, S = self.num_chunks, self.num_stages
+        k = self.num_layers // (S * V)
+        return stacked.reshape((V, S, k) + stacked.shape[1:])
+
+    def unpack_leaf(self, stored):
+        """Storage layout -> [L, ...] layer order."""
+        if self.schedule != "interleaved":
+            return stored
+        return stored.reshape((self.num_layers,) + stored.shape[3:])
+
+    def _storage_sharding(self, base_shard):
+        if self.schedule == "interleaved":
+            return (None, "pp", None) + tuple(base_shard)
+        return ("pp",) + tuple(base_shard)
+
     def stacked_tree(self) -> Dict[str, jax.Array]:
+        """Leaves in STORAGE layout ([L,...] or [V,S,k,...])."""
         return {name: getattr(self, "stack__" + name.replace(".", "__"))
                 for name in self._leaf_names}
 
@@ -268,10 +310,32 @@ class PipelineStack(Layer):
         """Apply ONE layer with the given unstacked param tree."""
         return self.template.functional_call(params_slice, h, *extras)
 
+    def stage_trees(self, tree=None):
+        """Group the stacked leaves for the active schedule:
+        [S, k, ...] (gpipe/1f1b) or [V, S, k, ...] (interleaved — already
+        the storage layout)."""
+        tree = self.stacked_tree() if tree is None else tree
+        if self.schedule == "interleaved":
+            return tree
+        S = self.num_stages
+        k = self.num_layers // S
+        return {n: v.reshape((S, k) + v.shape[1:]) for n, v in tree.items()}
+
+    def stage_fn(self, *extras):
+        """fn(stage_params, h) applying one stage (k stacked layers)."""
+        def fn(stage_params, hh):
+            def body(carry, sl):
+                return self._slice_fn(sl, carry, *extras), None
+            hh, _ = jax.lax.scan(body, hh, stage_params)
+            return hh
+        return fn
+
     def forward(self, h, *extras):
         tree = self.stacked_tree()
         if self.num_stages <= 1:
             # sequential: scan over the layer axis
+            tree = {n: self.unpack_leaf(v) for n, v in tree.items()}
+
             def body(carry, sl):
                 fn = (jax.checkpoint(self._slice_fn) if self.remat
                       else self._slice_fn)
@@ -279,19 +343,21 @@ class PipelineStack(Layer):
             h, _ = jax.lax.scan(body, h, tree)
             return h
 
-        # pipeline: group [L] -> [S, k]; one stage = k sequential layers
-        S, k = self.num_stages, self.num_layers // self.num_stages
-        staged = {n: v.reshape((S, k) + v.shape[1:]) for n, v in tree.items()}
-
-        def stage_fn(stage_params, hh, *ex):
-            def body(carry, sl):
-                return self._slice_fn(sl, carry, *ex), None
-            hh, _ = jax.lax.scan(body, hh, stage_params)
-            return hh
-
+        staged = self.stage_trees(tree)
         xmb = microbatch(h, self.num_microbatches)
-        out = pipeline_spmd(stage_fn, staged, xmb, num_stages=S,
-                            remat=self.remat, extras=extras)
+        if self.schedule == "interleaved":
+            from .schedules import pipeline_interleaved
+            out = pipeline_interleaved(self.stage_fn(*extras), staged, xmb,
+                                       num_stages=self.num_stages,
+                                       num_chunks=self.num_chunks,
+                                       remat=self.remat)
+        else:
+            # "1f1b" reaches here only on inference-style plain forwards;
+            # training uses the fused pipeline_1f1b via the owning model's
+            # loss_and_grads, where 1F1B's memory profile actually matters
+            out = pipeline_spmd(self.stage_fn(*extras), staged, xmb,
+                                num_stages=self.num_stages,
+                                remat=self.remat)
         return unmicrobatch(out)
 
 

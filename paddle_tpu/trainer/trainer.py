@@ -86,7 +86,16 @@ class Trainer:
 
         accum = self.accumulate_steps
 
+        # models with a fused forward+backward schedule (1F1B pipeline)
+        # provide loss_and_grads instead of being differentiated through
+        fused = (getattr(model, "pp_schedule", None) == "1f1b"
+                 and hasattr(model, "loss_and_grads"))
+
         def loss_of(params, batch, key):
+            if fused:
+                with rng_tracker().scope(key):
+                    return model.loss_and_grads(params, **batch)
+
             def loss_fn(p):
                 with rng_tracker().scope(key):
                     out = model.functional_call(p, **batch)
