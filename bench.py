@@ -26,57 +26,15 @@ chip, a tiny config on CPU smoke runs.
 
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
 import traceback
 
-PROBE_CODE = ("import jax; d=jax.devices(); "
-              "from paddle_tpu.ops.registry import device_is_tpu; "
-              "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
+from paddle_tpu.utils.hw_probe import probe_tpu
 
 
-def _probe_tpu(attempts=2, timeout=240.0, sleep=20.0):
-    """Check (in a subprocess) that the default backend is real TPU.
-
-    Returns (ok, note). The probe child runs in its own session and the
-    whole process group is killed on timeout — a wedged tunnel plugin that
-    forked helpers holding our pipes must not hang the bench. The child
-    must print TPU_OK: a child that silently fell back to CPU does not
-    count as TPU available.
-    """
-    if os.environ.get("PT_BENCH_FORCE_CPU"):
-        return False, "PT_BENCH_FORCE_CPU set"
-    note = None
-    for i in range(attempts):
-        p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
-                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                             text=True, start_new_session=True,
-                             cwd=os.path.dirname(os.path.abspath(__file__)))
-        try:
-            out, err = p.communicate(timeout=timeout)
-            if p.returncode == 0 and "TPU_OK" in out:
-                return True, None
-            note = (f"probe attempt {i + 1}/{attempts} rc={p.returncode} "
-                    f"platform={out.strip()[-40:] or '?'}: "
-                    f"{(err or '').strip()[-300:]}")
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            # drain with a short grace so communicate can't block on pipes
-            try:
-                p.communicate(timeout=10)
-            except Exception:
-                pass
-            note = (f"probe attempt {i + 1}/{attempts} hung "
-                    f">{timeout:.0f}s (TPU tunnel wedged?)")
-        sys.stderr.write(note + "\n")
-        if i < attempts - 1:
-            time.sleep(sleep)
-    return False, note
+def _probe_tpu():
+    return probe_tpu(cwd=os.path.dirname(os.path.abspath(__file__)))
 
 
 def _emit(payload):

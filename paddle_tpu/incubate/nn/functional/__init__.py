@@ -235,6 +235,17 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_decoder,
     key_cache = key_cache.at[phys_block, offset].set(k_new)
     value_cache = value_cache.at[phys_block, offset].set(v_new)
 
+    # TPU fast path: Pallas paged-decode kernel streams pages via a
+    # scalar-prefetched block table, never gathering [B, T] into HBM
+    from ....ops.registry import backend_kind
+    from ....ops.pallas.paged_attention import (paged_decode_attention,
+                                                paged_decode_supported)
+    if backend_kind() == "tpu" and paged_decode_supported(
+            q.reshape(B, H, -1), key_cache):
+        out = paged_decode_attention(q.reshape(B, H, -1), key_cache,
+                                     value_cache, block_tables, seq_lens)
+        return out.reshape(B, -1), key_cache, value_cache
+
     # gather each sequence's pages: [B, max_blocks, block_size, H_kv, D]
     safe_tables = jnp.maximum(block_tables, 0)
     k_pages = key_cache[safe_tables]

@@ -1,28 +1,37 @@
-"""Pallas TPU flash attention (forward + backward).
+"""Pallas TPU flash attention (forward + backward), with segment support.
 
 Reference analogue: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FA2 via
-dynload — flash_attn_fwd/bwd) and its python surface
-python/paddle/nn/functional/flash_attention.py. Re-designed for the TPU
-memory hierarchy instead of translated: the kernel streams K/V blocks
+dynload — flash_attn_fwd/bwd, incl. the varlen entry at :91) and its python
+surface python/paddle/nn/functional/flash_attention.py. Re-designed for the
+TPU memory hierarchy instead of translated: the kernel streams K/V blocks
 through VMEM with the online-softmax recurrence (running max m, denominator
 l) carried in VMEM scratch across the innermost sequential grid dimension,
 keeping the [sq, sk] score matrix out of HBM entirely; fp32 accumulation on
 the MXU via preferred_element_type.
 
 Layout: q [b, sq, h, d], k/v [b, sk, h_kv, d] (GQA: h_kv <= h, mapped via
-BlockSpec index arithmetic — no materialized head expansion in the forward).
-Backward = two kernels (dq; dk+dv) using the saved per-row logsumexp, plus a
-delta = rowsum(out * dout) precomputed in XLA.
+BlockSpec index arithmetic — no materialized head expansion in the forward,
+and dk/dv are accumulated AT KV-HEAD RESOLUTION inside the backward kernel
+by folding the query-head group into the innermost sequential grid dim, so
+no group-times-larger intermediate ever hits HBM).
+
+Varlen / packed sequences: integer ``segment_ids`` ([b, sq] / [b, sk])
+mask cross-segment attention inside the kernel — the TPU equivalent of the
+reference's cu_seqlens varlen API (flash_attn_kernel.cu:91): pack multiple
+sequences into one row, give each a distinct id (padding gets its own id).
+
+Backward = two kernels (dq; dk+dv) using the saved per-row logsumexp, plus
+a delta = rowsum(out * dout) precomputed in XLA.
 
 Falls back to the XLA composition (ops/attention.py) for dropout, arbitrary
-masks, or block-indivisible sequence lengths.
+dense masks, or block-indivisible sequence lengths.
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -54,15 +63,34 @@ def _block_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
 
 
+def _mask_scores(s, causal, qseg, kseg, qi, ki, offset, block_q, block_k):
+    """Apply causal and/or segment masking to a [bq, bk] score block."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (cols + ki * block_k) <= (rows + qi * block_q + offset)
+    if qseg is not None:
+        seg = qseg[:, None] == kseg[None, :]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, sq, sk,
-                block_q, block_k):
+def _fwd_kernel(*refs, scale, causal, has_seg, sq, sk, block_q, block_k):
     """Grid: (b, h, nq, nk) — nk innermost/sequential; scratch carries the
     online-softmax state across nk iterations."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -75,7 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # causal: skip blocks strictly above the diagonal (bottom-right aligned)
     offset = sk - sq
-    first_masked_col = qi * block_q + offset + block_q  # col >= this is masked
+    first_masked_col = qi * block_q + offset + block_q  # col >= this masked
 
     @pl.when(jnp.logical_not(causal) | (ki * block_k < first_masked_col))
     def _compute():
@@ -85,16 +113,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (cols + ki * block_k) <= (rows + qi * block_q + offset)
-            s = jnp.where(mask, s, NEG_INF)
+        s = _mask_scores(s, causal,
+                         qs_ref[0, :] if has_seg else None,
+                         ks_ref[0, :] if has_seg else None,
+                         qi, ki, offset, block_q, block_k)
         m_prev = m_scr[:, :1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
-        p = jnp.exp(s - m_new)                     # [bq, bk]
+        # masked entries must be EXACTLY zero even when the whole row is
+        # masked (m_new == NEG_INF would make exp(s - m_new) = 1, turning
+        # a fully-masked row into a mean over V)
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0,
+                      jnp.exp(s - m_new))          # [bq, bk]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -110,13 +141,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0, :] = (m_scr[:, 0] + jnp.log(safe_l[:, 0]))
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
     nq = sq // block_q
     nk = sk // block_k
     grid = (b, h, nq, nk)
+    has_seg = q_seg is not None
 
     q_spec = _block_spec((1, block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0))
     kv_spec = _block_spec((1, block_k, 1, d),
@@ -124,15 +156,24 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o_spec = _block_spec((1, block_q, 1, d), lambda bi, hi, qi, ki: (bi, qi, hi, 0))
     lse_spec = _block_spec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi))
 
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [q, k, v]
+    if has_seg:
+        in_specs += [
+            _block_spec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
+            _block_spec((1, block_k), lambda bi, hi, qi, ki: (bi, ki))]
+        inputs += [q_seg, kv_seg]
+
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, block_q=block_q, block_k=block_k)
+                               has_seg=has_seg, sq=sq, sk=sk,
+                               block_q=block_q, block_k=block_k)
     scratch = [pltpu.VMEM((block_q, 128), jnp.float32),
                pltpu.VMEM((block_q, 128), jnp.float32),
                pltpu.VMEM((block_q, d), jnp.float32)]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=[o_spec, lse_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((b, h, sq), jnp.float32)],
@@ -140,7 +181,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         compiler_params=_tpu_params("parallel", "parallel", "parallel",
                                     "arbitrary"),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -148,9 +189,15 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, sq, sk, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, has_seg, sq, sk, block_q, block_k):
     """Grid (b, h, nq, nk): accumulate dq over kv blocks."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -172,12 +219,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0, :][:, None]        # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (cols + ki * block_k) <= (rows + qi * block_q + offset)
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        s = _mask_scores(s, causal,
+                         qs_ref[0, :] if has_seg else None,
+                         ks_ref[0, :] if has_seg else None,
+                         qi, ki, offset, block_q, block_k)
+        # masked entries exactly zero (a fully-masked row has lse=NEG_INF;
+        # exp(NEG_INF - NEG_INF) = 1 would corrupt dq/dk/dv)
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0,
+                      jnp.exp(s - lse))            # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -190,23 +239,34 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, :, 0, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, sq, sk,
-                    block_q, block_k):
-    """Grid (b, h, nk, nq): accumulate dk/dv over q blocks (per q-head; the
-    caller group-sums to kv heads)."""
-    ki = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+def _bwd_dkv_kernel(*refs, scale, causal, has_seg, sq, sk, block_q, block_k,
+                    group, nq):
+    """Grid (b, h_kv, nk, nq*group): accumulate dk/dv at KV-HEAD resolution.
 
-    @pl.when(qi == 0)
+    The innermost sequential dim enumerates (query-head-in-group, q-block)
+    pairs, so the GQA group sum happens in the VMEM accumulator instead of
+    as a group-times-larger fp32 intermediate in HBM (round-1 weak item:
+    FA2 accumulates at kv-head resolution; flash_attn_kernel.cu)."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        qs_ref = ks_ref = None
+    ki = pl.program_id(2)
+    qg = pl.program_id(3)
+    nqg = pl.num_programs(3)
+    qi = qg % nq          # q-block index (group-major enumeration)
+
+    @pl.when(qg == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     offset = sk - sq
-    # causal: this (ki, qi) pair contributes unless the whole block is masked:
-    # masked iff min col in block > max row+offset in block
+    # causal: this (ki, qi) pair contributes unless the whole block is
+    # masked: masked iff min col in block > max row+offset in block
     max_row = qi * block_q + block_q - 1 + offset
 
     @pl.when(jnp.logical_not(causal) | (ki * block_k <= max_row))
@@ -219,12 +279,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (cols + ki * block_k) <= (rows + qi * block_q + offset)
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        s = _mask_scores(s, causal,
+                         qs_ref[0, :] if has_seg else None,
+                         ks_ref[0, :] if has_seg else None,
+                         qi, ki, offset, block_q, block_k)
+        # masked entries exactly zero (a fully-masked row has lse=NEG_INF;
+        # exp(NEG_INF - NEG_INF) = 1 would corrupt dq/dk/dv)
+        p = jnp.where(s <= NEG_INF * 0.5, 0.0,
+                      jnp.exp(s - lse))            # [bq, bk]
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -235,17 +297,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qg == nqg - 1)
     def _finalize():
         dk_ref[0, :, 0, :] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, :, 0, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
-    q, k, v, out, lse = res
+    q, k, v, q_seg, kv_seg, out, lse = res
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     group = h // h_kv
+    has_seg = q_seg is not None
     delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
                     axis=-1)                        # [b, sq, h]
     delta = jnp.moveaxis(delta, -1, 1)              # [b, h, sq]
@@ -256,60 +319,92 @@ def _bwd(scale, causal, block_q, block_k, interpret, res, dout):
                           lambda bi, hi, qi, ki: (bi, ki, hi // group, 0))
     lse_spec = _block_spec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi))
 
+    dq_inputs = [q, k, v, dout, lse, delta]
+    dq_specs = [q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec]
+    if has_seg:
+        dq_specs += [
+            _block_spec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
+            _block_spec((1, block_k), lambda bi, hi, qi, ki: (bi, ki))]
+        dq_inputs += [q_seg, kv_seg]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          sq=sq, sk=sk, block_q=block_q, block_k=block_k),
+                          has_seg=has_seg, sq=sq, sk=sk,
+                          block_q=block_q, block_k=block_k),
         grid=(b, h, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
+        in_specs=dq_specs,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_tpu_params("parallel", "parallel", "parallel",
                                     "arbitrary"),
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)[0]
+    )(*dq_inputs)[0]
 
-    # dk/dv at q-head resolution; kv blocks indexed per q-head
-    q_spec2 = _block_spec((1, block_q, 1, d), lambda bi, hi, ki, qi: (bi, qi, hi, 0))
+    # dk/dv accumulated at kv-head resolution: grid (b, h_kv, nk, nq*group);
+    # the q-head for inner index qg is hkv*group + qg//nq (group-major)
+    q_spec2 = _block_spec(
+        (1, block_q, 1, d),
+        lambda bi, hi, ki, qg: (bi, qg % nq, hi * group + qg // nq, 0))
     kv_spec2 = _block_spec((1, block_k, 1, d),
-                           lambda bi, hi, ki, qi: (bi, ki, hi // group, 0))
+                           lambda bi, hi, ki, qg: (bi, ki, hi, 0))
     kvout_spec = _block_spec((1, block_k, 1, d),
-                             lambda bi, hi, ki, qi: (bi, ki, hi, 0))
-    lse_spec2 = _block_spec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, hi, qi))
-    dk_full, dv_full = pl.pallas_call(
+                             lambda bi, hi, ki, qg: (bi, ki, hi, 0))
+    lse_spec2 = _block_spec(
+        (1, 1, block_q),
+        lambda bi, hi, ki, qg: (bi, hi * group + qg // nq, qg % nq))
+
+    dkv_inputs = [q, k, v, dout, lse, delta]
+    dkv_specs = [q_spec2, kv_spec2, kv_spec2, q_spec2, lse_spec2, lse_spec2]
+    if has_seg:
+        dkv_specs += [
+            _block_spec((1, block_q), lambda bi, hi, ki, qg: (bi, qg % nq)),
+            _block_spec((1, block_k), lambda bi, hi, ki, qg: (bi, ki))]
+        dkv_inputs += [q_seg, kv_seg]
+
+    dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          sq=sq, sk=sk, block_q=block_q, block_k=block_k),
-        grid=(b, h, nk, nq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, lse_spec2, lse_spec2],
+                          has_seg=has_seg, sq=sq, sk=sk, block_q=block_q,
+                          block_k=block_k, group=group, nq=nq),
+        grid=(b, h_kv, nk, nq * group),
+        in_specs=dkv_specs,
         out_specs=[kvout_spec, kvout_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, sk, h, d), jnp.float32),
-                   jax.ShapeDtypeStruct((b, sk, h, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=_tpu_params("parallel", "parallel", "parallel",
                                     "arbitrary"),
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(*dkv_inputs)
 
-    if group > 1:  # GQA: sum grads over the query-head group
-        dk_full = dk_full.reshape(b, sk, h_kv, group, d).sum(axis=3)
-        dv_full = dv_full.reshape(b, sk, h_kv, group, d).sum(axis=3)
-    return dq, dk_full.astype(k.dtype), dv_full.astype(v.dtype)
+    if has_seg:
+        # int cotangents are symbolically zero (float0) in jax
+        import numpy as _np
+        zseg = (_np.zeros(q_seg.shape, jax.dtypes.float0),
+                _np.zeros(kv_seg.shape, jax.dtypes.float0))
+    else:
+        zseg = (None, None)
+    return (dq, dk, dv) + zseg
 
 
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, q_seg, kv_seg, scale, causal, block_q,
+                     block_k, interpret):
+    out, _ = _fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
+                  interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
+                    interpret):
+    out, lse = _fwd(q, k, v, q_seg, kv_seg, scale, causal, block_q, block_k,
+                    interpret)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, dout):
@@ -317,6 +412,22 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, dout):
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _normalize_segments(segment_ids, b, sq, sk):
+    """segment_ids: [b, s] (self-attn) or (q_seg [b, sq], kv_seg [b, sk])."""
+    if segment_ids is None:
+        return None, None
+    if isinstance(segment_ids, (tuple, list)):
+        q_seg, kv_seg = segment_ids
+    else:
+        q_seg = kv_seg = segment_ids
+    q_seg = jnp.asarray(q_seg, jnp.int32)
+    kv_seg = jnp.asarray(kv_seg, jnp.int32)
+    if q_seg.shape != (b, sq) or kv_seg.shape != (b, sk):
+        raise ValueError(f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} "
+                         f"do not match (b={b}, sq={sq}, sk={sk})")
+    return q_seg, kv_seg
 
 
 def pallas_supported(q, k, v, attn_mask, dropout_p, causal=False,
@@ -338,25 +449,58 @@ def pallas_supported(q, k, v, attn_mask, dropout_p, causal=False,
 
 def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p: float = 0.0,
                            causal: bool = False, scale: Optional[float] = None,
-                           block_q: int = DEFAULT_BLOCK_Q,
-                           block_k: int = DEFAULT_BLOCK_K,
+                           segment_ids=None,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None,
                            interpret: bool = False):
-    """TPU flash attention; falls back to the XLA path when unsupported."""
+    """TPU flash attention; falls back to the XLA path when unsupported.
+
+    ``segment_ids`` ([b, s] ints, or a (q_seg, kv_seg) pair) restricts
+    attention to equal-id positions — packed-sequence (varlen) and padding
+    masking without a dense mask (reference varlen entry:
+    flash_attn_kernel.cu:91).
+
+    ``block_q``/``block_k`` default to the autotune database's choice for
+    this (shape, dtype, device) — see ops/pallas/autotune.py and
+    tools/tune_kernels.py (reference: phi/kernels/autotune/cache.h)."""
     from ..attention import _sdpa_xla
+    if block_q is None or block_k is None:
+        from .autotune import flash_attention_config
+        tq, tk = flash_attention_config(q.shape[1], k.shape[1], q.shape[3],
+                                        str(q.dtype), causal)
+        block_q = block_q if block_q is not None else tq
+        block_k = block_k if block_k is not None else tk
     if not pallas_supported(q, k, v, attn_mask, dropout_p, causal,
                             block_q, block_k):
+        if segment_ids is not None:
+            q_seg, kv_seg = _normalize_segments(segment_ids, q.shape[0],
+                                                q.shape[1], k.shape[1])
+            seg_mask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
+            if attn_mask is None:
+                m = seg_mask
+            elif attn_mask.dtype == jnp.bool_:
+                m = attn_mask & seg_mask
+            else:  # additive float mask: add a large-negative segment term
+                m = attn_mask + jnp.where(seg_mask, 0.0, NEG_INF).astype(
+                    attn_mask.dtype)
+            return _sdpa_xla(q, k, v, attn_mask=m, dropout_p=dropout_p,
+                             causal=causal, scale=scale)
         return _sdpa_xla(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
                          causal=causal, scale=scale)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     bq = min(block_q, q.shape[1])
     bk = min(block_k, k.shape[1])
-    return _flash_attention(q, k, v, scale, causal, bq, bk, interpret)
+    q_seg, kv_seg = _normalize_segments(segment_ids, q.shape[0], q.shape[1],
+                                        k.shape[1])
+    return _flash_attention(q, k, v, q_seg, kv_seg, scale, causal, bq, bk,
+                            interpret)
 
 
 @register_kernel("flash_attention", "tpu")
 def _flash_attention_tpu(q, k, v, attn_mask=None, dropout_p: float = 0.0,
-                         causal: bool = False, scale: Optional[float] = None):
+                         causal: bool = False, scale: Optional[float] = None,
+                         segment_ids=None):
     return flash_attention_pallas(q, k, v, attn_mask=attn_mask,
                                   dropout_p=dropout_p, causal=causal,
-                                  scale=scale)
+                                  scale=scale, segment_ids=segment_ids)

@@ -1,0 +1,151 @@
+"""Pallas TPU paged-KV decode attention (vLLM-style PagedAttention).
+
+Reference analogue: paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu (the paged decode kernel behind
+incubate block_multihead_attention). TPU redesign: one Pallas kernel whose
+grid walks each sequence's pages via a SCALAR-PREFETCHED block table — the
+BlockSpec index_map reads the table to stream the right physical page from
+HBM into VMEM, so the gather never materializes [B, max_pages*page_size]
+in HBM (which is what the XLA composition's jnp.take does). Online softmax
+(running max/denominator in VMEM scratch) across pages; the GQA query-head
+group is processed together per kv head ([group, d] x [page, d] MXU
+contractions).
+
+Semantics match incubate.nn.functional.block_multihead_attention: scores
+over positions 0..seq_len INCLUSIVE (the new token was just written at
+offset seq_len).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size, group):
+    """Grid (B, H_kv, max_pages); innermost sequential over pages."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    np_ = pl.num_programs(2)
+    seq_len = lens_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages fully past the sequence (and unmapped table slots) are skipped
+    @pl.when(p * page_size <= seq_len)
+    def _compute():
+        q = q_ref[0, 0, :, :]                     # [group, d]
+        k = k_ref[0, :, 0, :]                     # [page, d]
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [group, page]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos <= seq_len, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(pr, axis=-1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(p == np_ - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """One decode step of attention over a paged KV cache.
+
+    q:            [B, H, D] — the new token's queries
+    k/v_pages:    [num_pages, page_size, H_kv, D] block pools
+    block_tables: [B, max_pages] int32; logical page i -> pool id (-1 unused)
+    seq_lens:     [B] int32 tokens already cached (new token at this offset)
+
+    Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    num_pages, page_size, H_kv, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    group = H // H_kv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    tables = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    qg = q.reshape(B, H_kv, group, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H_kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D),
+                         lambda b, h, p, tables, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, D),
+                         lambda b, h, p, tables, lens: (tables[b, p], 0, h, 0)),
+            pl.BlockSpec((1, page_size, 1, D),
+                         lambda b, h, p, tables, lens: (tables[b, p], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D),
+                               lambda b, h, p, tables, lens: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((group, 128), jnp.float32),
+                        pltpu.VMEM((group, 128), jnp.float32),
+                        pltpu.VMEM((group, D), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page_size=page_size,
+                          group=group),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H_kv, group, D), q.dtype),
+        compiler_params=_tpu_params(),
+        interpret=interpret,
+    )(tables, lens, qg, k_pages, v_pages)
+    return out.reshape(B, H, D)
+
+
+def _tpu_params():
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def paged_decode_supported(q, k_pages) -> bool:
+    if not _HAS_PLTPU:
+        return False
+    B, H, D = q.shape
+    H_kv = k_pages.shape[2]
+    page_size = k_pages.shape[1]
+    return (H % H_kv == 0 and D in (32, 64, 128, 256)
+            and page_size % 8 == 0)
+
+
+__all__ = ["paged_decode_attention", "paged_decode_supported"]

@@ -1,0 +1,68 @@
+"""Safe TPU-availability probing.
+
+Tunneled TPU PJRT plugins can hang indefinitely inside backend init (not
+just fail), so availability is checked in a killable SUBPROCESS: the child
+runs in its own session and the whole process group is SIGKILLed on
+timeout. Used by bench.py and tools/tune_kernels.py before they commit
+this process to a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+PROBE_CODE = ("import jax; d=jax.devices(); "
+              "from paddle_tpu.ops.registry import device_is_tpu; "
+              "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
+
+
+def probe_tpu(attempts: int = 2, timeout: float = 240.0,
+              sleep: float = 20.0,
+              cwd: Optional[str] = None) -> Tuple[bool, Optional[str]]:
+    """Returns (tpu_available, note). The child must print TPU_OK — a
+    silent CPU fallback in the child does not count as TPU."""
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return False, "PT_BENCH_FORCE_CPU set"
+    note = None
+    cwd = cwd or os.getcwd()
+    for i in range(attempts):
+        p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True, start_new_session=True, cwd=cwd)
+        try:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode == 0 and "TPU_OK" in out:
+                return True, None
+            note = (f"probe attempt {i + 1}/{attempts} rc={p.returncode} "
+                    f"platform={out.strip()[-40:] or '?'}: "
+                    f"{(err or '').strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+            note = (f"probe attempt {i + 1}/{attempts} hung "
+                    f">{timeout:.0f}s (TPU tunnel wedged?)")
+        sys.stderr.write(note + "\n")
+        if i < attempts - 1:
+            time.sleep(sleep)
+    return False, note
+
+
+def force_cpu():
+    """Pin this process to the CPU backend (wins over the site hook's
+    forced platform selection); call before any backend init."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+__all__ = ["probe_tpu", "force_cpu", "PROBE_CODE"]

@@ -1,0 +1,101 @@
+"""Kernel autotune DB tests (reference: phi/kernels/autotune/cache.h —
+AutoTuneCache keyed lookup; CINN auto_schedule/database persistence)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.autotune import (TuneDB, flash_attention_config,
+                                            get_db)
+
+
+def test_bucket_powers_of_two():
+    assert TuneDB.bucket(1) == 128
+    assert TuneDB.bucket(128) == 128
+    assert TuneDB.bucket(129) == 256
+    assert TuneDB.bucket(2048) == 2048
+    assert TuneDB.bucket(3000) == 4096
+
+
+def test_key_buckets_seq_dims_only():
+    k1 = TuneDB.key("fa", "TPU v5e", "bfloat16", sq=2000, sk=2048, d=128)
+    k2 = TuneDB.key("fa", "TPU v5e", "bfloat16", sq=2048, sk=2048, d=128)
+    assert k1 == k2
+    k3 = TuneDB.key("fa", "TPU v5e", "bfloat16", sq=2048, sk=2048, d=64)
+    assert k3 != k1  # d is not a seq dim: kept exact
+
+
+def test_record_save_load_roundtrip(tmp_path, monkeypatch):
+    path = str(tmp_path / "db.json")
+    monkeypatch.setenv("PT_TUNE_DB", path)
+    db = TuneDB()
+    key = TuneDB.key("flash_attention", "TPU v5e", "bfloat16",
+                     sq=2048, sk=2048, d=128, causal=1)
+    db.record(key, {"block_q": 256, "block_k": 512, "us": 123.4})
+    db.save()
+    fresh = TuneDB()
+    hit = fresh.lookup(key)
+    assert hit == {"block_q": 256, "block_k": 512, "us": 123.4}
+    # merge-over: a second save with a different key keeps the first
+    db2 = TuneDB()
+    db2.record("other|key", {"block_q": 128, "block_k": 128})
+    db2.save()
+    data = json.load(open(path))
+    assert key in data and "other|key" in data
+
+
+def test_dispatch_uses_db_on_tpu(monkeypatch, tmp_path):
+    """flash_attention_config consults the DB when the backend is TPU."""
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.ops import registry
+
+    path = str(tmp_path / "db.json")
+    monkeypatch.setenv("PT_TUNE_DB", path)
+    key = TuneDB.key("flash_attention", "TPU v5e", "bfloat16",
+                     sq=4096, sk=4096, d=128, causal=1)
+    json.dump({key: {"block_q": 512, "block_k": 256}}, open(path, "w"))
+
+    fresh = TuneDB()
+    monkeypatch.setattr(autotune, "_DB", fresh)
+    monkeypatch.setattr(registry, "backend_kind", lambda: "tpu")
+
+    class FakeDev:
+        device_kind = "TPU v5e"
+
+    import jax
+    monkeypatch.setattr(autotune, "flash_attention_config",
+                        autotune.flash_attention_config)
+    real_devices = jax.devices
+    monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
+    try:
+        bq, bk = flash_attention_config(4096, 4096, 128, "bfloat16", True)
+    finally:
+        monkeypatch.setattr(jax, "devices", real_devices)
+    assert (bq, bk) == (512, 256)
+    # unknown shape falls back to defaults
+    monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
+    bq, bk = flash_attention_config(1024, 1024, 64, "bfloat16", False)
+    assert (bq, bk) == (128, 128)
+
+
+def test_dispatch_defaults_on_cpu():
+    assert flash_attention_config(256, 256, 64, "float32", True) \
+        == (128, 128)
+
+
+def test_flash_attention_auto_blocks_still_correct():
+    """End-to-end: block sizes resolved via autotune path (defaults on CPU)
+    produce the same result as explicit blocks."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.normal(0, 1, (1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rs.normal(0, 1, (1, 128, 2, 32)), jnp.float32)
+    v = jnp.asarray(rs.normal(0, 1, (1, 128, 2, 32)), jnp.float32)
+    auto = flash_attention_pallas(q, k, v, causal=True, interpret=True)
+    manual = flash_attention_pallas(q, k, v, causal=True, interpret=True,
+                                    block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(manual),
+                               rtol=2e-5, atol=2e-5)
