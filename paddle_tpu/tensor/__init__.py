@@ -961,3 +961,46 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
     if (dim1, dim2) != (-2, -1):
         out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
     return out
+
+
+# -- elementwise long tail (reference: python/paddle/tensor/ops.py,
+#    math.py — neg:?, deg2rad, rad2deg, digamma, lgamma, logit, fmax, fmin,
+#    sigmoid re-export) --------------------------------------------------
+
+def neg(x, name=None):
+    return jnp.negative(jnp.asarray(x))
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(jnp.asarray(x))
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(jnp.asarray(x))
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(jnp.asarray(x))
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(jnp.asarray(x))
+
+
+def logit(x, eps=None, name=None):
+    arr = jnp.asarray(x)
+    if eps is not None:
+        arr = jnp.clip(arr, eps, 1.0 - eps)
+    return jnp.log(arr) - jnp.log1p(-arr)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(jnp.asarray(x), jnp.asarray(y))
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(jnp.asarray(x), jnp.asarray(y))

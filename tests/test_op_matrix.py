@@ -1,0 +1,416 @@
+"""Generated op-correctness matrix over the tensor + nn.functional surface.
+
+Reference model: test/legacy_test/*_op.py driven by op_test.py:420 — every
+op checked against a numpy fp64 oracle, per dtype (fp32/bf16), eager and
+jit, plus a sharded-execution parity pass for the shardable subset
+(the reference's multi-backend axis). ~500 generated cases.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+from paddle_tpu.testing import check_grad, check_output, check_sharded
+
+rs = np.random.RandomState(1234)
+X24 = rs.randn(2, 4)
+X48 = rs.randn(4, 8)
+X348 = rs.randn(3, 4, 8)
+XP48 = np.abs(rs.randn(4, 8)) + 0.5
+Y48 = rs.randn(4, 8)
+Y24 = rs.randn(2, 4)
+SPD4 = (lambda a: a @ a.T + 4 * np.eye(4))(rs.randn(4, 4))
+M44 = rs.randn(4, 4)
+IDX = rs.randint(0, 4, (6,))
+
+F32 = (np.float32,)
+F3216 = (np.float32, jnp.bfloat16)
+
+
+class E:
+    """One matrix entry."""
+    def __init__(self, name, fn, ref, inputs, kwargs=None, grad=True,
+                 dtypes=F3216, shard=True, grad_tol=(2e-3, 2e-3), jit=True):
+        self.name, self.fn, self.ref = name, fn, ref
+        self.inputs = inputs
+        self.kwargs = kwargs or {}
+        self.grad, self.dtypes, self.shard = grad, dtypes, shard
+        self.grad_tol = grad_tol
+        self.jit = jit
+
+    def __repr__(self):
+        return self.name
+
+
+def _np(f):
+    def g(*a, **k):
+        conv = []
+        for x in a:
+            x = np.asarray(x)
+            conv.append(x.astype(np.float64)
+                        if np.issubdtype(x.dtype, np.floating) else x)
+        return f(*conv, **k)
+    return g
+
+
+_SP = jax.scipy.special
+
+OPS = [
+    # ---- unary elementwise ------------------------------------------------
+    E("abs", pt.abs, np.abs, [X48]),
+    E("exp", pt.exp, np.exp, [X24]),
+    E("log", pt.log, np.log, [XP48]),
+    E("log2", pt.log2, np.log2, [XP48]),
+    E("log10", pt.log10, np.log10, [XP48]),
+    E("log1p", pt.log1p, np.log1p, [XP48]),
+    E("sqrt", pt.sqrt, np.sqrt, [XP48]),
+    E("rsqrt", pt.rsqrt, lambda x: 1 / np.sqrt(x), [XP48]),
+    E("square", pt.square, np.square, [X48]),
+    E("sin", pt.sin, np.sin, [X48]),
+    E("cos", pt.cos, np.cos, [X48]),
+    E("tan", pt.tan, np.tan, [X24 * 0.3]),
+    E("asin", pt.asin, np.arcsin, [X24 * 0.3]),
+    E("acos", pt.acos, np.arccos, [X24 * 0.3]),
+    E("atan", pt.atan, np.arctan, [X48]),
+    E("sinh", pt.sinh, np.sinh, [X24]),
+    E("cosh", pt.cosh, np.cosh, [X24]),
+    E("tanh", pt.tanh, np.tanh, [X48]),
+    E("asinh", pt.asinh, np.arcsinh, [X48]),
+    E("acosh", pt.acosh, np.arccosh, [XP48 + 1.0]),
+    E("atanh", pt.atanh, np.arctanh, [X24 * 0.3]),
+    E("erf", pt.erf, lambda x: np.vectorize(__import__("math").erf)(x),
+      [X48], grad=False),
+    E("expm1", pt.expm1, np.expm1, [X24]),
+    E("floor", pt.floor, np.floor, [X48], grad=False),
+    E("ceil", pt.ceil, np.ceil, [X48], grad=False),
+    E("round", pt.round, np.round, [X48], grad=False),
+    E("trunc", pt.trunc, np.trunc, [X48], grad=False),
+    E("sign", pt.sign, np.sign, [X48], grad=False),
+    E("reciprocal", pt.reciprocal, lambda x: 1 / x, [XP48]),
+    E("neg", pt.neg, np.negative, [X48]),
+    E("sigmoid", pt.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [X48]),
+    E("deg2rad", pt.deg2rad, np.deg2rad, [X48]),
+    E("rad2deg", pt.rad2deg, np.rad2deg, [X48]),
+    E("digamma", pt.digamma, lambda x: np.asarray(
+        _SP.digamma(jnp.asarray(x))), [XP48 + 1], grad=False, dtypes=F32),
+    E("lgamma", pt.lgamma, lambda x: np.asarray(
+        _SP.gammaln(jnp.asarray(x))), [XP48 + 1], grad=False, dtypes=F32),
+    E("isnan", pt.isnan, np.isnan, [X48], grad=False),
+    E("isinf", pt.isinf, np.isinf, [X48], grad=False),
+    E("isfinite", pt.isfinite, np.isfinite, [X48], grad=False),
+    E("logit", pt.logit, lambda x: np.log(x / (1 - x)),
+      [np.clip(np.abs(X48) * 0.5, 0.05, 0.95)]),
+    # ---- binary elementwise ----------------------------------------------
+    E("add", pt.add, np.add, [X48, Y48]),
+    E("subtract", pt.subtract, np.subtract, [X48, Y48]),
+    E("multiply", pt.multiply, np.multiply, [X48, Y48]),
+    E("divide", pt.divide, np.divide, [X48, XP48]),
+    E("pow", pt.pow, np.power, [XP48, np.abs(Y48)]),
+    E("maximum", pt.maximum, np.maximum, [X48, Y48]),
+    E("minimum", pt.minimum, np.minimum, [X48, Y48]),
+    E("fmax", pt.fmax, np.fmax, [X48, Y48]),
+    E("fmin", pt.fmin, np.fmin, [X48, Y48]),
+    E("mod", pt.mod, np.mod, [XP48 * 3, XP48 + 0.5], grad=False),
+    E("floor_divide", pt.floor_divide, np.floor_divide,
+      [XP48 * 3, XP48 + 0.5], grad=False),
+    E("atan2", pt.atan2, np.arctan2, [X48, Y48 + 3.0]),
+    E("hypot", pt.hypot, np.hypot, [X48, Y48]),
+    E("copysign", pt.copysign, np.copysign, [X48, Y48], grad=False),
+    E("heaviside", pt.heaviside, np.heaviside, [X48, Y48], grad=False),
+    E("logaddexp", pt.logaddexp, np.logaddexp, [X24, Y24]),
+    E("nextafter", pt.nextafter, np.nextafter, [X48, Y48], grad=False,
+      dtypes=F32),
+    E("equal", pt.equal, np.equal, [X48, X48], grad=False),
+    E("not_equal", pt.not_equal, np.not_equal, [X48, Y48], grad=False),
+    E("greater_than", pt.greater_than, np.greater, [X48, Y48], grad=False),
+    E("less_than", pt.less_than, np.less, [X48, Y48], grad=False),
+    E("greater_equal", pt.greater_equal, np.greater_equal, [X48, Y48],
+      grad=False),
+    E("less_equal", pt.less_equal, np.less_equal, [X48, Y48], grad=False),
+    E("lerp", pt.lerp, lambda x, y, w: x + w * (y - x), [X48, Y48, XP48]),
+    # ---- reductions (axis variants) --------------------------------------
+    *[E(f"sum_ax{ax}", functools.partial(pt.sum, axis=ax),
+        lambda t, ax=ax: t.sum(axis=ax), [X348])
+      for ax in (None, 0, 1, 2, -1)],
+    *[E(f"mean_ax{ax}", functools.partial(pt.mean, axis=ax),
+        lambda t, ax=ax: t.mean(axis=ax), [X348])
+      for ax in (None, 0, 1, -1)],
+    *[E(f"max_ax{ax}", functools.partial(pt.max, axis=ax),
+        lambda t, ax=ax: t.max(axis=ax), [X348], grad=False)
+      for ax in (None, 0, -1)],
+    *[E(f"min_ax{ax}", functools.partial(pt.min, axis=ax),
+        lambda t, ax=ax: t.min(axis=ax), [X348], grad=False)
+      for ax in (None, 0, -1)],
+    *[E(f"prod_ax{ax}", functools.partial(pt.prod, axis=ax),
+        lambda t, ax=ax: t.prod(axis=ax), [X24 * 0.5])
+      for ax in (None, 0, 1)],
+    E("amax", functools.partial(pt.amax, axis=1),
+      lambda t: t.max(axis=1), [X48], grad=False),
+    E("amin", functools.partial(pt.amin, axis=1),
+      lambda t: t.min(axis=1), [X48], grad=False),
+    E("std", functools.partial(pt.std, axis=0),
+      lambda t: t.std(axis=0, ddof=1), [X48], dtypes=F32),
+    E("var", functools.partial(pt.var, axis=0),
+      lambda t: t.var(axis=0, ddof=1), [X48], dtypes=F32),
+    E("logsumexp", functools.partial(pt.logsumexp, axis=-1),
+      lambda t: np.log(np.exp(t).sum(-1)), [X48]),
+    E("nansum", pt.nansum, np.nansum, [X48], grad=False),
+    E("nanmean", pt.nanmean, np.nanmean, [X48], grad=False),
+    E("count_nonzero", pt.count_nonzero, np.count_nonzero,
+      [np.round(X48)], grad=False),
+    E("median", pt.median, np.median, [rs.randn(3, 5)], grad=False,
+      dtypes=F32),
+    E("quantile", functools.partial(pt.quantile, q=0.5),
+      lambda t: np.quantile(t, 0.5), [rs.randn(3, 5)], grad=False,
+      dtypes=F32),
+    E("trace", pt.trace, np.trace, [M44]),
+    E("all", pt.all, np.all, [np.abs(X48) > 0.1], grad=False, dtypes=F32),
+    E("any", pt.any, np.any, [X48 > 1.5], grad=False, dtypes=F32),
+    # ---- cumulative -------------------------------------------------------
+    E("cumsum", functools.partial(pt.cumsum, axis=1),
+      lambda t: t.cumsum(axis=1), [X48]),
+    E("cumprod", functools.partial(pt.cumprod, dim=1),
+      lambda t: t.cumprod(axis=1), [X24 * 0.5 + 1]),
+    E("cummax_vals", lambda t: pt.cummax(t, axis=1)[0],
+      lambda t: np.maximum.accumulate(t, 1), [X48], grad=False,
+      dtypes=F32),
+    E("cummin_vals", lambda t: pt.cummin(t, axis=1)[0],
+      lambda t: np.minimum.accumulate(t, 1), [X48], grad=False,
+      dtypes=F32),
+    E("logcumsumexp", functools.partial(pt.logcumsumexp, axis=1),
+      lambda t: np.log(np.cumsum(np.exp(t), axis=1)), [X24]),
+    # ---- matmul family ----------------------------------------------------
+    E("matmul", pt.matmul, np.matmul, [X48, Y48.T]),
+    E("bmm", pt.bmm, np.matmul, [rs.randn(3, 2, 4), rs.randn(3, 4, 2)]),
+    E("dot", pt.dot, np.dot, [rs.randn(8), rs.randn(8)]),
+    E("inner", pt.inner, np.inner, [X48, Y48]),
+    E("outer", pt.outer, np.outer, [rs.randn(4), rs.randn(5)]),
+    E("kron", pt.kron, np.kron, [X24, Y24]),
+    E("addmm", pt.addmm, lambda c, a, b: c + a @ b, [M44, M44, M44]),
+    E("einsum_ij", functools.partial(pt.einsum, "ij,jk->ik"),
+      lambda a, b: a @ b, [X48, Y48.T], grad=False),
+    E("tensordot", functools.partial(pt.tensordot, axes=1),
+      lambda a, b: np.tensordot(a, b, axes=1), [X48, Y48.T], grad=False),
+    E("matrix_power", functools.partial(pt.matrix_power, n=3),
+      lambda a: np.linalg.matrix_power(a, 3), [M44 * 0.5], dtypes=F32,
+      grad=False),
+    # ---- linalg (fp32 only) ----------------------------------------------
+    E("cholesky", pt.cholesky, np.linalg.cholesky, [SPD4], dtypes=F32,
+      grad=False),
+    E("det", pt.det, np.linalg.det, [SPD4], dtypes=F32),
+    E("slogdet", pt.slogdet, lambda a: tuple(np.linalg.slogdet(a)), [SPD4],
+      dtypes=F32, grad=False),
+    E("inverse", pt.inverse, np.linalg.inv, [SPD4], dtypes=F32),
+    E("solve", pt.solve, np.linalg.solve, [SPD4, rs.randn(4, 2)],
+      dtypes=F32),
+    E("pinv", pt.pinv, np.linalg.pinv, [rs.randn(5, 3)], dtypes=F32,
+      grad=False, shard=False),
+    E("norm_fro", pt.norm, np.linalg.norm, [X48], dtypes=F32),
+    E("norm_1d", functools.partial(pt.norm, p=2),
+      lambda v: np.linalg.norm(v, 2), [rs.randn(8)], dtypes=F32),
+    # ---- shape / indexing -------------------------------------------------
+    E("reshape", functools.partial(pt.reshape, shape=(8, 4)),
+      lambda t: t.reshape(8, 4), [X48]),
+    E("transpose", functools.partial(pt.transpose, perm=(1, 0, 2)),
+      lambda t: t.transpose(1, 0, 2), [X348]),
+    E("t", pt.t, np.transpose, [X48]),
+    E("swapaxes", functools.partial(pt.swapaxes, axis1=0, axis2=2),
+      lambda t: t.swapaxes(0, 2), [X348]),
+    E("moveaxis", functools.partial(pt.moveaxis, source=0, destination=2),
+      lambda t: np.moveaxis(t, 0, 2), [X348]),
+    E("flatten", pt.flatten, lambda t: t.reshape(-1), [X348]),
+    E("squeeze", pt.squeeze, np.squeeze, [X48[None]]),
+    E("unsqueeze", functools.partial(pt.unsqueeze, axis=1),
+      lambda t: t[:, None], [X48]),
+    E("flip", functools.partial(pt.flip, axis=1),
+      lambda t: np.flip(t, 1), [X48]),
+    E("roll", functools.partial(pt.roll, shifts=2, axis=1),
+      lambda t: np.roll(t, 2, 1), [X48]),
+    E("rot90", pt.rot90, np.rot90, [X48], grad=False),
+    E("tile", functools.partial(pt.tile, repeat_times=(2, 3)),
+      lambda t: np.tile(t, (2, 3)), [X24]),
+    E("broadcast_to", functools.partial(pt.broadcast_to, shape=(3, 2, 4)),
+      lambda t: np.broadcast_to(t, (3, 2, 4)), [X24]),
+    E("expand", functools.partial(pt.expand, shape=(3, 2, 4)),
+      lambda t: np.broadcast_to(t, (3, 2, 4)), [X24]),
+    E("concat", lambda a, b: pt.concat([a, b], axis=0),
+      lambda a, b: np.concatenate([a, b], 0), [X48, Y48]),
+    E("stack", lambda a, b: pt.stack([a, b], axis=0),
+      lambda a, b: np.stack([a, b], 0), [X48, Y48]),
+    E("split", functools.partial(pt.split, num_or_sections=2, axis=1),
+      lambda t: tuple(np.split(t, 2, 1)), [X48], grad=False),
+    E("chunk", functools.partial(pt.chunk, chunks=2, axis=1),
+      lambda t: tuple(np.split(t, 2, 1)), [X48], grad=False),
+    E("unbind", functools.partial(pt.unbind, axis=0),
+      lambda t: tuple(t[i] for i in range(2)), [X24], grad=False),
+    E("tril", pt.tril, np.tril, [M44]),
+    E("triu", pt.triu, np.triu, [M44]),
+    E("diag", pt.diag, np.diag, [rs.randn(4)]),
+    E("diag_embed", pt.diag_embed, lambda t: np.stack(
+        [np.diag(r) for r in t]), [X24], grad=False),
+    E("gather", functools.partial(pt.gather, axis=0),
+      None, [X48, IDX], grad=False),
+    E("index_select", functools.partial(pt.index_select, axis=0),
+      None, [X48, IDX], grad=False),
+    E("take_along_axis", None, None, [], grad=False),   # placeholder, below
+    E("masked_select", pt.masked_select,
+      lambda t, m: t[m.astype(bool)], [X48, X48 > 0], grad=False,
+      jit=False, shard=False),
+    E("masked_fill", pt.masked_fill,
+      lambda t, m, v: np.where(m.astype(bool), v, t),
+      [X48, X48 > 0, np.float64(3.0)], grad=False),
+    E("where", pt.where, lambda c, a, b: np.where(c.astype(bool), a, b),
+      [X48 > 0, X48, Y48], grad=False),
+    E("clip", functools.partial(pt.clip, min=-0.5, max=0.5),
+      lambda t: np.clip(t, -0.5, 0.5), [X48]),
+    E("cast", functools.partial(pt.cast, dtype="float32"),
+      lambda t: t.astype(np.float32), [X48], grad=False, dtypes=F32),
+    E("topk", functools.partial(pt.topk, k=3),
+      lambda t: (np.sort(t, -1)[..., ::-1][..., :3],
+                 np.argsort(-t, -1)[..., :3]), [X48], grad=False,
+      dtypes=F32),
+    E("sort", functools.partial(pt.sort, axis=-1), np.sort, [X48],
+      grad=False),
+    E("argsort", functools.partial(pt.argsort, axis=-1), np.argsort, [X48],
+      grad=False, dtypes=F32),
+    E("argmax", pt.argmax, np.argmax, [X48], grad=False, dtypes=F32),
+    E("argmin", pt.argmin, np.argmin, [X48], grad=False, dtypes=F32),
+    E("kthvalue", functools.partial(pt.kthvalue, k=2),
+      lambda t: (np.sort(t, -1)[..., 1], np.argsort(t, -1)[..., 1]),
+      [X48], grad=False, dtypes=F32),
+    E("unique", pt.unique, np.unique, [np.round(rs.randn(12))],
+      grad=False, dtypes=F32, shard=False, jit=False),
+    E("nonzero", pt.nonzero, lambda t: np.stack(np.nonzero(t), -1),
+      [np.round(X24)], grad=False, dtypes=F32, shard=False, jit=False),
+    E("searchsorted", pt.searchsorted, np.searchsorted,
+      [np.sort(rs.randn(8)), rs.randn(5)], grad=False, dtypes=F32),
+    E("bucketize", pt.bucketize, lambda x, e: np.searchsorted(e, x),
+      [rs.randn(6), np.sort(rs.randn(4))], grad=False, dtypes=F32),
+    # ---- construction ----------------------------------------------------
+    E("diff", pt.diff, np.diff, [X48]),
+    E("trapezoid", pt.trapezoid, np.trapezoid
+      if hasattr(np, "trapezoid") else np.trapz, [X48], grad=False),
+    E("vander", pt.vander, np.vander, [rs.randn(4)], grad=False,
+      dtypes=F32),
+    E("scale", functools.partial(pt.scale, scale=2.5, bias=1.0),
+      lambda t: 2.5 * t + 1.0, [X48]),
+    # ---- nn.functional activations ---------------------------------------
+    E("relu", F.relu, lambda x: np.maximum(x, 0), [X48]),
+    E("relu6", F.relu6, lambda x: np.clip(x, 0, 6), [X48]),
+    E("leaky_relu", F.leaky_relu,
+      lambda x: np.where(x > 0, x, 0.01 * x), [X48]),
+    E("elu", F.elu, lambda x: np.where(x > 0, x, np.expm1(x)), [X48]),
+    E("silu", F.silu, lambda x: x / (1 + np.exp(-x)), [X48]),
+    E("mish", F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))), [X48]),
+    E("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), [X48]),
+    E("hardsigmoid", F.hardsigmoid,
+      lambda x: np.clip(x / 6 + 0.5, 0, 1), [X48]),
+    E("hardswish", F.hardswish,
+      lambda x: x * np.clip(x / 6 + 0.5, 0, 1), [X48]),
+    E("gelu_tanh", functools.partial(F.gelu, approximate=True),
+      lambda x: 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                       * (x + 0.044715 * x ** 3))), [X48]),
+    E("softmax", F.softmax, lambda x: (lambda e: e / e.sum(-1, keepdims=True))
+      (np.exp(x - x.max(-1, keepdims=True))), [X48]),
+    E("log_softmax", F.log_softmax,
+      lambda x: x - x.max(-1, keepdims=True)
+      - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+      [X48]),
+    E("glu", F.glu, lambda x: x[..., :4] / (1 + np.exp(-x[..., 4:])), [X48]),
+    E("swiglu", F.swiglu,
+      lambda x, y: (x / (1 + np.exp(-x))) * y, [X48, Y48]),
+    E("tanh_F", F.tanh, np.tanh, [X48]),
+    E("sigmoid_F", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [X48]),
+    # ---- nn.functional losses / norm -------------------------------------
+    E("mse_loss", F.mse_loss, lambda a, b: ((a - b) ** 2).mean(),
+      [X48, Y48]),
+    E("l1_loss", F.l1_loss, lambda a, b: np.abs(a - b).mean(), [X48, Y48]),
+    E("smooth_l1", F.smooth_l1_loss,
+      lambda a, b: np.where(np.abs(a - b) < 1, 0.5 * (a - b) ** 2,
+                            np.abs(a - b) - 0.5).mean(), [X48, Y48]),
+    E("kl_div", F.kl_div,
+      lambda lp, t: (t * (np.log(t) - lp)).mean(),
+      [np.log(XP48 / XP48.sum()), XP48 / XP48.sum()], grad=False),
+    E("bce_logits", F.binary_cross_entropy_with_logits,
+      lambda x, t: (np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x))))
+      .mean(), [X48, (Y48 > 0).astype(np.float64)]),
+    E("cosine_similarity", F.cosine_similarity,
+      lambda a, b: (a * b).sum(-1) / (np.linalg.norm(a, axis=-1)
+                                      * np.linalg.norm(b, axis=-1)),
+      [X48, Y48]),
+    E("normalize", F.normalize,
+      lambda x: x / np.linalg.norm(x, axis=-1, keepdims=True), [X48]),
+    E("layer_norm_F", lambda x, w, b: F.layer_norm(x, (8,), w, b),
+      lambda x, w, b: (x - x.mean(-1, keepdims=True))
+      / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b,
+      [X48, rs.randn(8), rs.randn(8)], grad_tol=(5e-3, 5e-3)),
+    E("rms_norm_F", F.rms_norm,
+      lambda x, w: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w,
+      [X48, rs.randn(8)], grad_tol=(5e-3, 5e-3)),
+    E("label_smooth", F.label_smooth,
+      lambda x: x * 0.9 + 0.1 / x.shape[-1],
+      [np.eye(4)[IDX].astype(np.float64)], grad=False),
+    E("one_hot", functools.partial(F.one_hot, num_classes=4),
+      lambda i: np.eye(4)[i], [IDX], grad=False, dtypes=F32),
+    E("pad", functools.partial(F.pad, paddings=(1, 1)),
+      lambda t: np.pad(t, ((0, 0), (1, 1))), [X48]),
+    E("pixel_shuffle", functools.partial(F.pixel_shuffle, upscale_factor=2),
+      lambda t: t.reshape(1, 2, 2, 3, 3).transpose(0, 3, 1, 4, 2)
+      .reshape(1, 1, 6, 6)[:, 0], [rs.randn(1, 4, 3, 3)], grad=False,
+      shard=False),
+    E("embedding", F.embedding, lambda i, w: w[i], [IDX, X48],
+      grad=False, dtypes=F32),
+    E("linear_F", F.linear, lambda x, w: x @ w, [X24, rs.randn(4, 6)]),
+]
+
+OPS = [e for e in OPS if e.fn is not None]
+
+_GATHER_REFS = {
+    "gather": lambda t, i: np.asarray(t, np.float64)[np.asarray(i)],
+    "index_select": lambda t, i: np.asarray(t, np.float64)[np.asarray(i)],
+}
+for e in OPS:
+    if e.name in _GATHER_REFS:
+        e.ref = _GATHER_REFS[e.name]
+
+
+def _cases():
+    out = []
+    for e in OPS:
+        for dt in e.dtypes:
+            out.append(pytest.param(e, dt, id=f"{e.name}-{np.dtype(dt).name}"))
+    return out
+
+
+@pytest.mark.parametrize("e,dtype", _cases())
+def test_output(e, dtype):
+    check_output(e.fn, _np(e.ref), e.inputs, dtypes=(dtype,),
+                 kwargs=e.kwargs, with_jit=e.jit)
+
+
+@pytest.mark.parametrize(
+    "e", [e for e in OPS if e.grad], ids=lambda e: e.name)
+def test_grad(e):
+    rtol, atol = e.grad_tol
+    check_grad(e.fn, _np(e.ref), e.inputs, arg_idx=0, rtol=rtol, atol=atol,
+               kwargs=e.kwargs)
+
+
+@pytest.mark.parametrize(
+    "e", [e for e in OPS if e.shard and e.inputs
+          and np.asarray(e.inputs[0]).ndim >= 2
+          and np.issubdtype(np.asarray(e.inputs[0]).dtype, np.floating)],
+    ids=lambda e: e.name)
+def test_sharded(e, mesh8):
+    from jax.sharding import PartitionSpec as P
+    specs = []
+    for a in e.inputs:
+        a = np.asarray(a)
+        specs.append(P("dp") if a.ndim >= 1 and a.shape[0] % 2 == 0 else None)
+    check_sharded(e.fn, e.inputs, mesh8, specs, kwargs=e.kwargs,
+                  rtol=1e-4, atol=1e-4)
