@@ -384,12 +384,19 @@ def pad(x, paddings, mode: str = "constant", value: float = 0.0,
         n_spec = len(paddings) // 2
         pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(n_spec)]
         cfg = [(0, 0)] * x.ndim
-        if data_format.startswith("NC"):       # NCL / NCHW / NCDHW
-            spatial_dims = list(range(2, x.ndim))
-        else:                                   # NLC / NHWC / NDHWC
-            spatial_dims = list(range(1, x.ndim - 1))
-        for i, dim in enumerate(reversed(spatial_dims[-n_spec:])):
-            cfg[dim] = pairs[i]
+        if n_spec == x.ndim:
+            # full-rank flat list: pads first dim → last dim (paddle constant
+            # mode with len(pad) == 2*ndim)
+            cfg = pairs
+        else:
+            if x.ndim >= 3 and data_format.startswith("NC"):  # NCL/NCHW/NCDHW
+                spatial_dims = list(range(2, x.ndim))
+            elif x.ndim >= 3:                                 # NLC/NHWC/NDHWC
+                spatial_dims = list(range(1, x.ndim - 1))
+            else:  # low-rank tensors: pad trailing dims, last dim first
+                spatial_dims = list(range(x.ndim))
+            for i, dim in enumerate(reversed(spatial_dims[-n_spec:])):
+                cfg[dim] = pairs[i]
     if mode == "constant":
         return jnp.pad(x, cfg, mode="constant", constant_values=value)
     jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

@@ -951,7 +951,8 @@ def cartesian_prod(x, name=None):
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
     arr = jnp.asarray(x)
-    n = arr.shape[-1] + abs(offset)
+    offset = int(offset)  # static: shapes derive from it (module-level `abs`
+    n = arr.shape[-1] + (offset if offset >= 0 else -offset)  # is jnp.abs)
     out_shape = arr.shape[:-1] + (n, n)
     out = jnp.zeros(out_shape, arr.dtype)
     i = jnp.arange(arr.shape[-1])

@@ -360,8 +360,10 @@ OPS = [
     E("pad", functools.partial(F.pad, paddings=(1, 1)),
       lambda t: np.pad(t, ((0, 0), (1, 1))), [X48]),
     E("pixel_shuffle", functools.partial(F.pixel_shuffle, upscale_factor=2),
-      lambda t: t.reshape(1, 2, 2, 3, 3).transpose(0, 3, 1, 4, 2)
-      .reshape(1, 1, 6, 6)[:, 0], [rs.randn(1, 4, 3, 3)], grad=False,
+      # paddle NCHW semantics: out[n, c, h*r+i, w*r+j] = x[n, c*r*r + i*r + j,
+      # h, w]; output stays 4-D [N, C/r^2, H*r, W*r]
+      lambda t: t.reshape(1, 1, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3)
+      .reshape(1, 1, 6, 6), [rs.randn(1, 4, 3, 3)], grad=False,
       shard=False),
     E("embedding", F.embedding, lambda i, w: w[i], [IDX, X48],
       grad=False, dtypes=F32),
