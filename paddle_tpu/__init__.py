@@ -47,6 +47,7 @@ from . import audio
 from . import geometric
 from . import utils
 from . import profiler
+from . import onnx
 from . import hapi
 from .hapi import Model
 from .hapi.summary import summary

@@ -25,6 +25,7 @@ from .strategy import (DistributedStrategy, HybridConfig, AmpConfig,
                        TensorParallelConfig)
 from . import fleet
 from .sharding import group_sharded_parallel, save_group_sharded_model
+from .watchdog import StepWatchdog, watchdog_from_env
 from .recompute import (recompute, recompute_sequential, recompute_hybrid,
                         recompute_wrapper)
 from .. import checkpoint  # paddle.distributed.checkpoint parity

@@ -205,19 +205,20 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_decoder,
     phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu — the
     vLLM-style PagedAttention).
 
-    Cache layout: ``key_cache``/``value_cache`` are block pools
-    [num_blocks, block_size, H_kv, D]; ``block_tables`` [B, max_blocks]
-    maps each sequence's logical block i to a pool block id (−1 = unused);
-    ``seq_lens_decoder`` [B] counts tokens already cached per sequence.
+    Cache layout: ``key_cache``/``value_cache`` are HEAD-MAJOR block pools
+    [H_kv, num_blocks, block_size, D] (the TPU-native layout the Pallas
+    paged kernel streams — consecutive pages of a kv head are contiguous
+    and page blocks are Mosaic (sublane, lane)-legal; the reference's CUDA
+    kernel uses [max_block_nums, kv_num_heads, block_size, head_size]);
+    ``block_tables`` [B, max_blocks] maps each sequence's logical block i
+    to a pool block id (−1 = unused); ``seq_lens_decoder`` [B] counts
+    tokens already cached per sequence.
 
     One decode step: writes the new token's k/v into the right block slot,
     attends q over the sequence's gathered pages. Returns
     (out [B, H*D], key_cache, value_cache) functionally.
-
-    TPU note: the page gather is a jnp.take over the pool (XLA dynamic-
-    gather); block_size should be a multiple of 128 lanes for layout.
     """
-    num_blocks, block_size, H_kv, D = key_cache.shape
+    H_kv, num_blocks, block_size, D = key_cache.shape
     B, max_blocks = block_tables.shape
     HD3 = qkv.shape[-1]
     H = num_heads or (HD3 // 3 // (head_dim or D))
@@ -232,8 +233,11 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_decoder,
     offset = seq_lens % block_size
     b_idx = jnp.arange(B)
     phys_block = block_tables[b_idx, logical_block]        # [B]
-    key_cache = key_cache.at[phys_block, offset].set(k_new)
-    value_cache = value_cache.at[phys_block, offset].set(v_new)
+    # pool[h, phys_block[b], offset[b]] = new[b, h]
+    key_cache = key_cache.at[:, phys_block, offset].set(
+        jnp.swapaxes(k_new, 0, 1))
+    value_cache = value_cache.at[:, phys_block, offset].set(
+        jnp.swapaxes(v_new, 0, 1))
 
     # TPU fast path: Pallas paged-decode kernel streams pages via a
     # scalar-prefetched block table, never gathering [B, T] into HBM
@@ -246,13 +250,13 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_decoder,
                                      value_cache, block_tables, seq_lens)
         return out.reshape(B, -1), key_cache, value_cache
 
-    # gather each sequence's pages: [B, max_blocks, block_size, H_kv, D]
+    # gather each sequence's pages: [H_kv, B, max_blocks, block_size, D]
     safe_tables = jnp.maximum(block_tables, 0)
-    k_pages = key_cache[safe_tables]
-    v_pages = value_cache[safe_tables]
+    k_pages = key_cache[:, safe_tables]
+    v_pages = value_cache[:, safe_tables]
     T = max_blocks * block_size
-    k_seq = k_pages.reshape(B, T, H_kv, D)
-    v_seq = v_pages.reshape(B, T, H_kv, D)
+    k_seq = jnp.moveaxis(k_pages.reshape(H_kv, B, T, D), 0, 2)  # [B,T,H_kv,D]
+    v_seq = jnp.moveaxis(v_pages.reshape(H_kv, B, T, D), 0, 2)
     k_seq = _gqa_expand(k_seq, H)
     v_seq = _gqa_expand(v_seq, H)
 

@@ -81,13 +81,39 @@ class LlamaConfig:
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":
-        return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
-                           num_hidden_layers=2, num_attention_heads=4,
-                           num_key_value_heads=2, max_position_embeddings=256, **kw)
+        defaults = dict(vocab_size=512, hidden_size=128, intermediate_size=384,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=256)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
 
 
 def _normal(std):
     return I.Normal(0.0, std)
+
+
+def causal_lm_loss(logits, labels, ignore_index: int = -100):
+    """Token-weighted mean CE for causal-LM heads.
+
+    When a mesh with an active "tp" axis is present, computes the loss over
+    VOCAB-SHARDED logits via parallel_cross_entropy — the [b, s, vocab]
+    fp32 logits tensor (the single largest activation at Llama-3's 128K
+    vocab: b*s*128256*4 bytes) is never gathered or upcast whole; each tp
+    shard reduces its vocab slice and psums (reference:
+    c_softmax_with_cross_entropy_op.cu:1, surfaced at
+    fleet/layers/mpu/mp_layers.py:741). Otherwise the dense fp32 path.
+    """
+    from ..parallel.mesh import current_mesh
+    hm = current_mesh()
+    if (hm is not None and hm.axis_size("tp") > 1
+            and logits.shape[-1] % hm.axis_size("tp") == 0):
+        from ..parallel.mp_layers import parallel_cross_entropy
+        nll = parallel_cross_entropy(logits, labels,
+                                     ignore_index=ignore_index)
+        cnt = jnp.sum(labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll) / jnp.maximum(cnt, 1.0)
+    return F.cross_entropy(logits.astype(jnp.float32), labels,
+                           ignore_index=ignore_index)
 
 
 class LlamaAttention(nn.Layer):
@@ -116,6 +142,17 @@ class LlamaAttention(nn.Layer):
         k = k.reshape(b, s, n_kv, hd)
         v = v.reshape(b, s, n_kv, hd)
         q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, position_ids)
+        if cfg.sequence_parallel and attn_mask is None:
+            from ..parallel.mesh import current_mesh
+            hm = current_mesh()
+            if hm is not None and hm.axis_size("sep") > 1:
+                # long-context path: K/V stay seq-sharded over "sep" and
+                # rotate through the ring of flash blocks (never a dense
+                # [s, s] score tensor) — SURVEY §5 long-context/SP
+                from ..parallel.ring_attention import ring_attention
+                out = ring_attention(q, k, v, causal=True)
+                out = out.reshape(b, s, n_h * hd)
+                return jnp.matmul(out, self.o_proj.astype(x.dtype))
         if cfg.use_flash_attention:
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=True,
@@ -325,8 +362,7 @@ class LlamaForCausalLM(nn.Layer):
         logits = self.logits(hidden)
         if labels is None:
             return logits
-        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
-                               ignore_index=-100)
+        loss = causal_lm_loss(logits, labels)
         return loss, logits
 
     # -- size accounting (MFU calculator input) -----------------------------
@@ -411,8 +447,7 @@ class LlamaForCausalLMPipe(nn.Layer):
         logits = jnp.matmul(hidden, w.astype(hidden.dtype))
         if labels is None:
             return logits
-        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
-                               ignore_index=-100)
+        loss = causal_lm_loss(logits, labels)
         return loss, logits
 
     def loss_and_grads(self, params, input_ids, labels):
@@ -456,9 +491,9 @@ class LlamaForCausalLMPipe(nn.Layer):
             logits = jnp.matmul(hidden, w.astype(hidden.dtype))
             # (token-summed loss, valid count): pipeline_1f1b normalizes by
             # the GLOBAL count so unevenly-padded microbatches reproduce the
-            # unpipelined token-weighted mean exactly
-            mean = F.cross_entropy(logits.astype(jnp.float32), tgt,
-                                   ignore_index=-100)
+            # unpipelined token-weighted mean exactly. causal_lm_loss keeps
+            # tp-sharded vocab un-gathered (parallel CE) when tp is active.
+            mean = causal_lm_loss(logits, tgt)
             cnt = jnp.sum(tgt != -100).astype(jnp.float32)
             return mean * jnp.maximum(cnt, 1.0), cnt
 

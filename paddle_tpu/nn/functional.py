@@ -549,6 +549,55 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                     causal=is_causal)
 
 
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Attention restricted to a per-(batch, head) CSR sparsity pattern
+    (reference: python/paddle/nn/functional/sparse_attention.py:1, kernel
+    phi/kernels/gpu/sparse_attention — CUDA-only there; here an XLA
+    composition: the CSR pattern scatters into a boolean mask and the
+    masked softmax runs on the MXU. Correct for any pattern; for the
+    block-sparse patterns that actually pay off on TPU, prefer the flash
+    kernel's segment_ids or a dense mask).
+
+    query/key/value: [B, H, S, D]; sparse_csr_offset: [B, H, S+1] int32;
+    sparse_csr_columns: [B, H, nnz] int32. Optional key_padding_mask
+    [B, S] and attn_mask [S, S] follow the reference convention:
+    value 0 masks the position. Returns [B, H, S, D].
+    """
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    offset = jnp.asarray(sparse_csr_offset, jnp.int32)
+    columns = jnp.asarray(sparse_csr_columns, jnp.int32)
+    B, H, S, D = q.shape
+    nnz = columns.shape[-1]
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+
+    def one_mask(off, cols):
+        # row of the j-th stored element = # of offset entries <= j, minus 1
+        rows = jnp.searchsorted(off, jnp.arange(nnz, dtype=jnp.int32),
+                                side="right") - 1
+        rows = jnp.clip(rows, 0, S - 1)
+        return jnp.zeros((S, S), bool).at[rows, cols].set(True)
+
+    mask = jax.vmap(jax.vmap(one_mask))(offset, columns)      # [B,H,S,S]
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask) != 0               # [B, S]
+        mask = mask & kpm[:, None, None, :]
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask) != 0                       # [S, S]
+        mask = mask & am[None, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    # rows with an empty pattern produce zeros, not NaN
+    has_any = jnp.any(mask, axis=-1, keepdims=True)
+    p = jax.nn.softmax(jnp.where(has_any, logits, 0.0), axis=-1)
+    p = jnp.where(has_any, p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = False,
                     return_softmax: bool = False, training: bool = True):
     out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,

@@ -45,19 +45,24 @@ def _fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(ms + eps)                  # [br, 1]
     o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
-    rstd_ref[...] = rstd[:, 0]
+    rstd_ref[...] = rstd                            # [br, 1]
 
 
 def _bwd_kernel(x_ref, w_ref, rstd_ref, dy_ref, dx_ref, dwp_ref):
     x = x_ref[...].astype(jnp.float32)              # [br, D]
     dy = dy_ref[...].astype(jnp.float32)
     w = w_ref[...].astype(jnp.float32)              # [1, D]-broadcastable
-    rstd = rstd_ref[...][:, None]                   # [br, 1]
+    rstd = rstd_ref[...]                            # [br, 1]
     xhat = x * rstd
     wdy = dy * w
     c = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
     dx_ref[...] = ((wdy - xhat * c) * rstd).astype(dx_ref.dtype)
-    dwp_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)  # [1, D] fp32
+    # per-block partial weight grad, padded to a full (8, D) sublane tile
+    # (a (1, D) block over an (nblocks, D) array violates Mosaic's sublane
+    # rule — the round-2 bench died here); only sublane 0 carries data
+    part = jnp.sum(dy * xhat, axis=0, keepdims=True)          # [1, D] fp32
+    sub = jax.lax.broadcasted_iota(jnp.int32, (8, part.shape[1]), 0)
+    dwp_ref[...] = jnp.where(sub == 0, jnp.broadcast_to(part, sub.shape), 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -80,9 +85,11 @@ def _rms_fwd(x2d, w, eps, block_r, interpret):
         in_specs=[_vmem((br, D), lambda r: (r, 0)),
                   _vmem((1, D), lambda r: (0, 0))],
         out_specs=[_vmem((br, D), lambda r: (r, 0)),
-                   _vmem((br,), lambda r: (r,))],
+                   # rstd kept 2-D [R, 1]: rank-1 outputs trip an XLA-vs-
+                   # Mosaic tiling mismatch (T(1024) vs T(256)) on real TPU
+                   _vmem((br, 1), lambda r: (r, 0))],
         out_shape=[jax.ShapeDtypeStruct((R, D), x2d.dtype),
-                   jax.ShapeDtypeStruct((R,), jnp.float32)],
+                   jax.ShapeDtypeStruct((R, 1), jnp.float32)],
         compiler_params=(pltpu.CompilerParams(
             dimension_semantics=("parallel",)) if pltpu else None),
         interpret=interpret,
@@ -105,12 +112,12 @@ def _rms_bwd_rule(eps, block_r, interpret, res, dy):
         grid=(nblocks,),
         in_specs=[_vmem((br, D), lambda r: (r, 0)),
                   _vmem((1, D), lambda r: (0, 0)),
-                  _vmem((br,), lambda r: (r,)),
+                  _vmem((br, 1), lambda r: (r, 0)),
                   _vmem((br, D), lambda r: (r, 0))],
         out_specs=[_vmem((br, D), lambda r: (r, 0)),
-                   _vmem((1, D), lambda r: (r, 0))],
+                   _vmem((8, D), lambda r: (r, 0))],
         out_shape=[jax.ShapeDtypeStruct((R, D), x2d.dtype),
-                   jax.ShapeDtypeStruct((nblocks, D), jnp.float32)],
+                   jax.ShapeDtypeStruct((nblocks * 8, D), jnp.float32)],
         compiler_params=(pltpu.CompilerParams(
             dimension_semantics=("parallel",)) if pltpu else None),
         interpret=interpret,
@@ -123,7 +130,10 @@ _rms_norm_p.defvjp(_rms_fwd_rule, _rms_bwd_rule)
 
 
 def pallas_rms_supported(x, weight) -> bool:
+    import os
     if not _HAS_PLTPU or weight is None:
+        return False
+    if os.environ.get("PT_DISABLE_PALLAS"):
         return False
     D = x.shape[-1]
     R = max(x.size // D, 1)

@@ -11,6 +11,14 @@ in HBM (which is what the XLA composition's jnp.take does). Online softmax
 group is processed together per kv head ([group, d] x [page, d] MXU
 contractions).
 
+Pool layout is HEAD-MAJOR: k/v pools are [H_kv, num_pages, page_size, D]
+(round-3 fix). Mosaic requires each block's last two dims to be
+(sublane, lane)-aligned or equal to the array dims, so the streamed page
+block must be (page_size, D)-shaped in the trailing dims — the round-2
+token-major layout [num_pages, page_size, H_kv, D] put (H_kv, D) last and
+was rejected at lowering for any H_kv > 1. Head-major is also what the
+page stream wants: consecutive pages of one kv head are contiguous.
+
 Semantics match incubate.nn.functional.block_multihead_attention: scores
 over positions 0..seq_len INCLUSIVE (the new token was just written at
 offset seq_len).
@@ -54,8 +62,8 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(p * page_size <= seq_len)
     def _compute():
         q = q_ref[0, 0, :, :]                     # [group, d]
-        k = k_ref[0, :, 0, :]                     # [page, d]
-        v = v_ref[0, :, 0, :]
+        k = k_ref[0, 0, :, :]                     # [page, d]
+        v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [group, page]
@@ -87,14 +95,14 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     """One decode step of attention over a paged KV cache.
 
     q:            [B, H, D] — the new token's queries
-    k/v_pages:    [num_pages, page_size, H_kv, D] block pools
+    k/v_pages:    [H_kv, num_pages, page_size, D] head-major block pools
     block_tables: [B, max_pages] int32; logical page i -> pool id (-1 unused)
     seq_lens:     [B] int32 tokens already cached (new token at this offset)
 
     Returns [B, H, D].
     """
     B, H, D = q.shape
-    num_pages, page_size, H_kv, _ = k_pages.shape
+    H_kv, num_pages, page_size, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     group = H // H_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
@@ -109,10 +117,10 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
         in_specs=[
             pl.BlockSpec((1, 1, group, D),
                          lambda b, h, p, tables, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, D),
-                         lambda b, h, p, tables, lens: (tables[b, p], 0, h, 0)),
-            pl.BlockSpec((1, page_size, 1, D),
-                         lambda b, h, p, tables, lens: (tables[b, p], 0, h, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda b, h, p, tables, lens: (h, tables[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda b, h, p, tables, lens: (h, tables[b, p], 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, group, D),
                                lambda b, h, p, tables, lens: (b, h, 0, 0)),
@@ -139,11 +147,18 @@ def _tpu_params():
 
 
 def paged_decode_supported(q, k_pages) -> bool:
+    """Mosaic-rule gate for the head-major pool layout: page blocks are
+    (1, 1, page_size, D) == the trailing array dims, and the q/out blocks
+    are (1, 1, group, D) == theirs, so only divisibility and a sane D
+    remain to check."""
+    import os
     if not _HAS_PLTPU:
         return False
+    if os.environ.get("PT_DISABLE_PALLAS"):
+        return False
     B, H, D = q.shape
-    H_kv = k_pages.shape[2]
-    page_size = k_pages.shape[1]
+    H_kv = k_pages.shape[0]
+    page_size = k_pages.shape[2]
     return (H % H_kv == 0 and D in (32, 64, 128, 256)
             and page_size % 8 == 0)
 

@@ -77,6 +77,7 @@ class Trainer:
         self._donate = donate
         self._step = 0
         self._peak = device_peak_flops()
+        self._watchdog = None
         self.accumulate_steps = max(1, int(accumulate_steps))
 
     # -- step function -------------------------------------------------------
@@ -137,6 +138,8 @@ class Trainer:
         arrays (e.g. {"input_ids": ..., "labels": ...})."""
         if self._step_fn is None:
             self._build_step()
+        if self._watchdog is not None:
+            self._watchdog.tick()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.key(self._step)
         self.params, self.opt_state, loss = self._step_fn(
@@ -157,11 +160,30 @@ class Trainer:
     def fit(self, data: Iterable[Dict[str, jax.Array]], steps: int,
             log_every: int = 10, on_metrics: Optional[Callable] = None,
             seq_len: Optional[int] = None):
+        # hung-step watchdog (PT_STEP_TIMEOUT_S): armed only for the
+        # duration of this bounded loop — inter-step gaps here ARE steps
+        # (device sync + next-batch wait), so a stall is a real hang, and
+        # stopping it on exit means eval/checkpoint phases outside fit()
+        # can never trigger a spurious kill (reference:
+        # phi/core/distributed/comm_task_manager.cc per-task timeouts)
+        from ..distributed.watchdog import watchdog_from_env
+        if self._watchdog is None:
+            self._watchdog = watchdog_from_env()
         it = iter(data)
         history = []
         t_last = time.perf_counter()
         tokens_since = 0
         loss = None
+        try:
+            return self._fit_loop(it, steps, log_every, on_metrics, seq_len,
+                                  history, t_last, tokens_since, loss)
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+
+    def _fit_loop(self, it, steps, log_every, on_metrics, seq_len,
+                  history, t_last, tokens_since, loss):
         for _ in range(steps):
             batch = next(it)
             ids = batch.get("input_ids")

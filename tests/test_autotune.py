@@ -77,7 +77,11 @@ def test_dispatch_uses_db_on_tpu(monkeypatch, tmp_path):
     assert (bq, bk) == (512, 256)
     # unknown shape falls back to defaults
     monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
+    # unknown shape: shape-aware heuristic defaults (largest dividing
+    # candidate — the round-3 hardware sweep favors big blocks)
     bq, bk = flash_attention_config(1024, 1024, 64, "bfloat16", False)
+    assert (bq, bk) == (512, 1024)
+    bq, bk = flash_attention_config(384, 384, 64, "bfloat16", False)
     assert (bq, bk) == (128, 128)
 
 

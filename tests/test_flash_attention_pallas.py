@@ -247,3 +247,101 @@ def test_additive_float_mask_with_segments_fallback():
     out = flash_attention_pallas(q, k, v, attn_mask=add_mask,
                                  segment_ids=jnp.asarray(seg))
     assert np.isfinite(np.asarray(out)).all()
+
+
+# ---------------------------------------------------------------------------
+# in-kernel dropout (reference: the philox dropout path of
+# phi/kernels/gpu/flash_attn_kernel.cu) — counter-based PRNG seeded on
+# semantic block coordinates so fwd/bwd replay identical masks
+# ---------------------------------------------------------------------------
+
+def _drop(q, k, v, p, seed, **kw):
+    return flash_attention_pallas(q, k, v, dropout_p=p, dropout_seed=seed,
+                                  interpret=True, block_q=64, block_k=64,
+                                  **kw)
+
+
+def test_dropout_deterministic_per_seed():
+    q, k, v = make_qkv(b=2, h=2, seed=21)
+    a = _drop(q, k, v, 0.3, 7, causal=True)
+    b = _drop(q, k, v, 0.3, 7, causal=True)
+    c = _drop(q, k, v, 0.3, 8, causal=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-4
+
+
+def test_dropout_zero_p_matches_baseline():
+    q, k, v = make_qkv(seed=22)
+    base = flash_attention_pallas(q, k, v, causal=True, interpret=True,
+                                  block_q=64, block_k=64)
+    out = _drop(q, k, v, 0.0, 3, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_dropout_is_unbiased():
+    """E[dropout(P)] = P, so averaging outputs over many seeds approaches
+    the no-dropout output."""
+    q, k, v = make_qkv(b=1, sq=64, sk=64, h=2, d=32, seed=23)
+    base = np.asarray(flash_attention_pallas(
+        q, k, v, interpret=True, block_q=64, block_k=64), np.float64)
+    acc = np.zeros_like(base)
+    n = 48
+    for s in range(n):
+        acc += np.asarray(_drop(q, k, v, 0.4, s), np.float64)
+    err = np.abs(acc / n - base).max()
+    assert err < 0.15, err   # ~1/sqrt(48) monte-carlo noise on O(1) values
+
+
+def test_dropout_grads_finite_and_deterministic():
+    q, k, v = make_qkv(b=1, sq=64, sk=64, h=2, d=32, seed=24)
+
+    def loss(q, k, v, seed):
+        o = _drop(q, k, v, 0.25, seed, causal=True)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, 11)
+    g2 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, 11)
+    for a, b in zip(g1, g2):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dropout_grad_matches_finite_difference():
+    """The custom VJP with dropout must be the true derivative of the
+    (fixed-seed) forward: check dq against central differences."""
+    q, k, v = make_qkv(b=1, sq=32, sk=32, h=1, d=32, seed=25)
+    q = q.astype(jnp.float64) if jax.config.jax_enable_x64 else q
+
+    def f(q):
+        return float(jnp.sum(_drop(q, k, v, 0.3, 5).astype(jnp.float32)))
+
+    g = jax.grad(lambda q: jnp.sum(
+        _drop(q, k, v, 0.3, 5).astype(jnp.float32)))(q)
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        i = tuple(rs.randint(0, s) for s in q.shape)
+        eps = 1e-2
+        qp = np.asarray(q, np.float64); qp[i] += eps
+        qm = np.asarray(q, np.float64); qm[i] -= eps
+        fd = (f(jnp.asarray(qp, q.dtype)) - f(jnp.asarray(qm, q.dtype))) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g)[i], fd, rtol=5e-2, atol=5e-3)
+
+
+def test_dropout_with_segments():
+    """Dropout composes with segment masking: cross-segment positions stay
+    exactly masked regardless of the keep-mask."""
+    q, k, v = make_qkv(b=1, sq=64, sk=64, h=2, d=32, seed=26)
+    ids = np.zeros((1, 64), np.int32)
+    ids[:, 32:] = 1
+    out = flash_attention_pallas(
+        q, k, v, dropout_p=0.3, dropout_seed=2, interpret=True,
+        segment_ids=jnp.asarray(ids), block_q=64, block_k=64)
+    # rows in segment 0 must not see any v from segment 1: zero out v's
+    # second half and the first half of the output must be unchanged
+    v2 = v.at[:, 32:].set(0.0)
+    out2 = flash_attention_pallas(
+        q, k, v2, dropout_p=0.3, dropout_seed=2, interpret=True,
+        segment_ids=jnp.asarray(ids), block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out)[:, :32],
+                               np.asarray(out2)[:, :32], rtol=1e-6, atol=1e-6)

@@ -108,8 +108,9 @@ def test_block_multihead_attention_matches_dense():
     B, H, D = 2, 4, 8
     block_size, max_blocks, num_blocks = 4, 4, 32
     lens = np.asarray([5, 11], np.int32)   # tokens already cached
-    key_cache = np.zeros((num_blocks, block_size, H, D), np.float32)
-    value_cache = np.zeros((num_blocks, block_size, H, D), np.float32)
+    # head-major pools [H_kv, num_blocks, block_size, D] (TPU-native layout)
+    key_cache = np.zeros((H, num_blocks, block_size, D), np.float32)
+    value_cache = np.zeros((H, num_blocks, block_size, D), np.float32)
     # non-trivial block table: arbitrary pool blocks per sequence
     block_tables = np.asarray([[7, 3, 19, -1], [22, 9, 1, 14]], np.int32)
     dense_k = rs.randn(B, max_blocks * block_size, H, D).astype(np.float32)
@@ -120,8 +121,8 @@ def test_block_multihead_attention_matches_dense():
             if pb < 0:
                 continue
             sl = slice(lb * block_size, (lb + 1) * block_size)
-            key_cache[pb] = dense_k[b, sl]
-            value_cache[pb] = dense_v[b, sl]
+            key_cache[:, pb] = dense_k[b, sl].transpose(1, 0, 2)
+            value_cache[:, pb] = dense_v[b, sl].transpose(1, 0, 2)
 
     qkv = rs.randn(B, 3 * H * D).astype(np.float32)
     out, kc, vc = IF.block_multihead_attention(
@@ -141,7 +142,7 @@ def test_block_multihead_attention_matches_dense():
     # new token landed in the right physical block slot
     b = 0
     pb = block_tables[b, lens[b] // block_size]
-    np.testing.assert_allclose(np.asarray(kc)[pb, lens[b] % block_size],
+    np.testing.assert_allclose(np.asarray(kc)[:, pb, lens[b] % block_size],
                                q[b, 1], rtol=1e-6)
 
 
@@ -150,8 +151,8 @@ def test_block_attention_multi_step_decode():
     rs = np.random.RandomState(1)
     B, H, D = 1, 2, 4
     block_size, max_blocks, num_blocks = 2, 4, 8
-    key_cache = jnp.zeros((num_blocks, block_size, H, D), jnp.float32)
-    value_cache = jnp.zeros((num_blocks, block_size, H, D), jnp.float32)
+    key_cache = jnp.zeros((H, num_blocks, block_size, D), jnp.float32)
+    value_cache = jnp.zeros((H, num_blocks, block_size, D), jnp.float32)
     block_tables = jnp.asarray([[5, 2, 7, 0]], jnp.int32)
     dense_k = np.zeros((max_blocks * block_size, H, D), np.float32)
     dense_v = np.zeros_like(dense_k)

@@ -1,0 +1,82 @@
+"""LBFGS optimizer (reference: python/paddle/optimizer/lbfgs.py — the
+closure-driven whole-vector optimizer; tests model the reference's
+test/legacy_test/test_lbfgs.py minimization checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.optimizer import LBFGS
+
+
+class _Quad(nn.Layer):
+    def __init__(self, n=6, seed=0):
+        super().__init__()
+        rs = np.random.RandomState(seed)
+        a = rs.randn(n, n)
+        self.A = jnp.asarray(a @ a.T + n * np.eye(n), jnp.float32)
+        self.b = jnp.asarray(rs.randn(n), jnp.float32)
+        self.x = self.create_parameter([n], dtype="float32",
+                                       initializer=I.Constant(0.0))
+
+
+def _quad_closure(m):
+    def closure():
+        def f(p):
+            x = p["x"]
+            return 0.5 * x @ m.A @ x - m.b @ x
+        pv = {n: pp.value for n, pp in m.named_parameters()}
+        return jax.value_and_grad(f)(pv)
+    return closure
+
+
+def test_lbfgs_solves_quadratic():
+    pt.seed(0)
+    m = _Quad()
+    opt = LBFGS(learning_rate=1.0, max_iter=30, parameters=m)
+    opt.step(_quad_closure(m))
+    x_star = jnp.linalg.solve(m.A, m.b)
+    np.testing.assert_allclose(np.asarray(m.x), np.asarray(x_star),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_lbfgs_strong_wolfe_rosenbrock():
+    """Rosenbrock needs the line search; a handful of outer steps must
+    reach the (1, 1) minimum."""
+
+    class Rosen(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.x = self.create_parameter([2], dtype="float32",
+                                           initializer=I.Constant(-1.0))
+
+    m = Rosen()
+    opt = LBFGS(learning_rate=1.0, max_iter=60,
+                line_search_fn="strong_wolfe", parameters=m)
+
+    def closure():
+        def f(p):
+            x = p["x"]
+            return (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+        pv = {n: pp.value for n, pp in m.named_parameters()}
+        return jax.value_and_grad(f)(pv)
+
+    loss = None
+    for _ in range(4):
+        loss = opt.step(closure)
+    assert float(loss) < 1e-5, float(loss)
+    np.testing.assert_allclose(np.asarray(m.x), [1.0, 1.0],
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_lbfgs_history_bounded():
+    m = _Quad(n=4, seed=1)
+    opt = LBFGS(learning_rate=1.0, max_iter=50, history_size=3,
+                parameters=m)
+    opt.step(_quad_closure(m))
+    assert len(opt._s) <= 3
+    sd = opt.state_dict()
+    assert "s" in sd and "rho" in sd

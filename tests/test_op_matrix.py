@@ -416,3 +416,52 @@ def test_sharded(e, mesh8):
         specs.append(P("dp") if a.ndim >= 1 and a.shape[0] % 2 == 0 else None)
     check_sharded(e.fn, e.inputs, mesh8, specs, kwargs=e.kwargs,
                   rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_attention_matches_dense():
+    """CSR-patterned attention == dense attention masked to the pattern
+    (reference: nn/functional/sparse_attention.py semantics)."""
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 2, 8, 4
+    q = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rs.randn(B, H, S, D).astype(np.float32))
+
+    # random pattern: each row keeps a random nonempty set of columns,
+    # same nnz layout per (b, h) built explicitly in CSR
+    offs = np.zeros((B, H, S + 1), np.int32)
+    cols_l = [[[] for _ in range(H)] for _ in range(B)]
+    for b in range(B):
+        for h in range(H):
+            acc = 0
+            for r in range(S):
+                keep = sorted(rs.choice(S, rs.randint(1, 4), replace=False))
+                cols_l[b][h] += keep
+                acc += len(keep)
+                offs[b, h, r + 1] = acc
+    nnz = max(len(cols_l[b][h]) for b in range(B) for h in range(H))
+    cols = np.zeros((B, H, nnz), np.int32)
+    for b in range(B):
+        for h in range(H):
+            cs = cols_l[b][h]
+            cols[b, h, :len(cs)] = cs
+            # pad by repeating the last entry inside the final row (harmless:
+            # duplicate True in the mask)
+            cols[b, h, len(cs):] = cs[-1] if cs else 0
+            offs[b, h, -1] = nnz if len(cs) < nnz else offs[b, h, -1]
+
+    out = F.sparse_attention(q, k, v, jnp.asarray(offs), jnp.asarray(cols))
+
+    # dense oracle
+    mask = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            for r in range(S):
+                for j in range(offs[b, h, r], offs[b, h, r + 1]):
+                    mask[b, h, r, cols[b, h, j]] = True
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    logits = np.where(mask, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)

@@ -17,10 +17,11 @@ def _setup(B=2, H=4, H_kv=2, D=32, page_size=16, pages_per_seq=4,
            num_pages=16, seed=0):
     rs = np.random.RandomState(seed)
     q = jnp.asarray(rs.normal(0, 1, (B, H, D)).astype(np.float32))
+    # head-major pools [H_kv, num_pages, page_size, D] (TPU-native layout)
     k_pages = jnp.asarray(
-        rs.normal(0, 1, (num_pages, page_size, H_kv, D)).astype(np.float32))
+        rs.normal(0, 1, (H_kv, num_pages, page_size, D)).astype(np.float32))
     v_pages = jnp.asarray(
-        rs.normal(0, 1, (num_pages, page_size, H_kv, D)).astype(np.float32))
+        rs.normal(0, 1, (H_kv, num_pages, page_size, D)).astype(np.float32))
     # distinct pools per sequence, permuted to exercise the indirection
     perm = rs.permutation(num_pages)[:B * pages_per_seq]
     tables = jnp.asarray(perm.reshape(B, pages_per_seq).astype(np.int32))
@@ -31,12 +32,14 @@ def _setup(B=2, H=4, H_kv=2, D=32, page_size=16, pages_per_seq=4,
 
 def _xla_ref(q, k_pages, v_pages, tables, lens):
     B, H, D = q.shape
-    H_kv = k_pages.shape[2]
-    page_size = k_pages.shape[1]
+    H_kv = k_pages.shape[0]
+    page_size = k_pages.shape[2]
     T = tables.shape[1] * page_size
     group = H // H_kv
-    k_seq = k_pages[jnp.maximum(tables, 0)].reshape(B, T, H_kv, D)
-    v_seq = v_pages[jnp.maximum(tables, 0)].reshape(B, T, H_kv, D)
+    k_seq = jnp.moveaxis(
+        k_pages[:, jnp.maximum(tables, 0)].reshape(H_kv, B, T, D), 0, 2)
+    v_seq = jnp.moveaxis(
+        v_pages[:, jnp.maximum(tables, 0)].reshape(H_kv, B, T, D), 0, 2)
     k_seq = jnp.repeat(k_seq, group, axis=2)
     v_seq = jnp.repeat(v_seq, group, axis=2)
     scale = 1.0 / np.sqrt(D)
@@ -91,4 +94,4 @@ def test_supported_gate():
     q, kp, *_ = _setup()
     assert paged_decode_supported(q, kp)
     assert not paged_decode_supported(jnp.zeros((1, 3, 48)),
-                                      jnp.zeros((4, 16, 1, 48)))
+                                      jnp.zeros((1, 4, 16, 48)))

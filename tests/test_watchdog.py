@@ -1,0 +1,49 @@
+"""Step-hang watchdog (reference: phi/core/distributed/comm_task_manager.cc
+— per-task timeout watch with abort/log)."""
+
+import time
+
+from paddle_tpu.distributed import StepWatchdog
+
+
+def test_watchdog_fires_on_stall():
+    fired = []
+    wd = StepWatchdog(timeout_s=0.2, action="log",
+                      on_timeout=lambda stalled: fired.append(stalled),
+                      poll_interval_s=0.05)
+    wd.start()
+    wd.tick()
+    time.sleep(0.6)            # simulated hung step: no further ticks
+    wd.stop()
+    assert wd.fired
+    assert fired and fired[0] >= 0.2
+
+
+def test_watchdog_quiet_while_progressing():
+    fired = []
+    wd = StepWatchdog(timeout_s=0.3, action="log",
+                      on_timeout=lambda s: fired.append(s),
+                      poll_interval_s=0.05)
+    wd.start()
+    for _ in range(8):
+        wd.tick()
+        time.sleep(0.05)
+    wd.stop()
+    assert not wd.fired and not fired
+
+
+def test_watchdog_inactive_before_first_tick():
+    wd = StepWatchdog(timeout_s=0.1, poll_interval_s=0.02)
+    wd.start()
+    time.sleep(0.3)            # armed only after the first tick
+    wd.stop()
+    assert not wd.fired
+
+
+def test_watchdog_step_context():
+    wd = StepWatchdog(timeout_s=5.0)
+    wd.start()
+    with wd.step():
+        pass
+    wd.stop()
+    assert wd._step_id == 2
