@@ -50,12 +50,7 @@ def _log(msg):
 _T0 = time.perf_counter()
 
 
-def _sync(x):
-    """Force device->host readback (block_until_ready alone has been seen
-    returning early through the tunneled plugin)."""
-    import jax
-    import numpy as np
-    np.asarray(jax.tree.leaves(x)[0].ravel()[0])
+from paddle_tpu.utils.hw_probe import force_host_sync as _sync
 
 
 def _make_loader(cfg, batch_size, seq_len, steps):

@@ -61,6 +61,8 @@ class StepWatchdog:
     def start(self) -> "StepWatchdog":
         if self._thread is not None:
             return self
+        self._stop.clear()          # restartable after stop()
+        self._fired = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="pt-step-watchdog")
         self._thread.start()

@@ -224,8 +224,9 @@ class MoEForCausalLM(nn.Layer):
         logits = jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
         if labels is None:
             return logits
-        ce = F.cross_entropy(logits.astype(jnp.float32), labels,
-                             ignore_index=-100)
+        from .llama import causal_lm_loss
+        # vocab-parallel CE when tp is active (no gathered fp32 logits)
+        ce = causal_lm_loss(logits, labels)
         loss = ce + cfg.aux_loss_weight * aux_total
         return loss, logits
 

@@ -577,10 +577,13 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
 
     def one_mask(off, cols):
         # row of the j-th stored element = # of offset entries <= j, minus 1
-        rows = jnp.searchsorted(off, jnp.arange(nnz, dtype=jnp.int32),
-                                side="right") - 1
-        rows = jnp.clip(rows, 0, S - 1)
-        return jnp.zeros((S, S), bool).at[rows, cols].set(True)
+        j = jnp.arange(nnz, dtype=jnp.int32)
+        rows = jnp.searchsorted(off, j, side="right") - 1
+        # rectangular [B, H, nnz] storage pads ragged heads: entries past
+        # this head's true nnz (off[-1]) must not scatter anywhere — route
+        # them out of bounds and drop
+        rows = jnp.where(j < off[-1], jnp.clip(rows, 0, S - 1), S)
+        return jnp.zeros((S, S), bool).at[rows, cols].set(True, mode="drop")
 
     mask = jax.vmap(jax.vmap(one_mask))(offset, columns)      # [B,H,S,S]
     if key_padding_mask is not None:

@@ -36,10 +36,6 @@ from . import _proto as P
 
 __all__ = ["export"]
 
-_ONNX_DTYPE = {"float32": "float32", "float16": "float16",
-               "bfloat16": "bfloat16", "int32": "int32", "int64": "int64",
-               "bool": "bool", "float64": "float64"}
-
 
 class _Converter:
     def __init__(self):

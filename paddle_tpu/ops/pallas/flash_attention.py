@@ -581,9 +581,8 @@ def pallas_supported(q, k, v, attn_mask, dropout_p, causal=False,
     are present block_k additionally needs 128-alignment or to equal sk
     (it is the LANE dim of the kv-segment tile). ``interpret`` relaxes the
     alignment rules (no Mosaic involved) so CPU tests can run small blocks."""
-    if not _HAS_PLTPU:
-        return False
-    if os.environ.get("PT_DISABLE_PALLAS"):
+    from ..registry import pallas_disabled
+    if not _HAS_PLTPU or pallas_disabled():
         return False
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]

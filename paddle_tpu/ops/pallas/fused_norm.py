@@ -130,10 +130,8 @@ _rms_norm_p.defvjp(_rms_fwd_rule, _rms_bwd_rule)
 
 
 def pallas_rms_supported(x, weight) -> bool:
-    import os
-    if not _HAS_PLTPU or weight is None:
-        return False
-    if os.environ.get("PT_DISABLE_PALLAS"):
+    from ..registry import pallas_disabled
+    if not _HAS_PLTPU or weight is None or pallas_disabled():
         return False
     D = x.shape[-1]
     R = max(x.size // D, 1)

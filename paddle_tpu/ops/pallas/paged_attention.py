@@ -44,45 +44,56 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, page_size, group):
-    """Grid (B, H_kv, max_pages); innermost sequential over pages."""
+def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, page_size,
+                   group, n_fetch):
+    """Grid (B, H_kv, max_pages // n_fetch); innermost sequential over page
+    GROUPS. Each step streams ``n_fetch`` (possibly scattered) pages via
+    n_fetch independent block specs — one page per spec, since a single
+    BlockSpec can only address one pool offset — amortizing the per-step
+    grid/DMA-issue overhead that made the one-page-per-step version
+    latency-bound (~8us/step measured on v5)."""
+    k_refs = refs[:n_fetch]
+    v_refs = refs[n_fetch:2 * n_fetch]
+    o_ref = refs[2 * n_fetch]
+    m_scr, l_scr, acc_scr = refs[2 * n_fetch + 1:]
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    np_ = pl.num_programs(2)
+    pg = pl.program_id(2)
+    npg = pl.num_programs(2)
     seq_len = lens_ref[b]
 
-    @pl.when(p == 0)
+    @pl.when(pg == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # pages fully past the sequence (and unmapped table slots) are skipped
-    @pl.when(p * page_size <= seq_len)
+    # group fully past the sequence (and unmapped table slots) is skipped
+    @pl.when(pg * n_fetch * page_size <= seq_len)
     def _compute():
         q = q_ref[0, 0, :, :]                     # [group, d]
-        k = k_ref[0, 0, :, :]                     # [page, d]
-        v = v_ref[0, 0, :, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [group, page]
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(pos <= seq_len, s, NEG_INF)
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        pr = jnp.exp(s - m_new)
-        l_scr[:] = jnp.broadcast_to(
-            alpha * l_scr[:, :1] + jnp.sum(pr, axis=-1, keepdims=True),
-            l_scr.shape)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        for i in range(n_fetch):
+            p = pg * n_fetch + i
+            k = k_refs[i][0, 0, :, :]             # [page, d]
+            v = v_refs[i][0, 0, :, :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [group, page]
+            pos = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(pos <= seq_len, s, NEG_INF)
+            m_prev = m_scr[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            pr = jnp.exp(s - m_new)
+            l_scr[:] = jnp.broadcast_to(
+                alpha * l_scr[:, :1] + jnp.sum(pr, axis=-1, keepdims=True),
+                l_scr.shape)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    @pl.when(p == np_ - 1)
+    @pl.when(pg == npg - 1)
     def _finalize():
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -106,36 +117,42 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     max_pages = block_tables.shape[1]
     group = H // H_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    # pages streamed per grid step (divisor of max_pages)
+    n_fetch = next((n for n in (8, 4, 2, 1) if max_pages % n == 0), 1)
 
     tables = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
     lens = jnp.asarray(seq_lens, jnp.int32)
     qg = q.reshape(B, H_kv, group, D)
 
+    def page_spec(i):
+        return pl.BlockSpec(
+            (1, 1, page_size, D),
+            lambda b, h, pg, tables, lens, i=i: (
+                h, tables[b, pg * n_fetch + i], 0, 0))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, H_kv, max_pages),
+        grid=(B, H_kv, max_pages // n_fetch),
         in_specs=[
             pl.BlockSpec((1, 1, group, D),
-                         lambda b, h, p, tables, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D),
-                         lambda b, h, p, tables, lens: (h, tables[b, p], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, D),
-                         lambda b, h, p, tables, lens: (h, tables[b, p], 0, 0)),
+                         lambda b, h, pg, tables, lens: (b, h, 0, 0)),
+            *[page_spec(i) for i in range(n_fetch)],
+            *[page_spec(i) for i in range(n_fetch)],
         ],
         out_specs=pl.BlockSpec((1, 1, group, D),
-                               lambda b, h, p, tables, lens: (b, h, 0, 0)),
+                               lambda b, h, pg, tables, lens: (b, h, 0, 0)),
         scratch_shapes=[pltpu.VMEM((group, 128), jnp.float32),
                         pltpu.VMEM((group, 128), jnp.float32),
                         pltpu.VMEM((group, D), jnp.float32)],
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, page_size=page_size,
-                          group=group),
+                          group=group, n_fetch=n_fetch),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H_kv, group, D), q.dtype),
         compiler_params=_tpu_params(),
         interpret=interpret,
-    )(tables, lens, qg, k_pages, v_pages)
+    )(tables, lens, qg, *([k_pages] * n_fetch), *([v_pages] * n_fetch))
     return out.reshape(B, H, D)
 
 
@@ -151,10 +168,8 @@ def paged_decode_supported(q, k_pages) -> bool:
     (1, 1, page_size, D) == the trailing array dims, and the q/out blocks
     are (1, 1, group, D) == theirs, so only divisibility and a sane D
     remain to check."""
-    import os
-    if not _HAS_PLTPU:
-        return False
-    if os.environ.get("PT_DISABLE_PALLAS"):
+    from ..registry import pallas_disabled
+    if not _HAS_PLTPU or pallas_disabled():
         return False
     B, H, D = q.shape
     H_kv = k_pages.shape[0]

@@ -43,6 +43,14 @@ def backend_kind() -> str:
     return backend
 
 
+def pallas_disabled() -> bool:
+    """Global Pallas kill-switch (PT_DISABLE_PALLAS): one predicate shared
+    by every kernel-family support gate so the bench's degrade-to-XLA
+    retry covers all of them."""
+    import os
+    return bool(os.environ.get("PT_DISABLE_PALLAS"))
+
+
 def register_kernel(op: str, backend: str):
     """Register an implementation for op on backend ('tpu'|'cpu'|'any')."""
     def deco(fn):

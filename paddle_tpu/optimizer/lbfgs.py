@@ -119,7 +119,13 @@ class LBFGS(Optimizer):
                  tolerance_change: float = 1e-9, history_size: int = 100,
                  line_search_fn: Optional[str] = None, parameters=None,
                  weight_decay: float = 0.0, grad_clip=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+        if weight_decay:
+            raise ValueError("LBFGS does not apply weight_decay; fold the "
+                             "penalty into the closure's loss instead")
+        if grad_clip is not None:
+            raise ValueError("LBFGS does not support grad_clip (the line "
+                             "search already bounds the step)")
+        super().__init__(learning_rate, parameters, 0.0, None,
                          multi_precision=False)
         if line_search_fn not in (None, "strong_wolfe"):
             raise ValueError("line_search_fn must be None or 'strong_wolfe'")

@@ -66,3 +66,16 @@ def force_cpu():
 
 
 __all__ = ["probe_tpu", "force_cpu", "PROBE_CODE"]
+
+
+def force_host_sync(x) -> None:
+    """Force a real device->host readback of one leaf of ``x``.
+
+    Through the tunneled-TPU plugin, jax.block_until_ready alone has been
+    observed returning before the queued work drains, yielding
+    microsecond-scale fantasy timings — a scalar np.asarray round-trip is
+    the reliable fence. Shared by bench.py and tools/tune_kernels.py."""
+    import jax
+    import numpy as np
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(leaf.ravel()[0])

@@ -33,13 +33,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _sync(r):
-    # force a real device->host read: through the tunneled-TPU plugin,
-    # block_until_ready alone has been observed returning before the work
-    # drains, yielding microsecond-scale fantasy timings
-    import jax
-    import numpy as np
-    leaf = jax.tree.leaves(r)[0]
-    np.asarray(leaf.ravel()[0])
+    from paddle_tpu.utils.hw_probe import force_host_sync
+    force_host_sync(r)
 
 
 def _time_fn(fn, *args, iters=5, warmup=2, reps=3):
