@@ -99,6 +99,71 @@ def generate(model, input_ids, generation_config: GenerationConfig = None,
     return jnp.concatenate(tokens, axis=1)
 
 
+def _compiled_generate(model, cfg: GenerationConfig, b: int, prompt_len: int,
+                       kind: str, page_size: int):
+    """One jitted (prefill → scan-decode → tokens) program, cached ON THE
+    MODEL per (config, shape, cache kind): repeat calls with the same
+    shapes reuse the executable instead of re-tracing (the Python-loop
+    ``generate`` gets this via _compiled_decode; the scan drivers need it
+    too or every call pays full compile).
+
+    ``kind``: "dense" (contiguous [b, max_len, kv, hd] caches) or "paged"
+    (head-major page pools + block table — the vLLM-style serving path,
+    reference: block_multi_head_attention_kernel.cu). All cache state is
+    allocated INSIDE the traced function so nothing is baked into the
+    executable as a constant.
+    """
+    key_ = (kind, page_size, b, prompt_len, cfg.max_new_tokens,
+            cfg.do_sample, cfg.temperature, cfg.top_k, cfg.top_p,
+            cfg.eos_token_id, cfg.pad_token_id)
+    cache = model.__dict__.setdefault("_generate_cache", {})
+    if key_ in cache:
+        return cache[key_]
+
+    max_len = prompt_len + cfg.max_new_tokens
+    core = getattr(model, "model", model)
+    head = model.logits if hasattr(model, "logits") else (lambda h: h)
+    eos = cfg.eos_token_id
+
+    def run(params, input_ids, key):
+        # run under the layer's functional bridge so params are traced inputs
+        with model._bind(params) if hasattr(model, "_bind") else \
+                _nullcontext():
+            if kind == "paged":
+                pools0, tables = core.alloc_paged_caches(b, max_len,
+                                                         page_size)
+                hidden, caches = core.prefill_paged(input_ids, pools0,
+                                                    tables)
+                decode = lambda tok, pos, c: core.decode_step_paged(
+                    tok, pos, c, tables)
+            else:
+                hidden, caches = core.prefill(input_ids, max_len)
+                decode = core.decode_step
+            logits0 = head(hidden[:, -1, :])
+
+            def step(carry, i):
+                logits, caches, key, finished = carry
+                key, sub = jax.random.split(key)
+                tok = _sample_logits(logits.astype(jnp.float32), cfg, sub)
+                if eos is not None:
+                    tok = jnp.where(finished, cfg.pad_token_id, tok)
+                    finished = finished | (tok == eos)
+                pos = jnp.full((b,), prompt_len + i, jnp.int32)
+                h, caches = decode(tok, pos, caches)
+                new_logits = head(h[:, 0, :])
+                return (new_logits, caches, key, finished), tok
+
+            finished0 = jnp.zeros((b,), bool)
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (logits0, caches, key, finished0),
+                jnp.arange(cfg.max_new_tokens))
+        return jnp.concatenate([input_ids, toks.T], axis=1)
+
+    compiled = jax.jit(run)
+    cache[key_] = compiled
+    return compiled
+
+
 def generate_scan(model, input_ids, generation_config: GenerationConfig = None,
                   **kwargs) -> jnp.ndarray:
     """Fully-compiled generation: the whole decode loop is ONE lax.scan
@@ -113,39 +178,28 @@ def generate_scan(model, input_ids, generation_config: GenerationConfig = None,
     cfg = generation_config or GenerationConfig(**kwargs)
     input_ids = jnp.asarray(input_ids)
     b, prompt_len = input_ids.shape
-    max_len = prompt_len + cfg.max_new_tokens
-    core = getattr(model, "model", model)
-    head = model.logits if hasattr(model, "logits") else (lambda h: h)
-    eos = cfg.eos_token_id
-
     params = model.raw_parameters() if hasattr(model, "raw_parameters") else {}
+    compiled = _compiled_generate(model, cfg, b, prompt_len, "dense", 0)
+    return compiled(params, input_ids, jax.random.PRNGKey(cfg.seed))
 
-    def run(params, input_ids, key):
-        # run under the layer's functional bridge so params are traced inputs
-        with model._bind(params) if hasattr(model, "_bind") else \
-                _nullcontext():
-            hidden, caches = core.prefill(input_ids, max_len)
-            logits0 = head(hidden[:, -1, :])
 
-            def step(carry, i):
-                logits, caches, key, finished = carry
-                key, sub = jax.random.split(key)
-                tok = _sample_logits(logits.astype(jnp.float32), cfg, sub)
-                if eos is not None:
-                    tok = jnp.where(finished, cfg.pad_token_id, tok)
-                    finished = finished | (tok == eos)
-                pos = jnp.full((b,), prompt_len + i, jnp.int32)
-                h, caches = core.decode_step(tok, pos, caches)
-                new_logits = head(h[:, 0, :])
-                return (new_logits, caches, key, finished), tok
+def generate_paged(model, input_ids,
+                   generation_config: GenerationConfig = None,
+                   page_size: int = 128, **kwargs) -> jnp.ndarray:
+    """Fully-compiled generation over PAGED KV caches (vLLM-style serving
+    path; reference capability: block_multi_head_attention_kernel.cu).
 
-            finished0 = jnp.zeros((b,), bool)
-            (_, _, _, _), toks = jax.lax.scan(
-                step, (logits0, caches, key, finished0),
-                jnp.arange(cfg.max_new_tokens))
-        return jnp.concatenate([input_ids, toks.T], axis=1)
-
-    compiled = jax.jit(run)
+    Instead of one dense [b, max_len, kv, hd] cache per layer, K/V live in
+    head-major page pools indexed by a block table; each decode step
+    writes one page slot and attends through the Pallas paged kernel on
+    TPU (XLA gather elsewhere). Greedy output matches generate_scan.
+    """
+    cfg = generation_config or GenerationConfig(**kwargs)
+    input_ids = jnp.asarray(input_ids)
+    b, prompt_len = input_ids.shape
+    params = model.raw_parameters() if hasattr(model, "raw_parameters") else {}
+    compiled = _compiled_generate(model, cfg, b, prompt_len, "paged",
+                                  page_size)
     return compiled(params, input_ids, jax.random.PRNGKey(cfg.seed))
 
 

@@ -71,6 +71,12 @@ class Predictor:
         self._inputs: Dict[str, _Handle] = {}
         self._outputs: Dict[str, _Handle] = {}
         self._input_names: List[str] = list(input_names or [])
+        # device routing applies to LIVE layers only: a jit.save'd export
+        # was lowered for its recorded device — re-routing its inputs would
+        # mix committed devices and fail, so the loaded path keeps jax's
+        # default placement
+        self._device = (self._resolve_device(self.config._device)
+                        if layer is not None else None)
         if layer is not None:
             self._fn = self._wrap_layer(layer)
         elif self.config.model_path:
@@ -91,12 +97,38 @@ class Predictor:
             self._inputs[n] = _Handle(n)
 
     @staticmethod
-    def _wrap_layer(layer):
+    def _resolve_device(kind: str):
+        """Map the Config device selection to a concrete jax device —
+        the reference's enable_use_gpu/disable_gpu actually routes
+        execution; accepting-and-ignoring it would silently run inference
+        on the wrong chip."""
+        try:
+            if kind == "cpu":
+                return jax.devices("cpu")[0]
+            return jax.devices()[0]
+        except RuntimeError:
+            return None
+
+    def _place(self, args):
+        if self._device is None:
+            return args
+        return [jax.device_put(a, self._device) for a in args]
+
+    def _wrap_layer(self, layer):
         if hasattr(layer, "functional"):
             params = layer.raw_parameters()
             fn = jax.jit(lambda p, *args: layer.functional_call(p, *args))
+            if self._device is not None:
+                params = jax.device_put(params, self._device)
             return lambda *args: fn(params, *args)
         return jax.jit(layer)
+
+    def warmup(self, *example_args):
+        """Pre-compile for the given example shapes (reference analogue:
+        AnalysisPredictor's first-run engine build, surfaced explicitly so
+        serving can pay compilation before traffic)."""
+        self._fn(*self._place(list(example_args)))
+        return self
 
     # -- reference API surface --------------------------------------------
 
@@ -118,7 +150,7 @@ class Predictor:
             missing = [n for n in self._input_names
                        if self._inputs[n]._value is None]
             raise RuntimeError(f"inputs not set: {missing}")
-        out = self._fn(*args)
+        out = self._fn(*self._place(args))
         outs = out if isinstance(out, (tuple, list)) else [out]
         self._outputs = {}
         results = []
@@ -131,7 +163,7 @@ class Predictor:
 
     def __call__(self, *args):
         """Direct functional run (modern convenience path)."""
-        return self._fn(*args)
+        return self._fn(*self._place(list(args)))
 
 
 def create_predictor(config: Config) -> Predictor:

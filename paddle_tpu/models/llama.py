@@ -132,16 +132,26 @@ class LlamaAttention(nn.Layer):
             [n_h * hd, d], dtype=cfg.dtype, initializer=_normal(std),
             sharding=("tp", "fsdp"))
 
-    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+    def _qkv_rope(self, x, cos, sin, position_ids=None):
+        """Fused QKV projection + head split + rotary embedding — shared by
+        every forward/prefill/decode variant (dense and paged)."""
         cfg = self.cfg
-        b, s, d = x.shape
-        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        b, s, _ = x.shape
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
         qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
         q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
         q = q.reshape(b, s, n_h, hd)
         k = k.reshape(b, s, n_kv, hd)
         v = v.reshape(b, s, n_kv, hd)
         q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, position_ids)
+        return q, k, v
+
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+        cfg = self.cfg
+        b, s, d = x.shape
+        n_h, hd = cfg.num_attention_heads, cfg.head_dim
+        q, k, v = self._qkv_rope(x, cos, sin, position_ids)
         if cfg.sequence_parallel and attn_mask is None:
             from ..parallel.mesh import current_mesh
             hm = current_mesh()
@@ -172,12 +182,7 @@ class LlamaAttention(nn.Layer):
         cfg = self.cfg
         b, s, _ = x.shape
         n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
-        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
-        q = q.reshape(b, s, n_h, hd)
-        k = k.reshape(b, s, n_kv, hd)
-        v = v.reshape(b, s, n_kv, hd)
-        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos[:s], sin[:s])
+        q, k, v = self._qkv_rope(x, cos[:s], sin[:s])
         from ..ops.attention import _sdpa_xla
         out = _sdpa_xla(q, k, v, causal=True)
         out = out.reshape(b, s, n_h * hd)
@@ -194,13 +199,7 @@ class LlamaAttention(nn.Layer):
         b = x.shape[0]
         n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         k_cache, v_cache = kv_cache
-        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
-        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
-        q = q.reshape(b, 1, n_h, hd)
-        k = k.reshape(b, 1, n_kv, hd)
-        v = v.reshape(b, 1, n_kv, hd)
-        pos_ids = pos.reshape(b, 1)
-        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, pos_ids)
+        q, k, v = self._qkv_rope(x, cos, sin, pos.reshape(b, 1))
         b_idx = jnp.arange(b)
         k_cache = k_cache.at[b_idx, pos].set(k[:, 0])
         v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
@@ -219,6 +218,69 @@ class LlamaAttention(nn.Layer):
         out = jnp.einsum("bht,bthd->bhd", p, v_full.astype(jnp.float32))
         out = out.astype(x.dtype).reshape(b, 1, n_h * hd)
         return jnp.matmul(out, self.o_proj.astype(x.dtype)), (k_cache, v_cache)
+
+
+    # -- paged-KV (vLLM-style) inference paths ------------------------------
+
+    def prefill_paged(self, x, cos, sin, k_pool, v_pool, tables):
+        """Prompt pass writing K/V into head-major page pools
+        [H_kv, num_pages, page_size, hd] via ``tables`` [b, max_pages]
+        (reference capability: block_multi_head_attention_kernel.cu's
+        prefill write path). Prompt length is padded up to a page multiple
+        inside the pool; padded slots sit beyond seq_len and are never
+        unmasked before being overwritten by decode steps."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        page = k_pool.shape[2]
+        q, k, v = self._qkv_rope(x, cos[:s], sin[:s])
+        from ..ops.attention import _sdpa_xla
+        out = _sdpa_xla(q, k, v, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+
+        np_ = -(-s // page)                       # pages holding the prompt
+        pad = np_ * page - s
+        def scatter(pool, new):
+            padded = jnp.pad(new, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            # [b, np_, page, n_kv, hd] -> [n_kv, b*np_, page, hd]
+            tiles = jnp.transpose(
+                padded.reshape(b, np_, page, n_kv, hd), (3, 0, 1, 2, 4)
+            ).reshape(n_kv, b * np_, page, hd)
+            return pool.at[:, tables[:, :np_].reshape(-1)].set(
+                tiles.astype(pool.dtype))
+        return out, scatter(k_pool, k), scatter(v_pool, v)
+
+    def decode_paged(self, x, cos, sin, pos, k_pool, v_pool, tables):
+        """One-token step over the page pools: writes the new K/V into the
+        page slot for position ``pos`` and attends via the Pallas paged
+        kernel (XLA gather fallback off-TPU)."""
+        from ..ops.pallas.paged_attention import (paged_decode_attention,
+                                                 paged_decode_supported,
+                                                 paged_decode_xla)
+        from ..ops.registry import backend_kind
+        cfg = self.cfg
+        b = x.shape[0]
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        page = k_pool.shape[2]
+        q, k, v = self._qkv_rope(x, cos, sin, pos.reshape(b, 1))
+        b_idx = jnp.arange(b)
+        phys = tables[b_idx, pos // page]          # [b]
+        off = pos % page
+        k_pool = k_pool.at[:, phys, off].set(
+            jnp.swapaxes(k[:, 0], 0, 1).astype(k_pool.dtype))
+        v_pool = v_pool.at[:, phys, off].set(
+            jnp.swapaxes(v[:, 0], 0, 1).astype(v_pool.dtype))
+        q2 = q[:, 0]                               # [b, n_h, hd]
+        if backend_kind() == "tpu" and paged_decode_supported(q2, k_pool):
+            out = paged_decode_attention(q2, k_pool, v_pool, tables, pos)
+        else:
+            out = paged_decode_xla(q2, k_pool, v_pool, tables, pos)
+        out = out.reshape(b, 1, n_h * hd).astype(x.dtype)
+        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
+                k_pool, v_pool)
 
 
 class LlamaMLP(nn.Layer):
@@ -337,6 +399,52 @@ class LlamaModel(nn.Layer):
             x, cache = layer.decode(x, self.rope_cos, self.rope_sin, pos, cache)
             new_caches.append(cache)
         return self.norm(x), new_caches
+
+    # -- paged-KV (vLLM-style) inference paths ------------------------------
+
+    def alloc_paged_caches(self, batch: int, max_len: int,
+                           page_size: int = 128):
+        """Per-layer head-major page pools + the shared block table.
+        Pages are assigned contiguously per sequence (the allocator is the
+        caller's concern at serving scale; reference:
+        block_multi_head_attention's table-driven pool)."""
+        cfg = self.cfg
+        pages_per_seq = -(-max_len // page_size)
+        num_pages = batch * pages_per_seq
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        pools = [
+            (jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
+                        cfg.head_dim), dt),
+             jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
+                        cfg.head_dim), dt))
+            for _ in range(cfg.num_hidden_layers)]
+        tables = jnp.arange(num_pages, dtype=jnp.int32).reshape(
+            batch, pages_per_seq)
+        return pools, tables
+
+    def prefill_paged(self, input_ids, pools, tables):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.prefill_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
+
+    def decode_step_paged(self, token_ids, pos, pools, tables):
+        x = jnp.take(self.embed_tokens, token_ids[:, None], axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.decode_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                pos, kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
 
 
 class LlamaForCausalLM(nn.Layer):

@@ -163,6 +163,29 @@ def _tpu_params():
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
+def paged_decode_xla(q, k_pages, v_pages, block_tables, seq_lens,
+                     scale: Optional[float] = None):
+    """XLA gather composition with identical semantics to the kernel —
+    the fallback for unsupported shapes/backends and the test oracle."""
+    B, H, D = q.shape
+    H_kv, _, page_size, _ = k_pages.shape
+    T = block_tables.shape[1] * page_size
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    safe = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    ks = jnp.moveaxis(k_pages[:, safe].reshape(H_kv, B, T, D), 0, 2)
+    vs = jnp.moveaxis(v_pages[:, safe].reshape(H_kv, B, T, D), 0, 2)
+    ks = jnp.repeat(ks, H // H_kv, axis=2)
+    vs = jnp.repeat(vs, H // H_kv, axis=2)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    lg = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                    ks.astype(jnp.float32)) * scale
+    lg = jnp.where(jnp.arange(T)[None, None, :] <= lens[:, None, None],
+                   lg, -jnp.inf)
+    p = jax.nn.softmax(lg, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", p, vs.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_decode_supported(q, k_pages) -> bool:
     """Mosaic-rule gate for the head-major pool layout: page blocks are
     (1, 1, page_size, D) == the trailing array dims, and the q/out blocks
@@ -178,4 +201,5 @@ def paged_decode_supported(q, k_pages) -> bool:
             and page_size % 8 == 0)
 
 
-__all__ = ["paged_decode_attention", "paged_decode_supported"]
+__all__ = ["paged_decode_attention", "paged_decode_supported",
+           "paged_decode_xla"]
