@@ -127,21 +127,27 @@ def _decode_bench(cfg, on_tpu):
                                                  generate_paged,
                                                  generate_scan)
     out = {}
-    # shared serving-model setup — outside the try blocks so a failure here
-    # reports its real cause instead of a downstream NameError
-    dcfg = LlamaConfig(vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
-                       intermediate_size=cfg.intermediate_size,
-                       num_hidden_layers=cfg.num_hidden_layers,
-                       num_attention_heads=cfg.num_attention_heads,
-                       num_key_value_heads=cfg.num_key_value_heads,
-                       max_position_embeddings=512, dtype=cfg.dtype) \
-        if on_tpu else LlamaConfig.tiny()
-    pt.seed(0)
-    dmodel = LlamaForCausalLM(dcfg)
-    B, prompt_len, new_tokens = (8, 128, 128) if on_tpu else (2, 8, 8)
-    rs = np.random.RandomState(0)
-    ids = jnp.asarray(rs.randint(0, dcfg.vocab_size, (B, prompt_len)))
-    gc = GenerationConfig(max_new_tokens=new_tokens, do_sample=False)
+    # shared serving-model setup in its OWN try: a failure here (e.g. OOM
+    # building a second model next to the training one) must degrade to a
+    # decode_error detail, never zero the already-measured training number
+    try:
+        dcfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=cfg.num_hidden_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            max_position_embeddings=512, dtype=cfg.dtype) \
+            if on_tpu else LlamaConfig.tiny()
+        pt.seed(0)
+        dmodel = LlamaForCausalLM(dcfg)
+        B, prompt_len, new_tokens = (8, 128, 128) if on_tpu else (2, 8, 8)
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, dcfg.vocab_size, (B, prompt_len)))
+        gc = GenerationConfig(max_new_tokens=new_tokens, do_sample=False)
+    except Exception as e:
+        out["decode_error"] = f"setup: {type(e).__name__}: {str(e)[:150]}"
+        return out
     try:
         _log("decode: compiling generate_scan")
         toks = generate_scan(dmodel, ids, gc)          # compile
@@ -237,22 +243,25 @@ def _run(error_note):
         attempts.append(("PT_DISABLE_PALLAS",
                          lambda: os.environ.__setitem__(
                              "PT_DISABLE_PALLAS", "1")))
-    last_err = None
+    last_exc = None
     for tier, apply in attempts:
         apply()
         try:
             tps, step_s, stall_s, loss, model = _train_bench(
                 cfg, batch_size, seq_len, steps, warmup)
             if tier != "as-configured":
-                note = f"degraded to {tier} after: {last_err}"
+                note = (f"degraded to {tier} after: "
+                        f"{type(last_exc).__name__}: {str(last_exc)[:200]}")
                 error_note = f"{error_note}; {note}" if error_note else note
                 if tier == "PT_DISABLE_PALLAS":
                     attn_path = "xla-fallback"
             break
         except Exception as e:
-            last_err = f"{type(e).__name__}: {str(e)[:200]}"
+            last_exc = e
     else:
-        raise RuntimeError(f"all bench tiers failed; last: {last_err}")
+        # chain the real exception so main()'s traceback artifact shows
+        # where the bench actually failed, not this raise site
+        raise RuntimeError("all bench tiers failed") from last_exc
 
     if attn_path == "pallas":
         # report what actually ran: the kernel's own lowering probe can

@@ -118,6 +118,7 @@ def _compiled_generate(model, cfg: GenerationConfig, b: int, prompt_len: int,
             cfg.eos_token_id, cfg.pad_token_id)
     cache = model.__dict__.setdefault("_generate_cache", {})
     if key_ in cache:
+        cache[key_] = cache.pop(key_)        # LRU refresh (dict is ordered)
         return cache[key_]
 
     max_len = prompt_len + cfg.max_new_tokens
@@ -161,6 +162,10 @@ def _compiled_generate(model, cfg: GenerationConfig, b: int, prompt_len: int,
 
     compiled = jax.jit(run)
     cache[key_] = compiled
+    # bounded LRU: serving with varied (batch, prompt_len) shapes must not
+    # retain every compiled executable for the model's lifetime
+    while len(cache) > 8:
+        cache.pop(next(iter(cache)))
     return compiled
 
 

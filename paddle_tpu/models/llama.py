@@ -46,15 +46,21 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     dtype: str = "float32"
-    # recompute (activation checkpointing) granularity: "none"|"full"
+    # recompute (activation checkpointing) granularity:
+    #   "none"      — save all activations
+    #   "selective" — save projection/matmul outputs, recompute the cheap
+    #                 elementwise/attention-score work (reference analogue:
+    #                 recompute_granularity="core_attn" in the fleet
+    #                 recipes; policy = XLA-side dots_with_no_batch_dims)
+    #   "full"      — save only layer boundaries
     recompute: str = "none"
     # sequence parallel: shard activations along seq dim over "sep"
     sequence_parallel: bool = False
 
     def __post_init__(self):
-        if self.recompute not in ("none", "full"):
-            raise ValueError(f"recompute must be 'none'|'full', got "
-                             f"{self.recompute!r}")
+        if self.recompute not in ("none", "selective", "full"):
+            raise ValueError(f"recompute must be 'none'|'selective'|'full', "
+                             f"got {self.recompute!r}")
         if self.hidden_size % self.num_attention_heads:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads:
@@ -368,10 +374,12 @@ class LlamaModel(nn.Layer):
             s = input_ids.shape[1]
             cos, sin = cos[:s], sin[:s]
         x = self._seq_shard(x)
-        if self.cfg.recompute == "full":
+        if self.cfg.recompute in ("full", "selective"):
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if self.cfg.recompute == "selective" else None)
             ckpt = jax.checkpoint(
                 lambda layer, h: layer(h, cos, sin, position_ids, attn_mask),
-                static_argnums=(0,))
+                static_argnums=(0,), policy=policy)
             for layer in self.layers:
                 x = self._seq_shard(ckpt(layer, x))
         else:

@@ -220,3 +220,30 @@ def test_tp_parallel_ce_loss_parity_and_no_gathered_logits(mesh8=None):
         b, s, v = inp.shape[0], inp.shape[1], cfg.vocab_size
         assert f"f32[{b},{s},{v}]" not in hlo, \
             "full-vocab fp32 logits materialized despite tp parallel CE"
+
+
+def test_selective_recompute_matches_none():
+    """recompute='selective' (save matmul outputs, recompute the rest)
+    must be numerically identical to no recompute (reference analogue:
+    fleet recompute_granularity)."""
+    rs = np.random.RandomState(3)
+    ids = rs.randint(0, 512, (2, 17))
+    inp, lab = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+    losses, grads = [], []
+    for mode in ("none", "selective"):
+        pt.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(recompute=mode))
+        params = m.raw_parameters()
+
+        def loss_fn(p):
+            return m.functional_call(p, inp, labels=lab)[0]
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        losses.append(float(l))
+        grads.append(g)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    for k in grads[0]:
+        np.testing.assert_allclose(np.asarray(grads[0][k]),
+                                   np.asarray(grads[1][k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
