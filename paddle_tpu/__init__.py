@@ -48,6 +48,7 @@ from . import geometric
 from . import utils
 from . import profiler
 from . import onnx
+from . import reader
 from . import hapi
 from .hapi import Model
 from .hapi.summary import summary

@@ -6,5 +6,6 @@ reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
 
 from . import nn
 from . import asp
+from . import operators
 
-__all__ = ["nn", "asp"]
+__all__ = ["nn", "asp", "operators"]

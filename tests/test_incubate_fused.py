@@ -187,3 +187,28 @@ def test_variable_length_attention_masks_out_of_range():
     p /= p.sum(-1, keepdims=True)
     ref = np.einsum("hst,htd->hsd", p, np.asarray(v[1]))
     np.testing.assert_allclose(np.asarray(out[1]), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_incubate_operators():
+    """incubate.operators parity (reference: incubate/operators/ —
+    softmax_mask_fuse*, graph_send_recv)."""
+    from paddle_tpu.incubate import operators as OPS
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(1, 2, 4, 4).astype(np.float32))
+    mask = jnp.where(jnp.asarray(rs.rand(1, 1, 4, 4)) > 0.5, 0.0, -1e9)
+    out = OPS.softmax_mask_fuse(x, mask)
+    ref = np.asarray(jax.nn.softmax(np.asarray(x) + np.asarray(mask), axis=-1))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+    outc = OPS.softmax_mask_fuse_upper_triangle(x)
+    assert np.allclose(np.asarray(outc)[..., 0, 1:], 0.0)
+    np.testing.assert_allclose(np.asarray(outc).sum(-1), 1.0, rtol=1e-5)
+
+    feat = jnp.asarray(rs.randn(4, 3).astype(np.float32))
+    src = jnp.asarray([0, 1, 2, 0])
+    dst = jnp.asarray([1, 2, 1, 0])
+    got = OPS.graph_send_recv(feat, src, dst, pool_type="sum")
+    ref = np.zeros((4, 3), np.float32)
+    for s_, d_ in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        ref[d_] += np.asarray(feat)[s_]
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)

@@ -1,0 +1,99 @@
+"""Legacy reader decorators (reference: python/paddle/reader/decorator.py
+test model: test/legacy_test/test_multiprocess_reader_exception.py etc.)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _r(n=6):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+def test_cache_and_firstn():
+    calls = []
+
+    def reader():
+        calls.append(1)
+        yield from range(4)
+
+    c = pt.reader.cache(reader)
+    assert list(c()) == [0, 1, 2, 3]
+    assert list(c()) == [0, 1, 2, 3]
+    assert len(calls) == 1                # second pass replays from memory
+    assert list(pt.reader.firstn(_r(), 3)()) == [0, 1, 2]
+
+
+def test_map_chain_compose():
+    m = pt.reader.map_readers(lambda a, b: a + b, _r(3), _r(3))
+    assert list(m()) == [0, 2, 4]
+    assert list(pt.reader.chain(_r(2), _r(2))()) == [0, 1, 0, 1]
+    comp = pt.reader.compose(_r(2), _r(2))
+    assert list(comp()) == [(0, 0), (1, 1)]
+    import pytest
+    with pytest.raises(RuntimeError):
+        list(pt.reader.compose(_r(2), _r(3))())
+
+
+def test_shuffle_and_buffered():
+    out = list(pt.reader.shuffle(_r(10), buf_size=4)())
+    assert sorted(out) == list(range(10))
+    assert list(pt.reader.buffered(_r(5), size=2)()) == [0, 1, 2, 3, 4]
+
+
+def test_xmap_readers_ordered():
+    out = list(pt.reader.xmap_readers(lambda x: x * 2, _r(8),
+                                      process_num=3, buffer_size=4,
+                                      order=True)())
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+    out = sorted(pt.reader.xmap_readers(lambda x: x * 2, _r(8),
+                                        process_num=3, buffer_size=4)())
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_reader_error_and_raggedness_propagate():
+    """Round-3 review findings: source exceptions must not truncate the
+    stream silently; compose detects raggedness in both orderings; a
+    failed first cache pass doesn't replay partial items."""
+    import itertools
+    import pytest
+
+    def flaky():
+        fail = {"n": 0}
+
+        def reader():
+            yield 1
+            if fail["n"] == 0:
+                fail["n"] += 1
+                raise ValueError("boom")
+            yield 2
+        return reader
+
+    buf = pt.reader.buffered(flaky(), size=2)
+    with pytest.raises(ValueError):
+        list(buf())
+
+    c = pt.reader.cache(flaky())
+    with pytest.raises(ValueError):
+        list(c())
+    assert list(c()) == [1, 2]            # clean retry, no duplicates
+
+    def rn(n):
+        def r():
+            yield from range(n)
+        return r
+    for a, b in ((2, 3), (3, 2)):
+        with pytest.raises(RuntimeError):
+            list(pt.reader.compose(rn(a), rn(b))())
+
+    # abandoning a buffered generator releases the fill thread
+    import threading
+    before = threading.active_count()
+    g = pt.reader.buffered(rn(1000), size=2)()
+    next(g)
+    g.close()
+    import time
+    time.sleep(0.3)
+    assert threading.active_count() <= before + 1
