@@ -16,8 +16,9 @@ from ..parallel.api import (shard_tensor, reshard, shard_layer,
                             Shard, Replicate, Partial, Placement)
 from .communication import (ReduceOp, Group, new_group, get_rank,
                             get_world_size, barrier, all_reduce, all_gather,
-                            reduce_scatter, alltoall, broadcast, psum,
-                            pmean, pmax, pmin, ppermute, send_recv,
+                            reduce_scatter, alltoall, broadcast, reduce,
+                            scatter, gather, send_to, batch_isend_irecv,
+                            psum, pmean, pmax, pmin, ppermute, send_recv,
                             rank_view, stream)
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from .strategy import (DistributedStrategy, HybridConfig, AmpConfig,

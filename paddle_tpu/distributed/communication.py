@@ -269,6 +269,93 @@ def broadcast(x, src: int = 0, group=None):
                          out_specs=P(axis))(x)
 
 
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+    """Rooted reduce: all ranks' slices reduce; rank ``dst`` receives the
+    result, other ranks keep their input (reference:
+    communication/reduce.py — NCCL reduce-to-root semantics)."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("reduce needs a single-axis group")
+    axis = g.axes[0]
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}.get(op)
+    if red is None:
+        raise NotImplementedError(f"unsupported reduce op {op!r}")
+
+    def fn(xs):  # [1, ...]
+        total = red(xs[0], axis)
+        me = jax.lax.axis_index(axis)
+        return jnp.where(me == dst, total, xs[0])[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def scatter(x, src: int = 0, group=None):
+    """Rank ``src``'s slice (itself rank-major [n, m, ...]) scatters piece
+    i to rank i (reference: communication/scatter.py). Other ranks'
+    payloads are ignored, as NCCL scatter does."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("scatter needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):  # [1, n, m, ...] this rank's (ignored unless src) payload
+        # all_to_all moves O(n*m): rank i ships payload row j to rank j,
+        # so each rank ends with column [i=src] of the transposed layout —
+        # no O(n^2*m) all_gather of every rank's full payload
+        transposed = jax.lax.all_to_all(xs, axis, split_axis=1,
+                                        concat_axis=0, tiled=True)
+        # transposed: [n, 1, m...] — row i is rank i's piece for THIS rank
+        return transposed[src, 0][None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def gather(x, dst: int = 0, group=None, axis: int = 0):
+    """Rooted gather: rank ``dst`` receives all slices concatenated; other
+    ranks receive their own slice tiled (XLA has no rooted gather — the
+    all-gather rides ICI either way; reference: communication/gather.py)."""
+    del dst  # every rank materializes the gather (documented deviation)
+    return all_gather(x, group=group, axis=axis)
+
+
+def send_to(x, dst: int, src: int, group=None):
+    """Point-to-point move of rank ``src``'s slice to rank ``dst`` (the
+    reference's send/recv pair, communication/{send,recv}.py — one XLA
+    CollectivePermute). Ranks other than dst keep their slice."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("send_to needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):
+        moved = jax.lax.ppermute(xs[0], axis, [(src, dst)])
+        me = jax.lax.axis_index(axis)
+        return jnp.where(me == dst, moved, xs[0])[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def batch_isend_irecv(x, pairs, group=None):
+    """Batched P2P: ``pairs`` is [(src, dst), ...] executed as ONE
+    CollectivePermute (reference: communication/batch_isend_irecv.py —
+    NCCL groups the sends; XLA's ppermute IS the batched form). Ranks that
+    are not a destination receive zeros, matching ppermute semantics."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("batch_isend_irecv needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):
+        return jax.lax.ppermute(xs[0], axis, list(pairs))[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
 class stream:
     """Namespace parity with paddle.distributed.stream.* — on TPU there are
     no user-visible comm streams (XLA schedules collectives); the stream API
@@ -278,3 +365,5 @@ class stream:
     reduce_scatter = staticmethod(reduce_scatter)
     alltoall = staticmethod(alltoall)
     broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)

@@ -88,10 +88,12 @@ def compose(*readers, check_alignment: bool = True):
         # off-by-one-longer predecessor from any post-loop probe)
         for items in itertools.zip_longest(*its, fillvalue=_SENTINEL):
             ragged = any(i is _SENTINEL for i in items)
-            if ragged and check_alignment:
-                raise RuntimeError("compose: readers of different length")
-            yield sum((_as_tuple(i) for i in items if i is not _SENTINEL),
-                      ())
+            if ragged:
+                if check_alignment:
+                    raise RuntimeError("compose: readers of different "
+                                       "length")
+                return        # unchecked mode truncates at the shortest
+            yield sum((_as_tuple(i) for i in items), ())
 
     return new_reader
 
@@ -110,20 +112,23 @@ def buffered(reader, size: int):
         q: queue.Queue = queue.Queue(maxsize=size)
         stop = threading.Event()
 
+        def put_or_stop(msg):
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def fill():
             try:
                 for item in reader():
-                    while not stop.is_set():
-                        try:
-                            q.put((False, item), timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
+                    if not put_or_stop((False, item)):
                         return
-                q.put((True, None))
+                put_or_stop((True, None))
             except BaseException as e:         # noqa: BLE001 — re-raised
-                q.put((True, e))
+                put_or_stop((True, e))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()

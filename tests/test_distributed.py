@@ -198,3 +198,34 @@ def test_recompute_matches_plain():
                                np.sin(np.asarray(x) * 2 + 1), rtol=1e-6)
     with pytest.raises(ValueError):
         recompute(f, x, policy="bogus")
+
+
+def test_rooted_and_p2p_collectives(mesh42):
+    """reduce/scatter/gather/send_to/batch_isend_irecv (reference:
+    communication/{reduce,scatter,gather,send,recv,batch_isend_irecv}.py)."""
+    x = np.arange(4 * 2, dtype=np.float32).reshape(4, 2)
+    xr = dist.rank_view(jnp.asarray(x), group="dp")
+
+    out = np.asarray(dist.reduce(xr, dst=1, group="dp"))
+    np.testing.assert_array_equal(out[1], x.sum(0))
+    np.testing.assert_array_equal(out[0], x[0])      # non-root keeps input
+
+    # scatter: src rank's payload is rank-major [n, m]; rank i gets row i
+    payload = np.arange(4 * 4 * 2, dtype=np.float32).reshape(4, 4, 2)
+    pr = dist.rank_view(jnp.asarray(payload), group="dp")
+    out = np.asarray(dist.scatter(pr, src=2, group="dp"))
+    np.testing.assert_array_equal(out, payload[2])
+
+    out = np.asarray(dist.gather(xr, dst=0, group="dp"))
+    np.testing.assert_array_equal(out[:4], x)
+
+    out = np.asarray(dist.send_to(xr, dst=3, src=0, group="dp"))
+    np.testing.assert_array_equal(out[3], x[0])
+    np.testing.assert_array_equal(out[1], x[1])
+
+    out = np.asarray(dist.batch_isend_irecv(
+        xr, pairs=[(0, 1), (1, 0), (2, 3)], group="dp"))
+    np.testing.assert_array_equal(out[1], x[0])
+    np.testing.assert_array_equal(out[0], x[1])
+    np.testing.assert_array_equal(out[3], x[2])
+    np.testing.assert_array_equal(out[2], 0 * x[2])  # no sender -> zeros
