@@ -49,6 +49,10 @@ from . import utils
 from . import profiler
 from . import onnx
 from . import reader
+from . import regularizer
+from . import signal
+from . import sysconfig
+from .reader import batch
 from . import hapi
 from .hapi import Model
 from .hapi.summary import summary

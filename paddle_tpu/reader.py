@@ -18,7 +18,7 @@ import threading
 from typing import Callable
 
 __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
-           "buffered", "firstn", "xmap_readers"]
+           "buffered", "firstn", "xmap_readers", "batch"]
 
 
 def cache(reader):
@@ -178,5 +178,24 @@ def xmap_readers(mapper: Callable, reader, process_num: int,
                         yield pending.pop(done).result()
             for f in pending:
                 yield f.result()
+
+    return new_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group items into lists of ``batch_size`` (reference:
+    python/paddle/batch.py — the legacy pre-DataLoader batcher)."""
+    if int(batch_size) <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def new_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
 
     return new_reader
