@@ -52,6 +52,8 @@ from . import reader
 from . import regularizer
 from . import signal
 from . import sysconfig
+from . import callbacks
+from . import hub
 from .reader import batch
 from . import hapi
 from .hapi import Model

@@ -1,0 +1,11 @@
+"""paddle.callbacks re-export (reference: python/paddle/callbacks.py —
+a thin alias of hapi.callbacks; VisualDL/Wandb integrations are external
+services and are out of scope by design, recorded in
+docs/DESIGN_DECISIONS.md)."""
+
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping, History,
+                             LRSchedulerCallback as LRScheduler,
+                             ModelCheckpoint, ProgBarLogger)
+
+__all__ = ["Callback", "CallbackList", "EarlyStopping", "History",
+           "LRScheduler", "ModelCheckpoint", "ProgBarLogger"]

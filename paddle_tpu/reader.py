@@ -185,7 +185,8 @@ def xmap_readers(mapper: Callable, reader, process_num: int,
 def batch(reader, batch_size: int, drop_last: bool = False):
     """Group items into lists of ``batch_size`` (reference:
     python/paddle/batch.py — the legacy pre-DataLoader batcher)."""
-    if int(batch_size) <= 0:
+    batch_size = int(batch_size)
+    if batch_size <= 0:
         raise ValueError(f"batch_size must be positive, got {batch_size}")
 
     def new_reader():

@@ -23,17 +23,21 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
     x = jnp.asarray(x)
     if axis not in (-1, x.ndim - 1, 0):
         raise ValueError("frame: axis must be 0 or -1")
-    seq = x.shape[-1] if axis in (-1, x.ndim - 1) else x.shape[0]
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    # axis=0 selects the frames-first layout; for 1-D input axis 0 IS the
+    # last axis, but the layouts still differ ([nf, fl] vs [fl, nf])
+    frames_first = (axis == 0)
+    seq = x.shape[0] if frames_first else x.shape[-1]
     if frame_length > seq:
         raise ValueError(f"frame_length {frame_length} > sequence {seq}")
     n_frames = 1 + (seq - frame_length) // hop_length
     starts = jnp.arange(n_frames) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [nf, fl]
-    if axis in (-1, x.ndim - 1):
-        frames = x[..., idx]                       # [..., nf, fl]
-        return jnp.swapaxes(frames, -1, -2)        # [..., fl, nf]
-    return x[idx]                                  # [nf, fl, ...] (paddle
-                                                   # axis=0: frames first)
+    if frames_first:
+        return x[idx]                              # [nf, fl, ...]
+    frames = x[..., idx]                           # [..., nf, fl]
+    return jnp.swapaxes(frames, -1, -2)            # [..., fl, nf]
 
 
 def overlap_add(x, hop_length: int, axis: int = -1, name=None):
@@ -41,7 +45,9 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     -> [..., seq]; axis=0 takes [n_frames, frame_length, ...] -> [seq, ...]
     (reference overlap_add_op layouts)."""
     x = jnp.asarray(x)
-    if axis in (-1, x.ndim - 1):
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    if axis != 0:
         fl, nf = x.shape[-2], x.shape[-1]
         frames = jnp.swapaxes(x, -1, -2)           # [..., nf, fl]
     else:
@@ -54,7 +60,7 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     starts = jnp.arange(nf) * hop_length
     idx = starts[:, None] + jnp.arange(fl)[None, :]
     out = out.at[..., idx].add(frames)
-    if axis not in (-1, x.ndim - 1):
+    if axis == 0:
         out = jnp.moveaxis(out, -1, 0)             # [seq, ...]
     return out
 
@@ -107,6 +113,10 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
         lpad = (n_fft - win_length) // 2
         window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
 
+    expected_bins = n_fft // 2 + 1 if onesided else n_fft
+    if x.shape[-2] != expected_bins:
+        raise ValueError(f"istft: spectrum has {x.shape[-2]} frequency bins "
+                         f"but n_fft={n_fft} implies {expected_bins}")
     spec = jnp.swapaxes(x, -1, -2)                 # [..., nf, bins]
     if normalized:
         spec = spec * math.sqrt(n_fft)

@@ -90,3 +90,44 @@ def test_frame_axis0_layout_and_guards():
                         onesided=True, return_complex=True)
     with pytest.raises(ValueError):
         pt.reader.batch(lambda: iter(()), 0)
+
+
+def test_callbacks_and_hub(tmp_path):
+    """paddle.callbacks re-export + paddle.hub local source (reference:
+    callbacks.py, hapi/hub.py)."""
+    assert pt.callbacks.EarlyStopping is not None
+    assert pt.callbacks.ModelCheckpoint is not None
+
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_mlp(width=4):\n"
+        "    'A tiny MLP entrypoint.'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, 2)\n")
+    names = pt.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names
+    assert "tiny MLP" in pt.hub.help(str(tmp_path), "tiny_mlp")
+    layer = pt.hub.load(str(tmp_path), "tiny_mlp", width=6)
+    assert layer.weight.shape == (6, 2)
+    with pytest.raises(NotImplementedError):
+        pt.hub.list("x", source="github")
+
+
+def test_frame_axis0_1d_and_validation():
+    """1-D axis=0 must still use the frames-first layout; bad hop/n_fft
+    raise (round-3 review findings)."""
+    x = jnp.asarray(np.arange(10, dtype=np.float32))
+    f = pt.signal.frame(x, frame_length=4, hop_length=2, axis=0)
+    assert f.shape == (4, 4)
+    np.testing.assert_array_equal(np.asarray(f)[1], [2, 3, 4, 5])
+    np.testing.assert_array_equal(
+        np.asarray(pt.signal.overlap_add(
+            pt.signal.frame(x, 5, 5, axis=0), hop_length=5, axis=0)),
+        np.asarray(x))
+    with pytest.raises(ValueError):
+        pt.signal.frame(x, 4, hop_length=0)
+    with pytest.raises(ValueError):
+        pt.signal.frame(x, 4, hop_length=-1, axis=0)
+    with pytest.raises(ValueError):
+        pt.signal.istft(jnp.zeros((17, 4), jnp.complex64), n_fft=64)
+    assert list(pt.batch(lambda: iter(range(5)), 2.99)()) \
+        == [[0, 1], [2, 3], [4]]
