@@ -9,7 +9,9 @@ namespace mirrors ``paddle.*``: tensor functions live here, layers under
 
 from .core import dtype as _dtype_ns
 from .core.dtype import (bool_, uint8, int8, int16, int32, int64, float16,
-                         bfloat16, float32, float64, complex64, complex128)
+                         bfloat16, float32, float64, complex64, complex128,
+                         dtype, finfo, iinfo)
+from .core.dtype import bool_ as bool  # noqa: A001 — paddle exports `bool`
 from .core.flags import set_flags, get_flags
 from .core.rng import seed
 
@@ -30,6 +32,16 @@ from .nn.layer import set_default_dtype, get_default_dtype
 
 from .framework import save, load, set_device, get_device, is_compiled_with_cuda, \
     is_compiled_with_tpu, device_count, no_grad
+from .base import (CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace,
+                   IPUPlace, ParamAttr, LazyGuard, DataParallel,
+                   in_dynamic_mode, in_dynamic_or_pir_mode, enable_static,
+                   disable_static, enable_grad, set_grad_enabled,
+                   is_grad_enabled, disable_signal_handler, set_printoptions,
+                   get_rng_state, set_rng_state, get_cuda_rng_state,
+                   set_cuda_rng_state, create_parameter, create_global_var,
+                   check_shape)
+from .autograd import grad
+from .hapi.summary import flops
 from . import jit
 from . import static
 from . import metric

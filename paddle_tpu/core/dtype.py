@@ -33,6 +33,44 @@ _NAME_TO_DTYPE = {
 }
 
 
+dtype = jnp.dtype  # paddle.dtype — the dtype type itself
+
+
+class finfo:
+    """Float type info (paddle.finfo; reference python/paddle/framework/
+    dtype.py finfo): eps/min/max/tiny/smallest_normal/bits/dtype."""
+
+    def __init__(self, dt):
+        info = jnp.finfo(convert_dtype(dt))
+        self.dtype = str(info.dtype)
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.bits = int(info.bits)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+    def __repr__(self):
+        return (f"finfo(dtype={self.dtype}, eps={self.eps}, min={self.min}, "
+                f"max={self.max}, bits={self.bits})")
+
+
+class iinfo:
+    """Integer type info (paddle.iinfo)."""
+
+    def __init__(self, dt):
+        info = jnp.iinfo(convert_dtype(dt))
+        self.dtype = str(info.dtype)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+
+    def __repr__(self):
+        return (f"iinfo(dtype={self.dtype}, min={self.min}, max={self.max}, "
+                f"bits={self.bits})")
+
+
 def convert_dtype(dtype) -> jnp.dtype:
     """Normalize a string/np/jnp dtype to a jnp dtype."""
     if isinstance(dtype, str):

@@ -47,6 +47,8 @@ def overlap_add(x, hop_length: int, axis: int = -1, name=None):
     x = jnp.asarray(x)
     if hop_length <= 0:
         raise ValueError(f"hop_length must be positive, got {hop_length}")
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
     if axis != 0:
         fl, nf = x.shape[-2], x.shape[-1]
         frames = jnp.swapaxes(x, -1, -2)           # [..., nf, fl]

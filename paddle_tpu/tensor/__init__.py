@@ -1005,3 +1005,8 @@ def fmax(x, y, name=None):
 
 def fmin(x, y, name=None):
     return jnp.fmin(jnp.asarray(x), jnp.asarray(y))
+
+
+# -- long-tail surface (extras) + inplace-spelled aliases --------------------
+from .extras import *          # noqa: F401,F403,E402
+from .inplace import *         # noqa: F401,F403,E402
