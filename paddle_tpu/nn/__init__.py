@@ -23,3 +23,28 @@ from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN,
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)
+
+# -- round-3 parity batch: activation/pool/loss/container long tail ---------
+from .layers_extras import (
+    Identity, CELU, ELU, GLU, Hardshrink, Hardtanh, LogSigmoid, LogSoftmax,
+    Maxout, ReLU6, SELU, Silu, Softplus, Softshrink, Softsign, Swish,
+    Tanhshrink, ThresholdedReLU, Softmax2D, PReLU, RReLU,
+    AvgPool1D, AvgPool3D, MaxPool1D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, ChannelShuffle, PixelUnshuffle,
+    Unflatten, Fold, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
+    AlphaDropout, Dropout2D, Dropout3D,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+    SpectralNorm, CosineSimilarity, PairwiseDistance, Bilinear,
+    ParameterList, Conv1DTranspose, Conv3DTranspose,
+    BCELoss, CosineEmbeddingLoss, HingeEmbeddingLoss, MarginRankingLoss,
+    PoissonNLLLoss, GaussianNLLLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss, CTCLoss, RNNTLoss, HSigmoidLoss,
+    BiRNN, RNNCellBase, BeamSearchDecoder, dynamic_decode,
+)
+from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                              ClipGradByValue)

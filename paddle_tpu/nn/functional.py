@@ -694,3 +694,8 @@ def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
     if data_format == "NHWC":
         out = jnp.transpose(out, (0, 2, 3, 1))
     return out
+
+
+# -- long tail (round-3 parity batch): activations, 1d/3d/adaptive pooling,
+#    unpool, grid ops, conv transposes, loss family remainder ---------------
+from .functional_extras import *   # noqa: F401,F403,E402

@@ -534,15 +534,17 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
     arr = jnp.asarray(x)
     if arr.ndim == 2:
         n, m = arr.shape
-        limit = n if wrap else min(n, m)
-        i = np.arange(limit)  # offset/shape are static: index on the host
-        # offset >= 0 shifts right (cols + offset); < 0 shifts down (rows
-        # - offset). Guard both ends so no negative index wraps around.
-        rows = i if offset >= 0 else i - offset
-        cols = (i + offset) % m if wrap and offset >= 0 else \
-            (i + offset if offset >= 0 else i)
-        keep = (rows < n) & (cols >= 0) & (cols < m)
-        return arr.at[rows[keep], cols[keep]].set(value)
+        # flat-storage stride m+1, like numpy/torch fill_diagonal_: with
+        # wrap=True on tall matrices the diagonal restarts after a blank
+        # row; offset shifts the starting flat position
+        start = offset if offset >= 0 else -offset * m
+        if wrap:
+            flat_idx = np.arange(start, n * m, m + 1)
+        else:
+            count = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+            flat_idx = start + np.arange(max(0, count)) * (m + 1)
+        flat = arr.reshape(-1).at[jnp.asarray(flat_idx)].set(value)
+        return flat.reshape(n, m)
     k = min(arr.shape)
     idx = jnp.arange(k)
     return arr.at[tuple(idx for _ in range(arr.ndim))].set(value)

@@ -1,0 +1,564 @@
+"""Tests for the nn/nn.functional round-3 parity batch
+(nn/functional_extras.py, nn/layers_extras.py).
+
+Oracles: torch.nn.functional (CPU torch is in the image) for the spatial /
+loss ops that have exact torch twins; closed-form numpy for the rest.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+RS = np.random.RandomState(3)
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+class TestActivations:
+    x = RS.randn(4, 6).astype("float32")
+
+    @pytest.mark.parametrize("ours,theirs,kw", [
+        (F.celu, TF.celu, {}),
+        (F.selu, TF.selu, {}),
+        (F.log_sigmoid, TF.logsigmoid, {}),
+        (F.hardshrink, TF.hardshrink, {}),
+        (F.softshrink, TF.softshrink, {}),
+        (F.softsign, TF.softsign, {}),
+        (F.tanhshrink, TF.tanhshrink, {}),
+    ])
+    def test_vs_torch(self, ours, theirs, kw):
+        got = np.asarray(ours(self.x, **kw))
+        exp = theirs(_t(self.x), **kw).numpy()
+        assert np.allclose(got, exp, atol=1e-5), ours.__name__
+
+    def test_hardtanh_thresholded(self):
+        assert np.allclose(F.hardtanh(self.x, -0.5, 0.5),
+                           np.clip(self.x, -0.5, 0.5))
+        got = np.asarray(F.thresholded_relu(self.x, 0.3))
+        assert np.allclose(got, np.where(self.x > 0.3, self.x, 0.0))
+
+    def test_maxout_prelu(self):
+        x = RS.randn(2, 6, 3, 3).astype("float32")
+        got = np.asarray(F.maxout(x, groups=3))
+        exp = x.reshape(2, 2, 3, 3, 3).max(2)
+        assert np.allclose(got, exp)
+        w = np.array([0.1, 0.2, 0.3, 0.1, 0.2, 0.3], "float32")
+        got = np.asarray(F.prelu(x, w))
+        exp = TF.prelu(_t(x), _t(w)).numpy()
+        assert np.allclose(got, exp, atol=1e-6)
+
+    def test_rrelu_gumbel(self):
+        pt.seed(0)
+        xr = F.rrelu(self.x, training=False)
+        a = (1 / 8 + 1 / 3) / 2
+        assert np.allclose(xr, np.where(self.x >= 0, self.x, a * self.x))
+        tr = np.asarray(F.rrelu(self.x, training=True))
+        neg = self.x < 0
+        ratio = tr[neg] / self.x[neg]
+        assert (ratio >= 1 / 8 - 1e-6).all() and (ratio <= 1 / 3 + 1e-6).all()
+        g = np.asarray(F.gumbel_softmax(self.x, hard=True))
+        assert np.allclose(g.sum(-1), 1.0) and set(np.unique(g)) <= {0.0, 1.0}
+
+    def test_inplace_spellings(self):
+        assert np.allclose(F.relu_(self.x), np.maximum(self.x, 0))
+        assert np.allclose(F.tanh_(self.x), np.tanh(self.x))
+        assert np.allclose(F.softmax_(self.x),
+                           TF.softmax(_t(self.x), -1).numpy(), atol=1e-6)
+
+
+class TestPooling:
+    def test_pool1d_3d_vs_torch(self):
+        x1 = RS.randn(2, 3, 16).astype("float32")
+        assert np.allclose(F.max_pool1d(x1, 4),
+                           TF.max_pool1d(_t(x1), 4).numpy())
+        assert np.allclose(F.avg_pool1d(x1, 4),
+                           TF.avg_pool1d(_t(x1), 4).numpy(), atol=1e-6)
+        x3 = RS.randn(2, 3, 8, 8, 8).astype("float32")
+        assert np.allclose(F.max_pool3d(x3, 2),
+                           TF.max_pool3d(_t(x3), 2).numpy())
+        assert np.allclose(F.avg_pool3d(x3, 2),
+                           TF.avg_pool3d(_t(x3), 2).numpy(), atol=1e-6)
+
+    def test_adaptive_avg_vs_torch(self):
+        x1 = RS.randn(2, 3, 17).astype("float32")   # non-divisible
+        assert np.allclose(F.adaptive_avg_pool1d(x1, 5),
+                           TF.adaptive_avg_pool1d(_t(x1), 5).numpy(),
+                           atol=1e-5)
+        x3 = RS.randn(2, 3, 9, 7, 5).astype("float32")
+        assert np.allclose(F.adaptive_avg_pool3d(x3, (4, 3, 2)),
+                           TF.adaptive_avg_pool3d(_t(x3), (4, 3, 2)).numpy(),
+                           atol=1e-5)
+
+    def test_adaptive_max_vs_torch(self):
+        x1 = RS.randn(2, 3, 17).astype("float32")
+        assert np.allclose(F.adaptive_max_pool1d(x1, 5),
+                           TF.adaptive_max_pool1d(_t(x1), 5).numpy())
+        x2 = RS.randn(2, 3, 9, 7).astype("float32")
+        vals, idx = F.adaptive_max_pool2d(x2, (4, 3), return_mask=True)
+        tv, ti = TF.adaptive_max_pool2d(_t(x2), (4, 3), return_indices=True)
+        assert np.allclose(vals, tv.numpy())
+        assert np.array_equal(np.asarray(idx), ti.numpy())
+        x3 = RS.randn(2, 3, 8, 6, 4).astype("float32")
+        assert np.allclose(F.adaptive_max_pool3d(x3, 2),
+                           TF.adaptive_max_pool3d(_t(x3), 2).numpy())
+
+    def test_unpool_roundtrip_vs_torch(self):
+        x = RS.randn(2, 3, 8, 8).astype("float32")
+        tv, ti = TF.max_pool2d(_t(x), 2, return_indices=True)
+        ours = F.max_unpool2d(tv.numpy(), ti.numpy(), 2)
+        theirs = TF.max_unpool2d(tv, ti, 2).numpy()
+        assert np.allclose(np.asarray(ours), theirs)
+
+    def test_pool_mask_consistency(self):
+        # our max_pool1d mask feeds our unpool back to the right slots
+        x = RS.randn(2, 3, 12).astype("float32")
+        out, mask = F.max_pool1d(x, 3, return_mask=True)
+        tv, ti = TF.max_pool1d(_t(x), 3, return_indices=True)
+        assert np.allclose(np.asarray(out), tv.numpy())
+        assert np.array_equal(np.asarray(mask), ti.numpy())
+        rec = F.max_unpool1d(out, mask, 3)
+        exp = TF.max_unpool1d(tv, ti, 3).numpy()
+        assert np.allclose(np.asarray(rec), exp)
+
+
+class TestSpatial:
+    def test_grid_sample_vs_torch(self):
+        x = RS.randn(2, 3, 6, 7).astype("float32")
+        grid = (RS.rand(2, 4, 5, 2).astype("float32") * 2.4 - 1.2)
+        for mode in ("bilinear", "nearest"):
+            for pad in ("zeros", "border", "reflection"):
+                for ac in (True, False):
+                    got = np.asarray(F.grid_sample(
+                        x, grid, mode=mode, padding_mode=pad,
+                        align_corners=ac))
+                    exp = TF.grid_sample(_t(x), _t(grid), mode=mode,
+                                         padding_mode=pad,
+                                         align_corners=ac).numpy()
+                    assert np.allclose(got, exp, atol=1e-4), (mode, pad, ac)
+
+    def test_affine_grid_vs_torch(self):
+        theta = RS.randn(2, 2, 3).astype("float32")
+        for ac in (True, False):
+            got = np.asarray(F.affine_grid(theta, (2, 3, 5, 6),
+                                           align_corners=ac))
+            exp = TF.affine_grid(_t(theta), (2, 3, 5, 6),
+                                 align_corners=ac).numpy()
+            assert np.allclose(got, exp, atol=1e-5), ac
+
+    def test_fold_vs_torch(self):
+        x = RS.randn(2, 3 * 2 * 2, 9).astype("float32")
+        got = np.asarray(F.fold(x, (4, 4), (2, 2), strides=1))
+        exp = TF.fold(_t(x), (4, 4), (2, 2)).numpy()
+        assert np.allclose(got, exp, atol=1e-5)
+        # with padding + stride
+        x2 = RS.randn(1, 4 * 9, 9).astype("float32")
+        got2 = np.asarray(F.fold(x2, (6, 6), (3, 3), strides=2, paddings=1))
+        exp2 = TF.fold(_t(x2), (6, 6), (3, 3), stride=2, padding=1).numpy()
+        assert np.allclose(got2, exp2, atol=1e-5)
+
+    def test_fold_unfold_roundtrip(self):
+        x = RS.randn(2, 3, 6, 6).astype("float32")
+        cols = F.unfold(x, 2, strides=2)
+        rec = np.asarray(F.fold(cols, (6, 6), 2, strides=2))
+        assert np.allclose(rec, x, atol=1e-6)  # non-overlapping: exact
+
+    def test_channel_ops(self):
+        x = RS.randn(2, 6, 4, 4).astype("float32")
+        got = np.asarray(F.channel_shuffle(x, 3))
+        exp = TF.channel_shuffle(_t(x), 3).numpy()
+        assert np.allclose(got, exp)
+        z = np.asarray(F.zeropad2d(x, [1, 2, 3, 4]))
+        assert z.shape == (2, 6, 4 + 3 + 4, 4 + 1 + 2)
+        assert np.allclose(z[:, :, 3:7, 1:5], x)
+
+    def test_lrn_vs_torch(self):
+        x = RS.randn(2, 7, 5, 5).astype("float32")
+        got = np.asarray(F.local_response_norm(x, size=5))
+        exp = TF.local_response_norm(_t(x), 5).numpy()
+        assert np.allclose(got, exp, atol=1e-5)
+
+    def test_temporal_shift(self):
+        x = RS.randn(4, 8, 2, 2).astype("float32")  # nt=4 (n=2, seg=2)
+        out = np.asarray(F.temporal_shift(x, seg_num=2, shift_ratio=0.25))
+        assert out.shape == x.shape
+        v = x.reshape(2, 2, 8, 2, 2)
+        o = out.reshape(2, 2, 8, 2, 2)
+        assert np.allclose(o[:, 0, :2], v[:, 1, :2])   # left-shifted fold
+        assert np.allclose(o[:, 1, :2], 0.0)
+        assert np.allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # right-shifted fold
+        assert np.allclose(o[:, :, 4:], v[:, :, 4:])    # rest untouched
+
+    def test_sequence_mask_gather_tree(self):
+        m = np.asarray(F.sequence_mask(np.array([2, 4]), maxlen=5))
+        assert np.array_equal(m, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+        ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], "int32")   # [T=3,B=1,W=2]
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], "int32")
+        got = np.asarray(F.gather_tree(ids, parents))
+        exp = torch.ops.aten  # torch has no public gather_tree; check walk
+        # beam 0 final token 4 at step2 parent 0 -> step1 beam0 token 3,
+        # parent of (step1,beam0)=1 -> step0 beam1 token 5
+        assert got[2, 0, 0] == 4 and got[1, 0, 0] == 3 and got[0, 0, 0] == 5
+
+    def test_instance_norm_vs_torch(self):
+        x = RS.randn(2, 3, 4, 5).astype("float32")
+        w = RS.rand(3).astype("float32")
+        b = RS.randn(3).astype("float32")
+        got = np.asarray(F.instance_norm(x, weight=w, bias=b))
+        exp = TF.instance_norm(_t(x), weight=_t(w), bias=_t(b)).numpy()
+        assert np.allclose(got, exp, atol=1e-4)
+
+    def test_conv_transpose_1d3d_vs_torch(self):
+        x = RS.randn(2, 4, 9).astype("float32")
+        w = RS.randn(4, 3, 3).astype("float32")
+        got = np.asarray(F.conv1d_transpose(x, w, stride=2, padding=1))
+        exp = TF.conv_transpose1d(_t(x), _t(w), stride=2, padding=1).numpy()
+        assert np.allclose(got, exp, atol=1e-4)
+        x3 = RS.randn(1, 2, 4, 4, 4).astype("float32")
+        w3 = RS.randn(2, 3, 2, 2, 2).astype("float32")
+        got3 = np.asarray(F.conv3d_transpose(x3, w3, stride=2))
+        exp3 = TF.conv_transpose3d(_t(x3), _t(w3), stride=2).numpy()
+        assert np.allclose(got3, exp3, atol=1e-4)
+
+    def test_bilinear_pairwise(self):
+        x1 = RS.randn(4, 3).astype("float32")
+        x2 = RS.randn(4, 5).astype("float32")
+        w = RS.randn(2, 3, 5).astype("float32")
+        b = RS.randn(2).astype("float32")
+        got = np.asarray(F.bilinear(x1, x2, w, b))
+        exp = TF.bilinear(_t(x1), _t(x2), _t(w), _t(b)).numpy()
+        assert np.allclose(got, exp, atol=1e-4)
+        d = np.asarray(F.pairwise_distance(x1, x1 + 1.0))
+        exp = TF.pairwise_distance(_t(x1), _t(x1 + 1.0)).numpy()
+        assert np.allclose(d, exp, atol=1e-5)
+
+
+class TestDropoutVariants:
+    def setup_method(self):
+        pt.seed(7)
+
+    def test_dropout2d_channels(self):
+        x = np.ones((4, 8, 5, 5), "float32")
+        out = np.asarray(F.dropout2d(x, 0.5, training=True))
+        # each channel either all-zero or all-1/(1-p)
+        per_ch = out.reshape(4, 8, -1)
+        assert all(np.all(c == c[0]) for b in per_ch for c in b)
+        assert np.allclose(F.dropout2d(x, 0.5, training=False), x)
+
+    def test_dropout3d_alpha(self):
+        x = np.ones((2, 4, 3, 3, 3), "float32")
+        out = np.asarray(F.dropout3d(x, 0.5, training=True))
+        per_ch = out.reshape(2, 4, -1)
+        assert all(np.all(c == c[0]) for b in per_ch for c in b)
+        xa = RS.randn(1000, 32).astype("float32")
+        ya = np.asarray(F.alpha_dropout(xa, 0.3, training=True))
+        # mean/var approximately preserved (SELU self-normalizing property)
+        assert abs(ya.mean() - xa.mean()) < 0.1
+        assert abs(ya.std() - xa.std()) < 0.15
+        assert np.allclose(F.alpha_dropout(xa, 0.3, training=False), xa)
+
+
+class TestLosses:
+    def test_simple_losses_vs_torch(self):
+        x = RS.randn(8, 5).astype("float32")
+        y = RS.randn(8, 5).astype("float32")
+        lbl = np.sign(RS.randn(8)).astype("float32")
+        assert np.allclose(
+            F.soft_margin_loss(x, np.sign(y)),
+            TF.soft_margin_loss(_t(x), _t(np.sign(y))).numpy(), atol=1e-5)
+        assert np.allclose(
+            F.margin_ranking_loss(x[:, 0], y[:, 0], lbl),
+            TF.margin_ranking_loss(_t(x[:, 0]), _t(y[:, 0]), _t(lbl)).numpy(),
+            atol=1e-6)
+        assert np.allclose(
+            F.cosine_embedding_loss(x, y, lbl),
+            TF.cosine_embedding_loss(_t(x), _t(y), _t(lbl)).numpy(),
+            atol=1e-5)
+        assert np.allclose(
+            F.hinge_embedding_loss(x, np.sign(y)),
+            TF.hinge_embedding_loss(_t(x), _t(np.sign(y))).numpy(),
+            atol=1e-6)
+
+    def test_nll_family_vs_torch(self):
+        x = RS.rand(8, 5).astype("float32") + 0.1
+        y = RS.rand(8, 5).astype("float32")
+        assert np.allclose(
+            F.poisson_nll_loss(x, y),
+            TF.poisson_nll_loss(_t(x), _t(y)).numpy(), atol=1e-5)
+        var = RS.rand(8, 5).astype("float32") + 0.1
+        assert np.allclose(
+            F.gaussian_nll_loss(x, y, var),
+            TF.gaussian_nll_loss(_t(x), _t(y), _t(var)).numpy(), atol=1e-5)
+
+    def test_margin_family_vs_torch(self):
+        x = RS.randn(6, 7).astype("float32")
+        y = RS.randint(0, 7, (6,)).astype("int64")
+        assert np.allclose(
+            F.multi_margin_loss(x, y),
+            TF.multi_margin_loss(_t(x), _t(y)).numpy(), atol=1e-5)
+        ml = (RS.rand(6, 7) > 0.5).astype("float32")
+        assert np.allclose(
+            F.multi_label_soft_margin_loss(x, ml),
+            TF.multilabel_soft_margin_loss(_t(x), _t(ml)).numpy(), atol=1e-5)
+
+    def test_triplet_vs_torch(self):
+        a = RS.randn(6, 4).astype("float32")
+        p = RS.randn(6, 4).astype("float32")
+        n = RS.randn(6, 4).astype("float32")
+        assert np.allclose(
+            F.triplet_margin_loss(a, p, n),
+            TF.triplet_margin_loss(_t(a), _t(p), _t(n)).numpy(), atol=1e-5)
+        got = F.triplet_margin_with_distance_loss(a, p, n, swap=True)
+        exp = TF.triplet_margin_with_distance_loss(
+            _t(a), _t(p), _t(n), swap=True,
+            distance_function=torch.nn.PairwiseDistance()).numpy()
+        assert np.allclose(np.asarray(got), exp, atol=1e-5)
+
+    def test_ctc_vs_torch(self):
+        import jax.numpy as jnp
+        import jax
+        tl = RS.randn(8, 2, 6).astype("float32")
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(tl), -1))
+        tgt = np.array([[1, 2, 3], [2, 3, 0]], "int64")
+        ilen = np.array([8, 7])
+        llen = np.array([3, 2])
+        ours = np.asarray(F.ctc_loss(lp, tgt, ilen, llen, reduction="none"))
+        exp = TF.ctc_loss(torch.tensor(lp), _t(tgt), _t(ilen), _t(llen),
+                          blank=0, reduction="none").numpy()
+        # optax recursion differs from warpctc at ~1e-3 level
+        assert np.allclose(ours, exp, atol=2e-2), (ours, exp)
+
+    def test_rnnt_brute_force(self):
+        from functools import lru_cache
+        import jax
+        import jax.numpy as jnp
+        logits = RS.randn(1, 4, 3, 5).astype("float32")
+        labels = np.array([[2, 3]], "int32")
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits[0]), -1))
+
+        @lru_cache(None)
+        def alpha(t, u):
+            if t == 0 and u == 0:
+                return 0.0
+            vals = []
+            if t > 0:
+                vals.append(alpha(t - 1, u) + lp[t - 1, u, 0])
+            if u > 0:
+                vals.append(alpha(t, u - 1) + lp[t, u - 1, labels[0][u - 1]])
+            return np.logaddexp.reduce(vals) if vals else -np.inf
+
+        exp = -(alpha(3, 2) + lp[3, 2, 0])
+        got = float(F.rnnt_loss(logits, labels, np.array([4]), np.array([2]),
+                                reduction="none")[0])
+        assert abs(got - exp) < 1e-3
+
+    def test_dice_focal_log_square(self):
+        x = RS.rand(4, 10).astype("float32")
+        lbl = RS.randint(0, 10, (4, 1))
+        d = float(F.dice_loss(x, lbl))
+        assert 0.0 <= d <= 1.0
+        logit = RS.randn(6, 3).astype("float32")
+        y = (RS.rand(6, 3) > 0.5).astype("float32")
+        got = np.asarray(F.sigmoid_focal_loss(logit, y, reduction="none"))
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        pt_ = p * y + (1 - p) * (1 - y)
+        at = 0.25 * y + 0.75 * (1 - y)
+        assert np.allclose(got, at * (1 - pt_) ** 2 * ce, atol=1e-4)
+        assert np.allclose(F.log_loss(np.array([0.7], "float32"),
+                                      np.array([1.0], "float32")),
+                           -np.log(0.7 + 1e-4), atol=1e-6)
+        assert np.allclose(F.square_error_cost(x, x + 1.0), 1.0, atol=1e-5)
+
+    def test_npair_hsigmoid_margin_ce(self):
+        a = RS.randn(4, 8).astype("float32")
+        p = RS.randn(4, 8).astype("float32")
+        y = np.array([0, 1, 0, 2])
+        assert np.isfinite(float(F.npair_loss(a, p, y)))
+        x = RS.randn(4, 8).astype("float32")
+        w = RS.randn(9, 8).astype("float32")  # num_classes=10 -> 9 nodes
+        out = np.asarray(F.hsigmoid_loss(x, np.array([3, 7, 0, 9]), 10, w))
+        assert out.shape == (4, 1) and (out > 0).all()
+        cos = np.clip(RS.randn(4, 6).astype("float32"), -1, 1) * 0.9
+        lbl = np.array([1, 2, 0, 5])
+        loss, sm = F.margin_cross_entropy(cos, lbl, return_softmax=True)
+        assert np.isfinite(float(loss)) and np.allclose(sm.sum(-1), 1.0,
+                                                        atol=1e-5)
+        # margins disabled == plain scaled CE
+        loss0 = F.margin_cross_entropy(cos, lbl, margin1=1.0, margin2=0.0,
+                                       margin3=0.0, scale=1.0)
+        exp = TF.cross_entropy(_t(cos), _t(lbl.astype("int64"))).numpy()
+        assert np.allclose(float(loss0), exp, atol=1e-5)
+
+    def test_class_center_sample(self):
+        pt.seed(0)
+        y = np.array([3, 7, 3, 15])
+        remap, sampled = F.class_center_sample(y, 20, 8)
+        sampled = np.asarray(sampled)
+        assert sampled.shape == (8,)
+        for cls in np.unique(y):
+            assert cls in sampled            # positives always kept
+        got = sampled[np.asarray(remap)]
+        assert np.array_equal(got, y)        # remap points back
+
+
+class TestLayersExtras:
+    def test_activation_layers(self):
+        x = RS.randn(3, 4).astype("float32")
+        assert np.allclose(nn.Identity()(x), x)
+        assert np.allclose(nn.CELU(alpha=0.5)(x),
+                           TF.celu(_t(x), 0.5).numpy(), atol=1e-5)
+        assert np.allclose(nn.Softshrink(0.3)(x),
+                           TF.softshrink(_t(x), 0.3).numpy(), atol=1e-6)
+        assert np.allclose(nn.Softmax2D()(x.reshape(3, 4, 1, 1)),
+                           TF.softmax(_t(x), 1).numpy().reshape(3, 4, 1, 1),
+                           atol=1e-6)
+        prelu = nn.PReLU(num_parameters=4, init=0.3)
+        assert np.allclose(prelu(x), np.where(x > 0, x, 0.3 * x), atol=1e-6)
+
+    def test_pool_pad_layers(self):
+        x = RS.randn(2, 3, 12).astype("float32")
+        assert np.allclose(nn.MaxPool1D(3)(x),
+                           TF.max_pool1d(_t(x), 3).numpy())
+        assert np.allclose(nn.AdaptiveAvgPool1D(4)(x),
+                           TF.adaptive_avg_pool1d(_t(x), 4).numpy(),
+                           atol=1e-5)
+        x2 = RS.randn(2, 3, 4, 4).astype("float32")
+        assert nn.ZeroPad2D([1, 1, 2, 2])(x2).shape == (2, 3, 8, 6)
+        assert nn.Unflatten(1, (3, 1))(x).shape == (2, 3, 1, 12)
+
+    def test_containers(self):
+        pl = nn.ParameterList([np.ones((2, 2), "float32") * i
+                               for i in range(3)])
+        assert len(pl) == 3
+        assert np.allclose(pl[1].value, 1.0)
+        params = dict(pl.named_parameters())
+        assert len(params) == 3
+
+    def test_loss_layers(self):
+        x = RS.randn(4, 3).astype("float32")
+        y = (RS.rand(4, 3) > 0.5).astype("float32")
+        bce = nn.BCELoss()(1 / (1 + np.exp(-x)), y)
+        exp = TF.binary_cross_entropy(torch.sigmoid(_t(x)), _t(y)).numpy()
+        assert np.allclose(float(bce), exp, atol=1e-5)
+        tl = nn.TripletMarginLoss()(x, x + 0.1, x + 2.0)
+        assert np.isfinite(float(tl))
+
+    def test_instance_spectral_norm_layers(self):
+        pt.seed(0)
+        x = RS.randn(2, 3, 5, 5).astype("float32")
+        ln = nn.InstanceNorm2D(3)
+        out = np.asarray(ln(x))
+        assert abs(out.mean()) < 1e-5 and abs(out.std() - 1.0) < 1e-2
+        sn = nn.SpectralNorm([4, 6], power_iters=20)
+        w = RS.randn(4, 6).astype("float32")
+        wn = np.asarray(sn(w))
+        assert abs(np.linalg.svd(wn, compute_uv=False)[0] - 1.0) < 1e-3
+
+    def test_conv_transpose_layers(self):
+        pt.seed(0)
+        m = nn.Conv1DTranspose(4, 6, 3, stride=2)
+        x = RS.randn(2, 4, 8).astype("float32")
+        out = m(x)
+        exp = TF.conv_transpose1d(_t(x), _t(np.asarray(m.weight)),
+                                  _t(np.asarray(m.bias)),
+                                  stride=2).numpy()
+        assert np.allclose(np.asarray(out), exp, atol=1e-4)
+
+    def test_birnn(self):
+        pt.seed(0)
+        from paddle_tpu.nn import SimpleRNNCell
+        bi = nn.BiRNN(SimpleRNNCell(4, 8), SimpleRNNCell(4, 8))
+        x = RS.randn(2, 5, 4).astype("float32")
+        out, (hf, hb) = bi(x)
+        assert out.shape == (2, 5, 16)
+
+    def test_beam_search_decode(self):
+        pt.seed(0)
+        from paddle_tpu.nn import GRUCell
+        cell = GRUCell(8, 8)
+        emb = np.asarray(RS.randn(10, 8), "float32")
+        import jax.numpy as jnp
+
+        def embed(tok):
+            return jnp.asarray(emb)[tok]
+
+        def out_fn(h):
+            return h @ jnp.asarray(RS.randn(8, 10).astype("float32"))
+
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=9,
+                                   beam_size=3, embedding_fn=embed,
+                                   output_fn=out_fn)
+        import jax.numpy as jnp
+        inits = jnp.zeros((2, 8))
+        ids, scores = nn.dynamic_decode(dec, inits, max_step_num=6)
+        assert ids.shape[0] == 2 and ids.shape[2] == 3
+        assert scores.shape == (2, 3)
+        # beams sorted by score
+        s = np.asarray(scores)
+        assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+class TestReviewRegressions:
+    """Regressions from the round-3 medium review of this batch."""
+
+    def test_ceil_mode_vs_torch(self):
+        x = RS.randn(1, 2, 10).astype("float32")
+        got = F.max_pool1d(x, 3, stride=3, ceil_mode=True)
+        exp = TF.max_pool1d(_t(x), 3, stride=3, ceil_mode=True).numpy()
+        assert got.shape == exp.shape and np.allclose(got, exp)
+        ga = np.asarray(F.avg_pool1d(x, 3, stride=3, ceil_mode=True))
+        ea = TF.avg_pool1d(_t(x), 3, stride=3, ceil_mode=True).numpy()
+        assert np.allclose(ga, ea, atol=1e-6)
+        x3 = RS.randn(1, 2, 7, 7, 7).astype("float32")
+        g3 = F.max_pool3d(x3, 2, stride=2, ceil_mode=True)
+        e3 = TF.max_pool3d(_t(x3), 2, stride=2, ceil_mode=True).numpy()
+        assert g3.shape == e3.shape and np.allclose(g3, e3)
+
+    def test_mask_with_tuple_kernel(self):
+        x = RS.randn(1, 1, 8).astype("float32")
+        out, mask = F.max_pool1d(x, (2,), return_mask=True)
+        assert out.shape == (1, 1, 4) and mask.shape == (1, 1, 4)
+
+    def test_adaptive_max3d_flat_mask(self):
+        x3 = RS.randn(1, 2, 4, 4, 4).astype("float32")
+        v, i = F.adaptive_max_pool3d(x3, 2, return_mask=True)
+        tv, ti = TF.adaptive_max_pool3d(_t(x3), 2, return_indices=True)
+        assert np.allclose(np.asarray(v), tv.numpy())
+        assert np.array_equal(np.asarray(i), ti.numpy())
+
+    def test_conv_transpose_positional_groups(self):
+        # paddle positional order: ..., output_padding, groups, dilation
+        m = nn.Conv1DTranspose(4, 8, 3, 1, 0, 0, 2, 1)
+        assert np.asarray(m.weight).shape == (4, 4, 3)  # out/groups = 4
+
+    def test_loss_layer_positional(self):
+        l = nn.MarginRankingLoss(0.5)
+        x = RS.randn(4).astype("float32")
+        got = float(l(x, x - 1.0, np.ones(4, "float32")))
+        exp = TF.margin_ranking_loss(_t(x), _t(x - 1.0),
+                                     _t(np.ones(4, "float32")),
+                                     margin=0.5).numpy()
+        assert np.allclose(got, exp, atol=1e-6)
+
+    def test_unpool_name_kw_and_parameterlist_bounds(self):
+        nn.MaxUnPool2D(2, name="u")
+        pl = nn.ParameterList([np.ones((2,), "float32")])
+        with pytest.raises(IndexError):
+            pl[5]
+        assert np.allclose(pl[-1].value, 1.0)
+
+    def test_fill_diagonal_wrap_vs_numpy(self):
+        for shape, wrap in [((6, 3), True), ((6, 3), False),
+                            ((3, 6), True), ((4, 4), True)]:
+            a = np.zeros(shape, "float32")
+            np.fill_diagonal(a, 5.0, wrap=wrap)
+            got = np.asarray(pt.fill_diagonal(
+                np.zeros(shape, "float32"), 5.0, wrap=wrap))
+            assert np.allclose(got, a), (shape, wrap)
