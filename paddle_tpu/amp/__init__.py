@@ -10,3 +10,15 @@ from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list
 from .grad_scaler import GradScaler, AmpScaler
 
 from . import debugging  # noqa: E402  (TensorCheckerConfig, check_numerics)
+
+from .auto_cast import decorate  # noqa: E402
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is the native TPU compute dtype (and jax CPU emulates it)."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    import jax
+    return jax.devices()[0].platform in ("tpu", "gpu", "cpu")

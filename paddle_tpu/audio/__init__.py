@@ -12,3 +12,10 @@ from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
            "MFCC"]
+
+# -- round-3 parity batch ---------------------------------------------------
+from . import backends
+from . import datasets
+from .backends import info, load, save
+
+__all__ += ["backends", "datasets", "info", "load", "save"]

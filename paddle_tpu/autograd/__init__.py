@@ -94,9 +94,20 @@ class PyLayer:
             self.attrs = {}
 
         def save_for_backward(self, *tensors):
+            hooks = getattr(_SAVED_HOOKS, "hooks", None) \
+                if "_SAVED_HOOKS" in globals() else None
+            if hooks is not None:
+                pack, unpack = hooks
+                tensors = tuple(pack(t) for t in tensors)
+                # capture the UNPACK hook at save time: backward usually
+                # runs after the hooks context has exited
+                self.attrs["_unpack_hook"] = unpack
             self.saved = tensors
 
         def saved_tensor(self):
+            unpack = self.attrs.get("_unpack_hook")
+            if unpack is not None:
+                return tuple(unpack(t) for t in self.saved)
             return self.saved
 
     @staticmethod
@@ -149,3 +160,49 @@ class PyLayer:
 
         _fn.defvjp(_fwd, _bwd)
         return _fn(*args)
+
+
+# -- round-3 parity batch ---------------------------------------------------
+
+PyLayerContext = PyLayer._Ctx
+"""Context object passed to PyLayer.forward/backward (reference:
+python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+
+import contextlib as _contextlib
+import threading as _threading
+
+_SAVED_HOOKS = _threading.local()
+
+
+@_contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """Intercept forward-saved tensors (reference:
+    python/paddle/autograd/saved_tensors_hooks.py). PyLayer's
+    save_for_backward applies pack_hook on save and unpack_hook on read
+    while this context is active — the reference's offload-to-host recipes
+    work unchanged."""
+    prev = getattr(_SAVED_HOOKS, "hooks", None)
+    _SAVED_HOOKS.hooks = (pack_hook, unpack_hook)
+    try:
+        yield
+    finally:
+        _SAVED_HOOKS.hooks = prev
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """reference: python/paddle/autograd/backward_mode.py backward.
+
+    The eager tape does not exist here — gradients flow through
+    functional transforms (``paddle_tpu.autograd.grad`` / ``layer_grad`` /
+    ``jax.grad``), which the reference's ``Tensor.backward()`` use cases
+    map onto directly (docs/DESIGN_DECISIONS.md: functional autograd).
+    Calling this raises with the migration recipe instead of silently
+    doing nothing."""
+    raise RuntimeError(
+        "paddle_tpu has no global autograd tape: compute gradients "
+        "functionally, e.g.\n"
+        "  loss, grads = paddle_tpu.autograd.layer_grad(model, loss_fn, x)\n"
+        "  opt.step(grads)\n"
+        "or jax.grad(fn)(params). See docs/DESIGN_DECISIONS.md "
+        "(functional autograd).")

@@ -258,3 +258,31 @@ class CUDAPlace(_Place):
 
 class XPUPlace(CUDAPlace):
     pass
+
+
+def get_cudnn_version():
+    """No cuDNN in the TPU stack (reference: device/__init__.py
+    get_cudnn_version returns None when CUDA is absent)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    """CINN's compiler slot is filled by XLA (SURVEY §2.2 design)."""
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def set_stream(stream=None):
+    """XLA orders work on internal streams; kept for API parity
+    (reference: device/__init__.py set_stream)."""
+    return stream
+
+
+from ..base import IPUPlace  # noqa: E402 — place shim (no IPU backend)

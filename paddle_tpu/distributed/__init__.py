@@ -50,3 +50,17 @@ from . import launch  # noqa: E402
 from . import elastic  # noqa: E402
 from . import auto_tuner  # noqa: E402
 from . import rpc  # noqa: E402
+
+# -- round-3 parity batch: semi-auto objects, p2p/object collectives, env --
+from .compat import (
+    ProcessMesh, DistAttr, ReduceType, dtensor_from_fn, unshard_dtensor,
+    shard_optimizer, Strategy, DistModel, to_static, ParallelEnv,
+    ParallelMode, is_available, is_initialized, destroy_process_group,
+    get_backend, get_group, wait, send, recv, isend, irecv,
+    alltoall_single, all_gather_object, broadcast_object_list,
+    scatter_object_list, gloo_init_parallel_env, gloo_barrier,
+    gloo_release, spawn, split, InMemoryDataset, QueueDataset,
+    CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+)
+from . import io
+from ..checkpoint import save_state_dict, load_state_dict

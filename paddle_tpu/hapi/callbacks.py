@@ -188,3 +188,126 @@ class LRSchedulerCallback(Callback):
         s = self._sched()
         if not self.by_step and s is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a metric stops improving (reference:
+    python/paddle/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor: str = "loss", factor: float = 0.1,
+                 patience: int = 10, verbose: int = 1, mode: str = "auto",
+                 min_delta: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._mode = ("min" if mode == "auto" and "acc" not in monitor
+                      else ("max" if mode == "auto" else mode))
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self._mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cool > 0:
+            self._cool -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"Epoch {epoch}: reducing learning rate "
+                              f"from {old:.6g} to {new:.6g}.")
+            self._cool = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger with the VisualDL callback surface (reference:
+    python/paddle/callbacks.py VisualDL). The visualdl package is not in
+    this image; scalars append to a JSONL the trace viewer and tests can
+    read (documented substitution)."""
+
+    def __init__(self, log_dir: str = "./log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": tag, "value": float(value),
+                                "step": int(step)}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}",
+                            v[0] if isinstance(v, (list, tuple)) else v,
+                            self._step)
+            except (TypeError, ValueError):
+                pass
+        self._step += 1
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"eval/{k}",
+                            v[0] if isinstance(v, (list, tuple)) else v,
+                            self._step)
+            except (TypeError, ValueError):
+                pass
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference: python/paddle/callbacks.py
+    WandbCallback). wandb is not installed in this offline image; if
+    import fails the callback degrades to the VisualDL JSONL sink."""
+
+    def __init__(self, project=None, name=None, dir=None, mode="offline",
+                 **kwargs):
+        try:
+            import wandb  # noqa: F401
+            self._wandb = wandb
+            self._run = wandb.init(project=project, name=name, dir=dir,
+                                   mode=mode, **kwargs)
+        except ImportError:
+            self._wandb = None
+            self._sink = VisualDL(log_dir=dir or "./wandb-offline")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb is not None:
+            self._run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self._sink.on_train_batch_end(step, logs)
+
+    def on_eval_end(self, logs=None):
+        if self._wandb is not None:
+            self._run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self._sink.on_eval_end(logs)

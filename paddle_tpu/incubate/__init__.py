@@ -9,3 +9,16 @@ from . import asp
 from . import operators
 
 __all__ = ["nn", "asp", "operators"]
+
+# -- round-3 parity batch ---------------------------------------------------
+from ..geometric import segment_sum, segment_mean, segment_max, segment_min
+from .operators import (softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+                        graph_send_recv)
+from .extras import (identity_loss, graph_khop_sampler, graph_reindex,
+                     graph_sample_neighbors, LookAhead, ModelAverage)
+
+__all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min",
+            "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+            "graph_send_recv", "identity_loss", "graph_khop_sampler",
+            "graph_reindex", "graph_sample_neighbors", "LookAhead",
+            "ModelAverage"]

@@ -7,10 +7,10 @@ TPU redesign notes in dataloader.py.
 
 from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
                       ChainDataset, ConcatDataset, Subset, random_split)
-from .sampler import (Sampler, SequenceSampler, RandomSampler,
+from .sampler import (Sampler, SequenceSampler, RandomSampler, SubsetRandomSampler,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn
+from .dataloader import DataLoader, default_collate_fn, get_worker_info, WorkerInfo
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",

@@ -64,9 +64,22 @@ def _fetch_map(dataset, indices, collate_fn):
 _WORKER_STATE = {}
 
 
-def _worker_init(dataset, collate_fn):
+_WORKER_ID_LOCK = threading.Lock()
+
+
+def _worker_init(dataset, collate_fn, num_workers=0):
     _WORKER_STATE["dataset"] = dataset
     _WORKER_STATE["collate_fn"] = collate_fn
+    import multiprocessing as mp
+    ident = mp.current_process()._identity
+    if ident:  # pool worker process: 1-based fork-order id
+        worker_id = (ident[0] - 1) % max(num_workers, 1)
+    else:  # thread pool: processwide counter + lock
+        with _WORKER_ID_LOCK:
+            worker_id = _WORKER_STATE.setdefault("_next_id", 0)
+            _WORKER_STATE["_next_id"] = worker_id + 1
+    _set_worker_info(WorkerInfo(id=worker_id, num_workers=num_workers,
+                                dataset=dataset))
 
 
 def _worker_fetch(indices):
@@ -223,11 +236,15 @@ class DataLoader:
                 max_workers=self.num_workers,
                 mp_context=mp.get_context(self.multiprocessing_context),
                 initializer=_worker_init,
-                initargs=(self.dataset, self.collate_fn))
+                initargs=(self.dataset, self.collate_fn, self.num_workers))
             fetch = _worker_fetch
             submit_args = lambda idx: (idx,)
         else:
-            pool = ThreadPoolExecutor(max_workers=self.num_workers)
+            _WORKER_STATE.pop("_next_id", None)  # fresh ids per loader
+            pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                initializer=_worker_init,
+                initargs=(self.dataset, self.collate_fn, self.num_workers))
             fetch = _fetch_map
             submit_args = lambda idx: (self.dataset, idx, self.collate_fn)
         try:
@@ -347,3 +364,27 @@ class DataLoader:
             if isinstance(item, BaseException):
                 raise item
             yield item
+
+
+class WorkerInfo:
+    """Worker context for IterableDataset sharding (reference:
+    python/paddle/io/dataloader/worker.py WorkerInfo/get_worker_info)."""
+
+    def __init__(self, id: int, num_workers: int, dataset=None, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_WORKER_INFO = threading.local()
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """Inside a DataLoader worker returns its WorkerInfo; None in the main
+    process (reference: io/dataloader/worker.py get_worker_info)."""
+    return getattr(_WORKER_INFO, "info", None)
+
+
+def _set_worker_info(info: Optional[WorkerInfo]) -> None:
+    _WORKER_INFO.info = info

@@ -171,3 +171,26 @@ class DistributedBatchSampler(BatchSampler):
         if self.drop_last:
             return self.num_samples // self.batch_size
         return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as np
+        from ..core.rng import rng_tracker, GLOBAL_STREAM
+        import jax
+        if rng_tracker().has(GLOBAL_STREAM):
+            seed = int(jax.random.randint(
+                rng_tracker().next_key(GLOBAL_STREAM), (), 0, 2**31 - 1))
+        else:
+            seed = None
+        order = np.random.RandomState(seed).permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)

@@ -203,3 +203,22 @@ def load(path: str) -> TranslatedLayer:
         state = pickle.load(f)
     params = jax.tree.map(jnp.asarray, state.get("params", {}))
     return TranslatedLayer(exported, params, meta["with_params"])
+
+
+_SOT_CODE_LEVEL = 0
+_SOT_VERBOSITY = 0
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """reference: jit/sot set_code_level — controls translated-code dump.
+    Tracing here is jax; the knob maps to jax's jaxpr dump verbosity."""
+    global _SOT_CODE_LEVEL
+    _SOT_CODE_LEVEL = level
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    global _SOT_VERBOSITY
+    _SOT_VERBOSITY = level
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)

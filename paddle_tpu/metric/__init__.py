@@ -157,3 +157,17 @@ class Auc(Metric):
         fpr = neg / tot_neg
         return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
             else float(np.trapz(tpr, fpr))
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: python/paddle/metric/metrics.py
+    accuracy): input [N, C] scores, label [N, 1] or [N] int."""
+    import jax.numpy as jnp
+    pred = jnp.asarray(input)
+    lab = jnp.asarray(label).reshape(-1)
+    topk = jnp.argsort(-pred, axis=-1)[:, :k]
+    hit = jnp.any(topk == lab[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+__all__.append("accuracy")

@@ -135,10 +135,13 @@ class BatchNorm2D(Layer):
                 training=True, momentum=self.momentum, epsilon=self.epsilon,
                 data_format=self.data_format)
             # NOTE: buffer updates are side effects; under the functional
-            # bridge these persist only outside jit. Training loops that jit
-            # whole steps should treat BN stats via state (trainer handles it).
-            self._buffers["_mean"].value = new_mean
-            self._buffers["_variance"].value = new_var
+            # bridge these persist only outside jit/grad traces — storing a
+            # tracer would leak it into later calls (trainer carries BN
+            # stats through state instead).
+            import jax as _jax
+            if not isinstance(new_mean, _jax.core.Tracer):
+                self._buffers["_mean"].value = new_mean
+                self._buffers["_variance"].value = new_var
             return out
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
                             training=False, epsilon=self.epsilon,

@@ -191,7 +191,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                    data_format == "NLC", ceil_mode)
     if return_mask:
         return out, _pool_argmax(arr, kernel_size, stride, padding,
-                                 data_format == "NLC")
+                                 data_format == "NLC", ceil_mode)
     return out
 
 
@@ -218,7 +218,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                    data_format == "NDHWC", ceil_mode)
     if return_mask:
         return out, _pool_argmax(arr, _norm_tuple(kernel_size, 3), stride,
-                                 padding, data_format == "NDHWC")
+                                 padding, data_format == "NDHWC", ceil_mode)
     return out
 
 
@@ -235,7 +235,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
     return summed / (k[0] * k[1] * k[2])
 
 
-def _pool_argmax(x, kernel, stride, padding, channel_last: bool):
+def _pool_argmax(x, kernel, stride, padding, channel_last: bool,
+                 ceil_mode: bool = False):
     """Flat (per-plane) argmax indices of each pooling window, the layout
     max_unpool consumes (reference returns int indices into the padded-less
     input plane). Works for 1-3 spatial dims via dilated patches."""
@@ -248,13 +249,21 @@ def _pool_argmax(x, kernel, stride, padding, channel_last: bool):
     p = _norm_tuple(padding, nd)
     n, c = x.shape[0], x.shape[1]
     spatial = x.shape[2:]
+    # trailing extra pad mirrors _pool_nd's ceil_mode so mask and values
+    # agree on the output grid
+    extra = tuple(
+        max(0, (-(-(spatial[i] + 2 * p[i] - k[i]) // s[i]) * s[i] + k[i])
+            - (spatial[i] + 2 * p[i])) if ceil_mode else 0
+        for i in range(nd))
+    sp_pads = tuple((p[i], p[i] + extra[i]) for i in range(nd))
     # index plane, same padding as the values, pad value -1 never wins
     flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
         spatial)
     big_neg = jnp.float32(-1e30)
-    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
-                 constant_values=-jnp.inf)
-    ip = jnp.pad(flat_idx, tuple((pi, pi) for pi in p), constant_values=-1)
+    # finite pad: the patch extraction is an identity-kernel conv, and
+    # 0 * -inf = nan would poison whole windows; ip<0 masks pads anyway
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + sp_pads, constant_values=-1e30)
+    ip = jnp.pad(flat_idx, sp_pads, constant_values=-1)
     # extract windows of both value and index and argmax per window
     vpat = lax.conv_general_dilated_patches(
         xp, filter_shape=k, window_strides=s, padding="VALID")
@@ -897,8 +906,8 @@ def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
     same = (y[:, None] == y[None, :]).astype(logits.dtype)
     tgt = same / jnp.sum(same, axis=1, keepdims=True)
     ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(logits, axis=1), axis=1))
-    l2 = jnp.mean(jnp.sum(a * a, 1) + jnp.sum(p * p, 1)) * 0.25 * l2_reg * 2
-    return ce + l2 * 2
+    l2 = jnp.mean(jnp.sum(a * a, 1) + jnp.sum(p * p, 1)) * 0.25 * l2_reg
+    return ce + l2
 
 
 def dice_loss(input, label, epsilon: float = 1e-5, name=None):

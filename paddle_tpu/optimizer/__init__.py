@@ -7,4 +7,4 @@ from . import lr
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
 from .lbfgs import LBFGS
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad,
-                        RMSProp, Adadelta, Lamb)
+                        RMSProp, Adadelta, Lamb, Rprop)

@@ -370,3 +370,42 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
         return p - lr * trust * r
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py):
+    sign-based per-parameter step sizes, grown on agreeing signs and shrunk
+    with update rollback on sign flips. Full-batch method like the
+    reference documents."""
+
+    def __init__(self, learning_rate: float = 0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         grad_clip=grad_clip,
+                         multi_precision=multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = learning_rate
+
+    def _init_slots(self, p):
+        import jax.numpy as jnp
+        return {"step_size": jnp.full(p.shape, self._init_lr, jnp.float32),
+                "prev_grad": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p, g, slots, lr, step):
+        import jax.numpy as jnp
+        sign = jnp.sign(g * slots["prev_grad"])
+        grow = sign > 0
+        flip = sign < 0
+        size = jnp.clip(
+            jnp.where(grow, slots["step_size"] * self._eta_pos,
+                      jnp.where(flip, slots["step_size"] * self._eta_neg,
+                                slots["step_size"])),
+            self._lr_min, self._lr_max)
+        # on sign flip: zero this step's grad (skip update, reference rule)
+        g_eff = jnp.where(flip, 0.0, g)
+        slots["step_size"] = size
+        slots["prev_grad"] = jnp.where(flip, 0.0, g)
+        return p - jnp.sign(g_eff) * size

@@ -405,3 +405,16 @@ _benchmark = _BenchmarkTimer()
 def benchmark() -> _BenchmarkTimer:
     """Global ips timer (reference: paddle.profiler.utils.benchmark)."""
     return _benchmark
+
+
+class SortedKeys:
+    """Summary sort orders (reference: python/paddle/profiler/profiler.py
+    SortedKeys enum)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7

@@ -13,7 +13,7 @@ calibration runs under jit too.
 from .config import QuantConfig
 from .observers import (BaseObserver, AbsmaxObserver,
                         MovingAverageAbsmaxObserver, PercentileObserver)
-from .quanters import (FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax,
+from .quanters import (BaseQuanter, quanter, FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax,
                        fake_quant, quantize_absmax, dequantize)
 from .qat import QAT, PTQ
 from .layers import QuantedLinear, QuantedConv2D, Int8Linear

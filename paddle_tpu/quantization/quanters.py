@@ -72,3 +72,33 @@ class FakeQuanterChannelWiseAbsMax:
 
     def __call__(self, w, update: bool = True):
         return fake_quant(w, self.scales(w), self.quant_bits)
+
+
+class BaseQuanter:
+    """Abstract quanter base (reference: python/paddle/quantization/
+    base_quanter.py BaseQuanter): scales()/zero_points()/quant_axis()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+def quanter(name: str):
+    """Class decorator registering a quanter factory by name (reference:
+    python/paddle/quantization/factory.py quanter)."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls._quanter_name = name
+        return cls
+    return deco
+
+
+_QUANTER_REGISTRY = {}

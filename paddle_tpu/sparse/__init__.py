@@ -210,3 +210,131 @@ def pow(x, factor, name=None):
 
 
 from . import nn  # noqa: E402  (re-export subpackage)
+
+
+# -- round-3 parity batch: zero-preserving unary tail + utilities -----------
+# (reference: python/paddle/sparse/unary.py — each op applies to the
+# nonzero values only, preserving the sparsity pattern)
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x)
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x)
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x)
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x)
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x)
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x)
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x)
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x)
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x)
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x)
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x)
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    id_ = convert_dtype(index_dtype) if index_dtype is not None else None
+    if is_sparse_coo(x):
+        idx = x.indices.astype(id_) if id_ is not None else x.indices
+        dat = x.data.astype(vd) if vd is not None else x.data
+        return jsparse.BCOO((dat, idx), shape=x.shape)
+    if is_sparse_csr(x):
+        dat = x.data.astype(vd) if vd is not None else x.data
+        ind = x.indices.astype(id_) if id_ is not None else x.indices
+        ptr = x.indptr.astype(id_) if id_ is not None else x.indptr
+        return jsparse.BCSR((dat, ind, ptr), shape=x.shape)
+    return jnp.asarray(x).astype(vd)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def reshape(x, shape, name=None):
+    """COO reshape via dense round-trip (reference sparse/unary.py reshape
+    supports re-distributing sparse dims; nnz is preserved)."""
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    out = dense.reshape(tuple(int(s) for s in shape))
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    if is_sparse_coo(x):
+        return to_sparse_coo(out, sparse_dim=out.ndim)
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):
+    import builtins
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    out = dense[tuple(idx)]
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    if is_sparse_coo(x):
+        return to_sparse_coo(out, sparse_dim=out.ndim)
+    return out
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/binary.py mv)."""
+    return matmul(x, jnp.asarray(vec)[:, None])[..., 0]
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference
+    sparse/binary.py addmm)."""
+    prod = matmul(x, y)
+    dense_prod = to_dense(prod) if is_sparse(prod) else prod
+    dense_in = to_dense(input) if is_sparse(input) else jnp.asarray(input)
+    return beta * dense_in + alpha * dense_prod
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2, name=None):
+    from ..linalg import pca_lowrank as _dense_pca
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "square",
+            "log1p", "expm1", "neg", "deg2rad", "rad2deg", "isnan", "cast",
+            "is_same_shape", "reshape", "slice", "mv", "addmm",
+            "pca_lowrank"]

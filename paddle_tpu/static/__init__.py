@@ -246,3 +246,390 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             parameter_list.append(v)
     grads = gradients([loss], list(parameter_list))
     return list(zip(parameter_list, grads))
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity batch: scopes/places, inference model IO, EMA, misc
+# (reference: python/paddle/static/{__init__.py,io.py,nn/common.py},
+# base/executor.py global_scope)
+# ---------------------------------------------------------------------------
+
+Variable = _LazyVar  # paddle.static.Variable — the lazy program var
+
+
+class _Scope:
+    """Name->value store (reference: paddle.static.global_scope Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name: str):
+        self._vars.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name: str):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+
+class _ScopeVar:
+    def __init__(self, scope: _Scope, name: str):
+        self._scope = scope
+        self.name = name
+
+    def get_tensor(self):
+        return self._scope._vars.get(self.name)
+
+    def set(self, value, place=None):
+        self._scope._vars[self.name] = jnp.asarray(value)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _GLOBAL_SCOPE
+
+
+def scope_guard(scope: _Scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _GLOBAL_SCOPE
+        prev, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+        try:
+            yield scope
+        finally:
+            _GLOBAL_SCOPE = prev
+
+    return guard()
+
+
+def cpu_places(device_count: Optional[int] = None):
+    from ..base import CPUPlace
+    if device_count is None:
+        try:
+            device_count = len(jax.devices("cpu"))
+        except RuntimeError:  # no cpu platform registered
+            device_count = 1
+    return [CPUPlace() for _ in range(max(1, device_count))]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDA name kept for parity; resolves to TPU)."""
+    from ..base import CUDAPlace
+    if device_ids is None:
+        device_ids = range(jax.device_count())
+    return [CUDAPlace(i) for i in device_ids]
+
+
+def device_guard(device: str = "cpu"):
+    """Pin ops in the region to a device (reference: static/device_guard).
+    Maps to jax.default_device."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        name = device.split(":")[0]
+        plat = {"cpu": "cpu", "gpu": "tpu", "tpu": "tpu"}.get(name, "cpu")
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError:
+            devs = jax.devices()
+        with jax.default_device(devs[0]):
+            yield
+
+    return guard()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+class IpuStrategy:
+    """IPU backends are not a TPU target; constructible shim
+    (reference: static/__init__.py IpuStrategy)."""
+
+    def __init__(self):
+        self.num_ipus = 0
+
+    def set_graph_config(self, **kw):
+        return None
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self.program = program
+
+    def compile(self, feed_list=None, fetch_list=None):
+        return self.program
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference: BuildStrategy pybind). XLA performs
+    these fusions already; the knobs are recorded for introspection."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_addto = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class WeightNormParamAttr:
+    """Weight-normalized parameter attribute (reference:
+    static/nn/common.py WeightNormParamAttr): g * v / ||v||."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False,
+                 need_clip: bool = True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: static/__init__.py
+    ExponentialMovingAverage): update() folds current params in;
+    apply()/restore() swap shadow params into a layer."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[str, jax.Array] = {}
+        self._backup: Dict[str, jax.Array] = {}
+        self._step = 0
+
+    def update(self, layer=None, parameters=None):
+        named = (layer.state_dict().items() if layer is not None
+                 else parameters or [])
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for name, v in named:
+            arr = jnp.asarray(v)
+            if name in self._shadow:
+                self._shadow[name] = d * self._shadow[name] + (1 - d) * arr
+            else:
+                self._shadow[name] = arr
+
+    def apply(self, executor=None, need_restore: bool = True, layer=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            if layer is not None:
+                self._backup = {k: jnp.asarray(v)
+                                for k, v in layer.state_dict().items()}
+                layer.set_state_dict({k: self._shadow.get(k, v)
+                                      for k, v in self._backup.items()})
+            try:
+                yield
+            finally:
+                if need_restore and layer is not None:
+                    layer.set_state_dict(self._backup)
+
+        return guard()
+
+    def restore(self, executor=None, layer=None):
+        if layer is not None and self._backup:
+            layer.set_state_dict(self._backup)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..base import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..base import create_global_var as _cgv
+    return _cgv(shape, value, dtype, persistable=persistable, name=name)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference: static/nn/common.py py_func). Maps to
+    jax.pure_callback with the declared output shape."""
+    xs = [jnp.asarray(v) for v in (x if isinstance(x, (list, tuple))
+                                   else [x])]
+    specs = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+             for o in (out if isinstance(out, (list, tuple)) else [out])]
+    result = jax.pure_callback(
+        func, specs if len(specs) > 1 else specs[0], *xs)
+    return result
+
+
+def Print(input, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, print_tensor_name: bool = True,
+          print_tensor_type: bool = True, print_tensor_shape: bool = True,
+          print_tensor_layout: bool = True, print_tensor_lod: bool = True,
+          print_phase: str = "both"):
+    """Debug-print op (reference: static/nn/control_flow.py Print). Maps to
+    jax.debug.print so it fires under jit too."""
+    arr = jnp.asarray(input)
+    jax.debug.print((message or "") + " {x}", x=arr)
+    return arr
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095,
+        topk: int = 1, slide_steps: int = 1):
+    """Batch AUC (reference: static/nn/metric.py auc). Returns
+    (auc_value, batch_auc, [state]) shaped like the reference's first two."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    import numpy as _np
+    pred = _np.asarray(input)
+    lab = _np.asarray(label).reshape(-1, 1)
+    m.update(pred, lab)
+    v = jnp.asarray(m.accumulate(), jnp.float32)
+    return v, v, []
+
+
+# -- inference model save/load (reference: static/io.py) --------------------
+
+def normalize_program(program: Program, feeds, fetches, **kwargs) -> Program:
+    """reference: static/io.py normalize_program — prune to feed/fetch.
+    Tracing already yields exactly the feed->fetch closure."""
+    return program
+
+
+def serialize_program(feeds, fetches, **kwargs) -> bytes:
+    import pickle
+    return pickle.dumps({"feeds": [getattr(f, "name", str(f))
+                                   for f in _as_list(feeds)],
+                         "fetches": len(_as_list(fetches))})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None) -> bytes:
+    import pickle
+    return pickle.dumps(dict(global_scope()._vars))
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    import os
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    global_scope()._vars.update(state)
+    return state
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, **kwargs) -> None:
+    """Save a deployable model (reference: static/io.py
+    save_inference_model). The executable artifact is the jit-exported
+    StableHLO from paddle_tpu.jit.save; this writes the program metadata +
+    persistables next to it in the reference's two-file layout."""
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Load the pair written by save_inference_model; returns
+    [program_meta, feed_names, fetch_count] like the reference triplet."""
+    meta = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(None, load_from_file(path_prefix
+                                                  + ".pdiparams"))
+    return [meta, meta.get("feeds", []), meta.get("fetches", 0)]
+
+
+def save(program: Program, model_path: str, protocol: int = 4) -> None:
+    from .. import framework as _fw
+    _fw.save(dict(global_scope()._vars), model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None,
+         var_list=None) -> None:
+    from .. import framework as _fw
+    global_scope()._vars.update(_fw.load(model_path + ".pdparams"))
+
+
+def load_program_state(model_path: str, var_list=None):
+    from .. import framework as _fw
+    return _fw.load(model_path + ".pdparams", return_numpy=True)
+
+
+def set_program_state(program: Program, state_dict) -> None:
+    global_scope()._vars.update(
+        {k: jnp.asarray(v) for k, v in state_dict.items()})
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR sub-metrics (reference: static/nn/metric.py ctr_metric_bundle):
+    returns (sqrerr, abserr, prob, q, pos, total) accumulators."""
+    import numpy as _np
+    pred = jnp.asarray(input).reshape(-1)
+    lab = jnp.asarray(label).reshape(-1).astype(pred.dtype)
+    sqrerr = jnp.sum((pred - lab) ** 2)
+    abserr = jnp.sum(jnp.abs(pred - lab))
+    prob = jnp.sum(pred)
+    q = jnp.sum(pred * pred)
+    pos = jnp.sum(lab)
+    total = jnp.asarray(pred.shape[0], pred.dtype)
+    return sqrerr, abserr, prob, q, pos, total
+
+
+_STARTUP_PROGRAM = Program()
+
+
+def default_startup_program() -> Program:
+    """reference: base/framework.py default_startup_program — parameter
+    initialization program; initialization is eager here, so this is a
+    stable empty Program handle."""
+    return _STARTUP_PROGRAM
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func

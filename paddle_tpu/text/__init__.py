@@ -147,3 +147,11 @@ def edit_distance(hyps, refs, normalized: bool = True):
         d = float(dp[-1])
         out.append(d / max(len(r), 1) if normalized else d)
     return jnp.asarray(out, dtype=jnp.float32)
+
+
+# -- datasets (round-3 parity batch) ----------------------------------------
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+
+__all__ += ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+            "WMT14", "WMT16"]

@@ -4,7 +4,8 @@ weights fetch/cache, dlpack interop, unique_name, cpp_extension."""
 from . import flops as flops_mod
 from .flops import flops, transformer_flops_per_token, model_flops_per_token
 from .download import get_weights_path_from_url, get_path_from_url, DownloadError
-from .misc import to_dlpack, from_dlpack, generate as unique_name_generate, guard
+from .misc import (to_dlpack, from_dlpack, generate as unique_name_generate, guard,
+                   deprecated, require_version, try_import, run_check)
 from . import misc as unique_name_mod
 from . import cpp_extension
 

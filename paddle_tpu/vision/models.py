@@ -233,3 +233,47 @@ class SqueezeNet(nn.Layer):
 
 def squeezenet1_0(**kw):
     return SqueezeNet(**kw)
+
+
+class _SqueezeNet11(nn.Layer):
+    """reference: vision/models/squeezenet.py v1.1 layout (3x3 stem,
+    earlier pools — same accuracy, ~2.4x cheaper)."""
+
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+            nn.MaxPool2D(3, 2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_1(**kw):
+    return _SqueezeNet11(**kw)
+
+
+def resnet34(**kw):  # noqa: F811 — original kept above; ensure export
+    return ResNet(34, **kw)
+
+
+# -- round-3 parity batch: deep/grouped/wide + classic families -------------
+from .models_extras import (  # noqa: E402
+    AlexNet, alexnet, DenseNet, densenet121, densenet161, densenet169,
+    densenet201, densenet264, GoogLeNet, googlenet, InceptionV3,
+    inception_v3, MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large, ShuffleNetV2, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0, shufflenet_v2_swish,
+    resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d, wide_resnet50_2,
+    wide_resnet101_2)

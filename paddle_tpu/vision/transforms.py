@@ -512,3 +512,141 @@ class RandomErasing(BaseTransform):
                 j = _pyrandom.randint(0, w - ew)
                 return erase(img, i, j, eh, ew, self.value, self.inplace)
         return img
+
+
+# -- round-3 parity batch: affine/perspective (reference:
+#    python/paddle/vision/transforms/{functional.py,transforms.py}) --------
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    # paddle/torchvision convention: M = T(center) R(angle) Sh(shear)
+    # Scale T(-center) + translate
+    rot = np.array([[np.cos(a + sy) / np.cos(sy),
+                     -np.cos(a + sy) * np.tan(sx) / np.cos(sy)
+                     - np.sin(a), 0],
+                    [np.sin(a + sy) / np.cos(sy),
+                     -np.sin(a + sy) * np.tan(sx) / np.cos(sy)
+                     + np.cos(a), 0],
+                    [0, 0, 1]])
+    rot[:2, :2] *= scale
+    t_pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                      [0, 0, 1]])
+    t_post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]])
+    return t_pre @ rot @ t_post
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference: vision/transforms/functional.py affine)."""
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    w, h = pil.size
+    if center is None:
+        center = (w * 0.5, h * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    inv = np.linalg.inv(m)
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = pil.transform((w, h), Image.AFFINE, data=inv[:2].reshape(-1),
+                        resample=resample, fillcolor=fill)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping startpoints->endpoints (reference:
+    vision/transforms/functional.py perspective)."""
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    # solve the 8-dof homography endpoints -> startpoints (PIL convention)
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b.append(sx)
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.append(sy)
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = pil.transform(pil.size, Image.PERSPECTIVE, data=coeffs,
+                        resample=resample, fillcolor=fill)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+class RandomAffine(BaseTransform):
+    """reference: vision/transforms/transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, numbers.Number) else degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        angle = _pyrandom.uniform(*self.degrees)
+        w, h = (_to_numpy(img).shape[1], _to_numpy(img).shape[0])
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = _pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        scale = (_pyrandom.uniform(*self.scale) if self.scale is not None
+                 else 1.0)
+        if self.shear is None:
+            shear = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            shear = (_pyrandom.uniform(-self.shear, self.shear), 0.0)
+        else:
+            shear = (_pyrandom.uniform(-self.shear[0], self.shear[0]),
+                     _pyrandom.uniform(-self.shear[1], self.shear[1])
+                     if len(self.shear) > 1 else 0.0)
+        return affine(img, angle, (tx, ty), scale, shear,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: vision/transforms/transforms.py RandomPerspective."""
+
+    def __init__(self, prob: float = 0.5, distortion_scale: float = 0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _points(self, w, h):
+        d = self.distortion_scale
+        half_w, half_h = w // 2, h // 2
+        tl = (_pyrandom.randint(0, int(d * half_w)),
+              _pyrandom.randint(0, int(d * half_h)))
+        tr = (w - 1 - _pyrandom.randint(0, int(d * half_w)),
+              _pyrandom.randint(0, int(d * half_h)))
+        br = (w - 1 - _pyrandom.randint(0, int(d * half_w)),
+              h - 1 - _pyrandom.randint(0, int(d * half_h)))
+        bl = (_pyrandom.randint(0, int(d * half_w)),
+              h - 1 - _pyrandom.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return start, [tl, tr, br, bl]
+
+    def __call__(self, img):
+        if _pyrandom.random() >= self.prob:
+            return img
+        arr = _to_numpy(img)
+        h, w = arr.shape[0], arr.shape[1]
+        start, end = self._points(w, h)
+        return perspective(img, start, end,
+                           interpolation=self.interpolation, fill=self.fill)
