@@ -14,6 +14,7 @@ paddle_tpu.inference.generation.
 from .predictor import Config, Predictor, create_predictor
 from . import generation
 from .generation import GenerationConfig, generate
+from .serving import ContinuousBatchingEngine
 
 __all__ = ["Config", "Predictor", "create_predictor", "generation",
-           "GenerationConfig", "generate"]
+           "GenerationConfig", "generate", "ContinuousBatchingEngine"]

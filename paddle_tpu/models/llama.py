@@ -56,11 +56,19 @@ class LlamaConfig:
     recompute: str = "none"
     # sequence parallel: shard activations along seq dim over "sep"
     sequence_parallel: bool = False
+    # long-context attention over the sep axis: "ring" rotates K/V blocks
+    # (works for any head count, overlaps compute with ppermute) or
+    # "ulysses" all-to-alls heads for full-sequence local flash (cheaper
+    # comm when heads divide the axis; parallel/ulysses.py)
+    sp_mode: str = "ring"
 
     def __post_init__(self):
         if self.recompute not in ("none", "selective", "full"):
             raise ValueError(f"recompute must be 'none'|'selective'|'full', "
                              f"got {self.recompute!r}")
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"sp_mode must be 'ring'|'ulysses', "
+                             f"got {self.sp_mode!r}")
         if self.hidden_size % self.num_attention_heads:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads:
@@ -158,26 +166,38 @@ class LlamaAttention(nn.Layer):
         b, s, d = x.shape
         n_h, hd = cfg.num_attention_heads, cfg.head_dim
         q, k, v = self._qkv_rope(x, cos, sin, position_ids)
-        if cfg.sequence_parallel and attn_mask is None:
-            from ..parallel.mesh import current_mesh
-            hm = current_mesh()
-            if hm is not None and hm.axis_size("sep") > 1:
-                # long-context path: K/V stay seq-sharded over "sep" and
-                # rotate through the ring of flash blocks (never a dense
-                # [s, s] score tensor) — SURVEY §5 long-context/SP
-                from ..parallel.ring_attention import ring_attention
-                out = ring_attention(q, k, v, causal=True)
-                out = out.reshape(b, s, n_h * hd)
-                return jnp.matmul(out, self.o_proj.astype(x.dtype))
-        if cfg.use_flash_attention:
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                                 is_causal=True,
-                                                 training=self.training)
-        else:
-            from ..ops.attention import _sdpa_xla
-            out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True)
+        out = self._sp_attention(q, k, v, attn_mask)
+        if out is None:
+            if cfg.use_flash_attention:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, is_causal=True,
+                    training=self.training)
+            else:
+                from ..ops.attention import _sdpa_xla
+                out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True)
         out = out.reshape(b, s, n_h * hd)
         return jnp.matmul(out, self.o_proj.astype(x.dtype))
+
+    def _sp_attention(self, q, k, v, attn_mask):
+        """Long-context path over the "sep" axis (SURVEY §5): the K/V ring
+        of flash blocks or Ulysses head all-to-all — never a dense [s, s]
+        score tensor. Returns None when sequence parallelism is inactive."""
+        cfg = self.cfg
+        if not cfg.sequence_parallel or attn_mask is not None:
+            return None
+        from ..parallel.mesh import current_mesh
+        hm = current_mesh()
+        if hm is None or hm.axis_size("sep") <= 1:
+            return None
+        if cfg.sp_mode == "ulysses":
+            from ..parallel.ulysses import (ulysses_attention,
+                                            ulysses_supported)
+            if ulysses_supported(cfg.num_attention_heads,
+                                 cfg.num_key_value_heads,
+                                 hm.axis_size("sep")):
+                return ulysses_attention(q, k, v, causal=True)
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, causal=True)
 
     # -- KV-cache inference paths ------------------------------------------
 

@@ -11,6 +11,7 @@ from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         ColumnSequenceParallelLinear, RowSequenceParallelLinear)
 from .moe import MoELayer, MoEMLP, top_k_gating
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention, ulysses_supported
 from .pipeline import (LayerDesc, SharedLayerDesc, SegmentLayers,
                        PipelineStack, PipelineLayer, pipeline_spmd,
                        microbatch, unmicrobatch)

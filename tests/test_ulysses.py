@@ -1,0 +1,125 @@
+"""Ulysses (all-to-all head-scatter) sequence parallelism tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.attention import _sdpa_xla
+from paddle_tpu.parallel import HybridMesh
+from paddle_tpu.parallel.ulysses import ulysses_attention, ulysses_supported
+
+
+def _rand_qkv(rs, b, s, h, h_kv, d):
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(b, s, h_kv, d).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(b, s, h_kv, d).astype(np.float32)) * 0.5
+    return q, k, v
+
+
+def _ref(q, k, v, causal):
+    h, h_kv = q.shape[2], k.shape[2]
+    if h_kv != h:
+        k = jnp.repeat(k, h // h_kv, axis=2)
+        v = jnp.repeat(v, h // h_kv, axis=2)
+    return _sdpa_xla(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(causal):
+    rs = np.random.RandomState(0)
+    q, k, v = _rand_qkv(rs, 2, 64, 8, 8, 16)
+    ref = _ref(q, k, v, causal)
+    with HybridMesh.build(sep=8):
+        out = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_divisible_kv():
+    # h_kv % n == 0: K/V all-to-all directly, group-aligned head slices
+    rs = np.random.RandomState(1)
+    q, k, v = _rand_qkv(rs, 1, 32, 8, 4, 8)
+    ref = _ref(q, k, v, True)
+    with HybridMesh.build(sep=4, devices=jax.devices()[:4]):
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_indivisible_kv_expands():
+    # h_kv=2 < n=4: KV heads repeated up to h before the all-to-all
+    rs = np.random.RandomState(2)
+    q, k, v = _rand_qkv(rs, 1, 32, 8, 2, 8)
+    ref = _ref(q, k, v, True)
+    with HybridMesh.build(sep=4, devices=jax.devices()[:4]):
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_grads_match_dense():
+    rs = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rs, 1, 32, 4, 4, 8)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    with HybridMesh.build(sep=4, devices=jax.devices()[:4]):
+        def loss_u(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, causal=True) ** 2)
+        g = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    for a, r, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_ulysses_no_mesh_fallback():
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(1, 16, 2, 8).astype(np.float32))
+    out = ulysses_attention(q, q, q, causal=True)
+    ref = _sdpa_xla(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_supported_gate():
+    assert ulysses_supported(8, 8, 4)
+    assert ulysses_supported(8, 2, 4)
+    assert not ulysses_supported(6, 2, 4)   # h % n != 0
+    assert not ulysses_supported(8, 8, 1)   # no axis
+    # h_kv neither divides the axis nor divides h (expansion impossible)
+    assert not ulysses_supported(8, 3, 4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    rs = np.random.RandomState(5)
+    q, k, v = _rand_qkv(rs, 1, 32, 6, 6, 8)
+    with HybridMesh.build(sep=4, devices=jax.devices()[:4]):
+        with pytest.raises(ValueError, match="ulysses"):
+            ulysses_attention(q, k, v)
+
+
+def test_llama_sp_mode_ulysses_matches_ring():
+    """The flagship model produces the same logits under both SP modes."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg_kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=64,
+                  sequence_parallel=True)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 64, (2, 32)), jnp.int32)
+
+    outs = {}
+    for mode in ("ring", "ulysses"):
+        pt.seed(0)
+        model = LlamaForCausalLM(LlamaConfig(sp_mode=mode, **cfg_kw))
+        with HybridMesh.build(sep=4, devices=jax.devices()[:4]):
+            outs[mode] = np.asarray(jax.jit(model.forward)(ids))
+    np.testing.assert_allclose(outs["ring"], outs["ulysses"],
+                               rtol=2e-4, atol=2e-4)
