@@ -41,7 +41,7 @@ class ShardingConfig:
     enable: bool = False
     stage: int = 1               # 1: opt-state, 2: +grads, 3: +params
     degree: int = 1
-    offload: bool = False        # accepted; host offload via jax.checkpoint policies
+    offload: bool = False        # opt-state to pinned_host (trainer/sharding)
 
 
 @dataclass

@@ -118,11 +118,16 @@ class ContinuousBatchingEngine:
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive until all submitted requests complete; returns
-        {rid: np.ndarray of generated tokens}."""
+        {rid: np.ndarray of generated tokens} for the requests finished by
+        this call and RELEASES them (a long-lived engine must not retain
+        every request it ever served)."""
         while self.has_work():
             self.step()
-        return {rid: np.asarray(r.generated, np.int32)
-                for rid, r in self._requests.items()}
+        out = {rid: np.asarray(r.generated, np.int32)
+               for rid, r in self._requests.items() if r.done}
+        for rid in out:
+            del self._requests[rid]
+        return out
 
     def stats(self) -> Dict[str, int]:
         return {"free_pages": len(self._free),

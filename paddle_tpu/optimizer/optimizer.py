@@ -29,6 +29,40 @@ from .clip import ClipGradBase, ClipGradByGlobalNorm
 from .lr import LRScheduler
 
 
+def place_opt_state(state: Dict, params: Dict[str, jax.Array], kind: str):
+    """Move an optimizer-state tree into memory space ``kind``
+    ("pinned_host" / "device") in ONE batched transfer, laying each
+    param-shaped slot/master leaf out like ITS PARAM — an offload
+    round-trip must not commit a previously-uncommitted leaf to a single
+    device while its mesh-sharded param spans the mesh. The host side of
+    GroupSharded ``offload=True`` (reference: group_sharded_storage.py);
+    used by Optimizer.step and Trainer.train_step."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    any_sh = next(iter(params.values())).sharding if params else None
+    if any_sh is None:
+        return state
+    rep = (NamedSharding(any_sh.mesh, PartitionSpec())
+           if isinstance(any_sh, NamedSharding) else any_sh)
+
+    def sh_of(path_name, leaf):
+        base = (params[path_name].sharding
+                if path_name in params else rep)
+        return base.with_memory_kind(kind)
+
+    shardings = {}
+    for k, v in state.items():
+        if k in ("slots", "master") and isinstance(v, dict):
+            shardings[k] = {
+                name: ({sk: sh_of(name, sv) for sk, sv in entry.items()}
+                       if isinstance(entry, dict) else sh_of(name, entry))
+                for name, entry in v.items()}
+        else:
+            shardings[k] = jax.tree.map(
+                lambda x: rep.with_memory_kind(kind), v)
+    return jax.device_put(state, shardings)
+
+
 def _is_low_precision(x):
     return x.dtype in (jnp.bfloat16, jnp.float16)
 
@@ -138,7 +172,15 @@ class Optimizer:
         params = {k: p.value for k, p in self._bound_params.items()}
         if self._state is None:
             self._state = self.init_state(params)
+            if getattr(self, "_offload_opt_state", False):
+                self._state = place_opt_state(self._state, params,
+                                              "pinned_host")
+        offload = getattr(self, "_offload_opt_state", False)
+        if offload:
+            self._state = place_opt_state(self._state, params, "device")
         new_params, self._state = self.apply_gradients(params, grads, self._state)
+        if offload:
+            self._state = place_opt_state(self._state, params, "pinned_host")
         for k, v in new_params.items():
             self._bound_params[k].value = v
 

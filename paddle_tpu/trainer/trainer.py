@@ -63,16 +63,30 @@ class TrainMetrics:
 
 class Trainer:
     """Single-program trainer: works 1-chip or over a mesh (pass sharded
-    params/opt-state; the jitted step inherits their shardings via GSPMD)."""
+    params/opt-state; the jitted step inherits their shardings via GSPMD).
+
+    ``offload_opt_state=True`` parks the optimizer moments in HOST memory
+    between steps (pinned_host memory space): train_step pulls them to
+    device for the (donated) update and pushes the result back, one
+    batched transfer each way. Device HBM then holds params+grads+acts
+    plus only a transient optimizer copy — the TPU analogue of the
+    reference's GroupSharded CPU offload."""
 
     def __init__(self, model: Layer, optimizer: Optimizer,
                  loss_key: Optional[str] = None, donate: bool = True,
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1,
+                 offload_opt_state: Optional[bool] = None):
         self.model = model
         self.optimizer = optimizer
         self._named = dict(model.named_parameters())
         self.params = model.raw_parameters()
         self.opt_state = optimizer.init_state(self.params)
+        if offload_opt_state is None:   # group_sharded_parallel(offload=True)
+            offload_opt_state = getattr(optimizer, "_offload_opt_state",
+                                        False)
+        self._offload = bool(offload_opt_state)
+        if self._offload:
+            self.opt_state = self._place_opt_state("pinned_host")
         self._step_fn = None
         self._donate = donate
         self._step = 0
@@ -133,17 +147,37 @@ class Trainer:
         donate = (0, 1) if self._donate else ()
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
 
+    def _place_opt_state(self, kind: str):
+        from ..optimizer.optimizer import place_opt_state
+        return place_opt_state(self.opt_state, self.params, kind)
+
     def train_step(self, batch: Dict[str, jax.Array]) -> float:
         """One optimization step. ``batch`` maps forward kwarg names to
         arrays (e.g. {"input_ids": ..., "labels": ...})."""
+        if not self._offload and getattr(self.optimizer,
+                                         "_offload_opt_state", False):
+            # group_sharded_parallel(offload=True) ran AFTER this Trainer
+            # was built — honor the flag from here on
+            self._offload = True
+            self.opt_state = self._place_opt_state("pinned_host")
         if self._step_fn is None:
             self._build_step()
         if self._watchdog is not None:
             self._watchdog.tick()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.key(self._step)
+        if self._offload:
+            # pull the state up for the step, push the update back down:
+            # host<->device streams around a device-resident step (the
+            # transient device copy is donated straight into the update).
+            # In-jit memory-space annotation is deliberately not used —
+            # mixed-space operands are rejected by XLA and the CPU test
+            # backend lacks annotate_device_placement entirely.
+            self.opt_state = self._place_opt_state("device")
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, batch, lr, key)
+        if self._offload:
+            self.opt_state = self._place_opt_state("pinned_host")
         self._step += 1
         if self._donate:
             # donation invalidates the previous param buffers, which the

@@ -1,0 +1,98 @@
+"""Optimizer-state host offload (pinned_host memory space) tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.trainer import Trainer
+
+
+def _model():
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _batchify(model):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 16).astype(np.float32))
+    y = jnp.asarray(rs.randn(8, 4).astype(np.float32))
+
+    class Wrapper(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.net = model
+
+        def forward(self, x, y):
+            return jnp.mean((self.net(x) - y) ** 2)
+
+    return Wrapper(), {"x": x, "y": y}
+
+
+def _kinds(tree):
+    return {getattr(leaf.sharding, "memory_kind", None)
+            for leaf in jax.tree.leaves(tree) if isinstance(leaf, jax.Array)}
+
+
+def test_offload_state_lives_on_host_and_training_matches():
+    losses = {}
+    for offload in (False, True):
+        m, batch = _batchify(_model())
+        opt = AdamW(learning_rate=1e-2, parameters=m)
+        tr = Trainer(m, opt, offload_opt_state=offload)
+        if offload:
+            assert _kinds(tr.opt_state) == {"pinned_host"}
+        losses[offload] = [float(tr.train_step(batch)) for _ in range(5)]
+        if offload:
+            # state returns to host after every step
+            assert _kinds(tr.opt_state) == {"pinned_host"}
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-6, atol=1e-6)
+    assert losses[True][-1] < losses[True][0]
+
+
+def test_offload_imperative_step_path():
+    """opt.step(grads) honors the offload flag too (not just the Trainer)."""
+    from paddle_tpu.autograd import layer_grad
+
+    m, batch = _batchify(_model())
+    opt = AdamW(learning_rate=1e-2, parameters=m)
+    opt._offload_opt_state = True
+    for _ in range(3):
+        loss, grads = layer_grad(m, lambda l: l, batch["x"], batch["y"])
+        opt.step(grads)
+    assert _kinds(opt._state) == {"pinned_host"}
+    assert np.isfinite(float(loss))
+
+
+def test_offload_flag_set_after_trainer_construction():
+    """group_sharded_parallel(offload=True) after Trainer() still engages
+    (the flag is re-read on the next train_step)."""
+    m, batch = _batchify(_model())
+    opt = AdamW(learning_rate=1e-2, parameters=m)
+    tr = Trainer(m, opt)
+    assert not tr._offload
+    opt._offload_opt_state = True
+    loss = float(tr.train_step(batch))
+    assert tr._offload
+    assert _kinds(tr.opt_state) == {"pinned_host"}
+    assert np.isfinite(loss)
+
+
+def test_group_sharded_offload_flag_reaches_trainer():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.parallel import HybridMesh
+
+    m, batch = _batchify(_model())
+    opt = AdamW(learning_rate=1e-2, parameters=m)
+    with HybridMesh.build(fsdp=4, devices=jax.devices()[:4]):
+        m2, opt2, _ = group_sharded_parallel(m, opt, level="os_g",
+                                             offload=True)
+        tr = Trainer(m2, opt2)
+        assert tr._offload
+        assert _kinds(tr.opt_state) == {"pinned_host"}
+        loss = float(tr.train_step(batch))
+        assert np.isfinite(loss)
+        assert _kinds(tr.opt_state) == {"pinned_host"}

@@ -140,6 +140,26 @@ def test_rejects_overlong_request(model):
         eng.submit(np.zeros((8,), np.int32))
 
 
+def test_engine_reuse_releases_finished_requests(model):
+    """run() returns only the requests finished by THIS call and drops
+    them from the engine (no unbounded retention on a long-lived engine)."""
+    rs = np.random.RandomState(7)
+    vocab = model.cfg.vocab_size
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=PAGE, max_len=64,
+        generation_config=GenerationConfig(max_new_tokens=4,
+                                           do_sample=False))
+    p1, p2 = _mk_prompt(rs, 5, vocab), _mk_prompt(rs, 6, vocab)
+    r1 = eng.submit(p1)
+    out1 = eng.run()
+    assert set(out1) == {r1}
+    r2 = eng.submit(p2)
+    out2 = eng.run()
+    assert set(out2) == {r2}            # r1 was released, not re-returned
+    assert len(eng._requests) == 0
+    np.testing.assert_array_equal(out2[r2], _ref_greedy(model, p2, 4))
+
+
 def test_rejects_degenerate_requests(model):
     eng = ContinuousBatchingEngine(
         model, max_batch=1, page_size=PAGE, max_len=64,
