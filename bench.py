@@ -178,6 +178,41 @@ def _decode_bench(cfg, on_tpu):
     except Exception as e:
         out["paged_generate_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
+    try:
+        # continuous-batching engine throughput: staggered prompts through
+        # fewer slots than requests (admission + retirement + lazy paging
+        # on the clock) — the serving-system layer over the paged kernel
+        from paddle_tpu.inference import ContinuousBatchingEngine
+        # each decode step costs a host round trip (per-token sampling on
+        # the scheduler); over the tunneled chip that latency dominates, so
+        # keep the serving leg short — it measures the SCHEDULER path, the
+        # raw decode rate is decode_tokens_per_sec above
+        n_req, slots = (8, 4) if on_tpu else (4, 2)
+        s_new = min(new_tokens, 24)
+        eng = ContinuousBatchingEngine(
+            dmodel, max_batch=slots, page_size=128 if on_tpu else 8,
+            max_len=(prompt_len + new_tokens + 128) if on_tpu else 32,
+            generation_config=GenerationConfig(max_new_tokens=s_new,
+                                               do_sample=False))
+        rs = np.random.RandomState(1)
+        stag = 8 if on_tpu else 2
+        reqs = [rs.randint(0, dcfg.vocab_size,
+                           (prompt_len - (i % 3) * stag,)).astype(np.int32)
+                for i in range(n_req)]
+        _log("decode: continuous-batching engine")
+        for r in reqs:
+            eng.submit(r)
+        t0 = time.perf_counter()
+        results = eng.run()
+        dt = time.perf_counter() - t0
+        total = sum(len(v) for v in results.values())
+        out["serving_tokens_per_sec"] = round(total / dt, 1)
+        out["serving_requests"] = n_req
+        out["serving_slots"] = slots
+        out["serving_preemptions"] = eng.preemptions
+    except Exception as e:
+        out["serving_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
     if on_tpu:
         try:
             from paddle_tpu.ops.pallas.paged_attention import (

@@ -70,13 +70,29 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
 
 
 def distributed_model(model):
-    """Place the model on the mesh (reference: fleet/model.py:32)."""
-    if current_mesh() is None:
+    """Place the model on the mesh (reference: fleet/model.py:32, which
+    wraps per active axis — ShardingParallel/SegmentParallel/TensorParallel;
+    here GSPMD placement + config wiring express the same)."""
+    hm = current_mesh()
+    if hm is None:
         raise RuntimeError("fleet.init() has not been called")
     strategy = _strategy or DistributedStrategy()
-    if strategy.recompute.enable and hasattr(model, "cfg") and \
-            hasattr(model.cfg, "recompute"):
-        model.cfg.recompute = "full"
+    cfg = getattr(model, "cfg", None)
+    if strategy.recompute.enable and hasattr(cfg, "recompute"):
+        cfg.recompute = "full"
+    if hm.axis_size("sep") > 1 and hasattr(cfg, "sequence_parallel"):
+        # an active sep axis means the user asked for sequence parallelism
+        # (reference: fleet/model.py:151 wraps in SegmentParallel); pick up
+        # sp_mode from strategy.extras when a recipe sets it
+        cfg.sequence_parallel = True
+        mode = (strategy.extras or {}).get("sp_mode")
+        if mode and hasattr(cfg, "sp_mode"):
+            if mode not in ("ring", "ulysses"):
+                # assignment bypasses the config's __post_init__ — validate
+                # here or a typo silently falls back to ring attention
+                raise ValueError(f"strategy sp_mode must be 'ring'|'ulysses',"
+                                 f" got {mode!r}")
+            cfg.sp_mode = mode
     return shard_layer(model)
 
 
@@ -86,6 +102,11 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     computes over global (sharded) arrays, so the inner optimizer IS the
     hybrid optimizer. Returned unchanged, tagged for introspection."""
     optimizer._is_fleet_distributed = True
+    st = strategy or _strategy
+    if st is not None and st.sharding.enable and st.sharding.offload:
+        # sharding_configs.offload → optimizer state to host memory
+        # (optimizer/optimizer.py place_opt_state)
+        optimizer._offload_opt_state = True
     return optimizer
 
 

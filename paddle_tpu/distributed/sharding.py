@@ -39,16 +39,17 @@ def group_sharded_parallel(model, optimizer, level: str = "p_g_os",
     """
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
-    if offload:
-        # reference: offload=True parks optimizer state on the CPU
-        # (group_sharded_storage.py); here: host (pinned_host) memory
-        # space between steps — honored by Optimizer.step and the Trainer
-        # (optimizer/optimizer.py place_opt_state)
-        optimizer._offload_opt_state = True
     hm = current_mesh()
     if hm is None:
         raise RuntimeError("no active mesh — call fleet.init or enter a "
                            "HybridMesh first")
+    if offload:
+        # reference: offload=True parks optimizer state on the CPU
+        # (group_sharded_storage.py); here: host (pinned_host) memory
+        # space between steps — honored by Optimizer.step and the Trainer
+        # (optimizer/optimizer.py place_opt_state). Set only after the
+        # mesh checks: a failed call must not leave the flag behind.
+        optimizer._offload_opt_state = True
     if hm.axis_size("fsdp") <= 1:
         # nothing to shard over; still place params on the mesh
         shard_layer(model)

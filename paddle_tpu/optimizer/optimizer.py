@@ -170,13 +170,12 @@ class Optimizer:
                 "paddle_tpu optimizers need explicit grads: opt.step(grads) — "
                 "compute them with paddle_tpu.autograd.grad / jax.grad.")
         params = {k: p.value for k, p in self._bound_params.items()}
-        if self._state is None:
-            self._state = self.init_state(params)
-            if getattr(self, "_offload_opt_state", False):
-                self._state = place_opt_state(self._state, params,
-                                              "pinned_host")
         offload = getattr(self, "_offload_opt_state", False)
-        if offload:
+        if self._state is None:
+            # fresh state is already device-resident; the post-step push
+            # parks it — no initial host round trip
+            self._state = self.init_state(params)
+        elif offload:
             self._state = place_opt_state(self._state, params, "device")
         new_params, self._state = self.apply_gradients(params, grads, self._state)
         if offload:

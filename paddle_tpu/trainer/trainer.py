@@ -81,7 +81,11 @@ class Trainer:
         self._named = dict(model.named_parameters())
         self.params = model.raw_parameters()
         self.opt_state = optimizer.init_state(self.params)
-        if offload_opt_state is None:   # group_sharded_parallel(offload=True)
+        # None = inherit from the optimizer flag (group_sharded_parallel /
+        # fleet set it); an explicit True/False always wins, including over
+        # a flag set later
+        self._offload_explicit = offload_opt_state is not None
+        if offload_opt_state is None:
             offload_opt_state = getattr(optimizer, "_offload_opt_state",
                                         False)
         self._offload = bool(offload_opt_state)
@@ -154,10 +158,11 @@ class Trainer:
     def train_step(self, batch: Dict[str, jax.Array]) -> float:
         """One optimization step. ``batch`` maps forward kwarg names to
         arrays (e.g. {"input_ids": ..., "labels": ...})."""
-        if not self._offload and getattr(self.optimizer,
-                                         "_offload_opt_state", False):
+        if (not self._offload and not self._offload_explicit
+                and getattr(self.optimizer, "_offload_opt_state", False)):
             # group_sharded_parallel(offload=True) ran AFTER this Trainer
-            # was built — honor the flag from here on
+            # was built — honor the flag from here on (unless the caller
+            # explicitly passed offload_opt_state=False)
             self._offload = True
             self.opt_state = self._place_opt_state("pinned_host")
         if self._step_fn is None:

@@ -149,6 +149,56 @@ def test_fleet_facade():
         fleet.stop()
 
 
+def test_fleet_strategy_wires_sep_and_offload():
+    """An active sep axis flips the model into sequence parallelism (with
+    sp_mode from strategy.extras), and sharding_configs.offload reaches the
+    optimizer (reference: fleet/model.py:151 SegmentParallel wrap +
+    sharding offload)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.trainer import Trainer
+
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sep_degree": 4}
+    s.sharding = {"enable": True, "offload": True}
+    s.sp_mode = "ulysses"                      # extras knob
+    fleet.init(is_collective=True, strategy=s)
+    try:
+        model = fleet.distributed_model(LlamaForCausalLM(LlamaConfig.tiny()))
+        assert model.cfg.sequence_parallel
+        assert model.cfg.sp_mode == "ulysses"
+        opt = fleet.distributed_optimizer(AdamW(learning_rate=1e-3,
+                                                parameters=model))
+        assert opt._offload_opt_state
+        tr = Trainer(model, opt, donate=False)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, model.cfg.vocab_size, (4, 33))
+        batch = {"input_ids": dist.shard_tensor(jnp.asarray(ids[:, :-1]),
+                                                spec=P("dp", "sep")),
+                 "labels": dist.shard_tensor(jnp.asarray(ids[:, 1:]),
+                                             spec=P("dp", "sep"))}
+        assert np.isfinite(float(tr.train_step(batch)))
+        kinds = {l.sharding.memory_kind for l in jax.tree.leaves(tr.opt_state)
+                 if isinstance(l, jax.Array)}
+        assert kinds == {"pinned_host"}
+    finally:
+        fleet.stop()
+
+
+def test_fleet_rejects_bad_sp_mode():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sep_degree": 4}
+    s.sp_mode = "ulyses"                       # typo must raise, not
+    fleet.init(is_collective=True, strategy=s)  # silently fall back to ring
+    try:
+        with pytest.raises(ValueError, match="sp_mode"):
+            fleet.distributed_model(LlamaForCausalLM(LlamaConfig.tiny()))
+    finally:
+        fleet.stop()
+
+
 @pytest.mark.parametrize("level", ["os", "p_g_os"])
 def test_group_sharded_levels(level):
     from paddle_tpu import nn

@@ -81,6 +81,18 @@ def test_offload_flag_set_after_trainer_construction():
     assert np.isfinite(loss)
 
 
+def test_explicit_false_wins_over_optimizer_flag():
+    """Trainer(offload_opt_state=False) is a deliberate opt-out: the
+    optimizer flag must not re-engage offload on the next step."""
+    m, batch = _batchify(_model())
+    opt = AdamW(learning_rate=1e-2, parameters=m)
+    opt._offload_opt_state = True
+    tr = Trainer(m, opt, offload_opt_state=False)
+    float(tr.train_step(batch))
+    assert not tr._offload
+    assert _kinds(tr.opt_state) == {"device"}
+
+
 def test_group_sharded_offload_flag_reaches_trainer():
     from paddle_tpu.distributed.sharding import group_sharded_parallel
     from paddle_tpu.parallel import HybridMesh
