@@ -52,6 +52,8 @@ from . import distribution
 from . import vision
 from . import quantization
 from . import incubate
+from . import decomposition
+from . import dataset
 from . import inference
 from . import linalg
 from . import text

@@ -63,4 +63,10 @@ from .compat import (
     CountFilterEntry, ProbabilityEntry, ShowClickEntry,
 )
 from . import io
+from . import utils
+from . import collective
+from . import parallel
+from . import auto_parallel
+from . import models
+from . import passes
 from ..checkpoint import save_state_dict, load_state_dict

@@ -7,6 +7,10 @@ reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
 from . import nn
 from . import asp
 from . import operators
+from . import autograd
+from . import optimizer
+from . import autotune
+from . import checkpoint
 
 __all__ = ["nn", "asp", "operators"]
 

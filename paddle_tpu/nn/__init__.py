@@ -48,3 +48,7 @@ from .layers_extras import (
 )
 from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
                               ClipGradByValue)
+from . import utils
+from . import clip
+from . import decode
+from . import quant

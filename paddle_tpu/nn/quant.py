@@ -1,0 +1,51 @@
+"""paddle.nn.quant module-path parity (python/paddle/nn/quant/): the QAT
+layer set and quantize helpers live in paddle_tpu.quantization (observers,
+fake-quant STE, int8 MXU matmul); re-exported here under the reference
+path. The reference's FloatFunctionalLayer wrappers (add/matmul/... as
+layers for quant graph capture) are provided as thin Layer shims."""
+
+import jax.numpy as jnp
+
+from .layer import Layer
+from ..quantization import (QAT, PTQ, QuantConfig, quanter,
+                            BaseQuanter, BaseObserver)
+
+
+class FloatFunctionalLayer(Layer):
+    """Functional-op-as-layer so PTQ/QAT can observe activations at
+    arbitrary op sites (reference: nn/quant/functional_layers.py)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def _functional(fn):
+    return lambda: FloatFunctionalLayer(fn)
+
+
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:])
+    return x.reshape(shape)
+
+
+add = _functional(jnp.add)
+subtract = _functional(jnp.subtract)
+multiply = _functional(jnp.multiply)
+divide = _functional(jnp.divide)
+matmul = _functional(jnp.matmul)
+reshape = _functional(jnp.reshape)
+flatten = _functional(_flatten)
+concat = _functional(jnp.concatenate)
+transpose = _functional(jnp.transpose)
+
+__all__ = ["QAT", "PTQ", "QuantConfig", "quanter", "BaseQuanter",
+           "BaseObserver", "FloatFunctionalLayer", "add", "subtract",
+           "multiply", "divide", "matmul", "reshape", "flatten", "concat",
+           "transpose"]

@@ -51,6 +51,26 @@ def pallas_disabled() -> bool:
     return bool(os.environ.get("PT_DISABLE_PALLAS"))
 
 
+class pallas_disabled_scope:
+    """Context manager flipping the kill-switch for a region: ops trace as
+    their jnp/lax composite bodies instead of fused kernels (used by
+    paddle_tpu.decomposition.decompose to expose primitive jaxprs)."""
+
+    def __enter__(self):
+        import os
+        self._prev = os.environ.get("PT_DISABLE_PALLAS")
+        os.environ["PT_DISABLE_PALLAS"] = "1"
+        return self
+
+    def __exit__(self, *exc):
+        import os
+        if self._prev is None:
+            os.environ.pop("PT_DISABLE_PALLAS", None)
+        else:
+            os.environ["PT_DISABLE_PALLAS"] = self._prev
+        return False
+
+
 def register_kernel(op: str, backend: str):
     """Register an implementation for op on backend ('tpu'|'cpu'|'any')."""
     def deco(fn):

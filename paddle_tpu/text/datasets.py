@@ -70,7 +70,7 @@ class Imdb(_FileBackedDataset):
     _URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
 
     def __init__(self, data_file=None, mode: str = "train", cutoff: int = 150,
-                 download=True):
+                 download=True, word_idx=None):
         path = self._require(data_file)
         pat = re.compile(rf"aclImdb/{mode}/pos/.*\.txt$")
         pat_neg = re.compile(rf"aclImdb/{mode}/neg/.*\.txt$")
@@ -96,9 +96,14 @@ class Imdb(_FileBackedDataset):
                     docs_pos.append(words)
                 elif is_neg:
                     docs_neg.append(words)
-        vocab = {w: i for i, (w, c) in enumerate(
-            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
-            if c >= cutoff}
+        if word_idx is not None:
+            # caller-supplied dict wins (legacy paddle.dataset.imdb contract:
+            # yielded ids are mapped through the dict the user passes)
+            vocab = dict(word_idx)
+        else:
+            vocab = {w: i for i, (w, c) in enumerate(
+                sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+                if c >= cutoff}
         self.word_idx = vocab
         unk = len(vocab)
         self.docs = [np.asarray([vocab.get(w, unk) for w in d], np.int64)
@@ -120,7 +125,8 @@ class Imikolov(_FileBackedDataset):
     _URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tar.gz"
 
     def __init__(self, data_file=None, data_type: str = "NGRAM", window_size=2,
-                 mode: str = "train", min_word_freq: int = 50, download=True):
+                 mode: str = "train", min_word_freq: int = 50, download=True,
+                 word_idx=None):
         path = self._require(data_file)
         fname = {"train": "./simple-examples/data/ptb.train.txt",
                  "test": "./simple-examples/data/ptb.valid.txt"}[mode]
@@ -135,10 +141,16 @@ class Imikolov(_FileBackedDataset):
             txt = (train_txt if fname == train_name
                    else tf.extractfile(fname).read().decode())
             lines = [ln.strip().split() for ln in txt.splitlines()]
-        vocab = {w: i for i, (w, c) in enumerate(
-            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
-            if c >= min_word_freq and w != "<unk>"}
-        vocab["<unk>"] = len(vocab)
+        if word_idx is not None:
+            # caller-supplied dict wins (legacy paddle.dataset.imikolov
+            # contract); ensure an <unk> slot exists
+            vocab = dict(word_idx)
+            vocab.setdefault("<unk>", len(vocab))
+        else:
+            vocab = {w: i for i, (w, c) in enumerate(
+                sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+                if c >= min_word_freq and w != "<unk>"}
+            vocab["<unk>"] = len(vocab)
         self.word_idx = vocab
         unk = vocab["<unk>"]
         self.data = []

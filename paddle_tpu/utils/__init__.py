@@ -8,6 +8,9 @@ from .misc import (to_dlpack, from_dlpack, generate as unique_name_generate, gua
                    deprecated, require_version, try_import, run_check)
 from . import misc as unique_name_mod
 from . import cpp_extension
+from . import unique_name
+from . import dlpack
+from . import install_check
 
 __all__ = ["flops", "transformer_flops_per_token", "model_flops_per_token",
            "get_weights_path_from_url", "get_path_from_url", "DownloadError",

@@ -1,0 +1,122 @@
+"""incubate.autograd (prim API) and decomposition tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate import autograd as ia
+
+
+def test_jvp_vjp_roundtrip():
+    f = lambda x: jnp.sin(x) * x
+    x = jnp.asarray(np.random.RandomState(0).randn(4).astype(np.float32))
+    v = jnp.ones_like(x)
+    out, tangent = ia.jvp(f, x, v)
+    out2, cotangent = ia.vjp(f, x, v)
+    if isinstance(cotangent, (tuple, list)):
+        cotangent = cotangent[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-6)
+    # f is elementwise, so jvp and vjp against ones coincide
+    np.testing.assert_allclose(np.asarray(tangent), np.asarray(cotangent),
+                               rtol=1e-6)
+
+
+def test_forward_grad_matches_jvp():
+    f = lambda x: x ** 3
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    t = ia.forward_grad(f, x)
+    np.testing.assert_allclose(np.asarray(t), 3 * np.asarray(x) ** 2,
+                               rtol=1e-6)
+
+
+def test_grad_functional_form():
+    f = lambda x, y: jnp.sum(x * y)
+    x, y = jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0])
+    gx, gy = ia.grad(f, (x, y))
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(y))
+    np.testing.assert_allclose(np.asarray(gy), np.asarray(x))
+
+
+def test_grad_rejects_static_values():
+    with pytest.raises(TypeError, match="pass"):
+        ia.grad(jnp.asarray([1.0]), jnp.asarray([1.0]))
+
+
+def test_jacobian_full_and_sliced():
+    def f(x):
+        return jnp.stack([x[0] * x[1], x[0] + x[2], jnp.sin(x[2])])
+
+    x = jnp.asarray([1.0, 2.0, 0.5])
+    J = ia.Jacobian(f, x)
+    expect = np.array([[2.0, 1.0, 0.0],
+                       [1.0, 0.0, 1.0],
+                       [0.0, 0.0, np.cos(0.5)]], np.float32)
+    np.testing.assert_allclose(np.asarray(J[:]), expect, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(J[1, :]), expect[1], rtol=1e-5)
+    assert J.shape == (3, 3)
+
+
+def test_jacobian_multi_input_concatenated():
+    # reference contract: multiple inputs flatten-and-concatenate
+    f = lambda x, y: x * 2 + y * 3
+    x, y = jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0])
+    J = ia.Jacobian(f, (x, y))
+    expect = np.concatenate([np.eye(2) * 2, np.eye(2) * 3], axis=1)
+    np.testing.assert_allclose(np.asarray(J[:]), expect, rtol=1e-6)
+
+
+def test_jacobian_batched():
+    f = lambda x: x ** 2
+    x = jnp.asarray(np.random.RandomState(1).randn(3, 4).astype(np.float32))
+    J = ia.Jacobian(f, x, is_batched=True)
+    assert J.shape == (3, 4, 4)
+    for b in range(3):
+        np.testing.assert_allclose(np.asarray(J[b]),
+                                   np.diag(2 * np.asarray(x)[b]), rtol=1e-5)
+
+
+def test_hessian():
+    f = lambda x: jnp.sum(x ** 3)
+    x = jnp.asarray([1.0, 2.0])
+    H = ia.Hessian(f, x)
+    np.testing.assert_allclose(np.asarray(H[:]),
+                               np.diag(6 * np.asarray(x)), rtol=1e-5)
+
+
+def test_hessian_rejects_vector_output():
+    with pytest.raises(ValueError, match="scalar"):
+        ia.Hessian(lambda x: x * 2, jnp.asarray([1.0, 2.0]))[:]
+
+
+def test_prim_flags():
+    assert not ia.prim_enabled()
+    ia.enable_prim()
+    assert ia.prim_enabled()
+    ia.disable_prim()
+    assert not ia.prim_enabled()
+
+
+def test_decompose_callable_strips_fused_dispatch():
+    from paddle_tpu.decomposition import decompose
+    from paddle_tpu.ops.registry import pallas_disabled
+
+    seen = {}
+
+    def f(x):
+        seen["disabled"] = pallas_disabled()
+        return x * 2
+
+    x = jnp.asarray([1.0])
+    out = decompose(f)(x)
+    np.testing.assert_allclose(np.asarray(out), [2.0])
+    assert seen["disabled"]            # fused dispatch off inside
+    assert not pallas_disabled()       # restored outside
+
+
+def test_decompose_program_is_identity():
+    from paddle_tpu.decomposition import decompose
+    prog = pt.static.Program()
+    assert decompose(prog, ["v"]) == ["v"]
+    assert decompose(prog) is prog

@@ -1,0 +1,281 @@
+"""Second round-3 parity batch: nn.utils reparameterizations, module-path
+aliases (nn.clip/decode/quant, distributed.*, utils.*, incubate.*),
+legacy paddle.dataset readers, functional quasi-Newton minimizers."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+# -- nn.utils ---------------------------------------------------------------
+
+def test_weight_norm_forward_parity_and_grads():
+    pt.seed(0)
+    layer = nn.Linear(8, 4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    ref = layer(x)
+    nn.utils.weight_norm(layer, "weight", dim=0)
+    assert "weight_g" in layer._parameters and "weight_v" in layer._parameters
+    assert "weight" not in layer._parameters
+    np.testing.assert_allclose(np.asarray(layer(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # grads flow to the new leaves through the hook
+    params = layer.raw_parameters()
+
+    def loss(p):
+        return jnp.sum(layer.functional_call(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["weight_g"]).sum()) > 0
+    assert float(jnp.abs(g["weight_v"]).sum()) > 0
+
+
+def test_remove_weight_norm_restores():
+    pt.seed(0)
+    layer = nn.Linear(6, 3)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6).astype(np.float32))
+    ref = layer(x)
+    nn.utils.weight_norm(layer)
+    nn.utils.remove_weight_norm(layer)
+    assert "weight" in layer._parameters
+    np.testing.assert_allclose(np.asarray(layer(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_double_apply_raises():
+    layer = nn.Linear(4, 2)
+    nn.utils.weight_norm(layer)
+    with pytest.raises(ValueError, match="already"):
+        nn.utils.weight_norm(layer)
+
+
+def test_spectral_norm_unit_sigma():
+    pt.seed(0)
+    layer = nn.Linear(16, 8)
+    nn.utils.spectral_norm(layer, "weight", n_power_iterations=20)
+    x = jnp.eye(16)
+    layer(x)   # run the hook
+    w = layer.weight if not isinstance(layer.weight, type(None)) else None
+    s = np.linalg.svd(np.asarray(w), compute_uv=False)
+    assert abs(s[0] - 1.0) < 5e-2     # largest singular value ~ 1
+
+
+def test_parameters_to_vector_roundtrip():
+    pt.seed(0)
+    layer = nn.Linear(5, 3)
+    params = list(layer.parameters())
+    vec = nn.utils.parameters_to_vector(params)
+    assert vec.shape == (5 * 3 + 3,)
+    nn.utils.vector_to_parameters(vec * 2, params)
+    vec2 = nn.utils.parameters_to_vector(params)
+    np.testing.assert_allclose(np.asarray(vec2), 2 * np.asarray(vec),
+                               rtol=1e-6)
+
+
+def test_clip_grad_norm_explicit_grads():
+    g = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([0.0])}
+    total, clipped = nn.utils.clip_grad_norm_(None, 1.0, grads=g)
+    assert abs(float(total) - 5.0) < 1e-5
+    norm = np.sqrt(sum(float(jnp.sum(v ** 2)) for v in clipped.values()))
+    assert abs(norm - 1.0) < 1e-4
+    with pytest.raises(ValueError, match="grads"):
+        nn.utils.clip_grad_norm_(None, 1.0)
+
+
+def test_clip_grad_value():
+    clipped = nn.utils.clip_grad_value_(None, 0.5,
+                                        grads=[jnp.asarray([-2.0, 2.0])])
+    np.testing.assert_allclose(np.asarray(clipped[0]), [-0.5, 0.5])
+
+
+# -- module-path aliases ----------------------------------------------------
+
+def test_module_path_aliases():
+    assert nn.clip.ClipGradByGlobalNorm is pt.optimizer.clip.ClipGradByGlobalNorm \
+        if hasattr(pt.optimizer, "clip") else nn.clip.ClipGradByGlobalNorm
+    assert nn.decode.BeamSearchDecoder.__name__ == "BeamSearchDecoder"
+    assert nn.quant.QAT.__name__ == "QAT"
+    d = pt.distributed
+    assert d.collective.new_group is d.new_group
+    assert d.parallel.init_parallel_env.__name__ == "init_parallel_env"
+    assert d.auto_parallel.shard_tensor is d.shard_tensor
+    assert d.models.moe.MoELayer.__name__ == "MoELayer"
+    assert pt.utils.unique_name.generate("t").startswith("t_")
+    assert pt.utils.dlpack.to_dlpack.__name__ == "to_dlpack"
+    assert pt.utils.install_check.run_check.__name__ == "run_check"
+    from paddle_tpu.vision import image as vimage
+    assert vimage.image_load.__name__ == "image_load"
+    assert pt.incubate.checkpoint.TrainEpochRange.__name__ == "TrainEpochRange"
+
+
+def test_nn_quant_functional_layers():
+    add = nn.quant.add()
+    out = add(jnp.asarray([1.0]), jnp.asarray([2.0]))
+    np.testing.assert_allclose(np.asarray(out), [3.0])
+    fl = nn.quant.flatten()
+    x = jnp.zeros((2, 3, 4, 5))
+    assert fl(x, start_axis=1).shape == (2, 60)
+    assert fl(x, start_axis=1, stop_axis=2).shape == (2, 12, 5)
+    assert fl(x).shape == (120,)
+
+
+def test_distributed_passes_facade():
+    from paddle_tpu.distributed.passes import PassManager, new_pass
+    pm = PassManager([new_pass("auto_parallel_amp"),
+                      new_pass("pipeline_scheduler_1F1B")])
+    ctx = pm.apply([None])
+    assert ctx.attrs["applied_passes"] == ["auto_parallel_amp",
+                                           "pipeline_scheduler_1F1B"]
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("not_a_pass")
+
+
+def test_global_scatter_single_process_identity():
+    from paddle_tpu.distributed.utils import global_gather, global_scatter
+    x = jnp.asarray(np.random.RandomState(0).randn(6, 4).astype(np.float32))
+    lc = jnp.asarray([3, 3])
+    out = global_scatter(x, lc, lc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    out = global_gather(x, lc, lc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_incubate_optimizer_replaced_names_raise():
+    with pytest.raises(AttributeError, match="replaced on TPU"):
+        pt.incubate.optimizer.PipelineOptimizer
+
+
+def test_incubate_autotune_config():
+    from paddle_tpu.incubate import autotune
+    import os
+    autotune.set_config({"kernel": {"enable": False}})
+    assert os.environ.get("PT_DISABLE_PALLAS") == "1"
+    autotune.set_config()
+    assert os.environ.get("PT_DISABLE_PALLAS") is None
+    assert autotune.get_config()["kernel"]["enable"] is True
+    with pytest.raises(ValueError, match="unknown autotune domain"):
+        autotune.set_config({"nope": True})
+
+
+# -- functional minimizers --------------------------------------------------
+
+def test_minimize_bfgs_quadratic():
+    from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+    target = jnp.asarray([1.0, -2.0, 0.5])
+    ok, nf, x, fx, g, H = minimize_bfgs(
+        lambda x: jnp.sum((x - target) ** 2), jnp.zeros(3))
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(x), np.asarray(target), atol=1e-4)
+    assert float(fx) < 1e-8
+    assert H.shape == (3, 3)
+
+
+def test_minimize_lbfgs_coupled_quadratic():
+    from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+    rs = np.random.RandomState(0)
+    A = rs.randn(6, 6).astype(np.float32)
+    Q = jnp.asarray(A @ A.T + 6 * np.eye(6, dtype=np.float32))
+    b = jnp.asarray(rs.randn(6).astype(np.float32))
+
+    def f(x):
+        return 0.5 * x @ Q @ x - b @ x
+
+    ok, nf, x, fx, g = minimize_lbfgs(f, jnp.zeros(6), max_iters=200)
+    expect = np.linalg.solve(np.asarray(Q), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(x), expect, atol=1e-3)
+    assert float(jnp.max(jnp.abs(g))) < 1e-2
+
+
+def test_minimize_rejects_unknown_line_search():
+    from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+    with pytest.raises(NotImplementedError, match="strong_wolfe"):
+        minimize_bfgs(lambda x: jnp.sum(x ** 2), jnp.zeros(2),
+                      line_search_fn="hager_zhang")
+
+
+# -- legacy dataset readers -------------------------------------------------
+
+def test_dataset_mnist_reader_contract():
+    r = pt.dataset.mnist.train()          # fake backend
+    it = r()
+    x, y = next(it)
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert -1.0 <= float(x.min()) and float(x.max()) <= 1.0
+    assert isinstance(y, int)
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path):
+    import os
+    from paddle_tpu.dataset import common
+
+    def reader():
+        for i in range(10):
+            yield (i, i * i)
+
+    pat = str(tmp_path / "chunk-%05d.pickle")
+    files = common.split(reader, 4, suffix=pat)
+    assert len(files) == 3
+    got = []
+    for tid in range(2):
+        rd = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"),
+                                         2, tid)
+        got.extend(rd())
+    assert sorted(got) == [(i, i * i) for i in range(10)]
+
+
+def test_dataset_modules_importable():
+    for mod in ("cifar", "uci_housing", "imdb", "imikolov", "movielens",
+                "conll05", "wmt14", "wmt16", "flowers"):
+        assert hasattr(pt.dataset, mod)
+    with pytest.raises(RuntimeError, match="egress"):
+        pt.dataset.flowers.train()()
+
+
+def test_dataset_imdb_reader_honors_word_idx(tmp_path):
+    """The legacy contract: yielded ids come from the dict the USER passes,
+    not an internally rebuilt one."""
+    import io as _io
+    import tarfile
+
+    path = tmp_path / "aclImdb_tiny.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for split, pol, idx, text in [
+                ("train", "pos", 0, "good good movie"),
+                ("train", "neg", 1, "bad bad movie"),
+                ("test", "pos", 0, "good movie"),
+                ("test", "neg", 1, "bad movie")]:
+            data = text.encode()
+            ti = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{idx}_7.txt")
+            ti.size = len(data)
+            tf.addfile(ti, _io.BytesIO(data))
+
+    word_idx = {"good": 5, "bad": 9, "movie": 2}
+    r = pt.dataset.imdb.train(word_idx, data_file=str(path))
+    docs = {tuple(ids.tolist()): int(label) for ids, label in r()}
+    assert (5, 5, 2) in docs and docs[(5, 5, 2)] == 0
+    assert (9, 9, 2) in docs and docs[(9, 9, 2)] == 1
+
+
+def test_dataset_wmt16_forwards_vocab_caps(tmp_path):
+    import io as _io
+    import tarfile
+
+    lines = b"a b c\tx y z\nd e\tu v\n"
+    path = tmp_path / "wmt16_tiny.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for name in ("train", "test"):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(lines)
+            tf.addfile(ti, _io.BytesIO(lines))
+
+    r_all = pt.dataset.wmt16.train(data_file=str(path))
+    r_cap = pt.dataset.wmt16.train(src_dict_size=3, trg_dict_size=3,
+                                   data_file=str(path))
+    max_all = max(max(s.tolist() + t.tolist()) for s, t in r_all())
+    max_cap = max(max(s.tolist() + t.tolist()) for s, t in r_cap())
+    assert max_cap <= max_all
+    assert max_cap <= 3      # ids clamped into the capped vocab (+specials)
