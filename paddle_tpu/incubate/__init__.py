@@ -11,6 +11,8 @@ from . import autograd
 from . import optimizer
 from . import autotune
 from . import checkpoint
+from . import distributed
+from . import tensor
 
 __all__ = ["nn", "asp", "operators"]
 

@@ -47,3 +47,5 @@ def image_load(path: str, backend=None):
 
 
 __all__ += ["get_image_backend", "set_image_backend", "image_load"]
+
+from . import image  # paddle.vision.image module path

@@ -109,7 +109,12 @@ def test_module_path_aliases():
     assert pt.utils.install_check.run_check.__name__ == "run_check"
     from paddle_tpu.vision import image as vimage
     assert vimage.image_load.__name__ == "image_load"
+    assert pt.vision.image.image_load is vimage.image_load
     assert pt.incubate.checkpoint.TrainEpochRange.__name__ == "TrainEpochRange"
+    # the reference MoE recipe import path
+    assert pt.incubate.distributed.models.moe.MoELayer is \
+        pt.distributed.models.moe.MoELayer
+    assert pt.incubate.tensor.math.segment_sum.__name__ == "segment_sum"
 
 
 def test_nn_quant_functional_layers():
