@@ -132,6 +132,37 @@ def test_eos_retires_slot_early(model):
     np.testing.assert_array_equal(out[rid], ref[:4])
 
 
+def test_random_load_property(model):
+    """Property test: a random interleaving of submits and steps over a
+    tight pool (forced preemptions) still produces exact greedy outputs
+    for every request, and the allocator ends balanced."""
+    rs = np.random.RandomState(11)
+    vocab = model.cfg.vocab_size
+    eng = ContinuousBatchingEngine(
+        model, max_batch=3, page_size=PAGE, max_len=8 * PAGE, num_pages=7,
+        generation_config=GenerationConfig(max_new_tokens=PAGE + 3,
+                                           do_sample=False))
+    free0 = eng.stats()["free_pages"]
+    expected, outputs = {}, {}
+    pending = 7
+    while pending or eng.has_work():
+        if pending and (rs.rand() < 0.4 or not eng.has_work()):
+            n = int(rs.randint(2, 2 * PAGE))
+            p = _mk_prompt(rs, n, vocab)
+            rid = eng.submit(p)
+            expected[rid] = (p, PAGE + 3)
+            pending -= 1
+        else:
+            for rid, tok in eng.step():
+                outputs.setdefault(rid, []).append(tok)
+    for rid, (p, n) in expected.items():
+        np.testing.assert_array_equal(
+            np.asarray(outputs[rid], np.int32), _ref_greedy(model, p, n),
+            err_msg=f"rid={rid} len={len(p)} preempt={eng.preemptions}")
+    assert eng.preemptions >= 1      # the tight pool must exercise eviction
+    assert eng.stats()["free_pages"] == free0
+
+
 def test_rejects_overlong_request(model):
     eng = ContinuousBatchingEngine(
         model, max_batch=1, page_size=PAGE, max_len=16,

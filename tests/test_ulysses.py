@@ -104,6 +104,31 @@ def test_ulysses_rejects_indivisible_heads():
             ulysses_attention(q, k, v)
 
 
+def test_ulysses_hlo_uses_all_to_all():
+    """The compiled program moves heads with all-to-all — not an
+    all-gather of the full sequence (the memory win Ulysses exists for)."""
+    rs = np.random.RandomState(6)
+    b, s, h, d = 1, 64, 8, 16
+    q, k, v = _rand_qkv(rs, b, s, h, h, d)
+    with HybridMesh.build(sep=8):
+        fn = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal=True))
+        hlo = fn.lower(q, k, v).compile().as_text()
+    assert "all-to-all" in hlo
+    # no [b, s, h, d] full-tensor all-gather: the only gather-like shape
+    # allowed is the a2a result [b, s, h/n, d]
+    assert "all-gather" not in hlo or f"[{b},{s},{h},{d}]" not in hlo
+
+
+def test_ring_hlo_uses_collective_permute():
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    rs = np.random.RandomState(7)
+    q, k, v = _rand_qkv(rs, 1, 64, 2, 2, 16)
+    with HybridMesh.build(sep=8):
+        fn = jax.jit(lambda q, k, v: ring_attention(q, k, v, causal=True))
+        hlo = fn.lower(q, k, v).compile().as_text()
+    assert "collective-permute" in hlo
+
+
 def test_llama_sp_mode_ulysses_matches_ring():
     """The flagship model produces the same logits under both SP modes."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
