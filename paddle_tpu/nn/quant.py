@@ -7,6 +7,8 @@ layers for quant graph capture) are provided as thin Layer shims."""
 import jax.numpy as jnp
 
 from .layer import Layer
+from .quantized_linear import (weight_quantize, weight_dequantize,
+                               weight_only_linear, llm_int8_linear)
 from ..quantization import (QAT, PTQ, QuantConfig, quanter,
                             BaseQuanter, BaseObserver)
 
@@ -48,4 +50,5 @@ transpose = _functional(jnp.transpose)
 __all__ = ["QAT", "PTQ", "QuantConfig", "quanter", "BaseQuanter",
            "BaseObserver", "FloatFunctionalLayer", "add", "subtract",
            "multiply", "divide", "matmul", "reshape", "flatten", "concat",
-           "transpose"]
+           "transpose", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear"]

@@ -1,0 +1,170 @@
+"""Weight-only / LLM.int8 quantized linear tests (reference contracts:
+nn/quant/quantized_linear.py — transposed int8 weights, per-channel or
+group scales, int4 nibble packing, outlier decomposition)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.quant import (llm_int8_linear, weight_dequantize,
+                                 weight_only_linear, weight_quantize)
+
+K, N = 64, 32
+
+
+def _w(seed=0, k=K, n=N):
+    return jnp.asarray(np.random.RandomState(seed).randn(k, n)
+                       .astype(np.float32) * 0.1)
+
+
+def test_weight_quantize_contract_shapes():
+    w = _w()
+    q, scale = weight_quantize(w, algo="weight_only_int8")
+    assert q.shape == (N, K) and q.dtype == jnp.int8     # transposed
+    assert scale.shape == (N,) and scale.dtype == jnp.float32
+    q4, scale4 = weight_quantize(w, algo="weight_only_int4")
+    assert q4.shape == (N, K // 2)                       # packed nibbles
+    qg, sg = weight_quantize(w, group_size=64)
+    assert sg.shape == (K // 64, N)
+
+
+def test_quantize_dequantize_roundtrip_error():
+    w = _w()
+    # max roundtrip error is half a quantization step: amax/(2*qmax)
+    amax = float(jnp.max(jnp.abs(w)))
+    for algo, qmax in (("weight_only_int8", 127), ("weight_only_int4", 7)):
+        q, s = weight_quantize(w, algo=algo)
+        back = weight_dequantize(q, s, algo=algo, out_dtype="float32")
+        assert back.shape == w.shape
+        err = float(jnp.max(jnp.abs(back - w)))
+        assert err <= amax / qmax, (algo, err)    # one step, comfortably
+
+
+def test_group_wise_beats_or_matches_per_channel():
+    # one outlier row inflates the per-channel scale; group-wise isolates it
+    w = np.random.RandomState(1).randn(128, 8).astype(np.float32) * 0.1
+    w[0, :] = 5.0
+    w = jnp.asarray(w)
+    q1, s1 = weight_quantize(w)
+    qg, sg = weight_quantize(w, group_size=64)
+    e1 = float(jnp.mean(jnp.abs(
+        weight_dequantize(q1, s1, out_dtype="float32") - w)))
+    eg = float(jnp.mean(jnp.abs(
+        weight_dequantize(qg, sg, group_size=64, out_dtype="float32") - w)))
+    assert eg <= e1 + 1e-6
+
+
+@pytest.mark.parametrize("wdtype", ["int8", "int4"])
+def test_weight_only_linear_close_to_dense(wdtype):
+    rs = np.random.RandomState(2)
+    w = _w(2)
+    x = jnp.asarray(rs.randn(4, K).astype(np.float32))
+    bias = jnp.asarray(rs.randn(N).astype(np.float32))
+    ref = x @ w + bias
+    algo = f"weight_only_{wdtype}"
+    q, s = weight_quantize(w, algo=algo)
+    out = weight_only_linear(x, q, bias=bias, weight_scale=s,
+                             weight_dtype=wdtype)
+    # error accumulates over k terms: bound relative to the output scale
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < (0.02 if wdtype == "int8" else 0.15), rel
+
+
+def test_weight_only_linear_group_size():
+    rs = np.random.RandomState(3)
+    w = _w(3, k=128)
+    x = jnp.asarray(rs.randn(2, 128).astype(np.float32))
+    q, s = weight_quantize(w, group_size=64)
+    out = weight_only_linear(x, q, weight_scale=s, group_size=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               atol=0.02)
+
+
+def test_weight_only_linear_batched_input():
+    w = _w(4)
+    x = jnp.asarray(np.random.RandomState(4).randn(2, 3, K)
+                    .astype(np.float32))
+    q, s = weight_quantize(w)
+    out = weight_only_linear(x, q, weight_scale=s)
+    assert out.shape == (2, 3, N)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               atol=0.02)
+
+
+def test_llm_int8_linear_with_outliers():
+    """Columns driven past the threshold go through the fp path — overall
+    error stays small even with activation outliers (the LLM.int8 claim)."""
+    rs = np.random.RandomState(5)
+    w = _w(5)
+    x = rs.randn(4, K).astype(np.float32)
+    x[:, 7] *= 40.0                    # strong outlier channel
+    x[:, 21] *= 25.0
+    x = jnp.asarray(x)
+    q, s = weight_quantize(w, algo="llm.int8")
+    ref = x @ w
+    out = llm_int8_linear(x, q, weight_scale=s, threshold=6.0)
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.02, rel
+    # and the int8 path really is int8: same call jitted emits an s32 dot
+    hlo = jax.jit(lambda x: llm_int8_linear(x, q, weight_scale=s)) \
+        .lower(x).compile().as_text()
+    assert "s32" in hlo and "s8" in hlo
+
+
+def test_llm_int8_no_outliers_matches_plain_quant():
+    rs = np.random.RandomState(6)
+    w = _w(6)
+    x = jnp.asarray(rs.randn(4, K).astype(np.float32))
+    q, s = weight_quantize(w, algo="llm.int8")
+    out = llm_int8_linear(x, q, weight_scale=s, threshold=1e9)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               atol=0.03)
+
+
+def test_llm_int8_calibrated_outlier_indices():
+    """The serving shape: concrete outlier indices -> static-slice fp path;
+    matches the threshold path's math."""
+    rs = np.random.RandomState(7)
+    w = _w(7)
+    x = rs.randn(4, K).astype(np.float32)
+    x[:, 3] *= 30.0
+    x = jnp.asarray(x)
+    q, s = weight_quantize(w, algo="llm.int8")
+    ref = x @ w
+    out = llm_int8_linear(x, q, weight_scale=s, outlier_indices=[3])
+    rel = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert rel < 0.02, rel
+    # the fp matmul in the compiled program is the SMALL [.., 1] slice
+    hlo = jax.jit(lambda x: llm_int8_linear(
+        x, q, weight_scale=s, outlier_indices=[3])).lower(x) \
+        .compile().as_text()
+    assert "s32" in hlo and "s8" in hlo
+
+
+def test_validation_errors():
+    w = _w()
+    with pytest.raises(ValueError, match="algo"):
+        weight_quantize(w, algo="int3")
+    with pytest.raises(ValueError, match="group_size"):
+        weight_quantize(w, group_size=32)
+    with pytest.raises(ValueError, match="rank-2"):
+        weight_quantize(jnp.zeros((2, 3, 4)))
+    with pytest.raises(ValueError, match="weight_dtype"):
+        weight_only_linear(jnp.zeros((1, K)), jnp.zeros((N, K), jnp.int8),
+                           weight_dtype="int2")
+    with pytest.raises(ValueError, match="even"):
+        weight_quantize(jnp.zeros((63, 4)), algo="weight_only_int4")
+    with pytest.raises(ValueError, match="per-channel"):
+        weight_quantize(w, algo="llm.int8", group_size=64)
+    # group_size consistency between quantize and linear
+    q, sg = weight_quantize(_w(8, k=128), group_size=64)
+    with pytest.raises(ValueError, match="mismatch"):
+        weight_only_linear(jnp.zeros((1, 128)), q, weight_scale=sg,
+                           group_size=128)
+    with pytest.raises(ValueError, match="group_size"):
+        weight_only_linear(jnp.zeros((1, 128)), q, weight_scale=sg)
+    q1, s1 = weight_quantize(w)
+    with pytest.raises(ValueError, match="per-channel"):
+        weight_only_linear(jnp.zeros((1, K)), q1, weight_scale=s1,
+                           group_size=64)
