@@ -24,7 +24,9 @@ class Linear(Layer):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
-        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else I.XavierUniform()
+        # None -> create_parameter's chain: global initializer if set
+        # (set_global_initializer), else XavierUniform
+        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else None
         self.weight = self.create_parameter([in_features, out_features],
                                             dtype=dtype, initializer=init_w)
         if bias_attr is not False:
@@ -47,8 +49,9 @@ class Embedding(Layer):
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
-        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else I.Normal(0.0, 1.0)
+        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else None
         self.weight = self.create_parameter([num_embeddings, embedding_dim],
+                                            default_initializer=I.Normal(0.0, 1.0),
                                             dtype=dtype, initializer=init_w)
 
     def forward(self, ids):
@@ -187,10 +190,11 @@ class Conv2D(Layer):
         bound = 1.0 / math.sqrt(fan_in)
         self.weight = self.create_parameter(
             [out_channels, in_channels // groups, k[0], k[1]], dtype=dtype,
-            initializer=I.KaimingUniform())
+            default_initializer=I.KaimingUniform())
         if bias_attr is not False:
-            self.bias = self.create_parameter([out_channels], dtype=dtype,
-                                              initializer=I.Uniform(-bound, bound))
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
         else:
             self.add_parameter("bias", None)
 
@@ -210,7 +214,7 @@ class Conv2DTranspose(Layer):
         self.data_format = data_format
         self.weight = self.create_parameter(
             [in_channels, out_channels // groups, k[0], k[1]], dtype=dtype,
-            initializer=I.KaimingUniform())
+            default_initializer=I.KaimingUniform())
         if bias_attr is not False:
             self.bias = self.create_parameter([out_channels], dtype=dtype, is_bias=True)
         else:
@@ -468,10 +472,11 @@ class Conv1D(Layer):
         bound = 1.0 / math.sqrt(fan_in)
         self.weight = self.create_parameter(
             [out_channels, in_channels // groups, k], dtype=dtype,
-            initializer=I.KaimingUniform())
+            default_initializer=I.KaimingUniform())
         if bias_attr is not False:
             self.bias = self.create_parameter(
-                [out_channels], dtype=dtype, initializer=I.Uniform(-bound, bound))
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
         else:
             self.add_parameter("bias", None)
 
@@ -496,10 +501,11 @@ class Conv3D(Layer):
         bound = 1.0 / math.sqrt(fan_in)
         self.weight = self.create_parameter(
             [out_channels, in_channels // groups, *k], dtype=dtype,
-            initializer=I.KaimingUniform())
+            default_initializer=I.KaimingUniform())
         if bias_attr is not False:
             self.bias = self.create_parameter(
-                [out_channels], dtype=dtype, initializer=I.Uniform(-bound, bound))
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
         else:
             self.add_parameter("bias", None)
 

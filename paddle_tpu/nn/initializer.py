@@ -135,3 +135,105 @@ class KaimingNormal(Initializer):
         std = gain / math.sqrt(fan_in)
         x = jax.random.normal(_key(), shape, dtype=jnp.float32) * std
         return x.astype(dtype)
+
+
+class Orthogonal(Initializer):
+    """(Semi-)orthogonal matrix init via QR of a gaussian (reference:
+    nn/initializer/orthogonal.py; Saxe et al. 2013). For rank>2 the
+    trailing dims are flattened."""
+
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal needs at least 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        flat = (max(rows, cols), min(rows, cols))
+        a = jax.random.normal(_key(), flat, jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        # sign correction makes the distribution uniform over O(n)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference: nn/initializer/dirac.py):
+    within each group, out-channel j passes through in-channel j at the
+    spatial center for j < min(out_c/groups, in_c); remaining out-channels
+    stay zero. Requires a 3-5D shape [out, in, *spatial]."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        if not 3 <= len(shape) <= 5:
+            raise ValueError(f"Dirac needs a 3-5D conv weight, got {shape}")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups:
+            raise ValueError("out_channels must divide by groups")
+        w = np.zeros(shape, np.float32)
+        per = out_c // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for j in range(min(per, in_c)):
+                w[(g * per + j, j) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for transposed conv (reference:
+    nn/initializer/Bilinear): each spatial tap gets the separable linear
+    interpolation weight."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear needs a 4D conv weight, got {shape}")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy = 1 - np.abs(np.arange(kh) / fh - ch)
+        xx = 1 - np.abs(np.arange(kw) / fw - cw)
+        tap = np.outer(yy, xx).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for o in range(shape[0]):
+            for i in range(shape[1]):
+                w[o, i] = tap
+        return jnp.asarray(w, dtype)
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Recommended init gain per nonlinearity (reference:
+    nn/initializer/initializer.py calculate_gain)."""
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "conv1d_transpose": 1.0,
+             "conv2d_transpose": 1.0, "conv3d_transpose": 1.0,
+             "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None
+                                                 else 0.01) ** 2)),
+             "selu": 3.0 / 4.0}
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}; "
+                         f"one of {sorted(gains)}")
+    return gains[nonlinearity]
+
+
+_GLOBAL_INIT = [None, None]          # [weight_init, bias_init]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default parameter initializers framework-wide
+    (reference: nn/initializer/__init__.py set_global_initializer; pass
+    None, None to reset). Layer.create_parameter consults this."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def _global_default(is_bias: bool):
+    return _GLOBAL_INIT[1] if is_bias else _GLOBAL_INIT[0]

@@ -110,12 +110,21 @@ class Layer:
 
     def create_parameter(self, shape, dtype=None, initializer=None,
                          trainable: bool = True, is_bias: bool = False,
-                         sharding: Optional[Tuple] = None) -> Parameter:
+                         sharding: Optional[Tuple] = None,
+                         default_initializer=None) -> Parameter:
         """Create (but not yet attach) a Parameter. Assign it to an attribute
         to register it, mirroring the reference's create_parameter +
-        add_parameter flow (python/paddle/nn/layer/layers.py)."""
+        add_parameter flow (python/paddle/nn/layer/layers.py).
+
+        Precedence: ``initializer`` (user/model-explicit, wins always) >
+        the set_global_initializer override > ``default_initializer``
+        (the layer's curated default) > Xavier/zeros."""
         from . import initializer as init_mod
         dtype = _dtype_mod.convert_dtype(dtype) if dtype is not None else _default_dtype
+        if initializer is None:
+            initializer = init_mod._global_default(is_bias)
+        if initializer is None:
+            initializer = default_initializer
         if initializer is None:
             initializer = init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
         value = initializer(shape, dtype)

@@ -52,3 +52,26 @@ __all__ = ["QAT", "PTQ", "QuantConfig", "quanter", "BaseQuanter",
            "multiply", "divide", "matmul", "reshape", "flatten", "concat",
            "transpose", "weight_quantize", "weight_dequantize",
            "weight_only_linear", "llm_int8_linear"]
+
+
+class Stub(Layer):
+    """Observer placeholder (reference: nn/quant/stub.py): identity in the
+    float graph. An explicit ``observer`` quanter is invoked in-place so
+    the site calibrates during PTQ/QAT passes that run the float model;
+    without one the Stub marks the site and passes through."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None:
+            observe = getattr(self._observer, "observe", None)
+            if observe is not None:
+                observe(x)           # calibration side channel; x unchanged
+            else:
+                return self._observer(x)   # quanter: fake-quant in place
+        return x
+
+
+__all__.append("Stub")

@@ -27,7 +27,11 @@ class TestNamespaceParity:
                   "geometric", "distribution", "text", "audio", "onnx",
                   "quantization", "device", "profiler", "vision.ops",
                   "vision.transforms", "vision.models", "utils", "signal",
-                  "callbacks", "hub", "regularizer", "sysconfig"]
+                  "callbacks", "hub", "regularizer", "sysconfig",
+                  "nn.utils", "nn.quant", "nn.initializer",
+                  "incubate.autograd", "incubate.optimizer",
+                  "incubate.optimizer.functional", "utils.unique_name",
+                  "utils.dlpack"]
 
     @staticmethod
     def _ref_all(name):

@@ -219,3 +219,30 @@ def test_generate_paged_page_boundary():
         dense = generate_scan(m, ids, gc)
         paged = generate_paged(m, ids, gc, page_size=8)
         np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+
+def test_generation_under_tp_mesh_matches_single_device():
+    """Sharded serving: generate_scan and generate_paged under a tp=4 mesh
+    (params GSPMD-sharded, KV caches/pools propagated) emit exactly the
+    single-device tokens."""
+    import jax
+    from paddle_tpu.inference.generation import (GenerationConfig,
+                                                 generate_paged,
+                                                 generate_scan)
+    from paddle_tpu.parallel import HybridMesh, shard_layer
+
+    pt.seed(0)
+    ref_model = LlamaForCausalLM(LlamaConfig.tiny())
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, ref_model.cfg.vocab_size, (2, 12)))
+    gc = GenerationConfig(max_new_tokens=8, do_sample=False)
+    ref = np.asarray(generate_scan(ref_model, ids, gc))
+
+    pt.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    with HybridMesh.build(tp=4, devices=jax.devices()[:4]):
+        shard_layer(m)
+        np.testing.assert_array_equal(np.asarray(generate_scan(m, ids, gc)),
+                                      ref)
+        np.testing.assert_array_equal(
+            np.asarray(generate_paged(m, ids, gc, page_size=8)), ref)

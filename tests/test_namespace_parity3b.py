@@ -126,6 +126,12 @@ def test_nn_quant_functional_layers():
     assert fl(x, start_axis=1).shape == (2, 60)
     assert fl(x, start_axis=1, stop_axis=2).shape == (2, 12, 5)
     assert fl(x).shape == (120,)
+    # Stub: identity passthrough that feeds its observer
+    from paddle_tpu.quantization import AbsmaxObserver
+    obs = AbsmaxObserver()
+    out = nn.quant.Stub(obs)(jnp.asarray([-3.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out), [-3.0, 2.0])
+    assert obs._absmax == 3.0
 
 
 def test_distributed_passes_facade():
@@ -164,6 +170,43 @@ def test_incubate_autotune_config():
     assert autotune.get_config()["kernel"]["enable"] is True
     with pytest.raises(ValueError, match="unknown autotune domain"):
         autotune.set_config({"nope": True})
+
+
+def test_initializer_orthogonal_dirac_bilinear_gain():
+    I = pt.nn.initializer
+    q = I.Orthogonal()((6, 6), jnp.float32)
+    np.testing.assert_allclose(np.asarray(q @ q.T), np.eye(6), atol=1e-5)
+    # wide: rows orthonormal
+    q2 = I.Orthogonal(gain=2.0)((3, 9), jnp.float32)
+    np.testing.assert_allclose(np.asarray(q2 @ q2.T), 4 * np.eye(3),
+                               atol=1e-4)
+    d = np.asarray(I.Dirac()((4, 4, 3, 3), jnp.float32))
+    for c in range(4):
+        assert d[c, c, 1, 1] == 1.0 and d.sum() == 4.0
+    # out_c > in_c: extra out-channels stay ZERO (reference dirac_)
+    d2 = np.asarray(I.Dirac()((4, 2, 3, 3), jnp.float32))
+    assert d2.sum() == 2.0 and d2[2:].sum() == 0.0
+    # grouped: each group routes its own leading in-channels
+    d3 = np.asarray(I.Dirac(groups=2)((4, 2, 3, 3), jnp.float32))
+    assert d3.sum() == 4.0 and d3[2, 0, 1, 1] == 1.0
+    b = np.asarray(I.Bilinear()((1, 1, 4, 4), jnp.float32))
+    assert b[0, 0, 2, 2] == b.max()            # center tap dominates
+    assert abs(pt.nn.initializer.calculate_gain("tanh") - 5 / 3) < 1e-9
+    with pytest.raises(ValueError, match="nonlinearity"):
+        I.calculate_gain("nope")
+
+
+def test_set_global_initializer_scopes_defaults():
+    I = pt.nn.initializer
+    from paddle_tpu import nn as _nn
+    try:
+        I.set_global_initializer(I.Constant(2.5), I.Constant(0.5))
+        lin = _nn.Linear(3, 2)
+        assert float(lin.weight[0, 0]) == 2.5 and float(lin.bias[0]) == 0.5
+    finally:
+        I.set_global_initializer(None, None)
+    lin2 = _nn.Linear(3, 2)
+    assert float(lin2.weight[0, 0]) != 2.5     # default restored
 
 
 # -- functional minimizers --------------------------------------------------
