@@ -1,5 +1,14 @@
 """paddle_tpu.incubate.nn (reference: python/paddle/incubate/nn/)."""
 
 from . import functional
+from . import layer
+from .layer import (FusedLinear, FusedDropout, FusedDropoutAdd,
+                    FusedBiasDropoutResidualLayerNorm,
+                    FusedMultiHeadAttention, FusedFeedForward,
+                    FusedTransformerEncoderLayer, FusedMultiTransformer,
+                    FusedEcMoe)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedDropout", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe"]

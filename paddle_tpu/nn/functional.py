@@ -134,13 +134,32 @@ def one_hot(x, num_classes: int, dtype=jnp.float32):
 # fleet/layers/mpu/random.py — "local" stream for TP regions)
 # ---------------------------------------------------------------------------
 
-def dropout(x, p: float = 0.5, training: bool = True, mode: str = "upscale_in_train",
-            rng_name: str = GLOBAL_STREAM):
+def dropout(x, p: float = 0.5, axis=None, training: bool = True,
+            mode: str = "upscale_in_train", rng_name: str = GLOBAL_STREAM):
+    """``axis`` (reference: functional/common.py dropout): the mask is
+    drawn only along the listed axes and broadcast over the rest (e.g.
+    axis=0 drops whole rows). ``downscale_in_infer`` keeps train outputs
+    unscaled and multiplies by (1-p) at inference."""
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"mode must be 'upscale_in_train'|"
+                         f"'downscale_in_infer', got {mode!r}")
+    keep = 1.0 - p
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return (x * keep).astype(x.dtype)
         return x
     key = rng_tracker().next_key(rng_name)
-    keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, x.shape)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(a + x.ndim if a < 0 else a for a in axes)
+        if any(a < 0 or a >= x.ndim for a in axes):
+            raise ValueError(f"dropout axis {axis} out of range for "
+                             f"rank-{x.ndim} input")
+        mask_shape = tuple(s if i in axes else 1
+                           for i, s in enumerate(x.shape))
+    mask = jax.random.bernoulli(key, keep, mask_shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
     return jnp.where(mask, x, 0.0).astype(x.dtype)

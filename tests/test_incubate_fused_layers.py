@@ -1,0 +1,208 @@
+"""incubate.nn fused layer classes: parity with the unfused compositions
+(eval mode; dropout off) and shape/contract checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.incubate.nn import (FusedBiasDropoutResidualLayerNorm,
+                                    FusedDropout, FusedDropoutAdd, FusedEcMoe,
+                                    FusedFeedForward, FusedLinear,
+                                    FusedMultiHeadAttention,
+                                    FusedMultiTransformer,
+                                    FusedTransformerEncoderLayer)
+
+D, H, FF = 32, 4, 64
+
+
+def _x(b=2, s=8, d=D, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(b, s, d)
+                       .astype(np.float32))
+
+
+def test_fused_linear_matches_linear():
+    pt.seed(0)
+    fl = FusedLinear(16, 8)
+    x = _x(2, 4, 16)
+    ref = x @ fl.weight + fl.bias
+    np.testing.assert_allclose(np.asarray(fl(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # transposed storage
+    flt = FusedLinear(16, 8, transpose_weight=True)
+    assert flt.weight.shape == (8, 16)
+    out = flt(x)
+    assert out.shape == (2, 4, 8)
+
+
+def test_fused_dropout_layers():
+    pt.seed(0)
+    x = _x()
+    d = FusedDropout(p=0.5)
+    d.eval()
+    np.testing.assert_array_equal(np.asarray(d(x)), np.asarray(x))
+    d.train()
+    y = np.asarray(d(x))
+    assert (y == 0).any()
+    # axis-shared mask: whole rows drop together
+    da = FusedDropout(p=0.5, axis=0)
+    da.train()
+    m = np.asarray(da(jnp.ones((8, 16)))) != 0
+    assert all(row.all() or (~row).all() for row in m)
+
+    add = FusedDropoutAdd(p=0.5)
+    add.eval()
+    np.testing.assert_allclose(np.asarray(add(x, 2 * x)), np.asarray(3 * x),
+                               rtol=1e-6)
+
+
+def test_fused_bias_dropout_residual_ln():
+    pt.seed(0)
+    layer = FusedBiasDropoutResidualLayerNorm(D, dropout_rate=0.3)
+    layer.eval()
+    x, res = _x(seed=1), _x(seed=2)
+    ref = nn.functional.layer_norm(
+        res + x + layer.linear_bias, weight=layer.ln_scale,
+        bias=layer.ln_bias, epsilon=layer.epsilon)
+    np.testing.assert_allclose(np.asarray(layer(x, res)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("normalize_before", [False, True])
+def test_fused_mha_matches_unfused_composition(normalize_before):
+    pt.seed(0)
+    mha = FusedMultiHeadAttention(D, H, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0,
+                                  normalize_before=normalize_before)
+    mha.eval()
+    x = _x()
+    out = mha(x)
+    assert out.shape == x.shape
+
+    # manual composition with the same parameters
+    h = x
+    if normalize_before:
+        h = nn.functional.layer_norm(h, weight=mha.pre_ln_scale,
+                                     bias=mha.pre_ln_bias, epsilon=1e-5)
+    qkv = jnp.einsum("bse,thde->bsthd", h, mha.qkv_weight) + mha.qkv_bias
+    q, k, v = (qkv[:, :, i] for i in range(3))
+    a = nn.functional.scaled_dot_product_attention(q, k, v)
+    a = a.reshape(*x.shape[:2], D) @ mha.linear_weight + mha.linear_bias
+    ref = x + a
+    if not normalize_before:
+        ref = nn.functional.layer_norm(ref, weight=mha.ln_scale,
+                                       bias=mha.ln_bias, epsilon=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_mha_rejects_cross_attention_and_weights():
+    with pytest.raises(ValueError, match="self-attention"):
+        FusedMultiHeadAttention(D, H, kdim=16)
+    with pytest.raises(ValueError, match="need_weights"):
+        FusedMultiHeadAttention(D, H, need_weights=True)
+
+
+@pytest.mark.parametrize("normalize_before", [False, True])
+def test_fused_ffn_matches_unfused(normalize_before):
+    pt.seed(0)
+    ffn = FusedFeedForward(D, FF, dropout_rate=0.0, activation="gelu",
+                           normalize_before=normalize_before)
+    ffn.eval()
+    x = _x(seed=3)
+    out = ffn(x)
+    h = x
+    if normalize_before:
+        h = nn.functional.layer_norm(h, weight=ffn.ln_scale,
+                                     bias=ffn.ln_bias, epsilon=1e-5)
+    # fused_bias_act uses tanh-approximate gelu (the fused-kernel variant)
+    y = nn.functional.gelu(h @ ffn.linear1_weight + ffn.linear1_bias,
+                           approximate=True)
+    y = y @ ffn.linear2_weight + ffn.linear2_bias
+    ref = x + y
+    if not normalize_before:
+        ref = nn.functional.layer_norm(ref, weight=ffn.ln_scale,
+                                       bias=ffn.ln_bias, epsilon=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_encoder_layer_trains():
+    pt.seed(0)
+    layer = FusedTransformerEncoderLayer(D, H, FF, dropout_rate=0.1)
+    x = _x()
+    params = layer.raw_parameters()
+
+    def loss(p):
+        return jnp.sum(layer.functional_call(p, x) ** 2)
+
+    l, g = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l))
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+def test_fused_multi_transformer_causal():
+    pt.seed(0)
+    mt = FusedMultiTransformer(D, H, FF, num_layers=2)
+    mt.eval()
+    x = _x()
+    out = mt(x)
+    assert out.shape == x.shape
+    # causal: output at position t must not depend on positions > t
+    x2 = x.at[:, -1].set(0.0)
+    out2 = mt(x2)
+    np.testing.assert_allclose(np.asarray(out[:, :-1]),
+                               np.asarray(out2[:, :-1]),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(NotImplementedError, match="decode"):
+        mt(x, caches=[None])
+
+
+def test_fused_ec_moe_matches_loop():
+    pt.seed(0)
+    moe = FusedEcMoe(16, 32, num_experts=4, act_type="gelu")
+    moe.eval()
+    x = _x(1, 4, 16, seed=4)
+    gate = jnp.asarray(np.random.RandomState(5).randn(1, 4, 4)
+                       .astype(np.float32))
+    out = moe(x, gate)
+    probs = np.asarray(jax.nn.softmax(gate, axis=-1))
+    ref = np.zeros_like(np.asarray(x))
+    for e in range(4):
+        h = np.asarray(x) @ np.asarray(moe.bmm_weight0)[e] \
+            + np.asarray(moe.bmm_bias0)[e]
+        h = np.asarray(nn.functional.gelu(jnp.asarray(h)))
+        y = h @ np.asarray(moe.bmm_weight1)[e] + np.asarray(moe.bmm_bias1)[e]
+        ref += probs[..., e:e + 1] * y
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_mode_and_axis_validation():
+    F = nn.functional
+    x = jnp.ones((4, 4))
+    with pytest.raises(ValueError, match="mode"):
+        F.dropout(x, 0.5, mode="upscale")          # typo must raise
+    with pytest.raises(ValueError, match="out of range"):
+        F.dropout(x, 0.5, axis=2)
+    # negative axis normalizes
+    pt.seed(0)
+    m = np.asarray(F.dropout(x, 0.5, axis=-1)) != 0
+    assert all(col.all() or (~col).all() for col in m.T)
+    # downscale_in_infer: unscaled at train, scaled by (1-p) at eval
+    pt.seed(0)
+    y = np.asarray(F.dropout(x, 0.5, mode="downscale_in_infer"))
+    assert set(np.unique(y)) <= {0.0, 1.0}
+    ye = np.asarray(F.dropout(x, 0.5, training=False,
+                              mode="downscale_in_infer"))
+    np.testing.assert_allclose(ye, 0.5 * np.asarray(x))
+
+
+def test_fused_layers_reject_cache():
+    pt.seed(0)
+    x = _x()
+    with pytest.raises(NotImplementedError, match="decode"):
+        FusedMultiHeadAttention(D, H)(x, cache=object())
+    with pytest.raises(NotImplementedError, match="decode"):
+        FusedFeedForward(D, FF)(x, cache=object())
