@@ -2,6 +2,7 @@
 
 from . import functional
 from . import layer
+from . import attn_bias
 from .layer import (FusedLinear, FusedDropout, FusedDropoutAdd,
                     FusedBiasDropoutResidualLayerNorm,
                     FusedMultiHeadAttention, FusedFeedForward,

@@ -275,12 +275,54 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p: float = 0.0
                                scale: Optional[float] = None,
                                training: bool = True):
     """reference: incubate/nn/memory_efficient_attention.py — on TPU the
-    flash-attention path IS the memory-efficient path."""
+    flash-attention path IS the memory-efficient path.
+
+    ``attn_bias`` accepts the attn_bias.AttentionBias hierarchy and routes
+    each structure to its cheapest form: LowerTriangular -> the kernel's
+    causal flag; BlockDiagonal(Causal) -> SEGMENT IDS (packed varlen, no
+    dense bias in HBM); anything else materializes a dense additive bias
+    exactly like the reference."""
     from ....ops.attention import flash_attention
-    out = flash_attention(query, key, value, attn_mask=attn_bias,
-                          dropout_p=p if training else 0.0, causal=False,
-                          scale=scale)
-    return out
+    from ..attn_bias import (AttentionBias, BlockDiagonalMask,
+                             LowerTriangularMask,
+                             LowerTriangularMaskWithTensorBias)
+    causal = False
+    segment_ids = None
+    dropout_p = p if training else 0.0
+    if isinstance(attn_bias, AttentionBias):
+        if isinstance(attn_bias, BlockDiagonalMask) and (
+                not attn_bias.causal
+                or attn_bias.q_seqinfo is attn_bias.k_seqinfo):
+            # causal blocks need aligned q/k layouts for the kernel's global
+            # causal mask to equal the per-block triangles; unequal layouts
+            # fall through to the dense materialization below
+            segment_ids = attn_bias.to_segment_ids()
+            q_seg, kv_seg = segment_ids
+            segment_ids = (jnp.broadcast_to(q_seg, (query.shape[0],
+                                                    query.shape[1])),
+                           jnp.broadcast_to(kv_seg, (key.shape[0],
+                                                     key.shape[1])))
+            causal = attn_bias.causal
+            attn_bias = None
+        elif type(attn_bias) is LowerTriangularMask and \
+                query.shape[1] == key.shape[1]:
+            # the kernel's causal flag is bottom-right aligned (FA
+            # convention); the mask's own semantics are TOP-LEFT triu —
+            # identical only for square shapes, so rectangular falls
+            # through to the dense materialization
+            causal = True
+            attn_bias = None
+        elif isinstance(attn_bias, LowerTriangularMaskWithTensorBias) and \
+                query.shape[1] == key.shape[1]:
+            causal = True
+            attn_bias = jnp.asarray(attn_bias._bias)
+        else:
+            attn_bias = attn_bias.materialize(
+                (query.shape[0], 1, query.shape[1], key.shape[1]),
+                dtype=jnp.float32)
+    return flash_attention(query, key, value, attn_mask=attn_bias,
+                           dropout_p=dropout_p, causal=causal, scale=scale,
+                           segment_ids=segment_ids)
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens,

@@ -36,9 +36,23 @@ def _expand_kv(k, heads):
 
 @register_kernel("flash_attention", "any")
 def _sdpa_xla(q, k, v, attn_mask=None, dropout_p: float = 0.0, causal: bool = False,
-              scale: Optional[float] = None):
+              scale: Optional[float] = None, segment_ids=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if segment_ids is not None:
+        # packed-varlen masking (the flash kernel's native form): equal-id
+        # positions attend; fold into the boolean mask for the XLA path
+        q_seg, kv_seg = (segment_ids if isinstance(segment_ids, (tuple, list))
+                         else (segment_ids, segment_ids))
+        seg = (jnp.asarray(q_seg)[:, :, None]
+               == jnp.asarray(kv_seg)[:, None, :])[:, None]   # [b,1,sq,sk]
+        if attn_mask is None:
+            attn_mask = seg
+        elif attn_mask.dtype == jnp.bool_:
+            attn_mask = attn_mask & seg
+        else:
+            attn_mask = attn_mask + jnp.where(seg, 0.0, -jnp.inf).astype(
+                attn_mask.dtype)
     k = _expand_kv(k, h)
     v = _expand_kv(v, h)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -67,7 +81,8 @@ def _sdpa_xla(q, k, v, attn_mask=None, dropout_p: float = 0.0, causal: bool = Fa
 
 
 def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
-                    causal: bool = False, scale: Optional[float] = None):
+                    causal: bool = False, scale: Optional[float] = None,
+                    segment_ids=None):
     impl = dispatch("flash_attention")
     return impl(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, causal=causal,
-                scale=scale)
+                scale=scale, segment_ids=segment_ids)

@@ -664,20 +664,12 @@ def flash_attention_pallas(q, k, v, attn_mask=None, dropout_p: float = 0.0,
         supported = _tpu_lowering_ok()
     if not supported:
         if segment_ids is not None:
-            q_seg, kv_seg = _normalize_segments(segment_ids, q.shape[0],
-                                                q.shape[1], k.shape[1])
-            seg_mask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
-            if attn_mask is None:
-                m = seg_mask
-            elif attn_mask.dtype == jnp.bool_:
-                m = attn_mask & seg_mask
-            else:  # additive float mask: add a large-negative segment term
-                m = attn_mask + jnp.where(seg_mask, 0.0, NEG_INF).astype(
-                    attn_mask.dtype)
-            return _sdpa_xla(q, k, v, attn_mask=m, dropout_p=dropout_p,
-                             causal=causal, scale=scale)
+            # one shared segment->mask fold lives in _sdpa_xla
+            segment_ids = _normalize_segments(segment_ids, q.shape[0],
+                                              q.shape[1], k.shape[1])
         return _sdpa_xla(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p,
-                         causal=causal, scale=scale)
+                         causal=causal, scale=scale,
+                         segment_ids=segment_ids)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     bq = min(block_q, q.shape[1])

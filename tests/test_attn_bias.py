@@ -1,0 +1,143 @@
+"""AttentionBias hierarchy (incubate.nn.attn_bias) + its routing through
+memory_efficient_attention (segment-id fast path vs dense oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.incubate.nn import attn_bias as ab
+from paddle_tpu.incubate.nn.functional import memory_efficient_attention
+from paddle_tpu.ops.attention import _sdpa_xla
+
+
+def _qkv(b, s, h, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+                 for _ in range(3))
+
+
+def test_lower_triangular_materialize():
+    m = ab.LowerTriangularMask().materialize((1, 1, 4, 4))
+    mm = np.asarray(m)[0, 0]
+    assert (mm[np.triu_indices(4, 1)] == -np.inf).all()
+    assert (mm[np.tril_indices(4)] == 0).all()
+
+    biased = ab.LowerTriangularMask().add_bias(jnp.full((4, 4), 2.0))
+    mb = np.asarray(biased.materialize((1, 1, 4, 4)))[0, 0]
+    assert (mb[np.tril_indices(4)] == 2.0).all()
+
+
+def test_seqleninfo_and_split():
+    info = ab.SeqLenInfo.from_seqlens([3, 5, 2])
+    assert info.seqstart_py == [0, 3, 8, 10]
+    assert info.max_seqlen == 5
+    assert list(info.intervals()) == [(0, 3), (3, 8), (8, 10)]
+    np.testing.assert_array_equal(info.segment_ids(),
+                                  [0, 0, 0, 1, 1, 1, 1, 1, 2, 2])
+    x = jnp.arange(10).reshape(1, 10, 1)
+    parts = info.split(x)
+    assert [p.shape for p in parts] == [(1, 3, 1), (1, 5, 1), (1, 2, 1)]
+
+
+def test_padded_seqleninfo():
+    info = ab.PaddedSeqLenInfo.from_seqlens_padded([2, 3], padding=4)
+    assert info.seqstart_py == [0, 4, 8]
+    assert list(info.intervals()) == [(0, 2), (4, 7)]
+    with pytest.raises(ValueError, match="padding"):
+        ab.PaddedSeqLenInfo.from_seqlens_padded([5], padding=4)
+    with pytest.raises(NotImplementedError):
+        ab.PaddedSeqLenInfo.from_seqlens([2])
+
+
+def test_block_diagonal_materialize_matches_manual():
+    bd = ab.BlockDiagonalMask.from_seqlens([2, 3])
+    m = np.asarray(bd.materialize((1, 1, 5, 5)))[0, 0]
+    finite = np.isfinite(m)
+    expect = np.zeros((5, 5), bool)
+    expect[:2, :2] = True
+    expect[2:, 2:] = True
+    np.testing.assert_array_equal(finite, expect)
+    # causal variant adds per-block triangles
+    mc = np.asarray(bd.make_causal().materialize((1, 1, 5, 5)))[0, 0]
+    assert np.isfinite(mc[1, 0]) and mc[0, 1] == -np.inf
+    assert np.isfinite(mc[4, 2]) and mc[2, 3] == -np.inf
+
+
+def test_from_tensor_list_roundtrip():
+    rs = np.random.RandomState(1)
+    t1 = jnp.asarray(rs.randn(2, 3, 4).astype(np.float32))
+    t2 = jnp.asarray(rs.randn(1, 5, 4).astype(np.float32))
+    bd, packed = ab.BlockDiagonalMask.from_tensor_list([t1, t2])
+    assert packed.shape == (1, 11, 4)
+    back = bd.split(packed)
+    np.testing.assert_allclose(np.asarray(back[0]), np.asarray(t1))
+    np.testing.assert_allclose(np.asarray(back[1]), np.asarray(t2))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_mea_block_diagonal_segment_path_matches_dense(causal):
+    """The segment-id fast path must equal attention with the materialized
+    dense bias (the reference's execution)."""
+    seqlens = [3, 4, 1]
+    s = sum(seqlens)
+    q, k, v = _qkv(1, s, 2, 8)
+    bd = ab.BlockDiagonalMask.from_seqlens(seqlens)
+    if causal:
+        bd = bd.make_causal()
+    out = memory_efficient_attention(q, k, v, attn_bias=bd)
+    dense = bd.materialize((1, 1, s, s))
+    ref = _sdpa_xla(q, k, v, attn_mask=dense)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mea_lower_triangular_is_causal():
+    q, k, v = _qkv(2, 6, 2, 8, seed=2)
+    out = memory_efficient_attention(q, k, v,
+                                     attn_bias=ab.LowerTriangularMask())
+    ref = _sdpa_xla(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mea_lower_triangular_rectangular_uses_reference_alignment():
+    """sq != sk: the mask's TOP-LEFT triu semantics (reference) — must not
+    be routed to the kernel's bottom-right causal flag."""
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 2, 2, 8).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(1, 5, 2, 8).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(1, 5, 2, 8).astype(np.float32)) * 0.5
+    lt = ab.LowerTriangularMask()
+    out = memory_efficient_attention(q, k, v, attn_bias=lt)
+    ref = _sdpa_xla(q, k, v, attn_mask=lt.materialize((1, 1, 2, 5)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mea_padded_kv_segment_path_masks_gaps():
+    """Padding-gap keys must stay masked on the segment-id fast path
+    (gap positions carry id -1, matching no query)."""
+    q_info = ab.SeqLenInfo.from_seqlens([2, 3])
+    k_info = ab.PaddedSeqLenInfo.from_seqlens_padded([2, 3], padding=4)
+    bd = ab.BlockDiagonalMask(q_seqinfo=q_info, k_seqinfo=k_info)
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(1, 5, 2, 8).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(1, 8, 2, 8).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(1, 8, 2, 8).astype(np.float32)) * 0.5
+    out = memory_efficient_attention(q, k, v, attn_bias=bd)
+    ref = _sdpa_xla(q, k, v, attn_mask=bd.materialize((1, 1, 5, 8)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_offset_padded_keys_mask():
+    qi = ab.SeqLenInfo.from_seqlens([1, 1])
+    ki = ab.PaddedSeqLenInfo.from_seqlens_padded([3, 2], padding=4)
+    m = np.asarray(ab.BlockDiagonalCausalWithOffsetPaddedKeysMask(
+        q_seqinfo=qi, k_seqinfo=ki).materialize((1, 1, 2, 8)))[0, 0]
+    # row 0: sees keys 0..2 of block 0 (len 3, causal offset 3-1)
+    assert np.isfinite(m[0, :3]).all() and (m[0, 3:] == -np.inf).all()
+    # row 1: sees keys 4..5 (block 1, len 2)
+    assert np.isfinite(m[1, 4:6]).all()
+    assert (m[1, :4] == -np.inf).all() and (m[1, 6:] == -np.inf).all()
