@@ -286,3 +286,7 @@ def set_stream(stream=None):
 
 
 from ..base import IPUPlace  # noqa: E402 — place shim (no IPU backend)
+
+
+from . import cuda  # noqa: E402  paddle.device.cuda path
+from . import xpu  # noqa: E402  paddle.device.xpu path

@@ -21,10 +21,10 @@ from typing import Optional
 
 import jax
 
-from ..parallel.mesh import HybridMesh, current_mesh
-from ..parallel.api import shard_layer, shard_optimizer_state, param_spec_tree
-from .strategy import DistributedStrategy
-from .topology import HybridCommunicateGroup
+from ...parallel.mesh import HybridMesh, current_mesh
+from ...parallel.api import shard_layer, shard_optimizer_state, param_spec_tree
+from ..strategy import DistributedStrategy
+from ..topology import HybridCommunicateGroup
 
 _strategy: Optional[DistributedStrategy] = None
 _hcg: Optional[HybridCommunicateGroup] = None
@@ -120,3 +120,10 @@ def worker_num() -> int:
 
 def is_first_worker() -> bool:
     return jax.process_index() == 0
+
+
+# -- reference subpackage paths (recipes import these directly) -------------
+from . import base          # noqa: E402
+from . import utils         # noqa: E402
+from . import meta_parallel # noqa: E402
+from . import recompute     # noqa: E402

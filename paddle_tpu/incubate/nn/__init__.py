@@ -3,6 +3,8 @@
 from . import functional
 from . import layer
 from . import attn_bias
+from . import loss
+from . import memory_efficient_attention
 from .layer import (FusedLinear, FusedDropout, FusedDropoutAdd,
                     FusedBiasDropoutResidualLayerNorm,
                     FusedMultiHeadAttention, FusedFeedForward,

@@ -633,3 +633,7 @@ def xpu_places(device_ids=None):
 
 def set_ipu_shard(call_func, index=-1, stage=-1):
     return call_func
+
+
+from . import nn  # noqa: E402  (paddle.static.nn builders)
+from . import amp  # noqa: E402  (paddle.static.amp facade)

@@ -1,0 +1,165 @@
+"""paddle.static.nn builders + the final module-path batch (fleet
+subpackages, device.cuda/xpu, static.amp, incubate.nn aliases)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+st = pt.static
+
+
+def _run(prog, feed, fetch):
+    return st.Executor().run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_fc_chain_and_parameter_reuse():
+    prog = st.Program()
+    with st.program_guard(prog):
+        x = st.data("x", [None, 8])
+        out = st.nn.fc(st.nn.fc(x, 16, activation="relu"), 4, name="head")
+    xv = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    r1 = _run(prog, {"x": xv}, [out])[0]
+    r2 = _run(prog, {"x": xv}, [out])[0]
+    assert r1.shape == (3, 4)
+    np.testing.assert_array_equal(r1, r2)      # params cached per program
+
+
+def test_embedding_and_padding_idx():
+    prog = st.Program()
+    with st.program_guard(prog):
+        ids = st.data("ids", [None, 5], dtype="int32")
+        emb = st.nn.embedding(ids, size=(16, 8), padding_idx=0)
+    r = _run(prog, {"ids": np.array([[0, 1, 2, 3, 0]], np.int32)}, [emb])[0]
+    assert r.shape == (1, 5, 8)
+    assert (r[0, 0] == 0).all() and (r[0, 4] == 0).all()
+    assert (r[0, 1] != 0).any()
+
+
+def test_conv_and_norms():
+    prog = st.Program()
+    with st.program_guard(prog):
+        img = st.data("img", [None, 3, 8, 8])
+        c = st.nn.conv2d(img, 6, 3, padding=1, act="relu")
+        b = st.nn.batch_norm(c)
+        g = st.nn.group_norm(b, groups=2)
+        ln = st.nn.layer_norm(g, begin_norm_axis=1)
+        inorm = st.nn.instance_norm(ln)
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    r = _run(prog, {"img": x}, [inorm])[0]
+    assert r.shape == (2, 6, 8, 8) and np.isfinite(r).all()
+
+
+def test_prelu_and_bilinear():
+    prog = st.Program()
+    with st.program_guard(prog):
+        x = st.data("x", [None, 4])
+        y = st.data("y", [None, 6])
+        p = st.nn.prelu(x, mode="all")
+        bl = st.nn.bilinear_tensor_product(x, y, size=3)
+    xv = np.array([[-1.0, 2.0, -3.0, 4.0]], np.float32)
+    yv = np.random.RandomState(2).randn(1, 6).astype(np.float32)
+    rp, rb = _run(prog, {"x": xv, "y": yv}, [p, bl])
+    np.testing.assert_allclose(rp, [[-0.25, 2.0, -0.75, 4.0]], rtol=1e-6)
+    assert rb.shape == (1, 3)
+
+
+def test_control_flow_cond_switch_while():
+    prog = st.Program()
+    with st.program_guard(prog):
+        flag = st.data("flag", [1], dtype="int32")
+        c = st.nn.cond(flag.apply(lambda v: v[0] > 0, "gt"),
+                       lambda: jnp.asarray(1.0), lambda: jnp.asarray(-1.0))
+        sw = st.nn.switch_case(flag.apply(lambda v: v[0], "idx"),
+                               {1: lambda: jnp.asarray(10.0),
+                                3: lambda: jnp.asarray(30.0)},
+                               default=lambda: jnp.asarray(-1.0))
+        i0 = st.data("i0", [1], dtype="int32")
+        wl, = st.nn.while_loop(lambda i: i[0] < 5,
+                               lambda i: [i + 2], [i0])
+    one = np.array([1], np.int32)
+    r = _run(prog, {"flag": one, "i0": np.array([0], np.int32)},
+             [c, sw, wl])
+    assert float(r[0]) == 1.0 and float(r[1]) == 10.0
+    assert int(np.asarray(r[2])[0]) == 6
+    r = _run(prog, {"flag": np.array([-3], np.int32),
+                    "i0": np.array([1], np.int32)}, [c, sw, wl])
+    assert float(r[0]) == -1.0 and float(r[1]) == -1.0
+    assert int(np.asarray(r[2])[0]) == 5
+
+
+def test_case_first_true_wins():
+    prog = st.Program()
+    with st.program_guard(prog):
+        x = st.data("x", [1])
+        out = st.nn.case(
+            [(x.apply(lambda v: v[0] > 2.0, "a"), lambda: jnp.asarray(2.0)),
+             (x.apply(lambda v: v[0] > 0.0, "b"), lambda: jnp.asarray(1.0))],
+            default=lambda: jnp.asarray(0.0))
+    assert float(_run(prog, {"x": np.array([5.0], np.float32)}, [out])[0]) == 2.0
+    assert float(_run(prog, {"x": np.array([1.0], np.float32)}, [out])[0]) == 1.0
+    assert float(_run(prog, {"x": np.array([-1.0], np.float32)}, [out])[0]) == 0.0
+
+
+def test_programs_do_not_share_parameters():
+    """Same auto-generated layer name in two Programs must not alias."""
+    progA, progB = st.Program(), st.Program()
+    with st.program_guard(progA):
+        outA = st.nn.fc(st.data("x", [None, 8]), 16)
+    with st.program_guard(progB):
+        outB = st.nn.fc(st.data("x", [None, 8]), 4)
+    xv = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+    rA = _run(progA, {"x": xv}, [outA])[0]
+    rB = _run(progB, {"x": xv}, [outB])[0]
+    assert rA.shape == (2, 16) and rB.shape == (2, 4)
+
+
+def test_transpose_conv_act_and_missing_filter():
+    prog = st.Program()
+    with st.program_guard(prog):
+        img = st.data("img", [None, 2, 4, 4])
+        up = st.nn.conv2d_transpose(img, 3, filter_size=2, stride=2,
+                                    act="relu")
+    x = np.random.RandomState(4).randn(1, 2, 4, 4).astype(np.float32)
+    r = _run(prog, {"img": x}, [up])[0]
+    assert r.shape == (1, 3, 8, 8)
+    assert (r >= 0).all()                     # act applied
+    with pytest.raises(NotImplementedError, match="filter_size"):
+        with st.program_guard(st.Program()):
+            st.nn.conv2d_transpose(st.data("i", [None, 2, 4, 4]), 3,
+                                   output_size=[8, 8])
+
+
+def test_ps_era_builders_raise():
+    with pytest.raises(NotImplementedError, match="PS non-goal"):
+        st.nn.sequence_pool(None, "max")
+    with pytest.raises(NotImplementedError, match="PS non-goal"):
+        st.nn.nce(None, None, 10)
+
+
+def test_static_amp_and_module_paths():
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu import nn as dynn
+    opt = SGD(learning_rate=0.1, parameters=dynn.Linear(2, 2))
+    opt2 = st.amp.decorate(opt)
+    assert opt2._amp_decorated
+    lists = st.amp.CustomOpLists(custom_black_list=["softmax"])
+    assert "softmax" in lists.black_list
+
+    # fleet subpackage paths (recipe imports)
+    from paddle_tpu.distributed.fleet.base.topology import \
+        HybridCommunicateGroup                                   # noqa
+    from paddle_tpu.distributed.fleet.meta_parallel import \
+        ColumnParallelLinear, PipelineLayer                      # noqa
+    from paddle_tpu.distributed.fleet.recompute import recompute  # noqa
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import \
+        GatherOp, ScatterOp                                      # noqa
+    # device shims
+    assert pt.device.cuda.device_count() >= 1
+    assert pt.device.cuda.get_device_capability() == (0, 0)
+    assert pt.device.xpu.device_count() >= 1
+    # incubate.nn module aliases
+    from paddle_tpu.incubate.nn.loss import identity_loss        # noqa
+    from paddle_tpu.incubate.nn.memory_efficient_attention import \
+        memory_efficient_attention                               # noqa
