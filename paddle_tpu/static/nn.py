@@ -33,7 +33,11 @@ __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
            "group_norm", "prelu", "spectral_norm", "bilinear_tensor_product",
            "deform_conv2d", "cond", "case", "switch_case", "while_loop",
            "py_func", "static_pylayer", "sequence_conv", "sequence_softmax",
-           "sequence_pool", "sparse_embedding", "nce", "row_conv",
+           "sequence_pool", "sequence_concat", "sequence_first_step",
+           "sequence_last_step", "sequence_slice", "sequence_expand",
+           "sequence_expand_as", "sequence_pad", "sequence_unpad",
+           "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+           "sequence_reverse", "sparse_embedding", "nce", "row_conv",
            "data_norm"]
 
 
@@ -506,6 +510,18 @@ def _ps_era(name):
 sequence_conv = _ps_era("sequence_conv")
 sequence_softmax = _ps_era("sequence_softmax")
 sequence_pool = _ps_era("sequence_pool")
+sequence_concat = _ps_era("sequence_concat")
+sequence_first_step = _ps_era("sequence_first_step")
+sequence_last_step = _ps_era("sequence_last_step")
+sequence_slice = _ps_era("sequence_slice")
+sequence_expand = _ps_era("sequence_expand")
+sequence_expand_as = _ps_era("sequence_expand_as")
+sequence_pad = _ps_era("sequence_pad")
+sequence_unpad = _ps_era("sequence_unpad")
+sequence_reshape = _ps_era("sequence_reshape")
+sequence_scatter = _ps_era("sequence_scatter")
+sequence_enumerate = _ps_era("sequence_enumerate")
+sequence_reverse = _ps_era("sequence_reverse")
 sparse_embedding = _ps_era("sparse_embedding")
 nce = _ps_era("nce")
 row_conv = _ps_era("row_conv")

@@ -31,7 +31,7 @@ class TestNamespaceParity:
                   "nn.utils", "nn.quant", "nn.initializer",
                   "incubate.autograd", "incubate.optimizer",
                   "incubate.optimizer.functional", "utils.unique_name",
-                  "utils.dlpack"]
+                  "utils.dlpack", "static.nn", "incubate.nn"]
 
     @staticmethod
     def _ref_all(name):
