@@ -54,6 +54,7 @@ from . import quantization
 from . import incubate
 from . import decomposition
 from . import dataset
+from . import version
 from . import inference
 from . import linalg
 from . import text
@@ -73,4 +74,4 @@ from . import hapi
 from .hapi import Model
 from .hapi.summary import summary
 
-__version__ = "0.1.0"
+__version__ = version.full_version

@@ -327,3 +327,11 @@ def test_dataset_wmt16_forwards_vocab_caps(tmp_path):
     max_cap = max(max(s.tolist() + t.tolist()) for s, t in r_cap())
     assert max_cap <= max_all
     assert max_cap <= 3      # ids clamped into the capped vocab (+specials)
+
+
+def test_version_module():
+    assert pt.version.full_version == pt.__version__
+    assert pt.version.cuda() is False and pt.version.cudnn() is False
+    assert pt.version.xla()              # jaxlib provides the compiler
+    assert pt.version.major == pt.__version__.split(".")[0]
+    pt.version.show()                    # must not raise
