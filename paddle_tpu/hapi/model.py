@@ -64,7 +64,12 @@ class Model:
                 metrics: Optional[Sequence] = None):
         self._optimizer = optimizer
         self._loss = loss
-        self._metrics = list(metrics or [])
+        # reference accepts a single Metric or a list (hapi/model.py:1556)
+        if metrics is None:
+            metrics = []
+        elif not isinstance(metrics, (list, tuple)):
+            metrics = [metrics]
+        self._metrics = list(metrics)
         self._params = self.network.raw_parameters()
         self._named = dict(self.network.named_parameters())
         if optimizer is not None:

@@ -216,7 +216,17 @@ class Layer:
             yield from sub.named_parameters(prefix=sp)
 
     def parameters(self) -> List[Parameter]:
-        return [p for _, p in self.named_parameters()]
+        out = []
+        for n, p in self.named_parameters():
+            # Stamp the dotted path (deliberate mutation on read): list-form
+            # optimizer binding keys by p.name, and those keys must match
+            # the dotted grads layer_grad/raw_parameters of THIS root
+            # produce. Names are relative to the queried root, so an
+            # optimizer built from a CONCATENATION of sublayer lists can
+            # collide — Optimizer.__init__ rejects that loudly.
+            p.name = n
+            out.append(p)
+        return out
 
     def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, Buffer]]:
         for name, b in self._buffers.items():

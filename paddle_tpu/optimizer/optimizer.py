@@ -85,8 +85,18 @@ class Optimizer:
                 self._bound_params = {n: p for n, p in parameters.named_parameters()
                                       if p.trainable}
             else:
-                self._bound_params = {p.name or str(i): p
-                                      for i, p in enumerate(parameters) if p.trainable}
+                parameters = [p for p in parameters if p.trainable]
+                names = [p.name or str(i) for i, p in enumerate(parameters)]
+                if len(set(names)) != len(names):
+                    dupes = sorted({n for n in names if names.count(n) > 1})
+                    raise ValueError(
+                        f"list-form parameter binding has colliding names "
+                        f"{dupes[:3]} (e.g. lists from several sublayers "
+                        f"concatenated, or tied params listed twice) — "
+                        f"pass the Layer itself (parameters=model) or one "
+                        f"root model.parameters() call, whose names are "
+                        f"the unique dotted paths")
+                self._bound_params = dict(zip(names, parameters))
         self._state = None
 
     # -- lr ----------------------------------------------------------------
@@ -170,6 +180,19 @@ class Optimizer:
                 "paddle_tpu optimizers need explicit grads: opt.step(grads) — "
                 "compute them with paddle_tpu.autograd.grad / jax.grad.")
         params = {k: p.value for k, p in self._bound_params.items()}
+        if not params:
+            raise RuntimeError(
+                "optimizer has no trainable parameters bound (empty list or "
+                "all trainable=False) — nothing to update")
+        if grads and not (set(grads) & set(params)):
+            # apply_gradients skips unmatched keys — a fully-disjoint key
+            # set would silently update NOTHING (e.g. grads keyed by dotted
+            # paths vs an optimizer bound to a different layer's list)
+            raise KeyError(
+                f"no gradient key matches any bound parameter: grads use "
+                f"{sorted(grads)[:3]}..., optimizer bound "
+                f"{sorted(params)[:3]}... — bind the optimizer with "
+                f"parameters=<same layer>.parameters() (or the Layer)")
         offload = getattr(self, "_offload_opt_state", False)
         if self._state is None:
             # fresh state is already device-resident; the post-step push
