@@ -98,6 +98,7 @@ def to_static(function=None, input_spec=None, full_graph: bool = True,
 
             call.__wrapped_layer__ = layer
             call.__jitted__ = jitted
+            call.__input_spec__ = input_spec
             return call
         jitted = jax.jit(fn, static_argnums=static_argnums)
         jitted.__input_spec__ = input_spec
@@ -131,6 +132,13 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
     if d:
         os.makedirs(d, exist_ok=True)
 
+    if input_spec is None:
+        # a to_static-wrapped target carries its spec (reference behavior:
+        # jit.save reuses the spec the user gave to_static)
+        input_spec = getattr(layer_or_fn, "__input_spec__", None)
+    if hasattr(layer_or_fn, "__wrapped_layer__"):
+        # a to_static-wrapped Layer: export the underlying layer
+        layer_or_fn = layer_or_fn.__wrapped_layer__
     if hasattr(layer_or_fn, "functional"):
         pure, params = _layer_pure(layer_or_fn)
         state = {"params": jax.tree.map(np.asarray, params)}
@@ -142,7 +150,8 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
         with_params = False
 
     if input_spec is None:
-        raise ValueError("jit.save requires input_spec to trace the export")
+        raise ValueError("jit.save requires input_spec (pass it here or to "
+                         "jit.to_static) to trace the export")
     scope = jexport.SymbolicScope()
     arg_structs = [s.to_shape_struct(scope) if isinstance(s, InputSpec) else s
                    for s in input_spec]

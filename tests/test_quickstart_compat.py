@@ -98,3 +98,29 @@ def test_sublayer_optimizer_binding_and_collision_guard():
                                 parameters=frozen.parameters())
     with pytest.raises(RuntimeError, match="no trainable"):
         opt3.step({"weight": np.zeros((2, 2), np.float32)})
+
+
+def test_deploy_tutorial_to_static_save_load_predictor(tmp_path):
+    """The reference deploy flow: to_static(input_spec) -> jit.save (spec
+    inherited from the wrapper) -> jit.load and inference.Predictor on the
+    exported artifact, all output-identical."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    static_net = paddle.jit.to_static(
+        net, input_spec=[InputSpec([None, 8], "float32", "x")])
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8)
+                         .astype(np.float32))
+    ref = np.asarray(static_net(x))
+    path = str(tmp_path / "model")
+    paddle.jit.save(static_net, path)          # no explicit input_spec
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(np.asarray(loaded(x)), ref, rtol=1e-5)
+
+    pred = create_predictor(Config(path))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(np.asarray(x))
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
