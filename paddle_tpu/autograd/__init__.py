@@ -18,8 +18,29 @@ import jax.numpy as jnp
 from ..nn.layer import Layer
 
 
-def grad(fn: Callable, argnums=0, has_aux: bool = False, allow_unused: bool = False):
-    """jax.grad with paddle-flavored naming."""
+def grad(fn: Callable = None, argnums=0, has_aux: bool = False,
+         allow_unused: bool = False, **tape_kwargs):
+    """jax.grad with paddle-flavored naming.
+
+    The reference's TAPE form — ``paddle.grad(outputs=y, inputs=x)`` on
+    already-computed tensors — cannot exist without a global tape; it
+    raises with the functional migration recipe (same policy as
+    Tensor.backward; docs/DESIGN_DECISIONS.md eager-tape entry)."""
+    if "outputs" in tape_kwargs or "inputs" in tape_kwargs or (
+            fn is not None and not callable(fn)):
+        raise NotImplementedError(
+            "paddle.grad(outputs=..., inputs=...) differentiates an eager "
+            "tape, which this framework does not keep. Differentiate the "
+            "FUNCTION instead:\n"
+            "    g = paddle.autograd.grad(lambda x: (x * x).sum())(x)\n"
+            "or use autograd.layer_grad(model, loss_fn, *inputs) for "
+            "Layers (docs/DESIGN_DECISIONS.md eager-tape entry)")
+    if tape_kwargs:
+        raise TypeError(f"grad() got unexpected keyword arguments "
+                        f"{sorted(tape_kwargs)}")
+    if fn is None:
+        raise TypeError("grad() missing required argument: 'fn' (a callable"
+                        " to differentiate)")
     return jax.grad(fn, argnums=argnums, has_aux=has_aux)
 
 

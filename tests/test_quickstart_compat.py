@@ -124,3 +124,24 @@ def test_deploy_tutorial_to_static_save_load_predictor(tmp_path):
     pred.run()
     out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_tape_style_grad_raises_with_recipe():
+    import pytest
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    with pytest.raises(NotImplementedError, match="layer_grad"):
+        paddle.grad(outputs=y, inputs=x)
+    with pytest.raises(NotImplementedError, match="lambda"):
+        paddle.grad(y, x)           # positional tensors, not a callable
+    # functional form still works
+    g = paddle.autograd.grad(lambda v: (v * v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+def test_grad_keyword_typos_still_raise():
+    import pytest
+    with pytest.raises(TypeError, match="unexpected keyword"):
+        paddle.grad(lambda v: v.sum(), argnum=1)     # typo must not silently drop
+    with pytest.raises(TypeError, match="missing required"):
+        paddle.grad()
