@@ -167,7 +167,17 @@ class Strategy:
         self.fused_passes = self._Cfg(enable=False, fused_passes_list=[])
         self.gradient_merge = self._Cfg(enable=False, k_steps=1)
         for k, v in config.items():
-            setattr(self, k, v)
+            cur = getattr(self, k, None)
+            if isinstance(v, dict) and isinstance(cur, Strategy._Cfg):
+                unknown = set(v) - set(cur.__dict__)
+                if unknown:
+                    raise ValueError(
+                        f"Strategy config '{k}' has unknown keys "
+                        f"{sorted(unknown)}; valid: "
+                        f"{sorted(cur.__dict__)}")
+                cur.__dict__.update(v)  # merge into sub-config, ref-style
+            else:
+                setattr(self, k, v)
 
 
 class DistModel:

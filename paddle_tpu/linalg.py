@@ -38,8 +38,15 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
+    # Pivots are 1-based per the reference contract (paddle.linalg.lu docs;
+    # lu_unpack subtracts 1), while jax.scipy returns 0-based.
+    if not pivot:
+        raise NotImplementedError(
+            "paddle_tpu.linalg.lu: pivot=False (unpivoted LU) is not "
+            "supported; XLA's LU is always partially pivoted.")
     import jax.scipy.linalg as jsl
     lu_mat, piv = jsl.lu_factor(x)
+    piv = (piv + 1).astype(jnp.int32)
     if get_infos:
         return lu_mat, piv, jnp.zeros((), jnp.int32)
     return lu_mat, piv

@@ -324,12 +324,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     ph, pw = output_size
     arr = jnp.asarray(x)
     rois = jnp.asarray(boxes, jnp.float32)
+    rois_host = None  # fetched lazily; only the adaptive path needs it
     nums = np.asarray(boxes_num)
     batch_of_roi = np.repeat(np.arange(len(nums)), nums)
-    ratio = sampling_ratio if sampling_ratio > 0 else 2
     off = 0.5 if aligned else 0.0
 
-    def one_roi(feat, roi):
+    def one_roi(feat, roi, ry, rx):
         x1, y1, x2, y2 = roi * spatial_scale
         x1, y1 = x1 - off, y1 - off
         x2, y2 = x2 - off, y2 - off
@@ -338,17 +338,32 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         bw = rw / pw
         bh = rh / ph
         gy = (y1 + bh * (jnp.arange(ph)[:, None, None, None] +
-                         (jnp.arange(ratio)[None, None, :, None] + 0.5)
-                         / ratio))
+                         (jnp.arange(ry)[None, None, :, None] + 0.5)
+                         / ry))
         gx = (x1 + bw * (jnp.arange(pw)[None, :, None, None] +
-                         (jnp.arange(ratio)[None, None, None, :] + 0.5)
-                         / ratio))
-        ys = jnp.broadcast_to(gy, (ph, pw, ratio, ratio))
-        xs = jnp.broadcast_to(gx, (ph, pw, ratio, ratio))
-        vals = _bilinear_tap(feat, ys, xs)          # [C, ph, pw, r, r]
+                         (jnp.arange(rx)[None, None, None, :] + 0.5)
+                         / rx))
+        ys = jnp.broadcast_to(gy, (ph, pw, ry, rx))
+        xs = jnp.broadcast_to(gx, (ph, pw, ry, rx))
+        vals = _bilinear_tap(feat, ys, xs)          # [C, ph, pw, ry, rx]
         return jnp.mean(vals, axis=(-1, -2))        # [C, ph, pw]
 
-    outs = [one_roi(arr[int(b)], rois[i])
+    def grid_for(i):
+        # Reference: sampling_ratio<=0 -> adaptive ceil(roi_size/bin) per
+        # ROI (roi_align_kernel.cu); computed host-side so shapes stay
+        # static per trace.
+        if sampling_ratio > 0:
+            return sampling_ratio, sampling_ratio
+        nonlocal rois_host
+        if rois_host is None:
+            rois_host = np.asarray(rois, np.float32)
+        x1, y1, x2, y2 = rois_host[i] * spatial_scale
+        rh = max(float(y2 - y1), 1e-4)
+        rw = max(float(x2 - x1), 1e-4)
+        return (max(int(np.ceil(rh / ph)), 1),
+                max(int(np.ceil(rw / pw)), 1))
+
+    outs = [one_roi(arr[int(b)], rois[i], *grid_for(i))
             for i, b in enumerate(batch_of_roi)]
     return (jnp.stack(outs) if outs
             else jnp.zeros((0, arr.shape[1], ph, pw), arr.dtype))
@@ -411,7 +426,9 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         rw = max(x2 - x1, 0.1)
         rh = max(y2 - y1, 0.1)
         grid = jnp.zeros((co, ph, pw), arr.dtype)
-        feat = arr[int(b)].reshape(ph, pw, co, h, w)
+        # Reference kernel: input_channel = (c*ph_ + iy)*pw_ + ix, i.e.
+        # channels are laid out (co, ph, pw) — output channel outermost.
+        feat = arr[int(b)].reshape(co, ph, pw, h, w)
         for iy in range(ph):
             for ix in range(pw):
                 ys = int(np.floor(y1 + rh * iy / ph))
@@ -420,7 +437,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
                 xe = int(np.ceil(x1 + rw * (ix + 1) / pw))
                 ys, ye = max(ys, 0), min(max(ye, ys + 1), h)
                 xs_, xe = max(xs_, 0), min(max(xe, xs_ + 1), w)
-                region = feat[iy, ix, :, ys:ye, xs_:xe]
+                region = feat[:, iy, ix, ys:ye, xs_:xe]
                 grid = grid.at[:, iy, ix].set(jnp.mean(region, axis=(1, 2)))
         outs.append(grid)
     return (jnp.stack(outs) if outs

@@ -1,0 +1,87 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md)."""
+import numpy as np
+import jax.numpy as jnp
+
+RS = np.random.RandomState(0)
+
+
+class TestLuPivots:
+    def test_lu_unpack_round_trip(self):
+        # ADVICE #1: lu() must return 1-based pivots so lu -> lu_unpack
+        # reconstructs P @ L @ U == x.
+        import paddle_tpu.linalg as L
+        a = RS.randn(5, 5).astype("float32")
+        lu, piv = L.lu(jnp.asarray(a))
+        assert int(np.asarray(piv).min()) >= 1
+        P, Lm, U = L.lu_unpack(np.asarray(lu), np.asarray(piv))
+        rec = np.asarray(P) @ np.asarray(Lm) @ np.asarray(U)
+        assert np.allclose(rec, a, atol=1e-5)
+
+    def test_lu_get_infos(self):
+        import paddle_tpu.linalg as L
+        a = RS.randn(3, 3).astype("float32")
+        lu, piv, info = L.lu(jnp.asarray(a), get_infos=True)
+        assert int(info) == 0
+
+
+class TestPsroiPool:
+    def test_output_channels_gt_1(self):
+        # ADVICE #2: channel layout is (co, ph, pw) — output channel
+        # outermost (reference psroi_pool kernel:
+        # input_channel = (c*ph_ + iy)*pw_ + ix).
+        from paddle_tpu.vision.ops import psroi_pool
+        ph = pw = 2
+        co = 3
+        c = co * ph * pw
+        h = w = 8
+        x = RS.randn(1, c, h, w).astype("float32")
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = psroi_pool(jnp.asarray(x), boxes, np.array([1]), (ph, pw))
+        assert out.shape == (1, co, ph, pw)
+        # numpy oracle with the reference layout
+        feat = x[0].reshape(co, ph, pw, h, w)
+        want = np.zeros((co, ph, pw), np.float32)
+        for iy in range(ph):
+            for ix in range(pw):
+                ys, ye = int(np.floor(8.0 * iy / ph)), int(np.ceil(8.0 * (iy + 1) / ph))
+                xs, xe = int(np.floor(8.0 * ix / pw)), int(np.ceil(8.0 * (ix + 1) / pw))
+                want[:, iy, ix] = feat[:, iy, ix, ys:ye, xs:xe].mean(axis=(1, 2))
+        assert np.allclose(np.asarray(out[0]), want, atol=1e-5)
+
+
+class TestRoiAlignAdaptive:
+    def test_adaptive_matches_explicit_ratio(self):
+        # ADVICE #4: sampling_ratio=-1 uses adaptive ceil(roi_size/bin)
+        # per ROI. For a ROI of size 8 with 2x2 bins that's ratio 4.
+        from paddle_tpu.vision.ops import roi_align
+        x = RS.randn(1, 2, 16, 16).astype("float32")
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+        auto = roi_align(jnp.asarray(x), boxes, np.array([1]), 2,
+                         sampling_ratio=-1)
+        explicit = roi_align(jnp.asarray(x), boxes, np.array([1]), 2,
+                             sampling_ratio=4)
+        assert np.allclose(np.asarray(auto), np.asarray(explicit), atol=1e-6)
+
+    def test_per_roi_ratio_differs(self):
+        # Large and small ROIs get different grids but both stay finite.
+        from paddle_tpu.vision.ops import roi_align
+        x = RS.randn(1, 2, 32, 32).astype("float32")
+        boxes = np.array([[0.0, 0.0, 30.0, 30.0],
+                          [4.0, 4.0, 6.0, 6.0]], np.float32)
+        out = roi_align(jnp.asarray(x), boxes, np.array([2]), 2,
+                        sampling_ratio=-1)
+        assert out.shape == (2, 2, 2, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestStrategyNestedConfig:
+    def test_dict_config_merges_into_cfg(self):
+        # ADVICE #3: Strategy(config={'sharding': {...}}) must merge into
+        # the _Cfg sub-object, not replace it.
+        from paddle_tpu.distributed.compat import Strategy
+        s = Strategy(config={"sharding": {"enable": True}})
+        assert s.sharding.enable is True
+        assert s.sharding.degree == 8  # default preserved
+        s2 = Strategy(config={"pipeline": {"accumulate_steps": 4}})
+        assert s2.pipeline.accumulate_steps == 4
+        assert s2.pipeline.schedule_mode == "1F1B"
