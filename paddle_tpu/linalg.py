@@ -48,7 +48,8 @@ def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
     lu_mat, piv = jsl.lu_factor(x)
     piv = (piv + 1).astype(jnp.int32)
     if get_infos:
-        return lu_mat, piv, jnp.zeros((), jnp.int32)
+        # one info per matrix in the batch, like the reference
+        return lu_mat, piv, jnp.zeros(jnp.shape(x)[:-2], jnp.int32)
     return lu_mat, piv
 
 

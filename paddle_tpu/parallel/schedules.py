@@ -11,24 +11,38 @@ stage-to-stage movement = jnp.roll → CollectivePermute on ICI).
 
 1F1B (``pipeline_1f1b``)
 ------------------------
-Lockstep tick t runs, on every stage s (vmapped):
+Slot mapping — tick t, stage s:
 
   F-slot: forward microbatch  m_f = t - s            (mask: 0 <= m_f < M)
   B-slot: backward microbatch m_b = t - (2S-2-s)     (mask: 0 <= m_b < M)
 
 so stage S-1 runs B(m) in the same tick as F(m) — the defining 1F1B
-property; the backward wave then walks down one stage per tick. Stage
-INPUTS are saved in a ring buffer of R = min(M, 2S-1) slots and the
-backward slot recomputes the stage forward under jax.vjp (activation
-recompute, as the reference's recompute interval does) — so the live
-activation set is AT MOST 2S-1 microbatch inputs per stage, independent of
-M, versus M for GPipe-through-jax.grad. [Honesty note: classic 1F1B holds
-S-s microbatches at stage s; lockstep SPMD doubles that to 2(S-1-s)+1
-because the forward wave advances one stage per (F+B) tick rather than per
-F — the O(S)-not-O(M) property, which is what matters for large M, is
-preserved.] Total ticks: M + 2(S-1); each costs one chunk forward + one
-backward(+recompute), so the bubble is 2(S-1) ticks vs GPipe's (S-1) — the
-memory-for-bubble trade is explicit and documented.
+property; the backward wave then walks down one stage per tick. The
+T = M + 2(S-1) ticks are executed as THREE scans sharing one carry, so
+fill/drain ticks only pay for the slot that can be live:
+
+  fill   t in [0, S-1):         F-cell only (no B-slot is valid yet)
+  steady t in [S-1, M+S-1):     F-cell + loss head + B-cell
+  drain  t in [M+S-1, M+2S-2):  B-cell only (no F-slot is valid)
+
+Per-tick cost is therefore (S-1)·tF + M·(tF+tB) + (S-1)·tB — i.e. the
+classic (S-1)-bubble of the reference's 1F1B runtime
+(pipeline_parallel.py:440-580), not the 2(S-1) a single full-slot lockstep
+loop would pay. The two opposite-direction jnp.rolls in the steady body
+(F-activations s->s+1, B-cotangents s->s-1) lower to a pair of
+CollectivePermutes with no data dependence, which XLA schedules
+concurrently over the bidirectional ICI links — the SPMD analogue of the
+reference's fused ``send_forward_recv_backward`` pairs
+(pipeline_parallel.py:521,:544).
+
+Activation memory: stage INPUTS (``remat=True``, default) or full vjp
+RESIDUALS (``remat=False``) are saved in a ring of R = min(M, 2S-1) slots,
+so the live set is O(S), independent of M, versus M for
+GPipe-through-jax.grad. With ``remat=True`` the B-cell replays the stage
+forward under jax.vjp (the reference's recompute interval); with
+``remat=False`` the saved residuals are applied directly — no recompute,
+at 2S-1 microbatches of residual memory per stage (use when HBM allows,
+mirroring the reference's optional recompute).
 
 The loss head (final norm/projection + loss) runs once per tick,
 un-vmapped, on stage S-1's F-slot output (its B-slot microbatch equals its
@@ -101,9 +115,7 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
     if M < 1:
         raise ValueError("need at least one microbatch")
     R = min(M, 2 * S - 1)
-    fwd = jax.checkpoint(stage_fn) if remat else stage_fn
     sidx = jnp.arange(S)
-    is_last = sidx == S - 1
 
     if weighted_loss:
         head2 = loss_head_fn
@@ -113,8 +125,32 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
 
     fin0 = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
     bcot0 = jnp.zeros((S,) + x_mb.shape[1:], jnp.float32)
-    ring0 = jnp.zeros((S, R) + x_mb.shape[1:], x_mb.dtype)
     dx0 = jnp.zeros(x_mb.shape, jnp.float32)
+
+    # ---- F-cell: forward one stage, saving what backward will need ------
+    _stash = {}
+
+    def _fcell_res(p_s, h_s):
+        out, vjp_fn = jax.vjp(stage_fn, p_s, h_s)
+        leaves, td = jax.tree.flatten(vjp_fn)
+        _stash["td"] = td
+        _stash["out_dtype"] = out.dtype
+        return out, leaves
+
+    saved_td = saved_out_dtype = None
+    if remat:
+        # ring stores stage INPUTS; backward replays the stage under vjp
+        ring0 = [jnp.zeros((S, R) + x_mb.shape[1:], x_mb.dtype)]
+    else:
+        # ring stores vjp RESIDUALS (jax.vjp's pytree-registered closure,
+        # flattened); backward applies them with no recompute
+        _, leaf_sh = jax.eval_shape(
+            lambda P, H: jax.vmap(_fcell_res)(P, H), stacked_params, fin0)
+        saved_td = _stash["td"]          # trace-static closure structure
+        saved_out_dtype = _stash["out_dtype"]
+        ring0 = [jnp.zeros((s.shape[0], R) + tuple(s.shape[1:]), s.dtype)
+                 for s in leaf_sh]
+
     carry0 = (fin0, bcot0, ring0, dx0, _tree_zeros(stacked_params),
               _tree_zeros(head_params), jnp.float32(0.0), jnp.float32(0.0))
 
@@ -126,63 +162,119 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
     def ring_read(ring_s, idx):
         return jax.lax.dynamic_index_in_dim(ring_s, idx, 0, keepdims=False)
 
-    def bslot(p_s, h_s, g):
-        """One stage's backward cell: recompute fwd under vjp, pull the
-        stage back along the (pre-masked) cotangent g."""
-        out, vjp_fn = jax.vjp(lambda pp, hh: fwd(pp, hh), p_s, h_s)
-        dp, dh = vjp_fn(g.astype(out.dtype))
-        return dp, dh.astype(jnp.float32)
-
-    def tick(carry, t):
-        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
-        # ---- F slot -----------------------------------------------------
+    def f_cell(fin, ring, t):
+        """Inject stage-0 input, run all stages forward, save backward
+        state into the ring. Returns (out_f, ring)."""
         m_f = t - sidx                                   # [S]
         valid_f = (m_f >= 0) & (m_f < M)
         inj = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
         fin = fin.at[0].set(inj)
-        # save stage inputs for backward recompute
-        ring = jax.vmap(ring_write)(ring, fin, jnp.mod(m_f, R), valid_f)
-        out_f = jax.vmap(fwd)(stacked_params, fin)
-        # ---- loss head (once, un-vmapped) -------------------------------
-        # stage S-1 backwards m in the tick that forwarded it, so the head
-        # consumes this tick's F-slot output for that stage directly
+        slot = jnp.mod(m_f, R)
+        if remat:
+            ring = [jax.vmap(ring_write)(ring[0], fin, slot, valid_f)]
+            out_f = jax.vmap(stage_fn)(stacked_params, fin)
+        else:
+            out_f, leaves = jax.vmap(_fcell_res)(stacked_params, fin)
+            ring = [jax.vmap(ring_write)(r, l, slot, valid_f)
+                    for r, l in zip(ring, leaves)]
+        return out_f, ring
+
+    def bslot_remat(p_s, h_s, g):
+        """One stage's backward cell: recompute fwd under vjp, pull the
+        stage back along the (pre-masked) cotangent g."""
+        out, vjp_fn = jax.vjp(stage_fn, p_s, h_s)
+        dp, dh = vjp_fn(g.astype(out.dtype))
+        return dp, dh.astype(jnp.float32)
+
+    def bslot_saved(leaves_s, g):
+        vjp_fn = jax.tree.unflatten(saved_td, list(leaves_s))
+        dp, dh = vjp_fn(g.astype(saved_out_dtype))
+        return dp, dh.astype(jnp.float32)
+
+    def b_cell(bcot, ring, dx, gacc, t, g_loss=None):
+        """Run all stages backward along the (masked) cotangents; stage 0's
+        input-grad lands in dx. Returns (dh, dx, gacc)."""
         m_b = t - (2 * S - 2 - sidx)                     # [S]
         valid_b = (m_b >= 0) & (m_b < M)
-        tgt = jax.lax.dynamic_index_in_dim(
-            targets_mb, jnp.clip(m_b[S - 1], 0, M - 1), 0, keepdims=False)
-        (lsum, w), (g_head, g_loss) = jax.value_and_grad(
-            lambda hp, h: head2(hp, h, tgt), argnums=(0, 1),
-            has_aux=True)(head_params, out_f[S - 1])
-        live = valid_b[S - 1].astype(jnp.float32)
-        lacc = lacc + lsum * live
-        wacc = wacc + w * live
-        hacc = _tree_add(hacc, jax.tree.map(lambda x: x * live, g_head))
-        # ---- B slot -----------------------------------------------------
-        h_b = jax.vmap(ring_read)(ring, jnp.mod(m_b, R))
-        g = bcot.at[S - 1].set(g_loss.astype(jnp.float32))
+        slot = jnp.mod(m_b, R)
+        g = bcot if g_loss is None else bcot.at[S - 1].set(
+            g_loss.astype(jnp.float32))
         g = g * valid_b.astype(jnp.float32).reshape(
             (S,) + (1,) * (g.ndim - 1))
-        dparams, dh = jax.vmap(bslot)(stacked_params, h_b, g)
+        if remat:
+            h_b = jax.vmap(ring_read)(ring[0], slot)
+            dparams, dh = jax.vmap(bslot_remat)(stacked_params, h_b, g)
+        else:
+            leaves_b = [jax.vmap(ring_read)(r, slot) for r in ring]
+            dparams, dh = jax.vmap(bslot_saved)(leaves_b, g)
         gacc = _tree_add(gacc, dparams)
         # stage 0's input-grad is d x_mb[m_b[0]] — record for the caller
         m0 = jnp.clip(m_b[0], 0, M - 1)
         prev = jax.lax.dynamic_index_in_dim(dx, m0, 0, keepdims=False)
         dx = jax.lax.dynamic_update_index_in_dim(
             dx, jnp.where(valid_b[0], dh[0], prev), m0, 0)
-        # ---- advance the pipe ------------------------------------------
+        return dh, dx, gacc
+
+    # ---- fill: t in [0, S-1) — only F-slots can be live -----------------
+    def fill_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        out_f, ring = f_cell(fin, ring, t)
+        fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
+        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+
+    # ---- steady: t in [S-1, M+S-1) — one F and one B per tick -----------
+    def steady_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        out_f, ring = f_cell(fin, ring, t)
+        # loss head (once, un-vmapped): stage S-1 backwards microbatch m in
+        # the very tick that forwarded it, so the head consumes this tick's
+        # F-slot output directly. m_b[S-1] = t-(S-1) is always valid here.
+        tgt = jax.lax.dynamic_index_in_dim(
+            targets_mb, jnp.clip(t - (S - 1), 0, M - 1), 0, keepdims=False)
+        (lsum, w), (g_head, g_loss) = jax.value_and_grad(
+            lambda hp, h: head2(hp, h, tgt), argnums=(0, 1),
+            has_aux=True)(head_params, out_f[S - 1])
+        lacc = lacc + lsum
+        wacc = wacc + w
+        hacc = _tree_add(hacc, g_head)
+        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t, g_loss)
+        # fused neighbor exchange: the two opposite-direction permutes are
+        # independent — XLA runs them concurrently over bidirectional ICI
+        # (reference's send_forward_recv_backward pairing).
         fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
         bcot = jnp.roll(dh, -1, axis=0)     # stage s -> s-1
         return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
 
-    T = M + 2 * (S - 1)
-    (_, _, _, dx, gacc, hacc, lacc, wacc), _ = jax.lax.scan(
-        tick, carry0, jnp.arange(T))
+    # ---- drain: t in [M+S-1, M+2S-2) — only B-slots can be live ---------
+    def drain_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t)
+        bcot = jnp.roll(dh, -1, axis=0)
+        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+
+    carry, _ = jax.lax.scan(fill_tick, carry0, jnp.arange(S - 1))
+    carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(S - 1, M + S - 1))
+    carry, _ = jax.lax.scan(drain_tick, carry,
+                            jnp.arange(M + S - 1, M + 2 * S - 2))
+    (_, _, _, dx, gacc, hacc, lacc, wacc) = carry
     inv_w = 1.0 / jnp.maximum(wacc, 1e-9)
     scale = lambda t: jax.tree.map(lambda x: x * inv_w, t)
     if return_dx:
         return lacc * inv_w, scale(gacc), scale(hacc), dx * inv_w
     return lacc * inv_w, scale(gacc), scale(hacc)
+
+
+def schedule_ticks(num_stages: int, num_microbatches: int) -> dict:
+    """Per-phase tick counts of ``pipeline_1f1b`` — the bubble math.
+
+    fill and drain each cost only ONE slot (tF resp. tB), so the bubble is
+    (S-1)(tF+tB) — the reference 1F1B's (S-1), not the 2(S-1) of a
+    uniform-tick lockstep loop."""
+    S, M = num_stages, num_microbatches
+    return {"fill": S - 1, "steady": M, "drain": S - 1,
+            "total": M + 2 * (S - 1),
+            "bubble_slot_pairs": S - 1}
 
 
 def pipeline_interleaved(stage_fn: Callable, stacked_params, x_mb, *,
@@ -258,4 +350,5 @@ def interleaved_ticks(num_stages: int, num_chunks: int,
     return t, t_plain
 
 
-__all__ = ["pipeline_1f1b", "pipeline_interleaved", "interleaved_ticks"]
+__all__ = ["pipeline_1f1b", "pipeline_interleaved", "interleaved_ticks",
+           "schedule_ticks"]

@@ -351,11 +351,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
     def grid_for(i):
         # Reference: sampling_ratio<=0 -> adaptive ceil(roi_size/bin) per
         # ROI (roi_align_kernel.cu); computed host-side so shapes stay
-        # static per trace.
+        # static per trace. Under jit the boxes are traced (no host values)
+        # so the adaptive path degrades to the fixed 2x2 grid.
         if sampling_ratio > 0:
             return sampling_ratio, sampling_ratio
         nonlocal rois_host
         if rois_host is None:
+            if isinstance(rois, jax.core.Tracer):
+                return 2, 2
             rois_host = np.asarray(rois, np.float32)
         x1, y1, x2, y2 = rois_host[i] * spatial_scale
         rh = max(float(y2 - y1), 1e-4)

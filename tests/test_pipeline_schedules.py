@@ -178,3 +178,57 @@ def test_interleaved_rejects_bad_microbatch_count():
     p = {"w": jnp.zeros((2, 2, 4, 4)), "b": jnp.zeros((2, 2, 4))}
     with pytest.raises(ValueError, match="divisible"):
         pipeline_interleaved(_mlp_stage, p, x, num_stages=2, num_chunks=2)
+
+
+def test_schedule_ticks_s_minus_1_bubble():
+    from paddle_tpu.parallel.schedules import schedule_ticks
+    tk = schedule_ticks(4, 8)
+    assert tk == {"fill": 3, "steady": 8, "drain": 3, "total": 14,
+                  "bubble_slot_pairs": 3}
+
+
+def _count_dots(jaxpr):
+    n = 0
+    for e in jaxpr.eqns:
+        if e.primitive.name == "dot_general":
+            n += 1
+        for v in e.params.values():
+            if hasattr(v, "jaxpr"):
+                n += _count_dots(v.jaxpr)
+    return n
+
+
+def test_1f1b_bubble_is_s_minus_1_structurally():
+    """The (S-1)-bubble evidence: the schedule lowers to THREE scans —
+    fill (S-1 ticks, forward compute only), steady (M ticks, F+B+head),
+    drain (S-1 ticks, backward only). Fill ticks must contain NO backward
+    matmuls and drain ticks NO forward matmuls, so the fill/drain bubble
+    costs (S-1)(tF + tB) total — the reference 1F1B's bubble — instead of
+    the 2(S-1)(tF+tB) a uniform-slot lockstep loop pays."""
+    S, M, d, mb = 4, 8, 16, 4
+    rng = np.random.RandomState(0)
+    stacked = {"w": jnp.asarray(rng.normal(0, .5, (S, d, d)), jnp.float32),
+               "b": jnp.zeros((S, d), jnp.float32)}
+    head = {"w": jnp.asarray(rng.normal(0, .5, (d, d)), jnp.float32)}
+    x = jnp.asarray(rng.normal(0, 1, (M, mb, d)), jnp.float32)
+    t = jnp.asarray(rng.normal(0, 1, (M, mb, d)), jnp.float32)
+
+    jx = jax.make_jaxpr(lambda sp, hp: pipeline_1f1b(
+        _mlp_stage, sp, x, t, _loss_head, hp, num_stages=S, remat=False))(
+        stacked, head)
+    scans = [e for e in jx.jaxpr.eqns if e.primitive.name == "scan"]
+    assert len(scans) == 3
+    lengths = [e.params["length"] for e in scans]
+    dots = [_count_dots(e.params["jaxpr"].jaxpr) for e in scans]
+    assert lengths == [S - 1, M, S - 1]
+    fill_dots, steady_dots, drain_dots = dots
+    # _mlp_stage: fwd = 1 dot; bwd (saved-residual) = 2 dots (dh, dW);
+    # head = 1 fwd + 2 bwd dots. steady holds all of them.
+    assert fill_dots == 1, f"fill tick must be forward-only, got {dots}"
+    assert drain_dots == 2, f"drain tick must be backward-only, got {dots}"
+    assert steady_dots == fill_dots + drain_dots + 3
+    # weighted bubble: fill+drain cost = (S-1)*(F+B) — half the lockstep's
+    weighted = (lengths[0] * fill_dots + lengths[2] * drain_dots)
+    lockstep_bubble = 2 * (S - 1) * (fill_dots + drain_dots)
+    assert weighted == (S - 1) * (fill_dots + drain_dots)
+    assert weighted < lockstep_bubble
