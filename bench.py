@@ -74,7 +74,8 @@ def _make_loader(cfg, batch_size, seq_len, steps):
 
 
 def _train_bench(cfg, batch_size, seq_len, steps, warmup):
-    """Returns (tokens_per_sec_total, step_time_s, input_stall_s, loss)."""
+    """Returns (tokens_per_sec_total, step_time_s, input_stall_s, loss,
+    model, fenced_per_step_times)."""
     import jax
 
     import paddle_tpu as pt
@@ -109,9 +110,24 @@ def _train_bench(cfg, batch_size, seq_len, steps, warmup):
     dt = time.perf_counter() - t0
     _log("train: timed loop done")
 
+    # a few FENCED steps for the auditable artifact: per-step wall times
+    # with a host round-trip fence each (excluded from the headline, which
+    # keeps the async-dispatch profile). Never let a transient failure
+    # here discard the already-successful headline measurement.
+    per_step = []
+    try:
+        for _ in range(3):
+            batch = next(it)
+            s0 = time.perf_counter()
+            loss2 = tr.train_step(batch)
+            _sync(loss2)
+            per_step.append(round(time.perf_counter() - s0, 4))
+    except Exception as e:
+        _log(f"fenced-step loop failed (headline kept): {e}")
+
     tokens = batch_size * seq_len * steps
     return (tokens / dt, dt / steps, stall / steps, float(loss),
-            model)
+            model, per_step)
 
 
 def _decode_bench(cfg, on_tpu):
@@ -244,6 +260,75 @@ def _decode_bench(cfg, on_tpu):
     return out
 
 
+_ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_artifacts")
+
+
+def _write_tpu_artifact(payload):
+    """Persist every successful real-TPU measurement as an auditable JSON
+    (round-3 verdict: TPU claims without committed artifacts are
+    unauditable). Includes git HEAD so the artifact pins the exact code."""
+    import datetime
+    import subprocess
+    try:
+        os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+        try:
+            head = subprocess.run(
+                ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+                cwd=os.path.dirname(_ARTIFACT_DIR),
+                timeout=10).stdout.strip() or "unknown"
+        except Exception:
+            head = "unknown"
+        art = dict(payload)
+        art["git_head"] = head
+        now = datetime.datetime.now(datetime.timezone.utc)
+        art["captured_at"] = now.isoformat()
+        d = payload.get("detail", {})
+        # timestamp + attention path in the name: a later degraded run must
+        # never clobber an earlier good artifact of the same config
+        name = (f"tpu_{d.get('device', 'unknown').replace(' ', '_')}"
+                f"_{d.get('params', 0) // 1_000_000}M"
+                f"_s{d.get('seq_len', 0)}"
+                f"_{d.get('attention_path', 'x').split(' ')[0]}"
+                f"_{now.strftime('%Y%m%dT%H%M%S')}.json")
+        path = os.path.join(_ARTIFACT_DIR, name)
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1)
+        _log(f"TPU artifact written: {path} (commit it!)")
+    except Exception as e:
+        _log(f"artifact write failed: {e}")
+
+
+def _latest_tpu_artifact():
+    """Newest committed TPU artifact, surfaced when the round-end tunnel is
+    down so the official record still points at auditable TPU data."""
+    try:
+        files = [os.path.join(_ARTIFACT_DIR, f)
+                 for f in os.listdir(_ARTIFACT_DIR) if f.endswith(".json")]
+        if not files:
+            return None
+        # order by the embedded capture time, not fs mtime (fresh clones
+        # assign arbitrary near-identical mtimes)
+        def cap_time(path):
+            try:
+                with open(path) as f:
+                    return json.load(f).get("captured_at", "")
+            except Exception:
+                return ""
+        newest = max(files, key=cap_time)
+        with open(newest) as f:
+            art = json.load(f)
+        return {"file": os.path.relpath(newest, os.path.dirname(_ARTIFACT_DIR)),
+                "git_head": art.get("git_head"),
+                "captured_at": art.get("captured_at"),
+                "value": art.get("value"), "unit": art.get("unit"),
+                "vs_baseline": art.get("vs_baseline"),
+                "mfu": art.get("detail", {}).get("mfu"),
+                "backend": art.get("detail", {}).get("backend")}
+    except Exception:
+        return None
+
+
 def _run(error_note):
     import jax
 
@@ -282,7 +367,7 @@ def _run(error_note):
     for tier, apply in attempts:
         apply()
         try:
-            tps, step_s, stall_s, loss, model = _train_bench(
+            tps, step_s, stall_s, loss, model, per_step = _train_bench(
                 cfg, batch_size, seq_len, steps, warmup)
             if tier != "as-configured":
                 note = (f"degraded to {tier} after: "
@@ -321,6 +406,7 @@ def _run(error_note):
         "seq_len": seq_len,
         "steps": steps,
         "step_time_s": round(step_s, 4),
+        "fenced_step_times_s": per_step,
         "input_stall_s_per_step": round(stall_s, 4),
         "mfu": round(mfu, 4),
         "final_loss": loss,
@@ -336,6 +422,12 @@ def _run(error_note):
     }
     if error_note:
         payload["error"] = error_note
+    if on_tpu:
+        _write_tpu_artifact(payload)
+    else:
+        last = _latest_tpu_artifact()
+        if last:
+            payload["last_tpu_artifact"] = last
     _emit(payload)
 
 
