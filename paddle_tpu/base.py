@@ -165,18 +165,23 @@ class ParamAttr:
 
 class LazyGuard:
     """Context manager deferring parameter materialization (reference:
-    python/paddle/fluid/lazy_init.py LazyGuard). Initializers are cheap jnp
-    computations here, so laziness is not needed for memory — the guard is
-    provided for API parity and simply marks the scope."""
+    python/paddle/fluid/lazy_init.py LazyGuard). Inside the guard,
+    ``create_parameter`` produces ABSTRACT values (jax.ShapeDtypeStruct)
+    instead of running initializers — so an 8B/70B model can be
+    constructed for sharding-plan and memory-fit analysis (eval_shape
+    style) without materializing a single weight. Materialize later by
+    re-building the model outside the guard, or use the abstract tree with
+    jax.jit(...).lower() / NamedSharding.shard_shape."""
 
     _active = False
 
     def __enter__(self):
+        self._prev = type(self)._active
         type(self)._active = True
         return self
 
     def __exit__(self, *exc):
-        type(self)._active = False
+        type(self)._active = self._prev   # nesting-safe restore
         return False
 
 
@@ -187,13 +192,19 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     from .nn import initializer as init_mod
     from .nn.layer import Parameter
     from .core import dtype as _dt
+    trainable = attr.trainable if attr is not None else True
+    if LazyGuard._active:
+        import jax
+        import numpy as _np
+        value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                     _np.dtype(_dt.convert_dtype(dtype)))
+        return Parameter(value, trainable=trainable)
     init = default_initializer
     if attr is not None and getattr(attr, "initializer", None) is not None:
         init = attr.initializer
     if init is None:
         init = init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
     value = init(tuple(int(s) for s in shape), _dt.convert_dtype(dtype))
-    trainable = attr.trainable if attr is not None else True
     return Parameter(value, trainable=trainable)
 
 

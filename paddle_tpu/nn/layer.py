@@ -121,6 +121,12 @@ class Layer:
         (the layer's curated default) > Xavier/zeros."""
         from . import initializer as init_mod
         dtype = _dtype_mod.convert_dtype(dtype) if dtype is not None else _default_dtype
+        from ..base import LazyGuard
+        if LazyGuard._active:
+            # abstract init: shape/dtype only, no weight materialization
+            value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                         jnp.dtype(dtype))
+            return Parameter(value, trainable=trainable, sharding=sharding)
         if initializer is None:
             initializer = init_mod._global_default(is_bias)
         if initializer is None:

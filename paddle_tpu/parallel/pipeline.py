@@ -256,21 +256,35 @@ class PipelineStack(Layer):
         # stacked Parameters below, so the template's own values are dropped
         # (replaced by zero-cost host views — functional_call always binds
         # real values over them).
+        from ..base import LazyGuard
+        lazy = LazyGuard._active
         template = make_layer()
-        for _, p in template.named_parameters():
-            p.value = np.broadcast_to(np.zeros((), np.asarray(p.value).dtype),
-                                      tuple(p.value.shape))
+        if not lazy:
+            for _, p in template.named_parameters():
+                p.value = np.broadcast_to(
+                    np.zeros((), np.asarray(p.value).dtype),
+                    tuple(p.value.shape))
         object.__setattr__(self, "template", template)
         # build stacked parameters by initializing num_layers independent
         # copies and stacking leaf-wise (keeps per-layer init distributions).
-        trees = []
-        for _ in range(num_layers):
-            lyr = make_layer()
-            trees.append({n: p.value for n, p in lyr.named_parameters()})
+        # Under LazyGuard everything stays abstract: one template's shapes
+        # are enough to derive the [L, ...] stacked ShapeDtypeStructs.
         template_params = dict(self.template.named_parameters())
-        self._leaf_names = list(trees[0].keys())
+        if lazy:
+            self._leaf_names = list(template_params.keys())
+            stacks = {n: jax.ShapeDtypeStruct(
+                          (num_layers,) + tuple(p.value.shape), p.value.dtype)
+                      for n, p in template_params.items()}
+        else:
+            trees = []
+            for _ in range(num_layers):
+                lyr = make_layer()
+                trees.append({n: p.value for n, p in lyr.named_parameters()})
+            self._leaf_names = list(trees[0].keys())
+            stacks = {name: jnp.stack([t[name] for t in trees])
+                      for name in self._leaf_names}
         for name in self._leaf_names:
-            stacked = jnp.stack([t[name] for t in trees])
+            stacked = stacks[name]
             tp = template_params[name]
             base_shard = tuple(tp.sharding) if tp.sharding else (None,) * (stacked.ndim - 1)
             pname = "stack__" + name.replace(".", "__")
@@ -288,6 +302,9 @@ class PipelineStack(Layer):
             return stacked
         V, S = self.num_chunks, self.num_stages
         k = self.num_layers // (S * V)
+        if isinstance(stacked, jax.ShapeDtypeStruct):   # LazyGuard path
+            return jax.ShapeDtypeStruct((V, S, k) + tuple(stacked.shape[1:]),
+                                        stacked.dtype)
         return stacked.reshape((V, S, k) + stacked.shape[1:])
 
     def unpack_leaf(self, stored):

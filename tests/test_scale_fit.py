@@ -1,0 +1,152 @@
+"""Scale-fit proof for the flagship configs (round-3 verdict item 3).
+
+llama3_8b must fit a v5p-8 / v5p-16 mesh and llama3_70b a v5p-64 mesh —
+params + AdamW state + activations per microbatch — with every parameter's
+NamedSharding resolving on the planned axes. Models are built ABSTRACTLY
+under paddle_tpu.LazyGuard (no weights materialized), the per-device
+footprint comes from the real parameter tree + sharding annotations
+(parallel/scale.py), and the closed-form estimator
+(distributed.auto_tuner.estimate_memory_gb) is cross-checked against it.
+
+Reference analogue: auto_tuner/prune.py prune_by_memory_estimation and the
+4D recipes fleet/meta_parallel supports.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                     LlamaForCausalLMPipe)
+from paddle_tpu.parallel import scale
+
+
+def _abstract(cfg, pipe_stages=None, **pipe_kw):
+    with pt.LazyGuard():
+        if pipe_stages:
+            return LlamaForCausalLMPipe(cfg, num_stages=pipe_stages, **pipe_kw)
+        return LlamaForCausalLM(cfg)
+
+
+class TestLlama8B:
+    def test_param_count(self):
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        n = sum(int(np.prod(p.value.shape)) for _, p in m.named_parameters())
+        assert 8.0e9 < n < 8.1e9  # Llama-3-8B has 8.03B params
+
+    def test_fits_v5p8_pure_fsdp(self):
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        ok, br = scale.fits(m, {"fsdp": 8}, seq_len=8192,
+                            microbatch_size=1, device="v5p")
+        assert ok, br
+        # sanity: fp32 opt state dominates; per-device total in a
+        # plausible band (params 2 + grads 2 + opt 12 + acts)
+        assert 14 < br["total_gb"] < 40, br
+
+    def test_fits_v5p16_fsdp_tp(self):
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        ok, br = scale.fits(m, {"fsdp": 2, "tp": 8}, seq_len=8192,
+                            microbatch_size=2, device="v5p")
+        assert ok, br
+
+    def test_does_not_fit_v5e_single_chip(self):
+        # negative control: 8B training state cannot fit one 16GB v5e
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        ok, br = scale.fits(m, {"dp": 1}, seq_len=8192,
+                            microbatch_size=1, device="v5e")
+        assert not ok, br
+
+    def test_sharding_plan_resolves(self):
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        axes = {"fsdp": 2, "tp": 8}
+        plan = {name: (spec, local)
+                for name, p, spec, local in scale.param_plan(m, axes)}
+        # the matmul-heavy params must shard over BOTH axes
+        import jax.sharding as js
+        P = js.PartitionSpec
+        for key, want in [
+            ("lm_head", P("fsdp", "tp")),
+            ("model.embed_tokens", P("tp", "fsdp")),
+        ]:
+            assert plan[key][0] == want, (key, plan[key][0])
+        # every decoder projection is 2D-sharded (no replicated matmuls)
+        for name, (spec, local) in plan.items():
+            if any(t in name for t in ("qkv_proj", "o_proj", "gate_up",
+                                       "down_proj")):
+                assert set(a for a in spec if a) == {"fsdp", "tp"}, (name, spec)
+        # norms replicate
+        assert plan["model.norm.weight"][0] == P()
+
+    def test_matches_auto_tuner_estimate(self):
+        """The closed-form tuner estimate and the parameter-tree analysis
+        must agree within 2x (they are independent derivations)."""
+        from paddle_tpu.distributed.auto_tuner import (TunerConfig,
+                                                       estimate_memory_gb)
+        m = _abstract(LlamaConfig.llama3_8b(dtype="bfloat16"))
+        _, br = scale.fits(m, {"fsdp": 8}, seq_len=8192, microbatch_size=1,
+                           device="v5p")
+        tc = TunerConfig(num_devices=8, model_params_b=br["n_params"] / 1e9,
+                         hidden_size=4096, num_layers=32, seq_len=8192,
+                         vocab_size=128256)
+        cand = {"sharding_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                "dp_degree": 1, "micro_batch_size": 1, "use_recompute": True,
+                "accumulate_steps": 1}
+        est = estimate_memory_gb(tc, cand)
+        ratio = br["total_gb"] / est
+        assert 0.5 < ratio < 2.0, (br["total_gb"], est)
+
+
+class TestLlama70B:
+    def test_param_count(self):
+        cfg = LlamaConfig.llama3_70b(dtype="bfloat16")
+        m = _abstract(cfg, pipe_stages=4, num_microbatches=8,
+                      pp_schedule="1f1b")
+        n = sum(int(np.prod(p.value.shape)) for _, p in m.named_parameters())
+        assert 70.0e9 < n < 71.0e9  # Llama-3-70B has 70.6B params
+
+    def test_fits_v5p64_pp4_fsdp2_tp8(self):
+        cfg = LlamaConfig.llama3_70b(dtype="bfloat16")
+        m = _abstract(cfg, pipe_stages=4, num_microbatches=8,
+                      pp_schedule="1f1b")
+        axes = {"pp": 4, "fsdp": 2, "tp": 8}   # v5p-64
+        ok, br = scale.fits(m, axes, seq_len=8192, microbatch_size=1,
+                            device="v5p")
+        assert ok, br
+        assert 15 < br["total_gb"] < 60, br
+
+    def test_stacked_params_shard_over_pp(self):
+        cfg = LlamaConfig.llama3_70b(dtype="bfloat16")
+        m = _abstract(cfg, pipe_stages=4, num_microbatches=8,
+                      pp_schedule="1f1b")
+        axes = {"pp": 4, "fsdp": 2, "tp": 8}
+        saw_stack = 0
+        for name, p, spec, local in scale.param_plan(m, axes):
+            if name.startswith("decoder.stack__"):
+                saw_stack += 1
+                assert spec[0] == "pp", (name, spec)
+                # leading (layer) dim divides across pp: 80/4 = 20
+                assert local[0] == cfg.num_hidden_layers // 4, (name, local)
+        assert saw_stack >= 6  # qkv, o, gate_up, down, 2 norms
+
+    def test_gqa_kv_heads_vs_tp(self):
+        # tp=8 divides num_key_value_heads=8 exactly — the plan's TP degree
+        # is compatible with GQA head grouping
+        cfg = LlamaConfig.llama3_70b()
+        assert cfg.num_key_value_heads % 8 == 0
+
+
+class TestLazyGuard:
+    def test_lazy_params_are_abstract(self):
+        import jax
+        with pt.LazyGuard():
+            m = LlamaForCausalLM(LlamaConfig.tiny())
+        for _, p in m.named_parameters():
+            assert isinstance(p.value, jax.ShapeDtypeStruct)
+
+    def test_guard_restores_eager_init(self):
+        import jax
+        with pt.LazyGuard():
+            pass
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        for _, p in m.named_parameters():
+            assert isinstance(p.value, jax.Array)
