@@ -400,6 +400,12 @@ def _run(error_note):
         "backend": backend,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "attention_path": attn_path,
+        # report what is ACTUALLY in XLA_FLAGS — PT_NO_OVERLAP only stops
+        # bench from adding flags, it cannot strip preexisting ones
+        "overlap_flags": ("on" if "async_collective" in
+                          os.environ.get("XLA_FLAGS", "")
+                          else ("off" if os.environ.get("PT_NO_OVERLAP")
+                                else "default")),
         "n_chips": n_chips,
         "params": model.num_params(),
         "batch_size": batch_size,
@@ -434,7 +440,12 @@ def _run(error_note):
 def main():
     tpu_ok, note = _probe_tpu()
     error_note = None
-    if not tpu_ok:
+    if tpu_ok:
+        # async-collective + latency-hiding scheduler flags (overlap.py);
+        # A/B lever: PT_NO_OVERLAP=1
+        from paddle_tpu.distributed.overlap import apply_overlap_flags
+        apply_overlap_flags(True, target="tpu")
+    else:
         error_note = f"TPU unavailable, CPU fallback: {note}"
         # config.update beats the site hook's forced jax_platforms=axon,cpu;
         # must run before any backend initialization in this process

@@ -44,6 +44,11 @@ def init(is_collective: bool = True, strategy: Optional[DistributedStrategy] = N
         raise NotImplementedError(
             "parameter-server mode has no TPU backend; use collective")
     strategy = strategy or DistributedStrategy()
+    # overlap knobs (mp_async_allreduce etc.) map to XLA scheduler flags;
+    # must land before first backend use to take effect (overlap.py warns
+    # otherwise)
+    from ..overlap import apply_strategy_overlap
+    apply_strategy_overlap(strategy)
     hc = strategy.hybrid_configs
     hm = HybridMesh.build(dp=hc.dp_degree, fsdp=hc.sharding_degree,
                           tp=hc.mp_degree, pp=hc.pp_degree,

@@ -1,0 +1,255 @@
+"""Comm/compute overlap controls.
+
+Reference analogues:
+- ``mp_async_allreduce`` (fleet/layers/mpu/mp_layers.py:458-477): overlap
+  the TP backward input-grad allreduce with the weight-grad matmul.
+- ``allreduce_matmul_grad_overlapping``
+  (distributed/passes/allreduce_matmul_grad_overlapping.py): split matmul_grad
+  so the dx allreduce overlaps the dW matmul.
+- sharding comm overlap (dygraph_sharding_optimizer.py:470): overlap grad
+  reduce-scatter with backward compute.
+
+TPU redesign: the reference needs these passes because torch/paddle eager
+autograd executes ops in strict sequence on one stream. Under XLA the
+dataflow graph ALREADY contains the independence (dx's all-reduce and the
+dW dot share no edge — verify with :func:`backward_overlap_independent`),
+and the TPU compiler's latency-hiding scheduler turns that independence
+into actual overlap when async collectives are enabled. So the knobs here
+map to (a) XLA scheduler flags, applied process-wide before backend init,
+and (b) analysis helpers that PROVE the overlap precondition on compiled
+HLO — the moral equivalent of the reference's pass unit tests.
+
+GSPMD also already emits the overlap-friendly grad-sync structure for
+gradient accumulation: the dp/fsdp all-reduce sits INSIDE the microbatch
+loop body (one per microbatch, overlappable with the next microbatch's
+compute) rather than one deferred sync — check with
+:func:`collectives_in_loop`.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+# The TPU async-collective + latency-hiding-scheduler set. These are the
+# production XLA knobs that let the scheduler hide collective latency
+# behind independent compute (the effect the reference's overlap passes
+# hand-implement). Safe to set on CPU (unknown flags are rejected loudly at
+# backend init, so we only add them when the target is TPU).
+OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true "
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true "
+    "--xla_tpu_overlap_compute_collective_tc=true "
+    "--xla_enable_async_all_gather=true "
+    "--xla_enable_async_collective_permute=true "
+)
+
+
+def apply_overlap_flags(enable: bool = True, *, target: str = "tpu") -> str:
+    """Install the overlap scheduler flags into XLA_FLAGS (idempotent).
+
+    Must run BEFORE jax backend initialization — flags set after the
+    backend is live are ignored, in which case this warns and returns the
+    current value unchanged. ``PT_NO_OVERLAP=1`` forces them off (the A/B
+    lever for measuring the overlap win on hardware)."""
+    if os.environ.get("PT_NO_OVERLAP"):
+        enable = False
+    cur = os.environ.get("XLA_FLAGS", "")
+    if not enable or target != "tpu":
+        return cur
+    # match by flag NAME so an explicit user "=false" is respected, not
+    # silently overridden with a conflicting duplicate
+    missing = [f for f in OVERLAP_XLA_FLAGS.split()
+               if f.split("=")[0] not in cur]
+    if not missing:
+        return cur
+    try:
+        initialized = jax._src.xla_bridge._backends  # noqa: SLF001
+    except AttributeError:
+        initialized = {}
+    if initialized:
+        sys.stderr.write(
+            "paddle_tpu.overlap: backend already initialized; XLA overlap "
+            "flags NOT applied (set strategy before first jax use)\n")
+        return cur
+    new = (cur + " " + " ".join(missing)).strip()
+    os.environ["XLA_FLAGS"] = new
+    return new
+
+
+# ---------------------------------------------------------------------------
+# HLO analysis: prove the overlap preconditions on the compiled program
+# ---------------------------------------------------------------------------
+
+_INSTR_LHS = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*")
+# opcode = first word directly followed by '(' after the (possibly tuple)
+# result type — tuple types like "(s32[], f32[4])" never match word-paren
+_OPCODE = re.compile(r"\s([a-z][\w\-]*)\(")
+_OPND = re.compile(r"%([\w.\-]+)")
+# computation header: "%name (params...) -> type {" or "ENTRY %name (...) {"
+_COMP_HDR = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s+\(.*\{")
+_COMP_REF_ONE = re.compile(
+    r"(?:calls|body|condition|to_apply)=%?([\w.\-]+)")
+_COMP_REF_LIST = re.compile(
+    r"(?:calls|branch_computations)=\{([^}]*)\}")
+_COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                   "collective-permute", "all-to-all")
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|reduce-scatter|all-gather|collective-permute|"
+    r"all-to-all)(-start|-done)?\(")
+
+
+def _parse_hlo(txt: str):
+    """Returns (graph, comp_of, comp_members): instruction dataflow plus
+    computation membership. Instructions that reference a computation
+    (while body, fusion calls, conditional branches) get dependency edges
+    to EVERY instruction of that computation — a conservative
+    over-approximation that keeps independence claims sound."""
+    graph: Dict[str, Tuple[str, List[str]]] = {}
+    comp_of: Dict[str, str] = {}
+    comp_members: Dict[str, List[str]] = {}
+    cur = None
+    for line in txt.splitlines():
+        h = _COMP_HDR.match(line.strip())
+        if h and "=" not in line.split("(")[0]:
+            cur = h.group(1)
+            comp_members.setdefault(cur, [])
+        m = _INSTR_LHS.match(line)
+        if not m:
+            continue
+        name = m.group(1)
+        rhs = line.split("=", 1)[1]
+        mo = _OPCODE.search(" " + rhs)
+        if not mo:
+            continue
+        op = mo.group(1)
+        opnds = [o for o in _OPND.findall(rhs) if o != name]
+        # computation references become dependencies on the whole callee
+        refs = list(_COMP_REF_ONE.findall(rhs))
+        for r in _COMP_REF_LIST.findall(rhs):
+            refs.extend(p.strip().lstrip("%") for p in r.split(","))
+        graph[name] = (op, opnds + [f"comp:{r}" for r in refs if r])
+        if cur is not None:
+            comp_of[name] = cur
+            comp_members[cur].append(name)
+    return graph, comp_of, comp_members
+
+
+def _ancestors(graph, comp_members, name):
+    seen = set()
+    todo = list(graph.get(name, ("", []))[1])
+    while todo:
+        n = todo.pop()
+        if n.startswith("comp:"):
+            for member in comp_members.get(n[5:], ()):
+                if member not in seen:
+                    seen.add(member)
+                    todo.extend(graph.get(member, ("", []))[1])
+            continue
+        if n in seen or n not in graph:
+            continue
+        seen.add(n)
+        todo.extend(graph[n][1])
+    return seen
+
+
+def backward_overlap_independent(compiled_text: str) -> bool:
+    """True if some collective and some dot are mutually independent in the
+    HLO — the precondition for the latency-hiding scheduler to overlap the
+    TP backward allreduce with the weight-grad matmul
+    (reference mp_async_allreduce's effect)."""
+    g, _, members = _parse_hlo(compiled_text)
+    colls = [n for n, (op, _) in g.items()
+             if op.replace("-start", "").replace("-done", "")
+             in _COLLECTIVE_OPS]
+    dots = [n for n, (op, _) in g.items()
+            if op in ("dot", "convolution") or "dot" in op]
+    for c in colls:
+        anc_c = _ancestors(g, members, c)
+        for d in dots:
+            if d in anc_c:
+                continue
+            if c in _ancestors(g, members, d):
+                continue
+            return True
+    return False
+
+
+def collectives_in_loop(compiled_text: str) -> Tuple[int, int]:
+    """(total collectives, collectives inside while bodies), counting the
+    async -start forms too. A collective inside the microbatch loop body
+    syncs per microbatch — the structure that overlaps grad comm with the
+    next microbatch's compute."""
+    total = 0
+    in_body = 0
+    body_names = set(re.findall(r"body=%?([\w.\-]+)", compiled_text))
+    cur = None
+    for line in compiled_text.splitlines():
+        h = _COMP_HDR.match(line.strip())
+        if h and "=" not in line.split("(")[0]:
+            cur = h.group(1)
+        if _COLLECTIVE_RE.search(line) and "=" in line:
+            if "-done(" in line:
+                continue          # count start/done pairs once
+            total += 1
+            if cur in body_names:
+                in_body += 1
+    return total, in_body
+
+
+def strategy_overlap_summary(strategy) -> Dict[str, bool]:
+    """Which reference overlap knobs the strategy requests. Unknown knobs
+    land in strategy.extras; the three reference names are honored."""
+    tp_cfg = getattr(strategy, "tensor_parallel", None)
+    sh_cfg = getattr(strategy, "sharding", None)
+    extras = getattr(strategy, "extras", {}) or {}
+    return {
+        "mp_async_allreduce": bool(
+            getattr(tp_cfg, "mp_async_allreduce", False)
+            or extras.get("mp_async_allreduce")),
+        "allreduce_matmul_grad_overlapping": bool(
+            extras.get("allreduce_matmul_grad_overlapping")),
+        "sharding_comm_overlap": bool(
+            getattr(sh_cfg, "comm_overlap", False)
+            or extras.get("comm_overlap")),
+    }
+
+
+def apply_strategy_overlap(strategy, *, target: Optional[str] = None) -> str:
+    """Map the reference overlap knobs to the XLA scheduler flags. Any one
+    of them on → async collectives + latency hiding on (they are one
+    mechanism under XLA)."""
+    summary = strategy_overlap_summary(strategy)
+    if target is None:
+        target = _detect_target()
+    if any(summary.values()):
+        return apply_overlap_flags(True, target=target)
+    return os.environ.get("XLA_FLAGS", "")
+
+
+def _config_platforms() -> str:
+    try:
+        return jax.config.jax_platforms or ""
+    except AttributeError:
+        return ""
+
+
+def _detect_target() -> str:
+    """'tpu' only when the process is actually headed for a TPU backend —
+    the flags are TPU-compiler-only and make a CPU backend init fatal."""
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return "cpu"
+    jp = _config_platforms() or os.environ.get("JAX_PLATFORMS", "")
+    # unknown/auto platform -> 'cpu': installing TPU-only flags on a
+    # non-TPU backend is fatal at init, so only opt in on clear evidence
+    return "tpu" if ("tpu" in jp or "axon" in jp) else "cpu"
+
+
+__all__ = ["OVERLAP_XLA_FLAGS", "apply_overlap_flags",
+           "backward_overlap_independent", "collectives_in_loop",
+           "strategy_overlap_summary", "apply_strategy_overlap"]

@@ -42,6 +42,9 @@ class ShardingConfig:
     stage: int = 1               # 1: opt-state, 2: +grads, 3: +params
     degree: int = 1
     offload: bool = False        # opt-state to pinned_host (trainer/sharding)
+    comm_overlap: bool = False   # reduce-scatter overlaps backward compute
+                                 # (reference dygraph_sharding_optimizer:470;
+                                 # maps to XLA async collectives, overlap.py)
 
 
 @dataclass
@@ -58,6 +61,9 @@ class TensorParallelConfig:
     """Reference: strategy.tensor_parallel / tensor_parallel_configs."""
     enable: bool = False
     tensor_parallel_degree: int = 1
+    mp_async_allreduce: bool = False  # overlap TP bwd allreduce with dW
+                                      # matmul (reference mp_layers.py:458;
+                                      # maps to XLA async collectives)
 
 
 @dataclass
