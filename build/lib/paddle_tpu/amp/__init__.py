@@ -1,0 +1,24 @@
+"""paddle_tpu.amp — mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast at auto_cast.py:703, GradScaler at
+grad_scaler.py:578, op lists amp_lists.py). On TPU bf16 is the native compute
+dtype and needs no loss scaling, so GradScaler degrades to a pass-through for
+bf16 while keeping real dynamic loss scaling for fp16 API parity.
+"""
+
+from .auto_cast import auto_cast, amp_guard, amp_state, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+
+from . import debugging  # noqa: E402  (TensorCheckerConfig, check_numerics)
+
+from .auto_cast import decorate  # noqa: E402
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is the native TPU compute dtype (and jax CPU emulates it)."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    import jax
+    return jax.devices()[0].platform in ("tpu", "gpu", "cpu")

@@ -1,0 +1,106 @@
+"""auto_cast: mixed-precision regions.
+
+Reference: python/paddle/amp/auto_cast.py (amp_guard:273, auto_cast:703) and
+amp_lists.py (WHITE_LIST :20-35 — matmul/conv/einsum run in low precision;
+BLACK_LIST — softmax/CE/norms stay fp32). The two-list + O1/O2 level
+structure is preserved; on TPU the low-precision dtype defaults to bfloat16.
+
+Mechanism: a context sets thread-local amp state; the compute-heavy
+functional ops (linear, matmul-like, conv, attention) consult
+``maybe_cast_inputs`` to cast inputs to the low-precision dtype, while
+black-listed ops (norms, losses) already compute statistics in fp32.
+O2 additionally expects the model cast via ``amp.decorate`` /
+``layer.to('bfloat16')``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+
+# op-name lists for introspection/parity; the functional layer consults
+# membership through maybe_cast_inputs call sites.
+WHITE_LIST = {"conv1d", "conv2d", "conv3d", "einsum", "matmul", "matmul_v2", "mul", "linear",
+              "attention", "fused_rope", "bmm"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "rms_norm",
+              "group_norm", "batch_norm", "exp", "log", "mean", "sum", "cumsum"}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = frozenset(WHITE_LIST)
+        self.black = frozenset(BLACK_LIST)
+
+
+_STATE = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _STATE
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """Mirrors paddle.amp.auto_cast."""
+    prev = (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.white, _STATE.black)
+    _STATE.enabled = enable
+    _STATE.dtype = _dt.convert_dtype(dtype)
+    _STATE.level = level
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    black = set(BLACK_LIST) | set(custom_black_list or ())
+    _STATE.white = frozenset(white - black)
+    _STATE.black = frozenset(black)
+    try:
+        yield
+    finally:
+        (_STATE.enabled, _STATE.dtype, _STATE.level,
+         _STATE.white, _STATE.black) = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name: str, *xs):
+    """Cast floating inputs to the amp dtype when inside an enabled O1/O2
+    auto_cast region and the op is white-listed."""
+    if not _STATE.enabled or op_name not in _STATE.white:
+        return xs
+    out = []
+    for x in xs:
+        if x is not None and hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) \
+                and x.dtype != _STATE.dtype:
+            out.append(x.astype(_STATE.dtype))
+        else:
+            out.append(x)
+    return tuple(out)
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (master weights live
+    in the optimizer state — optimizer/optimizer.py multi_precision)."""
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    if level == "O2":
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else ms
+    return (models if single else ms), optimizers

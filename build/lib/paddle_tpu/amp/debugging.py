@@ -1,0 +1,165 @@
+"""AMP debugging / numerical-correctness nets (reference:
+python/paddle/amp/debugging.py — TensorCheckerConfig, enable_tensor_checker,
+check_numerics, collect_operator_stats; runtime flag FLAGS_check_nan_inf at
+paddle/phi/core/flags.cc:74 with per-op scanning in
+paddle/fluid/eager/nan_inf_utils.cc).
+
+TPU-native: inside jit, elementwise scans fold into the surrounding fusion
+(cheap), so ``check_numerics`` works both eagerly and traced —
+``jax.debug.print`` reports from device when tracing. ``enable_tensor_checker``
+additionally flips jax's global debug_nans for the eager path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics", "DebugMode",
+           "collect_operator_stats", "compare_accuracy"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+@dataclasses.dataclass
+class TensorCheckerConfig:
+    enable: bool = True
+    debug_mode: int = DebugMode.CHECK_NAN_INF_AND_ABORT
+    output_dir: Optional[str] = None
+    checked_op_list: Optional[list] = None
+    skipped_op_list: Optional[list] = None
+
+
+_checker_on = False
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    """Global nan/inf tripwire (reference enable_tensor_checker): eager jax
+    ops raise on nan when jax_debug_nans is on; traced code should call
+    check_numerics at the points of interest."""
+    global _checker_on
+    _checker_on = bool(config.enable)
+    jax.config.update("jax_debug_nans", _checker_on and
+                      config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT)
+
+
+def disable_tensor_checker() -> None:
+    global _checker_on
+    _checker_on = False
+    jax.config.update("jax_debug_nans", False)
+
+
+def check_numerics(x, op_type: str = "", var_name: str = "",
+                   raise_on_nan: bool = True):
+    """Scan a tensor (tree) for nan/inf (reference check_numerics /
+    FLAGS_check_nan_inf per-op scan). Jit-safe: uses error_if under trace
+    when raising, debug print otherwise. Returns x unchanged so it can be
+    inserted inline: ``x = check_numerics(x, "attn", "logits")``."""
+
+    def one(v):
+        if not isinstance(v, jax.Array) and not hasattr(v, "dtype"):
+            return v
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            return v
+        bad = jnp.logical_or(jnp.any(jnp.isnan(v)), jnp.any(jnp.isinf(v)))
+        if isinstance(bad, jax.core.Tracer):
+            # inside jit a Python raise is impossible; report from device.
+            # (an aborting traced check would need checkify — the reference's
+            # abort mode maps to the eager path below)
+            jax.debug.print(
+                "[check_numerics] {op}/{name}: nan/inf={b}",
+                op=op_type, name=var_name, b=bad)
+            return v
+        if bool(bad):
+            msg = (f"[check_numerics] nan/inf detected in {op_type or '?'}"
+                   f"/{var_name or '?'} shape={tuple(v.shape)}")
+            if raise_on_nan:
+                raise FloatingPointError(msg)
+            print(msg)
+        return v
+
+    return jax.tree.map(one, x)
+
+
+# ---------------------------------------------------------------------------
+# operator stats (reference collect_operator_stats: counts of fp16/bf16/fp32
+# calls while autocast is active)
+# ---------------------------------------------------------------------------
+
+class _OpStats:
+    def __init__(self):
+        self.counts = {"float16": 0, "bfloat16": 0, "float32": 0, "other": 0}
+
+    def record(self, dtype):
+        key = str(dtype)
+        # check bfloat16 before float16 — "float16" is a substring of it
+        for k in ("bfloat16", "float16", "float32"):
+            if k in key:
+                self.counts[k] += 1
+                return
+        self.counts["other"] += 1
+
+
+_active_stats: Optional[_OpStats] = None
+
+
+def record_op_dtype(dtype) -> None:
+    """Called by the autocast layer per op when stats collection is on."""
+    if _active_stats is not None:
+        _active_stats.record(dtype)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context manager printing low/high-precision op-call counts on exit
+    (reference debugging.collect_operator_stats)."""
+    global _active_stats
+    _active_stats = _OpStats()
+    try:
+        yield _active_stats
+    finally:
+        stats = _active_stats
+        _active_stats = None
+        total = sum(stats.counts.values())
+        print("<------------------------------ op list ------------------"
+              "------------>")
+        for k, v in stats.counts.items():
+            print(f"  {k:<10} calls: {v}")
+        print(f"  total      calls: {total}")
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str = "compare.csv",
+                     loss_scale: float = 1.0, dump_all: bool = False):
+    """Compare two runs' tensor dumps (reference debugging.compare_accuracy):
+    matches tensors by name between two .npz dumps and reports max abs/rel
+    difference per tensor into a CSV."""
+    import csv
+    import numpy as np
+    a = np.load(dump_path)
+    b = np.load(another_dump_path)
+    rows = []
+    for k in sorted(set(a.files) & set(b.files)):
+        x, y = np.asarray(a[k], np.float64), np.asarray(b[k], np.float64)
+        if x.shape != y.shape:
+            rows.append((k, "shape_mismatch", x.shape, y.shape, "", ""))
+            continue
+        diff = np.abs(x - y)
+        denom = np.maximum(np.abs(x), 1e-12)
+        rows.append((k, "ok", x.shape, y.shape, diff.max(),
+                     (diff / denom).max()))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["name", "status", "shape_a", "shape_b", "max_abs_diff",
+                    "max_rel_diff"])
+        w.writerows(rows)
+    return rows

@@ -1,0 +1,109 @@
+"""GradScaler: dynamic loss scaling.
+
+Reference: python/paddle/amp/grad_scaler.py (AmpScaler:41, GradScaler:578).
+On TPU with bf16 no scaling is needed (``enable=False`` path); the fp16
+dynamic-scaling algorithm is implemented faithfully for API parity:
+scale *= incr_ratio every incr_every_n_steps good steps; on NaN/Inf skip the
+update and scale *= decr_ratio after decr_every_n_nan_or_inf bad steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AmpScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float) -> None:
+        self._scale = float(v)
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """Unscale grads and record found_inf (host-side sync)."""
+        if not self._enable:
+            return grads
+        inv = 1.0 / self._scale
+        out = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        finite = all(bool(jnp.all(jnp.isfinite(g))) for g in jax.tree.leaves(out))
+        self._found_inf = not finite
+        self._already_unscaled = True
+        return out
+
+    def step(self, optimizer, grads: Optional[Dict] = None):
+        """unscale (unless the caller already did, e.g. to clip) +
+        skip-on-inf + optimizer.step. Mirrors the reference's unscaled-state
+        tracking (grad_scaler.py OptimizerState) so the standard
+        unscale_ -> clip -> step pattern never divides twice."""
+        if not self._enable:
+            optimizer.step(grads)
+            return
+        if not self._already_unscaled:
+            grads = self.unscale_(grads)
+        if not self._found_inf:
+            optimizer.step(grads)
+
+    def update(self) -> None:
+        self._already_unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._good_steps = 0
+            self._bad_steps += 1
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._bad_steps = 0
+            self._good_steps += 1
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, loss, grads=None):
+        self.step(optimizer, grads)
+        self.update()
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps, "enable": self._enable}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]
+
+
+class GradScaler(AmpScaler):
+    """Public name (reference: grad_scaler.py:578)."""
+    pass

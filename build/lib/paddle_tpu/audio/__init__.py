@@ -1,0 +1,21 @@
+"""paddle_tpu.audio — audio features (reference: python/paddle/audio/:
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC,
+functional/window.py get_window, functional/functional.py mel helpers).
+
+TPU-native: features are jnp compositions over paddle_tpu.fft (XLA lowers
+rFFTs natively), exposed both as functionals and as nn.Layer wrappers so
+they slot into models and get jit/vmap/grad for free.
+"""
+
+from . import functional
+from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+           "MFCC"]
+
+# -- round-3 parity batch ---------------------------------------------------
+from . import backends
+from . import datasets
+from .backends import info, load, save
+
+__all__ += ["backends", "datasets", "info", "load", "save"]

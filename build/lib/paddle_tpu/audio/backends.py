@@ -1,0 +1,89 @@
+"""paddle.audio.backends — wave I/O (reference: python/paddle/audio/
+backends/{init_backend.py,wave_backend.py}).
+
+The reference's default backend is its own wave_backend (stdlib wave) with
+optional paddleaudio acceleration; paddleaudio is not in this image, so the
+wave backend is the (only) registered backend — same default behavior.
+"""
+
+from __future__ import annotations
+
+import wave as _wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class AudioInfo:
+    """reference: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate: int, num_samples: int, num_channels: int,
+                 bits_per_sample: int, encoding: str = "PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave backend ships in this image "
+            "(paddleaudio is an optional external package)")
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[np.ndarray, int]:
+    """Returns (waveform [C, T] float32 in [-1, 1] when normalized, sr)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return arr, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: Optional[int] = 16
+         ) -> None:
+    arr = np.asarray(src)
+    if channels_first:
+        arr = arr.T                                   # [T, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(arr.astype("<i2").tobytes())

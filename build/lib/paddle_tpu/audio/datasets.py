@@ -1,0 +1,108 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/
+{tess.py,esc50.py}). Offline image: datasets take a local archive path
+(the same file the reference downloads); construction without one raises
+with the source URL.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends as _backends
+from .features import MelSpectrogram
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _AudioFolderDataset(Dataset):
+    _URL = ""
+    n_classes = 0
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000, **kwargs):
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_kwargs = kwargs
+
+    def _feature(self, wav: np.ndarray):
+        if self.feat_type == "raw":
+            return wav
+        if self.feat_type == "melspectrogram":
+            import jax.numpy as jnp
+            mel = MelSpectrogram(sr=self.sample_rate, **self.feat_kwargs)
+            return np.asarray(mel(jnp.asarray(wav)))
+        raise ValueError(f"unknown feat_type {self.feat_type!r}")
+
+    def __getitem__(self, idx):
+        wav, _ = _backends.load(self.files[idx])
+        return self._feature(wav[0]), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_AudioFolderDataset):
+    """Toronto emotional speech set (reference: audio/datasets/tess.py):
+    2800 wav files named ..._<emotion>.wav across 7 emotions."""
+
+    _URL = ("https://bj.bcebos.com/paddleaudio/datasets/"
+            "TESS_Toronto_emotional_speech_set.zip")
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+    n_classes = 7
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", archive=None,
+                 data_dir=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise ValueError(
+                f"TESS: pass data_dir= with the extracted archive "
+                f"(offline image; reference fetches {self._URL})")
+        files, labels = [], []
+        for root, _, names in os.walk(data_dir):
+            for name in sorted(names):
+                if not name.lower().endswith(".wav"):
+                    continue
+                emo = name.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.emotions:
+                    files.append(os.path.join(root, name))
+                    labels.append(self.emotions.index(emo))
+        # fold split like the reference: round-robin by index
+        keep = [i for i in range(len(files))
+                if (i % n_folds != split - 1) == (mode == "train")]
+        super().__init__([files[i] for i in keep],
+                         [labels[i] for i in keep], feat_type, **kwargs)
+
+
+class ESC50(_AudioFolderDataset):
+    """ESC-50 environmental sounds (reference: audio/datasets/esc50.py):
+    meta/esc50.csv with filename,fold,target columns."""
+
+    _URL = "https://bj.bcebos.com/paddleaudio/datasets/ESC-50-master.zip"
+    n_classes = 50
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", data_dir=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise ValueError(
+                f"ESC50: pass data_dir= with the extracted archive "
+                f"(offline image; reference fetches {self._URL})")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            header = f.readline().strip().split(",")
+            fi = header.index("filename")
+            fo = header.index("fold")
+            ta = header.index("target")
+            for line in f:
+                parts = line.strip().split(",")
+                in_test = int(parts[fo]) == split
+                if (mode == "train") != in_test:
+                    files.append(os.path.join(data_dir, "audio", parts[fi]))
+                    labels.append(int(parts[ta]))
+        super().__init__(files, labels, feat_type, **kwargs)

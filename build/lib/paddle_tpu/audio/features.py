@@ -1,0 +1,84 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    """|STFT|^power (reference features/layers.py Spectrogram)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length
+        self.win_length = win_length
+        self.window = window
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        spec = AF.stft(x, self.n_fft, self.hop_length, self.win_length,
+                       self.window, self.center, self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power)
+        fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+        self.register_buffer("fbank", fb)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)                    # [..., bins, frames]
+        return jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, n_mels, f_min, f_max)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, top_db: Optional[float] = None):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                         f_min=f_min, f_max=f_max,
+                                         top_db=top_db)
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        mel = self.log_mel(x)                          # [..., n_mels, frames]
+        return jnp.einsum("mk,...mt->...kt", self.dct, mel)

@@ -1,0 +1,130 @@
+"""Audio functionals (reference: python/paddle/audio/functional/):
+windows, mel scale conversion, filterbanks, stft power spectra, dct."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "stft", "power_to_db", "create_dct"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """reference: functional/window.py get_window (hann/hamming/blackman/
+    rect/triang). Periodic (fftbins) windows by default, like the reference."""
+    n = win_length
+    denom = n if fftbins else n - 1
+    k = jnp.arange(n)
+    if window in ("hann", "hanning"):
+        return 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+    if window == "hamming":
+        return 0.54 - 0.46 * jnp.cos(2 * math.pi * k / denom)
+    if window == "blackman":
+        return (0.42 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+                + 0.08 * jnp.cos(4 * math.pi * k / denom))
+    if window in ("rect", "boxcar", "ones"):
+        return jnp.ones((n,))
+    if window == "triang":
+        return 1.0 - jnp.abs((k - (n - 1) / 2) / ((n if fftbins else n - 1) / 2))
+    raise ValueError(f"unknown window {window!r}")
+
+
+def hz_to_mel(freq, htk: bool = False):
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # slaney
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(freq, 1e-10)
+                                           / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False):
+    mels = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """Triangular mel filterbank [n_mels, n_fft//2+1]
+    (reference functional.compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    fft_freqs = jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def stft(x, n_fft: int = 512, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window: str = "hann",
+         center: bool = True, pad_mode: str = "reflect"):
+    """[..., T] → complex [..., n_fft//2+1, frames]."""
+    from .. import fft as pfft
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = get_window(window, win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    if center:
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad_width, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])              # [frames, n_fft]
+    frames = x[..., idx] * w                          # [..., frames, n_fft]
+    spec = pfft.rfft(frames, axis=-1)                 # [..., frames, bins]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    log_spec = 10.0 * jnp.log10(jnp.maximum(magnitude, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                              math.sqrt(2.0 / n_mels))
+    else:
+        dct = dct * 2.0
+    return dct

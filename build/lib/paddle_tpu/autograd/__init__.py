@@ -1,0 +1,229 @@
+"""paddle_tpu.autograd — autodiff surface.
+
+Reference: python/paddle/autograd/ (backward_mode.py:23 backward,
+py_layer.py:29 PyLayer, functional jacobian/hessian) and the C++ eager engine
+(paddle/fluid/eager/backward.cc:105 RunBackward). There is no tape here: JAX
+vjp/jvp over the Layer functional bridge replaces the GradNode graph, and
+``grad``/``value_and_grad`` are the user-facing entry points.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+
+def grad(fn: Callable = None, argnums=0, has_aux: bool = False,
+         allow_unused: bool = False, **tape_kwargs):
+    """jax.grad with paddle-flavored naming.
+
+    The reference's TAPE form — ``paddle.grad(outputs=y, inputs=x)`` on
+    already-computed tensors — cannot exist without a global tape; it
+    raises with the functional migration recipe (same policy as
+    Tensor.backward; docs/DESIGN_DECISIONS.md eager-tape entry)."""
+    if "outputs" in tape_kwargs or "inputs" in tape_kwargs or (
+            fn is not None and not callable(fn)):
+        raise NotImplementedError(
+            "paddle.grad(outputs=..., inputs=...) differentiates an eager "
+            "tape, which this framework does not keep. Differentiate the "
+            "FUNCTION instead:\n"
+            "    g = paddle.autograd.grad(lambda x: (x * x).sum())(x)\n"
+            "or use autograd.layer_grad(model, loss_fn, *inputs) for "
+            "Layers (docs/DESIGN_DECISIONS.md eager-tape entry)")
+    if tape_kwargs:
+        raise TypeError(f"grad() got unexpected keyword arguments "
+                        f"{sorted(tape_kwargs)}")
+    if fn is None:
+        raise TypeError("grad() missing required argument: 'fn' (a callable"
+                        " to differentiate)")
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def layer_grad(layer: Layer, loss_fn: Callable, *args, **kwargs):
+    """Compute (loss, grads-dict) for a Layer: the imperative-API analogue of
+    ``loss.backward()`` + reading ``param.grad``.
+
+        loss, grads = autograd.layer_grad(model, lambda out: out.sum(), x)
+        opt.step(grads)
+    """
+    params = layer.raw_parameters()
+
+    def wrapped(p):
+        out = layer.functional_call(p, *args, **kwargs)
+        return loss_fn(out) if loss_fn is not None else out
+
+    loss, grads = jax.value_and_grad(wrapped)(params)
+    return loss, grads
+
+
+def jacobian(fn, xs, create_graph: bool = False):
+    return jax.jacobian(fn)(xs)
+
+
+def hessian(fn, xs, create_graph: bool = False):
+    return jax.hessian(fn)(xs)
+
+
+def vjp(fn, xs, v=None):
+    out, pullback = jax.vjp(fn, xs)
+    if v is None:
+        v = jnp.ones_like(out)
+    return out, pullback(v)
+
+
+def jvp(fn, xs, v=None):
+    if v is None:
+        v = jax.tree.map(jnp.ones_like, xs)
+    return jax.jvp(fn, (xs,), (v,))
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Parity shim: JAX only differentiates inside explicit grad transforms,
+    so no_grad is the default; kept for code portability."""
+    yield
+
+
+class PyLayer:
+    """Custom-VJP layer (reference: python/paddle/autograd/py_layer.py:29).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``;
+    ``ctx.save_for_backward(*ts)`` stashes residuals. ``apply`` builds a
+    jax.custom_vjp under the hood.
+    """
+
+    class _Ctx:
+        """Registered as a pytree so it can be a custom_vjp residual:
+        saved tensors are children; non-tensor attrs travel as aux data
+        (must be hashable)."""
+
+        def __init__(self):
+            self.saved = ()
+            self.attrs = {}
+
+        def save_for_backward(self, *tensors):
+            hooks = getattr(_SAVED_HOOKS, "hooks", None) \
+                if "_SAVED_HOOKS" in globals() else None
+            if hooks is not None:
+                pack, unpack = hooks
+                tensors = tuple(pack(t) for t in tensors)
+                # capture the UNPACK hook at save time: backward usually
+                # runs after the hooks context has exited
+                self.attrs["_unpack_hook"] = unpack
+            self.saved = tensors
+
+        def saved_tensor(self):
+            unpack = self.attrs.get("_unpack_hook")
+            if unpack is not None:
+                return tuple(unpack(t) for t in self.saved)
+            return self.saved
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    _pytree_registered = False
+
+    @classmethod
+    def _ensure_pytree(cls):
+        # _Ctx is shared by all PyLayer subclasses — register exactly once.
+        if PyLayer._pytree_registered:
+            return
+        import jax.tree_util as jtu
+
+        def flatten(ctx):
+            return ctx.saved, tuple(sorted(ctx.attrs.items()))
+
+        def unflatten(aux, children):
+            ctx = PyLayer._Ctx()
+            ctx.saved = tuple(children)
+            ctx.attrs = dict(aux)
+            return ctx
+
+        jtu.register_pytree_node(PyLayer._Ctx, flatten, unflatten)
+        PyLayer._pytree_registered = True
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        cls._ensure_pytree()
+        @jax.custom_vjp
+        def _fn(*xs):
+            ctx = cls._Ctx()
+            return cls.forward(ctx, *xs, **kwargs)
+
+        def _fwd(*xs):
+            ctx = cls._Ctx()
+            out = cls.forward(ctx, *xs, **kwargs)
+            return out, ctx
+
+        def _bwd(ctx, g):
+            grads = cls.backward(ctx, *(g if isinstance(g, tuple) else (g,)))
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return grads
+
+        _fn.defvjp(_fwd, _bwd)
+        return _fn(*args)
+
+
+# -- round-3 parity batch ---------------------------------------------------
+
+PyLayerContext = PyLayer._Ctx
+"""Context object passed to PyLayer.forward/backward (reference:
+python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+
+import contextlib as _contextlib
+import threading as _threading
+
+_SAVED_HOOKS = _threading.local()
+
+
+@_contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """Intercept forward-saved tensors (reference:
+    python/paddle/autograd/saved_tensors_hooks.py). PyLayer's
+    save_for_backward applies pack_hook on save and unpack_hook on read
+    while this context is active — the reference's offload-to-host recipes
+    work unchanged."""
+    prev = getattr(_SAVED_HOOKS, "hooks", None)
+    _SAVED_HOOKS.hooks = (pack_hook, unpack_hook)
+    try:
+        yield
+    finally:
+        _SAVED_HOOKS.hooks = prev
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """reference: python/paddle/autograd/backward_mode.py backward.
+
+    The eager tape does not exist here — gradients flow through
+    functional transforms (``paddle_tpu.autograd.grad`` / ``layer_grad`` /
+    ``jax.grad``), which the reference's ``Tensor.backward()`` use cases
+    map onto directly (docs/DESIGN_DECISIONS.md: functional autograd).
+    Calling this raises with the migration recipe instead of silently
+    doing nothing."""
+    raise RuntimeError(
+        "paddle_tpu has no global autograd tape: compute gradients "
+        "functionally, e.g.\n"
+        "  loss, grads = paddle_tpu.autograd.layer_grad(model, loss_fn, x)\n"
+        "  opt.step(grads)\n"
+        "or jax.grad(fn)(params). See docs/DESIGN_DECISIONS.md "
+        "(functional autograd).")

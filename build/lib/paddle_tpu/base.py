@@ -1,0 +1,300 @@
+"""Base framework plumbing: places, mode switches, grad-mode guards,
+ParamAttr, DataParallel, print options, RNG-state capture.
+
+Reference: python/paddle/base/{framework.py,core.py,dygraph/base.py} and
+python/paddle/framework/random.py. On TPU the runtime underneath is jax —
+places map to jax.Device, "dynamic vs static mode" collapses (ops are
+functional and trace-friendly either way), and grad-mode guards gate our
+autograd surface (autograd.no_grad) rather than a global tracer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# -- places (reference: paddle.CPUPlace/CUDAPlace/...; phi Place) ------------
+
+class _Place:
+    """Device handle with the reference's Place API shape. Resolves to a
+    jax.Device; accepted anywhere paddle_tpu takes a ``place``/``device``."""
+
+    _platform: str = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self._id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._id
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self._platform]
+        if not devs:  # graceful degrade (e.g. CUDAPlace on a TPU host)
+            devs = jax.devices()
+        return devs[min(self._id, len(devs) - 1)]
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._id == other._id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._id})"
+
+
+class CPUPlace(_Place):
+    _platform = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(_Place):
+    _platform = "tpu"
+
+
+class CUDAPlace(_Place):
+    """Accepted for API parity; resolves to the accelerator (TPU) if
+    present, else CPU — there is no CUDA in this stack."""
+    _platform = "tpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _platform = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class IPUPlace(_Place):
+    _platform = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class XPUPlace(_Place):
+    _platform = "tpu"
+
+
+# -- dynamic/static mode (reference: base/framework.py in_dynamic_mode) ------
+
+_static_mode = threading.local()
+
+
+def in_dynamic_mode() -> bool:
+    """True unless ``enable_static`` was called. Ops behave identically in
+    both modes here (jax traces the same functions); the switch only drives
+    the static.Program facade (static/__init__.py)."""
+    return not getattr(_static_mode, "on", False)
+
+
+def in_dynamic_or_pir_mode() -> bool:
+    return True
+
+
+def enable_static() -> None:
+    _static_mode.on = True
+
+
+def disable_static() -> None:
+    _static_mode.on = False
+
+
+def disable_signal_handler() -> None:
+    """No-op: jax installs no signal handlers to disable (reference:
+    paddle.disable_signal_handler guards the C++ fault handlers)."""
+
+
+# -- grad-mode guards (reference: base/dygraph/base.py) ----------------------
+
+_grad_mode = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_grad_mode, "enabled", True)
+
+
+@contextlib.contextmanager
+def _grad_guard(flag: bool):
+    prev = is_grad_enabled()
+    _grad_mode.enabled = flag
+    try:
+        yield
+    finally:
+        _grad_mode.enabled = prev
+
+
+def enable_grad():
+    """Context manager enabling gradient tracking (paddle.enable_grad)."""
+    return _grad_guard(True)
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager pinning grad mode (paddle.set_grad_enabled)."""
+    return _grad_guard(bool(mode))
+
+
+# -- ParamAttr / LazyGuard (reference: python/paddle/base/param_attr.py) -----
+
+class ParamAttr:
+    """Parameter attribute bundle (name/initializer/lr/regularizer/
+    trainable). Layers accept it for ``weight_attr``/``bias_attr``; fields
+    map onto Parameter metadata + the optimizer's per-param options."""
+
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = True,
+                 need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class LazyGuard:
+    """Context manager deferring parameter materialization (reference:
+    python/paddle/fluid/lazy_init.py LazyGuard). Inside the guard,
+    ``create_parameter`` produces ABSTRACT values (jax.ShapeDtypeStruct)
+    instead of running initializers — so an 8B/70B model can be
+    constructed for sharding-plan and memory-fit analysis (eval_shape
+    style) without materializing a single weight. Materialize later by
+    re-building the model outside the guard, or use the abstract tree with
+    jax.jit(...).lower() / NamedSharding.shard_shape."""
+
+    _active = False
+
+    def __enter__(self):
+        self._prev = type(self)._active
+        type(self)._active = True
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._active = self._prev   # nesting-safe restore
+        return False
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Free-standing parameter factory (paddle.create_parameter /
+    paddle.static.create_parameter)."""
+    from .nn import initializer as init_mod
+    from .nn.layer import Parameter
+    from .core import dtype as _dt
+    trainable = attr.trainable if attr is not None else True
+    if LazyGuard._active:
+        import jax
+        import numpy as _np
+        value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                     _np.dtype(_dt.convert_dtype(dtype)))
+        return Parameter(value, trainable=trainable)
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
+    value = init(tuple(int(s) for s in shape), _dt.convert_dtype(dtype))
+    return Parameter(value, trainable=trainable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from .core import dtype as _dt
+    return jnp.full(tuple(int(s) for s in shape), value,
+                    _dt.convert_dtype(dtype))
+
+
+# -- DataParallel (reference: python/paddle/distributed/parallel.py:202) -----
+
+def DataParallel(layer, strategy=None, comm_buffer_size_MB: int = 25,
+                 last_comm_buffer_size_MB: int = 1,
+                 find_unused_parameters: bool = False, group=None):
+    """DP wrapper. Under GSPMD there is no reducer to install: marking the
+    batch dim sharded over "dp" makes XLA emit the fused gradient
+    all-reduces the EagerReducer provides in the reference
+    (collective/reducer.cc). The layer itself is returned (its parameters
+    replicated, inputs expected dp-sharded) — kept callable for API parity
+    with ``paddle.DataParallel(model)``."""
+    from .parallel.mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is not None and "dp" in mesh.axis_names:
+        from .parallel.api import shard_layer
+        shard_layer(layer)
+    return layer
+
+
+# -- print options (reference: python/paddle/tensor/to_string.py) ------------
+
+_print_opts = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+               "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Mirrors paddle.set_printoptions by driving numpy's print options
+    (arrays print through numpy)."""
+    kw = {}
+    if precision is not None:
+        _print_opts["precision"] = kw["precision"] = int(precision)
+    if threshold is not None:
+        _print_opts["threshold"] = kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _print_opts["edgeitems"] = kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _print_opts["linewidth"] = kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        _print_opts["sci_mode"] = sci_mode
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# -- RNG state capture (reference: python/paddle/framework/random.py) --------
+
+def get_rng_state(device=None):
+    """Snapshot all named RNG streams (keys + counters) as an opaque,
+    picklable state list."""
+    from .core.rng import rng_tracker
+    tr = rng_tracker()
+    return [{"name": n,
+             "key": np.asarray(jax.random.key_data(k)),
+             "counter": tr._counters.get(n, 0)}
+            for n, k in tr._keys.items()]
+
+
+def set_rng_state(state_list, device=None):
+    from .core.rng import rng_tracker
+    tr = rng_tracker()
+    for st in state_list:
+        key = jax.random.wrap_key_data(jnp.asarray(st["key"]))
+        tr.add(st["name"], key)
+        tr._counters[st["name"]] = int(st["counter"])
+
+
+def get_cuda_rng_state():
+    """Accelerator alias of get_rng_state (no separate device generator:
+    jax PRNG keys are device-agnostic values)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)
+
+
+def check_shape(shape):
+    """Validate a shape argument the way paddle.static.nn checks inputs."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and s is not None:
+                raise TypeError(f"shape entries must be int, got {type(s)}")
+    return True

@@ -1,0 +1,13 @@
+"""paddle.callbacks re-export (reference: python/paddle/callbacks.py —
+a thin alias of hapi.callbacks). VisualDL/Wandb are external services not
+in this image; their callbacks degrade to a JSONL scalar sink
+(hapi/callbacks.py docstrings)."""
+
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping, History,
+                             LRSchedulerCallback as LRScheduler,
+                             ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, VisualDL, WandbCallback)
+
+__all__ = ["Callback", "CallbackList", "EarlyStopping", "History",
+           "LRScheduler", "ModelCheckpoint", "ProgBarLogger",
+           "ReduceLROnPlateau", "VisualDL", "WandbCallback"]

@@ -1,0 +1,160 @@
+"""Distributed sharded checkpoint with topology-reshard on load.
+
+Reference: python/paddle/distributed/checkpoint/{save_state_dict.py:104,
+load_state_dict.py,metadata.py} — per-rank shard files + a metadata manifest,
+and automatic resharding when the load-time parallel topology differs from
+save-time. Single-process paddle.save/load live in paddle_tpu.framework.
+
+TPU redesign: orbax is the storage engine (tensorstore/OCDBT — per-shard
+writes from every host, a manifest, atomic commit). The reference's
+flat-param manifest + slice-reassembly logic collapses into restoring with a
+*target tree of ShapeDtypeStructs carrying the new NamedShardings*: each
+device reads exactly the byte ranges of its new shard, which is the
+cross-topology reshard-on-load. Async save (reference's async_save flag)
+uses orbax's AsyncCheckpointer: the device→host copy is synchronous, the
+filesystem write happens on a background thread between steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_async_ckptr: Optional[ocp.AsyncCheckpointer] = None
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def _get_async() -> ocp.AsyncCheckpointer:
+    global _async_ckptr
+    if _async_ckptr is None:
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _async_ckptr
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    async_save: bool = False, overwrite: bool = True) -> None:
+    """Save a (nested) dict of arrays, sharded (reference:
+    save_state_dict.py:104). Every host writes only its local shards."""
+    path = _abs(path)
+    if async_save:
+        ck = _get_async()
+        ck.save(path, args=ocp.args.StandardSave(state_dict), force=overwrite)
+        return
+    ck = ocp.StandardCheckpointer()
+    ck.save(path, state_dict, force=overwrite)
+    ck.wait_until_finished()
+
+
+def wait_until_finished() -> None:
+    """Block until pending async saves are durable (reference: the implicit
+    barrier before the next save)."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
+def _target_like(state_dict: Dict[str, Any], mesh: Optional[Mesh],
+                 spec_tree: Optional[Dict[str, PartitionSpec]]):
+    """Build the restore target: same shapes/dtypes, NEW shardings.
+
+    ``spec_tree`` keys are matched against the leaf's full "/"-joined tree
+    path AND its final dict key (the param name) — so the same name →
+    PartitionSpec dict used for the model (param_spec_tree) also reshard
+    its optimizer slots.
+    """
+    from jax.tree_util import tree_map_with_path
+
+    def one(path, x):
+        keys = [str(getattr(p, "key", p)) for p in path]
+        full = "/".join(keys)
+        last = keys[-1] if keys else ""
+        shape = tuple(x.shape) if hasattr(x, "shape") else tuple(np.shape(x))
+        dtype = getattr(x, "dtype", None) or np.asarray(x).dtype
+        sharding = None
+        if mesh is not None:
+            spec = None
+            if spec_tree is not None:
+                spec = spec_tree.get(full)
+                if spec is None:
+                    spec = spec_tree.get(last)
+            if spec is None:
+                # scalars can't take a param's spec; keep replicated
+                spec = PartitionSpec()
+            if len(spec) > len(shape):
+                spec = PartitionSpec()
+            sharding = NamedSharding(mesh, spec)
+        elif isinstance(x, jax.Array) and isinstance(
+                getattr(x, "sharding", None), NamedSharding):
+            sharding = x.sharding
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return tree_map_with_path(one, state_dict)
+
+
+def load_state_dict(path: str, state_dict: Dict[str, Any],
+                    mesh: Optional[Mesh] = None,
+                    spec_tree: Optional[Dict[str, PartitionSpec]] = None
+                    ) -> Dict[str, Any]:
+    """Restore into the shapes of ``state_dict`` with NEW shardings — the
+    cross-topology reshard (reference: load_state_dict.py). ``state_dict``
+    supplies shapes/dtypes (its values may be abstract); sharding comes from
+    ``spec_tree`` (name → PartitionSpec) over ``mesh``, falling back to each
+    value's current sharding. Returns the restored tree."""
+    path = _abs(path)
+    target = _target_like(state_dict, mesh, spec_tree)
+    ck = ocp.StandardCheckpointer()
+    return ck.restore(path, target)
+
+
+# -- whole-training-state checkpoint (step/params/opt/lr) --------------------
+
+def save_training_state(path: str, step: int, params: Dict[str, jax.Array],
+                        opt_state: Dict[str, Any], extra: Optional[Dict] = None,
+                        async_save: bool = False) -> None:
+    """One-call trainer checkpoint (reference analogue: auto_checkpoint's
+    TrainEpochRange snapshot — base/incubate/checkpoint/auto_checkpoint.py:278)."""
+    tree = {"step": np.int64(step), "params": params, "opt_state": opt_state}
+    if extra:
+        tree["extra"] = extra
+    save_state_dict(tree, path, async_save=async_save)
+
+
+def load_training_state(path: str, params_like: Dict[str, jax.Array],
+                        opt_state_like: Dict[str, Any],
+                        mesh: Optional[Mesh] = None,
+                        spec_tree: Optional[Dict[str, PartitionSpec]] = None
+                        ) -> Dict[str, Any]:
+    tree = {"step": np.int64(0), "params": params_like,
+            "opt_state": opt_state_like}
+    return load_state_dict(path, tree, mesh=mesh, spec_tree=spec_tree)
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Scan ``root`` for step_N checkpoint dirs; return the largest N."""
+    root = _abs(root)
+    if not os.path.isdir(root):
+        return None
+    steps = []
+    for name in os.listdir(root):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name.split("_", 1)[1]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+
+__all__ = ["save_state_dict", "load_state_dict", "wait_until_finished",
+           "save_training_state", "load_training_state", "latest_step"]
+
+from . import auto_checkpoint  # noqa: E402  (TrainEpochRange, LocalFS)

@@ -1,0 +1,5 @@
+"""paddle_tpu.core — flags, dtypes, RNG."""
+
+from . import dtype, flags, rng
+from .flags import set_flags, get_flags, define_flag
+from .rng import seed, rng_tracker

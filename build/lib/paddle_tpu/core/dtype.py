@@ -1,0 +1,92 @@
+"""Dtype surface.
+
+Paddle-shaped dtype names mapped onto jnp dtypes (reference:
+paddle/phi/common/data_type.h; python surface python/paddle/framework/dtype.py).
+bfloat16 is the native TPU compute dtype; float16 is kept for API parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128,
+    # paddle aliases
+    "fp16": float16, "bf16": bfloat16, "fp32": float32, "fp64": float64,
+}
+
+
+dtype = jnp.dtype  # paddle.dtype — the dtype type itself
+
+
+class finfo:
+    """Float type info (paddle.finfo; reference python/paddle/framework/
+    dtype.py finfo): eps/min/max/tiny/smallest_normal/bits/dtype."""
+
+    def __init__(self, dt):
+        info = jnp.finfo(convert_dtype(dt))
+        self.dtype = str(info.dtype)
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.bits = int(info.bits)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+    def __repr__(self):
+        return (f"finfo(dtype={self.dtype}, eps={self.eps}, min={self.min}, "
+                f"max={self.max}, bits={self.bits})")
+
+
+class iinfo:
+    """Integer type info (paddle.iinfo)."""
+
+    def __init__(self, dt):
+        info = jnp.iinfo(convert_dtype(dt))
+        self.dtype = str(info.dtype)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+
+    def __repr__(self):
+        return (f"iinfo(dtype={self.dtype}, min={self.min}, max={self.max}, "
+                f"bits={self.bits})")
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a string/np/jnp dtype to a jnp dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype}")
+        return _NAME_TO_DTYPE[dtype]
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), np.complexfloating)

@@ -1,0 +1,94 @@
+"""Global runtime flag registry.
+
+TPU-native analogue of the reference's gflags-style global flag system
+(reference: paddle/phi/core/flags.cc — 120 PHI_DEFINE_EXPORTED_* flags;
+python surface paddle.set_flags / paddle.get_flags backed by
+paddle/fluid/pybind/global_value_getter_setter.cc).
+
+Flags are typed, have defaults, can be set programmatically via
+``set_flags`` or from the environment via ``FLAGS_<name>`` at import time.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, _FlagSpec] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def _parse(spec: _FlagSpec, raw: str) -> Any:
+    if spec.type is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return spec.type(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: Optional[type] = None,
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides default."""
+    t = type if type is not None else default.__class__
+    spec = _FlagSpec(name=name, default=default, type=t, help=help, on_change=on_change)
+    _REGISTRY[name] = spec
+    env = os.environ.get(f"FLAGS_{name}")
+    _VALUES[name] = _parse(spec, env) if env is not None else default
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set one or more flags. Mirrors ``paddle.set_flags``."""
+    for k, v in flags.items():
+        if k.startswith("FLAGS_"):
+            k = k[len("FLAGS_"):]
+        if k not in _REGISTRY:
+            raise KeyError(f"Unknown flag: {k}. Registered: {sorted(_REGISTRY)}")
+        spec = _REGISTRY[k]
+        if isinstance(v, str) and spec.type is not str:
+            v = _parse(spec, v)
+        _VALUES[k] = spec.type(v) if spec.type is not bool else bool(v)
+        if spec.on_change is not None:
+            spec.on_change(_VALUES[k])
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """Get flag values. Mirrors ``paddle.get_flags``; accepts str or list."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        out[k] = _VALUES[key]
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor used by the framework itself."""
+    return _VALUES[name]
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags (subset of reference paddle/phi/core/flags.cc that is
+# meaningful on TPU/XLA; allocator/cudnn flags intentionally dropped — XLA owns
+# device memory).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf during training "
+            "(reference: FLAGS_check_nan_inf, paddle/phi/core/flags.cc:74).")
+define_flag("check_nan_inf_level", 0, "0: fail on NaN/Inf; higher levels only log.")
+define_flag("benchmark", False, "Block-until-ready around steps for timing.")
+define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for hot ops when "
+            "on TPU; fall back to XLA compositions otherwise.")
+define_flag("matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("deterministic", False, "Force deterministic kernels where possible.")
+define_flag("log_memory_stats", False, "Log live/peak device memory per step.")
+define_flag("executor_trace_mode", True, "Trace (serial replay) executor mode; "
+            "kept for API parity with the reference new_executor.")

@@ -1,0 +1,100 @@
+"""RNG state management.
+
+TPU-native analogue of the reference's RNG tracker for parallelism-correct
+randomness (reference: python/paddle/distributed/fleet/layers/mpu/random.py —
+``RNGStatesTracker`` keeps named states, "global_seed" shared across
+model-parallel ranks and "local_seed" unique per rank, so dropout inside TP
+regions decorrelates across ranks while replicated regions stay identical).
+
+On TPU/JAX this is functional: a tracker holds named base keys; consumers draw
+sub-keys via an internal fold_in counter. Inside jit-traced functions the
+tracker is seeded with a traced key argument (``scope``), so compiled steps
+stay pure — the counter resets per trace and every re-execution of the traced
+python produces the same fold_in sequence.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+
+GLOBAL_STREAM = "global_seed"
+LOCAL_STREAM = "local_seed"
+
+
+class RNGStatesTracker:
+    """Named PRNG streams with deterministic fold_in sub-key derivation."""
+
+    def __init__(self):
+        self._keys: Dict[str, jax.Array] = {}
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        self._keys.clear()
+        self._counters.clear()
+
+    def add(self, name: str, seed_or_key) -> None:
+        if isinstance(seed_or_key, int):
+            key = jax.random.key(seed_or_key)
+        else:
+            key = seed_or_key
+        self._keys[name] = key
+        self._counters[name] = 0
+
+    def has(self, name: str) -> bool:
+        return name in self._keys
+
+    def next_key(self, name: str = GLOBAL_STREAM) -> jax.Array:
+        """Draw the next sub-key from stream ``name`` (deterministic sequence)."""
+        if name not in self._keys:
+            raise RuntimeError(
+                f"RNG stream '{name}' not seeded. Call paddle_tpu.seed(...) or "
+                f"rng_tracker().add('{name}', seed) first, or run inside "
+                f"rng_tracker().scope(key).")
+        with self._lock:
+            c = self._counters[name]
+            self._counters[name] = c + 1
+        return jax.random.fold_in(self._keys[name], c)
+
+    @contextlib.contextmanager
+    def scope(self, key: jax.Array, name: str = GLOBAL_STREAM,
+              local_key: Optional[jax.Array] = None):
+        """Temporarily seed stream(s) from (possibly traced) keys.
+
+        Used by training steps: the step key is an argument of the jitted
+        function, so randomness is reproducible and pure under jit.
+        """
+        saved = (dict(self._keys), dict(self._counters))
+        try:
+            self.add(name, key)
+            if local_key is not None:
+                self.add(LOCAL_STREAM, local_key)
+            elif name == GLOBAL_STREAM and LOCAL_STREAM not in self._keys:
+                # default local stream derived from global; parallel layers
+                # re-fold mesh coordinates in (parallel/mesh.py).
+                self.add(LOCAL_STREAM, jax.random.fold_in(key, 0x10C4))
+            yield self
+        finally:
+            self._keys, self._counters = saved
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def rng_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def seed(s: int) -> None:
+    """Seed the global + local default streams (mirrors ``paddle.seed``)."""
+    _TRACKER.reset()
+    _TRACKER.add(GLOBAL_STREAM, s)
+    _TRACKER.add(LOCAL_STREAM, s + 0x5EED)
+
+
+def next_key(name: str = GLOBAL_STREAM) -> jax.Array:
+    return _TRACKER.next_key(name)

@@ -1,0 +1,26 @@
+"""paddle.dataset legacy reader namespace (reference:
+python/paddle/dataset/ — reader-creator factories predating paddle.io;
+kept for BC with __all__ = [] exactly like the reference).
+
+Each module exposes ``train()``/``test()`` returning a READER: a zero-arg
+callable yielding per-sample tuples — the reference contract
+(dataset/mnist.py reader_creator). Implementation: thin adapters over the
+paddle.io-style dataset classes in vision.datasets / text.datasets, which
+parse the same archive formats; pass ``data_file=``/``data_dir=`` (no
+egress in this environment — constructors name the source URL when the
+file is absent, as those classes do).
+"""
+
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import conll05
+from . import wmt14
+from . import wmt16
+from . import flowers
+
+__all__ = []

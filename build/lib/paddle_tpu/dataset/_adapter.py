@@ -1,0 +1,24 @@
+"""Shared reader-adapter for the legacy dataset modules: wrap a paddle.io
+Dataset class into the reference's reader-creator contract."""
+
+import numpy as np
+
+
+def dataset_reader(cls, mode, flatten_images=False, **kw):
+    """Return a zero-arg generator factory over ``cls(mode=mode, **kw)``.
+    ``flatten_images``: legacy mnist/cifar readers yield flat float32
+    feature vectors (reference: dataset/mnist.py reader_creator yields
+    784-vectors in [-1, 1])."""
+    def reader():
+        ds = cls(mode=mode, **kw)
+        for i in range(len(ds)):
+            item = ds[i]
+            if flatten_images:
+                img, label = item
+                img = np.asarray(img, np.float32)
+                img = img.reshape(-1) / 127.5 - 1.0
+                yield img, int(np.asarray(label))
+            else:
+                yield tuple(np.asarray(x) for x in item) \
+                    if isinstance(item, (tuple, list)) else item
+    return reader

@@ -1,0 +1,34 @@
+"""Reference: python/paddle/dataset/cifar.py — train10/test10/
+train100/test100 readers yielding (3072-float32 in [0,1], int label)."""
+
+from ..vision.datasets import Cifar10, Cifar100
+from ._adapter import dataset_reader
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _rd(cls, mode, data_file):
+    def reader():
+        import numpy as np
+        ds = cls(data_file=data_file, mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            img = np.asarray(img, np.float32).reshape(-1) / 255.0
+            yield img, int(np.asarray(label))
+    return reader
+
+
+def train10(data_file=None):
+    return _rd(Cifar10, "train", data_file)
+
+
+def test10(data_file=None):
+    return _rd(Cifar10, "test", data_file)
+
+
+def train100(data_file=None):
+    return _rd(Cifar100, "train", data_file)
+
+
+def test100(data_file=None):
+    return _rd(Cifar100, "test", data_file)

@@ -1,0 +1,61 @@
+"""Reference: python/paddle/dataset/common.py — download/cache helpers and
+the cluster reader splitter."""
+
+import os
+
+from ..utils.download import get_path_from_url
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+__all__ = ["DATA_HOME", "download", "split", "cluster_files_reader"]
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Fetch-and-cache (reference: common.py download). No egress here:
+    resolves only already-cached files, else raises naming the URL."""
+    target_dir = os.path.join(DATA_HOME, module_name)
+    name = save_name or url.split("/")[-1]
+    path = os.path.join(target_dir, name)
+    if os.path.exists(path):
+        return path
+    return get_path_from_url(url, target_dir, md5sum)
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split reader output into pickled chunk files (reference:
+    common.py split)."""
+    import pickle
+    dumper = dumper or pickle.dump
+    lines = []
+    idx = 0
+    out = []
+    for item in reader():
+        lines.append(item)
+        if len(lines) >= line_count:
+            fname = suffix % idx
+            with open(fname, "wb") as f:
+                dumper(lines, f)
+            out.append(fname)
+            lines, idx = [], idx + 1
+    if lines:
+        fname = suffix % idx
+        with open(fname, "wb") as f:
+            dumper(lines, f)
+        out.append(fname)
+    return out
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Read this trainer's shard of chunk files (reference: common.py)."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for fname in flist[trainer_id::trainer_count]:
+            with open(fname, "rb") as f:
+                for item in loader(f):
+                    yield item
+    return reader

@@ -1,0 +1,20 @@
+"""Reference: python/paddle/dataset/conll05.py — SRL test reader."""
+
+from ..text.datasets import Conll05st
+
+__all__ = ["test"]
+
+
+def test(data_file=None, word_dict_file=None, verb_dict_file=None,
+         target_dict_file=None):
+    # Conll05st carries only the public test split (no mode parameter)
+    def reader():
+        import numpy as np
+        ds = Conll05st(data_file=data_file, word_dict_file=word_dict_file,
+                       verb_dict_file=verb_dict_file,
+                       target_dict_file=target_dict_file)
+        for i in range(len(ds)):
+            item = ds[i]
+            yield tuple(np.asarray(x) for x in item) \
+                if isinstance(item, (tuple, list)) else item
+    return reader

@@ -1,0 +1,52 @@
+"""Reference: python/paddle/dataset/flowers.py — Oxford-102 readers over
+the images tgz + imagelabels.mat + setid.mat triple (scipy.io for the
+label/split mats; no egress — files must be local)."""
+
+import io
+import tarfile
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_URLS = ("https://paddlemodels.cdn.bcebos.com/flowers/102flowers.tgz",
+         "https://paddlemodels.cdn.bcebos.com/flowers/imagelabels.mat",
+         "https://paddlemodels.cdn.bcebos.com/flowers/setid.mat")
+_SPLIT_KEYS = {"train": "trnid", "test": "tstid", "valid": "valid"}
+
+
+def _reader(mode, data_file, label_file, setid_file):
+    if not (data_file and label_file and setid_file):
+        raise RuntimeError(
+            "no network egress: pass data_file=102flowers.tgz, "
+            f"label_file and setid_file (.mat) — sources: {_URLS}")
+    import scipy.io
+    from PIL import Image
+
+    labels = scipy.io.loadmat(label_file)["labels"][0]
+    ids = scipy.io.loadmat(setid_file)[_SPLIT_KEYS[mode]][0]
+
+    def reader():
+        with tarfile.open(data_file) as tf:
+            members = {m.name: m for m in tf.getmembers()
+                       if m.name.endswith(".jpg")}
+            for i in ids:
+                name = f"jpg/image_{int(i):05d}.jpg"
+                if name not in members:
+                    continue
+                data = tf.extractfile(members[name]).read()
+                img = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+                yield img, int(labels[int(i) - 1]) - 1
+    return reader
+
+
+def train(data_file=None, label_file=None, setid_file=None, **kw):
+    return _reader("train", data_file, label_file, setid_file)
+
+
+def test(data_file=None, label_file=None, setid_file=None, **kw):
+    return _reader("test", data_file, label_file, setid_file)
+
+
+def valid(data_file=None, label_file=None, setid_file=None, **kw):
+    return _reader("valid", data_file, label_file, setid_file)

@@ -1,0 +1,21 @@
+"""Reference: python/paddle/dataset/imdb.py — train(word_idx)/test(word_idx)
+readers yielding (int64 word ids, 0/1 label), plus word_dict()."""
+
+from ..text.datasets import Imdb
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test", "word_dict"]
+
+
+def word_dict(data_file=None, cutoff: int = 150):
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+def train(word_idx=None, data_file=None):
+    return dataset_reader(Imdb, "train", data_file=data_file,
+                          word_idx=word_idx)
+
+
+def test(word_idx=None, data_file=None):
+    return dataset_reader(Imdb, "test", data_file=data_file,
+                          word_idx=word_idx)

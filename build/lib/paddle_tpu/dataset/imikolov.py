@@ -1,0 +1,24 @@
+"""Reference: python/paddle/dataset/imikolov.py — PTB n-gram readers +
+build_dict()."""
+
+from ..text.datasets import Imikolov
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test", "build_dict"]
+
+
+def build_dict(min_word_freq: int = 50, data_file=None):
+    return Imikolov(data_file=data_file, mode="train",
+                    min_word_freq=min_word_freq).word_idx
+
+
+def train(word_idx=None, n: int = 5, data_type="NGRAM", data_file=None):
+    return dataset_reader(Imikolov, "train", data_file=data_file,
+                          data_type=data_type, window_size=n,
+                          word_idx=word_idx)
+
+
+def test(word_idx=None, n: int = 5, data_type="NGRAM", data_file=None):
+    return dataset_reader(Imikolov, "test", data_file=data_file,
+                          data_type=data_type, window_size=n,
+                          word_idx=word_idx)

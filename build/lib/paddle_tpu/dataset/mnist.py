@@ -1,0 +1,19 @@
+"""Reference: python/paddle/dataset/mnist.py — train()/test() readers
+yielding (784-float32 in [-1,1], int label)."""
+
+from ..vision.datasets import MNIST
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test"]
+
+
+def train(image_path=None, label_path=None, backend="auto"):
+    return dataset_reader(MNIST, "train", flatten_images=True,
+                          image_path=image_path, label_path=label_path,
+                          backend=backend)
+
+
+def test(image_path=None, label_path=None, backend="auto"):
+    return dataset_reader(MNIST, "test", flatten_images=True,
+                          image_path=image_path, label_path=label_path,
+                          backend=backend)

@@ -1,0 +1,14 @@
+"""Reference: python/paddle/dataset/movielens.py — ml-1m readers."""
+
+from ..text.datasets import Movielens
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test"]
+
+
+def train(data_file=None):
+    return dataset_reader(Movielens, "train", data_file=data_file)
+
+
+def test(data_file=None):
+    return dataset_reader(Movielens, "test", data_file=data_file)

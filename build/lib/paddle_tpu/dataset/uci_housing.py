@@ -1,0 +1,15 @@
+"""Reference: python/paddle/dataset/uci_housing.py — train()/test()
+readers yielding (13-float32 features, float32 target)."""
+
+from ..text.datasets import UCIHousing
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test"]
+
+
+def train(data_file=None):
+    return dataset_reader(UCIHousing, "train", data_file=data_file)
+
+
+def test(data_file=None):
+    return dataset_reader(UCIHousing, "test", data_file=data_file)

@@ -1,0 +1,17 @@
+"""Reference: python/paddle/dataset/wmt14.py — en-fr translation readers;
+``dict_size`` caps both vocabularies like the reference."""
+
+from ..text.datasets import WMT14
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test"]
+
+
+def train(dict_size: int = -1, data_file=None):
+    return dataset_reader(WMT14, "train", data_file=data_file,
+                          src_dict_size=dict_size, trg_dict_size=dict_size)
+
+
+def test(dict_size: int = -1, data_file=None):
+    return dataset_reader(WMT14, "test", data_file=data_file,
+                          src_dict_size=dict_size, trg_dict_size=dict_size)

@@ -1,0 +1,21 @@
+"""Reference: python/paddle/dataset/wmt16.py — en-de translation readers
+with per-side vocab caps and source-language selection."""
+
+from ..text.datasets import WMT16
+from ._adapter import dataset_reader
+
+__all__ = ["train", "test"]
+
+
+def train(src_dict_size: int = -1, trg_dict_size: int = -1,
+          src_lang: str = "en", data_file=None):
+    return dataset_reader(WMT16, "train", data_file=data_file,
+                          src_dict_size=src_dict_size,
+                          trg_dict_size=trg_dict_size, lang=src_lang)
+
+
+def test(src_dict_size: int = -1, trg_dict_size: int = -1,
+         src_lang: str = "en", data_file=None):
+    return dataset_reader(WMT16, "test", data_file=data_file,
+                          src_dict_size=src_dict_size,
+                          trg_dict_size=trg_dict_size, lang=src_lang)

@@ -1,0 +1,54 @@
+"""paddle.decomposition parity: composite-op → primitive decomposition.
+
+Reference: python/paddle/decomposition/decomp.py:192 ``decompose(program,
+src_vars, blacklist, whitelist)`` rewrites registered composite ops in a
+PIR program into primitive ops so the compiler and higher-order AD see a
+closed primitive set.
+
+TPU redesign: tracing *is* the decomposition. Every paddle_tpu op is a
+jnp/lax composition, so by the time a program exists (a traced jaxpr) it
+is already expressed in the primitive set — there is no registered-rule
+rewrite left to run. The two knobs that still carry meaning:
+
+- fused kernels (flash attention, fused norms) hold their computation
+  behind ``custom_vjp`` boundaries. ``decompose`` can strip those
+  boundaries so higher-order AD differentiates through the composite body
+  (the reference's main use of decomposition), via
+  ``jax.custom_derivatives``' unrolled call when requested.
+- black/white lists select which ops that applies to; with no fused ops in
+  the program, ``decompose`` is the identity.
+
+The Program-based signature is honored for recipes: called on a
+``static.Program`` it returns ``src_vars`` unchanged (the reference
+returns the replacement dst_vars; with no rewrite, src ARE dst) — a no-op
+rather than an error.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, FrozenSet, Sequence
+
+__all__ = ["decompose"]
+
+
+def decompose(program_or_fn, src_vars=None, blacklist: FrozenSet = frozenset(),
+              whitelist: FrozenSet = frozenset()):
+    """Decompose composite ops into primitives.
+
+    - Called with a ``static.Program`` (the reference signature): returns
+      ``src_vars`` unchanged — traced programs are already primitive
+      jaxprs (see module docstring).
+    - Called with a CALLABLE: returns a function whose fused custom-VJP
+      regions are inlined, so jax sees only primitive ops (useful for
+      higher-order AD through e.g. the fused RMSNorm)."""
+    if callable(program_or_fn) and not hasattr(program_or_fn, "global_block"):
+        fn = program_or_fn
+
+        def decomposed(*args, **kwargs):
+            # run with fused-kernel dispatch disabled so every op traces
+            # as its jnp/lax composite body (primitive jaxpr)
+            from .ops.registry import pallas_disabled_scope
+            with pallas_disabled_scope():
+                return fn(*args, **kwargs)
+        return decomposed
+    return src_vars if src_vars is not None else program_or_fn

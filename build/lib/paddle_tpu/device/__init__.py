@@ -1,0 +1,292 @@
+"""paddle_tpu.device — device management (reference: python/paddle/device/).
+
+Reference surface: set_device/get_device, device_count, synchronize, CUDA
+streams/events (device/cuda/streams.py), device properties, custom-device
+discovery. TPU-native redesign: devices are XLA PjRt devices; "streams" do
+not exist in the XLA execution model (the runtime orders execution per
+device, and overlap is expressed inside the compiled program), so Stream /
+Event keep the reference API shape as synchronization-correct shims built on
+``block_until_ready`` — code written against them stays correct, XLA keeps
+the scheduling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from ..framework import set_device, get_device, device_count
+
+__all__ = [
+    "set_device", "get_device", "device_count", "synchronize", "get_device_properties",
+    "get_available_device", "get_available_custom_device", "get_all_device_type",
+    "get_all_custom_device_type", "is_compiled_with_cuda", "is_compiled_with_rocm",
+    "is_compiled_with_xpu", "is_compiled_with_custom_device", "Stream", "Event",
+    "current_stream", "stream_guard", "memory_stats", "XPUPlace", "CPUPlace",
+    "TPUPlace", "CUDAPlace",
+]
+
+
+def _resolve(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, (int,)):
+        return jax.devices()[device]
+    if hasattr(device, "platform"):
+        return device
+    name = str(device)
+    plat = name.split(":")[0]
+    idx = int(name.split(":")[1]) if ":" in name else 0
+    plat = {"gpu": "gpu", "xpu": "tpu", "tpu": "tpu", "cpu": "cpu"}.get(plat, plat)
+    return jax.devices(plat)[idx]
+
+
+def synchronize(device=None) -> None:
+    """Block until all queued work on the device is done (reference:
+    paddle.device.synchronize). XLA orders execution per device, so syncing
+    means flushing: round-trip a trivial computation through the device."""
+    d = _resolve(device)
+    jax.block_until_ready(jax.device_put(0, d))
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in get_all_custom_device_type()
+
+
+@dataclasses.dataclass
+class DeviceProperties:
+    name: str
+    platform: str
+    id: int
+    process_index: int
+    coords: Optional[tuple] = None
+    core_on_chip: Optional[int] = None
+    memory_stats: Optional[dict] = None
+
+
+def get_device_properties(device=None) -> DeviceProperties:
+    d = _resolve(device)
+    stats = None
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        pass
+    return DeviceProperties(
+        name=getattr(d, "device_kind", d.platform), platform=d.platform,
+        id=d.id, process_index=d.process_index,
+        coords=getattr(d, "coords", None),
+        core_on_chip=getattr(d, "core_on_chip", None), memory_stats=stats)
+
+
+def memory_stats(device=None) -> dict:
+    """HBM usage for a device (allocator stats slot: reference
+    paddle/fluid/memory/stats.h). Empty dict on backends without stats."""
+    try:
+        return dict(_resolve(device).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# Stream / Event shims
+# ---------------------------------------------------------------------------
+
+class Stream:
+    """API-shape shim for paddle.device.Stream. XLA has no user-visible
+    streams; ``synchronize``/``wait_event``/``wait_stream`` provide the same
+    ordering guarantees via block_until_ready."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = _resolve(device)
+        self.priority = priority
+        self._last = None
+
+    def record_event(self, event: "Event" = None) -> "Event":
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event: "Event") -> None:
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream") -> None:
+        stream.synchronize()
+
+    def synchronize(self) -> None:
+        synchronize(self.device)
+
+    def track(self, arrays) -> None:
+        """Associate in-flight arrays with this stream so synchronize() can
+        wait for them (TPU addition; XLA arrays are futures already)."""
+        self._last = arrays
+
+
+class Event:
+    """API-shape shim for paddle.device.Event."""
+
+    def __init__(self, device=None, enable_timing: bool = False,
+                 blocking: bool = False, interprocess: bool = False):
+        self.device = _resolve(device)
+        self.enable_timing = enable_timing
+        self._recorded_on: Optional[Stream] = None
+        self._t = None
+
+    def record(self, stream: Optional[Stream] = None) -> None:
+        import time
+        self._recorded_on = stream
+        if self.enable_timing:
+            self._t = time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self) -> None:
+        if self._recorded_on is not None:
+            self._recorded_on.synchronize()
+        else:
+            synchronize(self.device)
+
+    def elapsed_time(self, end: "Event") -> float:
+        if self._t is None or end._t is None:
+            raise RuntimeError("Event timing not enabled")
+        return (end._t - self._t) * 1000.0
+
+
+_current_stream: dict[int, Stream] = {}
+
+
+def current_stream(device=None) -> Stream:
+    d = _resolve(device)
+    if d.id not in _current_stream:
+        _current_stream[d.id] = Stream(d)
+    return _current_stream[d.id]
+
+
+class stream_guard:
+    """Context manager parity shim (reference: paddle.device.stream_guard)."""
+
+    def __init__(self, stream: Stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = _current_stream.get(self.stream.device.id)
+        _current_stream[self.stream.device.id] = self.stream
+        return self.stream
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            _current_stream.pop(self.stream.device.id, None)
+        else:
+            _current_stream[self.stream.device.id] = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Place classes (reference: paddle.CUDAPlace/CPUPlace/XPUPlace) — thin
+# wrappers resolving to jax devices so ported code can keep constructing them
+# ---------------------------------------------------------------------------
+
+class _Place:
+    platform = "cpu"
+
+    def __init__(self, idx: int = 0):
+        self.idx = idx
+
+    def jax_device(self):
+        return jax.devices(self.platform)[self.idx]
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.idx})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.idx == other.idx
+
+
+class CPUPlace(_Place):
+    platform = "cpu"
+
+    def __init__(self, idx: int = 0):
+        super().__init__(idx)
+
+
+class TPUPlace(_Place):
+    platform = "tpu"
+
+
+class CUDAPlace(_Place):
+    """Accepted for portability; resolves to the accelerator actually
+    present (TPU) rather than CUDA."""
+    platform = "tpu"
+
+    def jax_device(self):
+        try:
+            return jax.devices("tpu")[self.idx]
+        except RuntimeError:
+            return jax.devices()[self.idx]
+
+
+class XPUPlace(CUDAPlace):
+    pass
+
+
+def get_cudnn_version():
+    """No cuDNN in the TPU stack (reference: device/__init__.py
+    get_cudnn_version returns None when CUDA is absent)."""
+    return None
+
+
+def is_compiled_with_cinn() -> bool:
+    """CINN's compiler slot is filled by XLA (SURVEY §2.2 design)."""
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def set_stream(stream=None):
+    """XLA orders work on internal streams; kept for API parity
+    (reference: device/__init__.py set_stream)."""
+    return stream
+
+
+from ..base import IPUPlace  # noqa: E402 — place shim (no IPU backend)
+
+
+from . import cuda  # noqa: E402  paddle.device.cuda path
+from . import xpu  # noqa: E402  paddle.device.xpu path

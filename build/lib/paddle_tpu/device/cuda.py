@@ -1,0 +1,67 @@
+"""paddle.device.cuda module-path parity (reference:
+python/paddle/device/cuda/ — Stream/Event/synchronize/memory queries on
+the CUDA runtime). On TPU "cuda" device queries answer for the accelerator
+jax exposes (the reference pattern: the current device family); there is
+no CUDA runtime, so is_compiled-style predicates stay False."""
+
+import jax
+
+from . import (DeviceProperties, Event, Stream, get_device_properties,
+               memory_stats, synchronize)
+
+
+def device_count() -> int:
+    try:
+        return jax.device_count()
+    except Exception:
+        return 0
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext(stream)
+
+
+def get_device_capability(device=None):
+    """No SM capability on TPU; returns (0, 0) like unsupported devices."""
+    return (0, 0)
+
+
+def get_device_name(device=None) -> str:
+    d = jax.devices()[0]
+    return getattr(d, "device_kind", d.platform)
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    """XLA exposes no allocator-held-vs-allocated split; peak bytes in use
+    is the closest real stat (documented substitution, like empty_cache)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """See max_memory_reserved: bytes in use stands in for reserved."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def empty_cache() -> None:
+    """XLA's allocator has no user-drainable cache; no-op like the
+    reference on platforms without caching allocators."""
+
+
+__all__ = ["Stream", "Event", "current_stream", "stream_guard",
+           "synchronize", "device_count", "get_device_capability",
+           "get_device_name", "get_device_properties", "DeviceProperties",
+           "max_memory_allocated", "max_memory_reserved",
+           "memory_allocated", "memory_reserved", "empty_cache"]

@@ -1,0 +1,17 @@
+"""paddle.device.xpu module-path parity (reference:
+python/paddle/device/xpu/). No Kunlun runtime exists here; count/sync
+answer for the visible jax devices."""
+
+import jax
+
+from . import synchronize  # noqa: F401
+
+
+def device_count() -> int:
+    try:
+        return jax.device_count()
+    except Exception:
+        return 0
+
+
+__all__ = ["device_count", "synchronize"]

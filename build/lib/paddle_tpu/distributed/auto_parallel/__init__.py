@@ -1,0 +1,25 @@
+"""paddle.distributed.auto_parallel module-path parity (reference:
+python/paddle/distributed/auto_parallel/ — the semi-auto DistTensor API,
+api.py:118 shard_tensor etc.). The implementations live in
+paddle_tpu.parallel (GSPMD mesh/placement API); re-exported here so
+auto-parallel recipes import from the reference path."""
+
+from ...parallel.mesh import HybridMesh, current_mesh
+from ...parallel.api import (shard_tensor, reshard, shard_layer,
+                             shard_optimizer_state, param_spec_tree,
+                             Shard, Replicate, Partial)
+
+
+def dtensor_from_fn(fn, mesh=None, placements=(), *args, **kwargs):
+    """Build a sharded tensor from a creation fn (reference: api.py:248
+    dtensor_from_fn) — create then place."""
+    return shard_tensor(fn(*args, **kwargs), mesh=mesh,
+                        placements=placements)
+
+from ..compat import ProcessMesh
+from ..strategy import DistributedStrategy as Strategy
+
+__all__ = ["ProcessMesh", "shard_tensor", "reshard", "shard_layer",
+           "shard_optimizer_state", "dtensor_from_fn", "Shard",
+           "Replicate", "Partial", "Strategy", "HybridMesh",
+           "current_mesh", "param_spec_tree"]

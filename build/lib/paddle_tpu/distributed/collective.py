@@ -1,0 +1,15 @@
+"""paddle.distributed.collective module-path parity (reference:
+python/paddle/distributed/collective.py — group creation and the
+process-group plumbing behind the public collectives). Implementations
+live in distributed/communication.py (mesh-is-the-group design)."""
+
+from .communication import (Group, ReduceOp, new_group, get_rank,
+                            get_world_size, barrier, all_reduce, all_gather,
+                            reduce_scatter, alltoall, broadcast, reduce,
+                            scatter, gather)
+
+_get_global_group = new_group
+
+__all__ = ["Group", "ReduceOp", "new_group", "get_rank", "get_world_size",
+           "barrier", "all_reduce", "all_gather", "reduce_scatter",
+           "alltoall", "broadcast", "reduce", "scatter", "gather"]

@@ -1,0 +1,369 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/*.py (all_reduce,
+all_gather, alltoall, reduce_scatter, broadcast, send/recv,
+batch_isend_irecv) over ProcessGroupNCCL
+(paddle/fluid/distributed/collective/process_group_nccl.h:37), bootstrapped
+by TCPStore (phi/core/distributed/store/tcp_store.h:121).
+
+TPU-native contract (SURVEY.md §5 "Distributed communication backend"):
+
+- **The mesh is the group.** A ``Group`` names one or more mesh axes of the
+  ambient HybridMesh; there is no communicator object to create or destroy,
+  and ``new_group`` is a cheap name-binding.
+- **Two call contexts.** Inside a ``shard_map`` region these functions are
+  the XLA collectives themselves (lax.psum / all_gather / all_to_all /
+  ppermute — they ride ICI/DCN by mesh axis order). Outside (eager,
+  "dygraph-like"), they operate on the *rank-major view*: a global array
+  whose leading dim is the group size, sharded one-slice-per-rank — the
+  single-controller equivalent of "each rank holds its tensor". Use
+  ``rank_view(x, group)`` to build that layout.
+- Multi-host bootstrap is ``jax.distributed.initialize`` (the coordination
+  service replaces TCPStore) — see parallel.mesh.init_parallel_env.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import HybridMesh, current_mesh
+
+
+class ReduceOp:
+    """Reference: paddle.distributed.ReduceOp (SUM/MAX/MIN/PROD/AVG)."""
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named slice of the mesh: one or more axis names.
+
+    Reference analogue: paddle.distributed.collective.Group (rank list +
+    communicator); here the axes ARE the membership, ranks are mesh
+    coordinates along them.
+    """
+
+    def __init__(self, axes: Union[str, Sequence[str]], mesh: Optional[Mesh] = None):
+        self.axes: Tuple[str, ...] = ((axes,) if isinstance(axes, str)
+                                      else tuple(axes))
+        self._mesh = mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is not None:
+            return self._mesh
+        hm = current_mesh()
+        if hm is None:
+            raise RuntimeError("no active mesh — enter `with HybridMesh.build"
+                               "(...)` or pass mesh to Group")
+        return hm.mesh
+
+    @property
+    def nranks(self) -> int:
+        shape = self.mesh.shape
+        n = 1
+        for a in self.axes:
+            n *= shape.get(a, 1)
+        return n
+
+    world_size = nranks
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+def _resolve_group(group) -> Group:
+    if isinstance(group, Group):
+        return group
+    if group is None:
+        hm = current_mesh()
+        if hm is None:
+            raise RuntimeError("no active mesh")
+        return Group(tuple(hm.mesh.axis_names))
+    return Group(group)
+
+
+def new_group(axes=None, ranks=None, backend=None) -> Group:
+    """Bind a Group to mesh axes. ``ranks`` (the reference's rank-list
+    signature) is unsupported by design: arbitrary rank subsets don't map to
+    a mesh slice — regroup by reshaping the mesh instead."""
+    if ranks is not None:
+        raise NotImplementedError(
+            "rank-list groups don't exist on a mesh; name mesh axes instead "
+            "(e.g. new_group('tp') or new_group(('dp','fsdp')))")
+    return _resolve_group(axes)
+
+
+def get_rank(group=None) -> int:
+    """Process index (multi-host) — reference: paddle.distributed.get_rank."""
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is None:
+        return jax.process_count()
+    return _resolve_group(group).nranks
+
+
+def barrier(group=None) -> None:
+    """Device-sync barrier (reference: paddle.distributed.barrier). On a
+    single controller, draining all device work is the strongest barrier."""
+    for d in jax.live_arrays():
+        pass
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# in-shard_map spellings (usable ONLY under shard_map / pmap tracing)
+# ---------------------------------------------------------------------------
+
+def psum(x, group=None):
+    return jax.lax.psum(x, _resolve_group(group).axes)
+
+
+def pmean(x, group=None):
+    return jax.lax.pmean(x, _resolve_group(group).axes)
+
+
+def pmax(x, group=None):
+    return jax.lax.pmax(x, _resolve_group(group).axes)
+
+
+def pmin(x, group=None):
+    return jax.lax.pmin(x, _resolve_group(group).axes)
+
+
+def ppermute(x, perm, group=None):
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("ppermute needs a single-axis group")
+    return jax.lax.ppermute(x, g.axes[0], perm)
+
+
+def send_recv(x, shift: int = 1, group=None):
+    """Ring P2P: every rank sends to rank+shift (mod n) — the building block
+    the reference spells batch_isend_irecv (communication/batch_isend_irecv.py)
+    and PP's fused send/recv pairs with."""
+    g = _resolve_group(group)
+    n = g.nranks
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return ppermute(x, perm, g)
+
+
+# ---------------------------------------------------------------------------
+# eager (rank-major view) collectives
+# ---------------------------------------------------------------------------
+
+def rank_view(x, group=None):
+    """Shard ``x``'s leading dim one-slice-per-rank of ``group`` — the
+    layout eager collectives operate on."""
+    g = _resolve_group(group)
+    axes = g.axes if len(g.axes) > 1 else g.axes[0]
+    sh = NamedSharding(g.mesh, P(axes))
+    return jax.device_put(x, sh)
+
+
+def _eager_shard_map(fn, g: Group, x, out_specs):
+    axes = g.axes if len(g.axes) > 1 else g.axes[0]
+    in_specs = P(axes)
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=in_specs,
+                         out_specs=out_specs)(x)
+
+
+def all_reduce(x, op: str = ReduceOp.SUM, group=None, sync_op: bool = True):
+    """Rank-major all_reduce: x is [nranks, ...] (one slice per rank);
+    returns the reduced [...] replicated on the group.
+
+    Inside shard_map, use ``psum``/``pmax``/... directly."""
+    g = _resolve_group(group)
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}
+    if op not in red:
+        raise NotImplementedError(f"all_reduce op {op!r} (SUM/MAX/MIN/AVG "
+                                  f"supported)")
+
+    def fn(xs):  # xs: [nranks/|axes|, ...] local slice
+        local = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+                 ReduceOp.MIN: jnp.min, ReduceOp.AVG: jnp.mean}[op](xs, axis=0)
+        return red[op](local, g.axes)
+
+    return _eager_shard_map(fn, g, x, out_specs=P())
+
+
+def all_gather(x, group=None, axis: int = 0):
+    """Gather the rank-sharded dim to every rank (replicated result).
+    Reference: paddle.distributed.all_gather (returns tensor_list; here the
+    gathered global array — slice if you need per-rank pieces)."""
+    g = _resolve_group(group)
+    spec = [None] * jnp.ndim(x)
+    spec[axis] = g.axes if len(g.axes) > 1 else g.axes[0]
+    sh = NamedSharding(g.mesh, P(*spec))
+    x = jax.device_put(x, sh)  # ensure sharded along the group
+    return jax.device_put(x, NamedSharding(g.mesh, P()))  # XLA all-gather
+
+
+def reduce_scatter(x, op: str = ReduceOp.SUM, group=None):
+    """Rank-major reduce_scatter: x [nranks, m, ...] (rank i holds slice i);
+    slices are summed elementwise and the result split over ranks → returns
+    [nranks, m/nranks, ...] (rank i holds reduced chunk i).
+    Reference: communication/reduce_scatter.py."""
+    g = _resolve_group(group)
+    if op != ReduceOp.SUM:
+        raise NotImplementedError("reduce_scatter supports SUM")
+    if len(g.axes) != 1:
+        raise ValueError("reduce_scatter needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):  # [per-rank stack of slices, n*chunk, ...]
+        local = jnp.sum(xs, axis=0)
+        return jax.lax.psum_scatter(local, axis, scatter_dimension=0,
+                                    tiled=True)[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def alltoall(x, group=None):
+    """Rank-major all-to-all: x [nranks, m, ...] (rank i holds slice i);
+    rank i's slice splits into nranks pieces along dim 1 (local dim 0),
+    piece j goes to rank j → out[i] = concat_j(piece i of x[j]). The
+    m-dim transpose across ranks. Reference: communication/all_to_all.py;
+    MoE's global_scatter/global_gather is this op."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("alltoall needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):  # xs: [1, m, ...] this rank's slice
+        return jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=1,
+                                  tiled=True)
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def broadcast(x, src: int = 0, group=None):
+    """Broadcast rank ``src``'s slice of the rank-major array to all ranks.
+    Reference: communication/broadcast.py."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("broadcast needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):  # [1, ...]
+        # every rank receives src's slice: ppermute from src to all is an
+        # all_gather + index (cheap at these sizes, single hop on ICI)
+        gathered = jax.lax.all_gather(xs[0], axis)  # [n, ...]
+        return gathered[src][None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM, group=None):
+    """Rooted reduce: all ranks' slices reduce; rank ``dst`` receives the
+    result, other ranks keep their input (reference:
+    communication/reduce.py — NCCL reduce-to-root semantics)."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("reduce needs a single-axis group")
+    axis = g.axes[0]
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}.get(op)
+    if red is None:
+        raise NotImplementedError(f"unsupported reduce op {op!r}")
+
+    def fn(xs):  # [1, ...]
+        total = red(xs[0], axis)
+        me = jax.lax.axis_index(axis)
+        return jnp.where(me == dst, total, xs[0])[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def scatter(x, src: int = 0, group=None):
+    """Rank ``src``'s slice (itself rank-major [n, m, ...]) scatters piece
+    i to rank i (reference: communication/scatter.py). Other ranks'
+    payloads are ignored, as NCCL scatter does."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("scatter needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):  # [1, n, m, ...] this rank's (ignored unless src) payload
+        # all_to_all moves O(n*m): rank i ships payload row j to rank j,
+        # so each rank ends with column [i=src] of the transposed layout —
+        # no O(n^2*m) all_gather of every rank's full payload
+        transposed = jax.lax.all_to_all(xs, axis, split_axis=1,
+                                        concat_axis=0, tiled=True)
+        # transposed: [n, 1, m...] — row i is rank i's piece for THIS rank
+        return transposed[src, 0][None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def gather(x, dst: int = 0, group=None, axis: int = 0):
+    """Rooted gather: rank ``dst`` receives all slices concatenated; other
+    ranks receive their own slice tiled (XLA has no rooted gather — the
+    all-gather rides ICI either way; reference: communication/gather.py)."""
+    del dst  # every rank materializes the gather (documented deviation)
+    return all_gather(x, group=group, axis=axis)
+
+
+def send_to(x, dst: int, src: int, group=None):
+    """Point-to-point move of rank ``src``'s slice to rank ``dst`` (the
+    reference's send/recv pair, communication/{send,recv}.py — one XLA
+    CollectivePermute). Ranks other than dst keep their slice."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("send_to needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):
+        moved = jax.lax.ppermute(xs[0], axis, [(src, dst)])
+        me = jax.lax.axis_index(axis)
+        return jnp.where(me == dst, moved, xs[0])[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+def batch_isend_irecv(x, pairs, group=None):
+    """Batched P2P: ``pairs`` is [(src, dst), ...] executed as ONE
+    CollectivePermute (reference: communication/batch_isend_irecv.py —
+    NCCL groups the sends; XLA's ppermute IS the batched form). Ranks that
+    are not a destination receive zeros, matching ppermute semantics."""
+    g = _resolve_group(group)
+    if len(g.axes) != 1:
+        raise ValueError("batch_isend_irecv needs a single-axis group")
+    axis = g.axes[0]
+
+    def fn(xs):
+        return jax.lax.ppermute(xs[0], axis, list(pairs))[None]
+
+    return jax.shard_map(fn, mesh=g.mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+class stream:
+    """Namespace parity with paddle.distributed.stream.* — on TPU there are
+    no user-visible comm streams (XLA schedules collectives); the stream API
+    maps to the same collectives."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)

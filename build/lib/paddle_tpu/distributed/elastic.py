@@ -1,0 +1,154 @@
+"""Elastic training manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager;
+ElasticLevel at manager.py:43).
+
+Reference behavior: nodes register in etcd, a watcher tracks membership;
+on scale-in/out (or node death) training is killed and relaunched with a
+regenerated rank map; checkpoint/resume provides continuity.
+
+TPU-native redesign: the registry is the native C++ TCPStore (no etcd in a
+TPU pod; the coordinator host plays master), membership is heartbeat keys
+checked against a timeout window, and the relaunch path reuses
+distributed.launch. On TPU slices the chip topology is fixed per slice, so
+"elastic" primarily means surviving preemption/restart of hosts with
+checkpoint resume — the fault-tolerance level — rather than changing world
+size mid-run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import IntEnum
+from typing import Callable, Optional
+
+
+class ElasticLevel(IntEnum):
+    FAULT_TOLERANCE = 1   # fixed world size, relaunch on failure
+    ELASTIC = 2           # world size may change between restarts
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership + restart-policy driver.
+
+    ``host_port`` addresses the rank-0 TCPStore (None → host one in-process
+    as master). Each node heartbeats ``node/<id>``; :meth:`watch` reports
+    membership health; :meth:`run` relaunches a training callable on failure
+    up to ``max_restarts`` times, passing the restart ordinal so the callable
+    can resume from its latest checkpoint.
+    """
+
+    def __init__(self, host_port: Optional[str] = None, *,
+                 np: Optional[int] = None, is_master: bool = False,
+                 elastic_level: ElasticLevel = ElasticLevel.FAULT_TOLERANCE,
+                 heartbeat_interval: float = 2.0,
+                 heartbeat_timeout: float = 30.0, max_restarts: int = 3,
+                 node_id: Optional[str] = None):
+        from paddle_tpu import native
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.elastic_level = elastic_level
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        self.max_restarts = max_restarts
+        self.node_id = node_id or os.environ.get(
+            "PADDLE_TRAINER_ID", f"node-{os.getpid()}")
+        if host_port is None:
+            self.store = native.TCPStore(is_master=True, world_size=self.np)
+            self.host, self.port = "127.0.0.1", self.store.port
+        else:
+            host, port = host_port.rsplit(":", 1)
+            self.store = native.TCPStore(host=host, port=int(port),
+                                         is_master=is_master,
+                                         world_size=self.np)
+            self.host, self.port = host, int(port)
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    # -- membership --------------------------------------------------------
+
+    def register(self) -> None:
+        """Announce membership and start heartbeating (reference register +
+        etcd lease refresh). Node ids are also indexed through a shared
+        counter because the store (like the reference's) has no prefix scan."""
+        slot = self.store.add("node_count", 1) - 1
+        self.store.set(f"node_ids/{slot}", self.node_id)
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self) -> None:
+        self.store.set(f"node/{self.node_id}",
+                       json.dumps({"ts": time.time(), "pid": os.getpid()}))
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def alive_nodes(self) -> list[str]:
+        """Nodes whose latest heartbeat is inside the timeout window."""
+        alive = []
+        slot = 0
+        while True:
+            raw = self.store.try_get(f"node_ids/{slot}")
+            if raw is None:
+                break
+            node_id = raw.decode()
+            hb = self.store.try_get(f"node/{node_id}")
+            if hb is not None:
+                data = json.loads(hb)
+                if time.time() - data["ts"] <= self.heartbeat_timeout:
+                    alive.append(node_id)
+            slot += 1
+        return alive
+
+    def watch(self) -> str:
+        """One health poll (reference ElasticManager.watch loop body)."""
+        alive = self.alive_nodes()
+        if len(alive) >= self.np:
+            return (ElasticStatus.COMPLETED if self._stop.is_set()
+                    else ElasticStatus.HOLD)
+        if not alive:
+            return ElasticStatus.ERROR
+        if self.elastic_level == ElasticLevel.ELASTIC:
+            return ElasticStatus.RESTART
+        return ElasticStatus.RESTART
+
+    # -- restart policy ----------------------------------------------------
+
+    def run(self, train_fn: Callable[[int], None]) -> bool:
+        """Run with restart-on-failure (the relaunch half of manager.py; the
+        reference shells out to launch — here train_fn encapsulates it).
+        train_fn receives the restart ordinal (0 = first run) and should
+        resume from its latest checkpoint when > 0."""
+        while True:
+            try:
+                train_fn(self.restarts)
+                return True
+            except Exception as e:  # noqa: BLE001 — any training failure
+                if self.restarts >= self.max_restarts:
+                    print(f"[elastic] giving up after {self.restarts} "
+                          f"restarts: {e}")
+                    return False
+                self.restarts += 1
+                print(f"[elastic] training failed ({e}); restart "
+                      f"{self.restarts}/{self.max_restarts}")
+
+    def exit(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+        self.store.close()

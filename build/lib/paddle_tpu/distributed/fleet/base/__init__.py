@@ -1,0 +1,5 @@
+"""paddle.distributed.fleet.base subpackage path (reference:
+fleet/base/{topology.py,distributed_strategy.py,role_maker.py})."""
+from . import topology
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from ...strategy import DistributedStrategy

@@ -1,0 +1,5 @@
+"""Reference path fleet/base/topology.py (CommunicateTopology:61,
+HybridCommunicateGroup:174); implementation in distributed/topology.py."""
+from ...topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

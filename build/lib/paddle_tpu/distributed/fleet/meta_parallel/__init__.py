@@ -1,0 +1,16 @@
+"""paddle.distributed.fleet.meta_parallel subpackage path (reference:
+fleet/meta_parallel/{parallel_layers/mp_layers.py, pp_layers.py,
+pipeline_parallel.py}); implementations in paddle_tpu.parallel."""
+from ....parallel.mp_layers import (ColumnParallelLinear,
+                                    ColumnSequenceParallelLinear,
+                                    ParallelCrossEntropy,
+                                    RowParallelLinear,
+                                    RowSequenceParallelLinear,
+                                    VocabParallelEmbedding)
+from ....parallel.pipeline import (LayerDesc, PipelineLayer, SegmentLayers,
+                                   SharedLayerDesc)
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "PipelineLayer", "LayerDesc", "SharedLayerDesc", "SegmentLayers"]

@@ -1,0 +1,5 @@
+"""Reference path fleet/recompute/recompute.py:404; implementation in
+distributed/recompute.py (jax.checkpoint policies)."""
+from ...recompute import recompute, recompute_hybrid, recompute_sequential
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]

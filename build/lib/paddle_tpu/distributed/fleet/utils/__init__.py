@@ -1,0 +1,7 @@
+"""paddle.distributed.fleet.utils subpackage path (reference:
+fleet/utils/{recompute compat, sequence_parallel_utils.py,
+hybrid_parallel_util.py})."""
+from . import sequence_parallel_utils
+from ...recompute import recompute
+
+__all__ = ["recompute", "sequence_parallel_utils"]

@@ -1,0 +1,12 @@
+"""Reference path fleet/utils/sequence_parallel_utils.py:85-340 (the
+Megatron-SP scatter/gather PyLayers + SP linear variants); implementation
+in parallel/mp_layers.py."""
+from ....parallel.mp_layers import (ColumnSequenceParallelLinear,
+                                    RowSequenceParallelLinear, gather_seq,
+                                    scatter_seq)
+
+ScatterOp = scatter_seq
+GatherOp = gather_seq
+
+__all__ = ["ScatterOp", "GatherOp", "scatter_seq", "gather_seq",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]

@@ -1,0 +1,51 @@
+"""paddle.distributed.io — distributed persistable save/load.
+
+Reference: python/paddle/distributed/io.py (save_persistables /
+load_persistables over static programs). Here persistables are the
+parameter/buffer pytrees; hosts write only on process 0 (single
+controller), matching the reference's is_first_worker() gating.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from .. import framework as _fw
+
+
+def _is_chief() -> bool:
+    return jax.process_index() == 0
+
+
+def save_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename: Optional[str] = None) -> None:
+    """Save a layer/program's persistable state (reference:
+    distributed/io.py save_persistables). ``main_program`` may be a Layer
+    (its state_dict is saved) or a state dict itself."""
+    state: Any = main_program
+    if hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    if state is None:
+        raise ValueError("save_persistables: pass a Layer or state dict")
+    if _is_chief():
+        path = os.path.join(dirname, filename or "persistables.pdparams")
+        _fw.save(state, path)
+
+
+def load_persistables(executor=None, dirname: str = "", main_program=None,
+                      filename: Optional[str] = None):
+    """Load persistables saved by save_persistables; if ``main_program``
+    is a Layer, its state is set in place."""
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = _fw.load(path)
+    if hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+        return main_program
+    return state
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", True))

@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed.launch — multi-process/multi-host launcher.
+
+Reference: python/paddle/distributed/launch/ (main.py:20 entry; controllers/
+collective.py spawns per-rank containers; job/{job.py,pod.py,container.py}
+structures; master via HTTP/etcd; watcher restarts failed pods).
+
+TPU-native redesign: one worker process per host (JAX owns all local chips),
+rendezvous through the native C++ TCPStore (csrc/pt_native.cc) instead of
+etcd/HTTP, and worker env carries both the reference's PADDLE_* variables
+(for fleet topology code) and JAX distributed-init variables
+(coordinator address / process id / process count for
+jax.distributed.initialize over DCN).
+
+Usage:
+    python -m paddle_tpu.distributed.launch --nproc_per_node=4 train.py ...
+"""
+
+from .main import launch, build_pod, LaunchConfig, Pod, Container
+
+__all__ = ["launch", "build_pod", "LaunchConfig", "Pod", "Container"]

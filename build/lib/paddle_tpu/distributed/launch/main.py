@@ -1,0 +1,204 @@
+"""Launcher core (reference: launch/main.py + controllers/collective.py).
+
+Job model mirrors the reference: a **Pod** is this host's set of worker
+**Containers**; the controller spawns them with per-rank env, streams logs
+to files, watches exit codes, and tears the pod down on first failure
+(or relaunches under elastic policy — distributed/elastic.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class LaunchConfig:
+    """CLI surface (reference launch args subset that matters off-GPU)."""
+    nproc_per_node: int = 1
+    nnodes: int = 1
+    node_rank: int = 0
+    master: Optional[str] = None          # "host:port" of rank-0 TCPStore
+    log_dir: str = "log"
+    job_id: str = "default"
+    devices: Optional[str] = None          # visible-device list per rank
+    max_restarts: int = 0                  # >0 enables elastic relaunch
+    run_mode: str = "collective"
+
+
+@dataclasses.dataclass
+class Container:
+    """One worker process (reference: launch/job/container.py)."""
+    rank: int
+    local_rank: int
+    env: Dict[str, str]
+    cmd: List[str]
+    log_path: str
+    proc: Optional[subprocess.Popen] = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        logf = open(self.log_path, "ab")
+        env = dict(os.environ)
+        env.update(self.env)
+        self.proc = subprocess.Popen(self.cmd, env=env, stdout=logf,
+                                     stderr=subprocess.STDOUT)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace: float = 10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.1)
+        self.proc.kill()
+
+
+@dataclasses.dataclass
+class Pod:
+    """This node's containers (reference: launch/job/pod.py)."""
+    containers: List[Container] = dataclasses.field(default_factory=list)
+
+    def start(self):
+        for c in self.containers:
+            c.start()
+
+    def alive(self) -> bool:
+        return any(c.alive() for c in self.containers)
+
+    def failed(self) -> List[Container]:
+        return [c for c in self.containers
+                if c.exit_code not in (None, 0)]
+
+    def join(self, poll: float = 1.0) -> int:
+        """Watch until all exit or one fails (reference watcher behavior):
+        first non-zero exit tears down the pod. Returns pod exit code."""
+        while True:
+            bad = self.failed()
+            if bad:
+                for c in self.containers:
+                    c.terminate()
+                return bad[0].exit_code or 1
+            if not self.alive():
+                return 0
+            time.sleep(poll)
+
+    def terminate(self):
+        for c in self.containers:
+            c.terminate()
+
+
+def build_pod(cfg: LaunchConfig, training_script: str,
+              script_args: Sequence[str]) -> Pod:
+    """Construct per-rank containers with the collective env
+    (reference controllers/collective.py:build_pod)."""
+    world = cfg.nnodes * cfg.nproc_per_node
+    if cfg.master is None:
+        master_host, master_port = "127.0.0.1", _free_port()
+    else:
+        master_host, master_port = cfg.master.rsplit(":", 1)
+        master_port = int(master_port)
+
+    # endpoints across the whole job, node-major (reference fakes the same
+    # layout for single-host multi-proc tests)
+    base_port = _free_port()
+    endpoints = []
+    for node in range(cfg.nnodes):
+        host = master_host if cfg.nnodes > 1 else "127.0.0.1"
+        for lr in range(cfg.nproc_per_node):
+            endpoints.append(f"{host}:{base_port + lr}")
+
+    pod = Pod()
+    for local_rank in range(cfg.nproc_per_node):
+        rank = cfg.node_rank * cfg.nproc_per_node + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "MASTER_ADDR": master_host,
+            "MASTER_PORT": str(master_port),
+            "PADDLE_JOB_ID": cfg.job_id,
+            # jax.distributed.initialize() reads these
+            "JAX_COORDINATOR_ADDRESS": f"{master_host}:{master_port}",
+            "JAX_NUM_PROCESSES": str(world),
+            "JAX_PROCESS_ID": str(rank),
+        }
+        if cfg.devices is not None:
+            devs = cfg.devices.split(",")
+            env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+        pod.containers.append(Container(
+            rank=rank, local_rank=local_rank, env=env,
+            cmd=[sys.executable, "-u", training_script, *script_args],
+            log_path=os.path.join(cfg.log_dir,
+                                  f"workerlog.{rank}")))
+    return pod
+
+
+def launch(cfg: LaunchConfig, training_script: str,
+           script_args: Sequence[str] = ()) -> int:
+    """Run the job to completion; under cfg.max_restarts > 0 failed pods are
+    relaunched (elastic fault-tolerance level, reference
+    fleet/elastic/manager.py:43 ElasticLevel.FAULT_TOLERANCE)."""
+    attempt = 0
+    while True:
+        pod = build_pod(cfg, training_script, script_args)
+        pod.start()
+        code = pod.join()
+        if code == 0:
+            return 0
+        if attempt >= cfg.max_restarts:
+            return code
+        attempt += 1
+        print(f"[launch] pod failed (exit {code}); restart "
+              f"{attempt}/{cfg.max_restarts}", file=sys.stderr)
+
+
+def _parse_args(argv: Sequence[str]):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-process launcher (reference: "
+                    "python -m paddle.distributed.launch)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", type=str, default=None)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ns = _parse_args(argv if argv is not None else sys.argv[1:])
+    cfg = LaunchConfig(
+        nproc_per_node=ns.nproc_per_node, nnodes=ns.nnodes,
+        node_rank=ns.node_rank, master=ns.master, log_dir=ns.log_dir,
+        job_id=ns.job_id, devices=ns.devices, max_restarts=ns.max_restarts)
+    return launch(cfg, ns.training_script, ns.script_args)

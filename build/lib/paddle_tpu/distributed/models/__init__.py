@@ -1,0 +1,2 @@
+"""paddle.distributed.models parity (reference holds the moe package)."""
+from . import moe

@@ -1,0 +1,9 @@
+"""paddle.distributed.models.moe module-path parity (reference:
+python/paddle/distributed/models/moe + incubate/distributed/models/moe
+MoELayer:263 and gates). The TPU MoE (sort-based dispatch, dropless
+grouped matmul) lives in paddle_tpu.parallel.moe; re-exported here."""
+
+from ....parallel.moe import (MoELayer, MoEMLP, top_k_gating,
+                              top_k_routing)
+
+__all__ = ["MoELayer", "MoEMLP", "top_k_gating", "top_k_routing"]

@@ -62,11 +62,10 @@ def apply_overlap_flags(enable: bool = True, *, target: str = "tpu") -> str:
     cur = os.environ.get("XLA_FLAGS", "")
     if not enable or target != "tpu":
         return cur
-    # match by EXACT flag name so an explicit user "=false" is respected
-    # and a longer flag name doesn't shadow a shorter one's install
-    cur_names = {tok.split("=")[0] for tok in cur.split()}
+    # match by flag NAME so an explicit user "=false" is respected, not
+    # silently overridden with a conflicting duplicate
     missing = [f for f in OVERLAP_XLA_FLAGS.split()
-               if f.split("=")[0] not in cur_names]
+               if f.split("=")[0] not in cur]
     if not missing:
         return cur
     try:

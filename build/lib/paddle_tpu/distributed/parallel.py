@@ -1,0 +1,13 @@
+"""paddle.distributed.parallel module-path parity (reference:
+python/paddle/distributed/parallel.py — init_parallel_env:943,
+ParallelEnv:642, DataParallel:202). On TPU init_parallel_env is the
+coordination-service + mesh bootstrap (parallel/mesh.py) and DataParallel
+is GSPMD placement (compat.py)."""
+
+from ..parallel.mesh import init_parallel_env
+from .communication import get_rank, get_world_size
+from .compat import ParallelEnv
+from ..base import DataParallel
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "ParallelEnv", "DataParallel"]

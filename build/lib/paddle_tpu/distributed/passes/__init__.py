@@ -1,0 +1,76 @@
+"""paddle.distributed.passes parity (reference: pass_base.py new_pass:131 /
+PassManager:350 + the auto_parallel_* program passes).
+
+Design substitution (docs/DESIGN_DECISIONS.md "Distributed passes"): the
+reference's passes rewrite static programs (AMP casts, recompute insertion,
+sharding partition, pipeline scheduling); XLA/GSPMD performs those
+transformations on the jaxpr, driven by the DistributedStrategy knobs
+(amp/recompute/sharding configs) rather than by user-applied passes. The
+registry shape is preserved so recipes enumerate and "apply" passes
+without error: apply() validates inputs and records itself; the compiled
+program is produced by jit regardless.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_KNOWN = {
+    "auto_parallel_amp", "auto_parallel_fp16", "auto_parallel_recompute",
+    "auto_parallel_sharding", "auto_parallel_grad_clip",
+    "auto_parallel_gradient_merge", "auto_parallel_pipeline",
+    "auto_parallel_sequence_parallel_optimization",
+    "auto_parallel_supplement_explicit_dependencies",
+    "pipeline_scheduler_FThenB", "pipeline_scheduler_1F1B",
+    "pipeline_scheduler_VPP", "fuse_all_reduce",
+    "allreduce_matmul_grad_overlapping", "fused_attention", "fused_feedforward",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs: Dict = {}
+
+
+class _Pass:
+    def __init__(self, name: str, attrs: Optional[Dict] = None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.applied = False
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Record application. The equivalent transformation happens inside
+        jit/GSPMD per the strategy knobs (module docstring)."""
+        self.applied = True
+        if context is not None:
+            context.attrs.setdefault("applied_passes", []).append(self.name)
+        return context
+
+    def __repr__(self):
+        return f"Pass(name={self.name!r}, applied={self.applied})"
+
+
+def new_pass(name: str, pass_attrs: Optional[Dict] = None) -> _Pass:
+    if name not in _KNOWN:
+        raise ValueError(f"unknown pass {name!r}; known: {sorted(_KNOWN)}")
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes: Optional[List[_Pass]] = None):
+        self.passes = list(passes or [])
+        self.context = PassContext()
+
+    def append(self, p: _Pass):
+        self.passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self.passes:
+            p.apply(main_programs, startup_programs, self.context)
+        return self.context
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]

@@ -1,0 +1,83 @@
+"""Activation recomputation (checkpointing).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:404 — a
+PyLayer that stashes RNG state + inputs, drops activations, and re-runs the
+forward inside backward with the RNG tracker re-seeded identically
+(recompute_hybrid.py for the hybrid-parallel variant).
+
+TPU collapse: ``jax.checkpoint`` (remat) is the engine — XLA re-executes the
+forward in the backward pass. The reference's RNG bookkeeping is free here:
+randomness flows through explicit fold_in'd keys (core.rng), so the
+recomputed forward sees bit-identical dropout masks by construction.
+``policy`` selects WHAT to save (the reference's selective-recompute
+``checkpoints`` list generalized to XLA saveable-policies).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+_POLICIES = {
+    "full": None,  # save nothing extra: recompute everything
+    "nothing_saveable": None,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots": jax.checkpoint_policies.dots_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def resolve_policy(policy):
+    if policy is None or callable(policy):
+        return policy
+    if policy in _POLICIES:
+        return _POLICIES[policy]
+    raise ValueError(f"unknown recompute policy {policy!r}; "
+                     f"one of {sorted(_POLICIES)}")
+
+
+def recompute(function: Callable, *args, policy=None, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` under rematerialization.
+
+    Mirrors paddle.distributed.fleet.recompute's call-style (immediate
+    execution, not a decorator). ``preserve_rng_state`` is accepted for
+    parity — always true here (keys are explicit).
+    """
+    fn = jax.checkpoint(function, policy=resolve_policy(policy))
+    return fn(*args, **kwargs)
+
+
+def recompute_wrapper(function: Callable, policy=None) -> Callable:
+    """Decorator form: a remat'd callable (for layer forwards)."""
+    return jax.checkpoint(function, policy=resolve_policy(policy))
+
+
+def recompute_sequential(ctx: Optional[dict], functions, *args):
+    """Reference: recompute_sequential — remat each function in a
+    Sequential-like chain. ``ctx`` accepted for parity (segments etc.)."""
+    if len(args) != 1:
+        raise ValueError("recompute_sequential chains single-input functions")
+    segments = (ctx or {}).get("segments", 1)
+    fns = list(functions)
+    x = args[0]
+    # group functions into `segments` chunks; remat each chunk as one unit
+    per = max(1, (len(fns) + segments - 1) // segments)
+    for i in range(0, len(fns), per):
+        def run_chunk(xx, _chunk=tuple(fns[i:i + per])):
+            for f in _chunk:
+                xx = f(xx)
+            return xx
+
+        x = jax.checkpoint(run_chunk)(x)
+    return x
+
+
+def recompute_hybrid(ctx: Optional[dict], function: Callable, *args, **kwargs):
+    """Reference: recompute_hybrid.py — recompute with hybrid-parallel RNG
+    tracker sync. Keys being explicit makes this identical to recompute."""
+    return recompute(function, *args, policy=(ctx or {}).get("policy"),
+                     **kwargs)

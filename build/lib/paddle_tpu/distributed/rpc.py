@@ -1,0 +1,191 @@
+"""paddle.distributed.rpc equivalent (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc/rpc_sync/rpc_async/shutdown
+over a brpc C++ transport, paddle/fluid/distributed/rpc/).
+
+TPU-native redesign: the transport is plain TCP sockets + pickle with a
+threaded server per process (user RPC is a control-plane feature — tensors
+move via collectives, not RPC — so brpc-grade throughput buys nothing
+here), and the worker registry is the native C++ TCPStore instead of a
+separate master service. API and semantics (named workers, sync/async calls,
+barrier on shutdown) match the reference.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {}
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _serve_loop(server: socket.socket, stop: threading.Event):
+    while not stop.is_set():
+        try:
+            conn, _ = server.accept()
+        except OSError:
+            return
+        threading.Thread(target=_serve_one, args=(conn,), daemon=True).start()
+
+
+def _serve_one(conn: socket.socket):
+    try:
+        with conn:
+            payload = _recv_msg(conn)
+            fn, args, kwargs = pickle.loads(payload)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # noqa: BLE001 — marshal to caller
+                result = (False, e)
+            _send_msg(conn, pickle.dumps(result, protocol=4))
+    except ConnectionError:
+        pass
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this process's RPC server and register with the job
+    (reference rpc.init_rpc)."""
+    from paddle_tpu import native
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT") or "127.0.0.1:0"
+    host, port_s = master_endpoint.rsplit(":", 1)
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(64)
+    my_port = server.getsockname()[1]
+
+    store = native.TCPStore(host=host if rank != 0 else "127.0.0.1",
+                            port=int(port_s), is_master=(rank == 0),
+                            world_size=world_size)
+    my_ip = "127.0.0.1" if world_size == 1 or host in ("127.0.0.1",
+                                                       "localhost") \
+        else socket.gethostbyname(socket.gethostname())
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+    store.set(f"rpc/name/{name}", str(rank).encode())
+
+    stop = threading.Event()
+    t = threading.Thread(target=_serve_loop, args=(server, stop), daemon=True)
+    t.start()
+
+    workers: Dict[str, WorkerInfo] = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"rpc/worker/{r}", timeout=300))
+        workers[info.name] = info
+
+    _state.update(dict(store=store, server=server, stop=stop, thread=t,
+                       name=name, rank=rank, world_size=world_size,
+                       workers=workers,
+                       pool=concurrent.futures.ThreadPoolExecutor(8)))
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if not _state:
+        raise RuntimeError("init_rpc not called")
+    if name is None:
+        name = _state["name"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    if not _state:
+        raise RuntimeError("init_rpc not called")
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def _call(to: str, fn, args, kwargs, timeout: float):
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port), timeout=timeout) as s:
+        _send_msg(s, pickle.dumps((fn, args or (), kwargs or {}), protocol=4))
+        s.settimeout(timeout)
+        ok, result = pickle.loads(_recv_msg(s))
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = 180.0):
+    """Blocking remote call (reference rpc.rpc_sync). ``fn`` must be
+    picklable by reference (module-level function)."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = 180.0):
+    """Returns a Future (reference rpc.rpc_async → FutureWrapper)."""
+    return _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+
+
+def shutdown(graceful: bool = True) -> None:
+    """Barrier (when graceful) then stop serving (reference rpc.shutdown)."""
+    if not _state:
+        return
+    try:
+        if graceful:
+            store = _state["store"]
+            world = _state["world_size"]
+            store.barrier("rpc_shutdown", world_size=world, timeout=120)
+            store.add("rpc/shutdown_acks", 1)
+            if _state["rank"] == 0 and world > 1:
+                # rank 0 hosts the store server: keep it alive until every
+                # rank's barrier reply has landed, else their waits race the
+                # teardown and spuriously time out
+                import time as _time
+                deadline = _time.time() + 120
+                while _time.time() < deadline:
+                    if store.add("rpc/shutdown_acks", 0) >= world:
+                        break
+                    _time.sleep(0.05)
+    finally:
+        _state["stop"].set()
+        try:
+            _state["server"].close()
+        except OSError:
+            pass
+        _state["pool"].shutdown(wait=False)
+        _state["store"].close()
+        _state.clear()

@@ -1,0 +1,112 @@
+"""group_sharded_parallel — the ZeRO stage-2/3 user API.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py:40
+(group_sharded_parallel(model, optimizer, level='os'|'os_g'|'p_g_os')) over
+GroupShardedOptimizerStage2 / GroupShardedStage2 / GroupShardedStage3
+(meta_parallel/sharding/ — param slicing, JIT allgather pre-hooks,
+reduce-scatter grad hooks; SURVEY.md A.3).
+
+TPU collapse: all three stages are GSPMD placements on the "fsdp" axis —
+ - 'os'     (stage 1): optimizer state sharded, params replicated
+ - 'os_g'   (stage 2): + gradients effectively sharded (XLA reduce-scatters
+            into the sharded accumulator)
+ - 'p_g_os' (stage 3): + parameters sharded; XLA inserts the same
+            just-in-time allgather/ reduce-scatter pairs the reference's
+            forward hooks implement by hand.
+No hooks, no slice buffers — only placements differ.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.mesh import current_mesh
+from ..parallel.api import shard_layer, shard_optimizer_state, param_spec_tree
+
+_LEVELS = ("os", "os_g", "p_g_os")
+
+
+def group_sharded_parallel(model, optimizer, level: str = "p_g_os",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = True, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False):
+    """Shard model/optimizer over the "fsdp" axis by ZeRO level.
+
+    Returns (model, optimizer, scaler) like the reference.
+    """
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
+    hm = current_mesh()
+    if hm is None:
+        raise RuntimeError("no active mesh — call fleet.init or enter a "
+                           "HybridMesh first")
+    if offload:
+        # reference: offload=True parks optimizer state on the CPU
+        # (group_sharded_storage.py); here: host (pinned_host) memory
+        # space between steps — honored by Optimizer.step and the Trainer
+        # (optimizer/optimizer.py place_opt_state). Set only after the
+        # mesh checks: a failed call must not leave the flag behind.
+        optimizer._offload_opt_state = True
+    if hm.axis_size("fsdp") <= 1:
+        # nothing to shard over; still place params on the mesh
+        shard_layer(model)
+        return model, optimizer, scaler
+
+    if level == "p_g_os":
+        # parameters sharded: honor each param's fsdp annotation, defaulting
+        # to sharding dim 0 over fsdp when un-annotated
+        for _, p in model.named_parameters():
+            if p.sharding is None or not any(
+                    s == "fsdp" or (isinstance(s, (list, tuple)) and
+                                    "fsdp" in s) for s in (p.sharding or ())):
+                base = list(p.sharding) if p.sharding else [None] * len(p.shape)
+                for d in range(len(base)):
+                    if base[d] is None and p.shape[d] % hm.axis_size("fsdp") == 0:
+                        base[d] = "fsdp"
+                        break
+                p.sharding = tuple(base)
+        shard_layer(model)
+    else:
+        # params replicated over fsdp (strip fsdp from annotations)
+        for _, p in model.named_parameters():
+            if p.sharding:
+                p.sharding = tuple(
+                    None if s == "fsdp" else
+                    (tuple(a for a in s if a != "fsdp") or None
+                     if isinstance(s, (list, tuple)) else s)
+                    for s in p.sharding)
+        shard_layer(model)
+
+    # optimizer state: sharded in ALL levels (that's stage 1's definition).
+    # state is created lazily by Optimizer; shard what exists now and tag the
+    # optimizer so trainers shard the rest on creation.
+    spec = param_spec_tree(model)
+    if level != "p_g_os":
+        # opt state shards over fsdp even though params don't: dim-0 shard
+        m = hm.mesh
+        fsdp_spec = {}
+        for name, p in model.named_parameters():
+            entries = [None] * len(p.shape)
+            for d in range(len(entries)):
+                if p.shape[d] % hm.axis_size("fsdp") == 0:
+                    entries[d] = "fsdp"
+                    break
+            fsdp_spec[name] = PartitionSpec(*entries)
+        spec = fsdp_spec
+    optimizer._group_sharded_spec = spec
+    if getattr(optimizer, "_state", None):
+        optimizer._state = shard_optimizer_state(optimizer._state, spec)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None) -> None:
+    """Reference: sharding/group_sharded.py save_group_sharded_model —
+    gathers shards and saves. GSPMD arrays are already global; plain save."""
+    from ..framework import save
+    save(model.state_dict(), output if output.endswith(".pdparams")
+         else output + ".pdparams")
+    if optimizer is not None and getattr(optimizer, "_state", None):
+        save(optimizer._state, output + ".pdopt")

@@ -1,0 +1,117 @@
+"""DistributedStrategy: the typed strategy-knob tree.
+
+Reference: paddle/fluid/framework/distributed_strategy.proto:359 — a
+242-field protobuf of every distributed-training knob — wrapped by
+python/paddle/distributed/fleet/base/distributed_strategy.py. Here the same
+shape as plain dataclasses (no protobuf: the config never crosses a C++
+boundary on TPU), scoped to the knobs that change behavior in this
+framework; unknown reference fields are accepted into ``extras`` so recipes
+port without edits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AmpConfig:
+    """Reference: strategy.amp / amp_configs (decorator.py)."""
+    enable: bool = False
+    dtype: str = "bfloat16"      # TPU default; "float16" honored with scaler
+    level: str = "O1"
+    init_loss_scaling: float = 65536.0
+    use_dynamic_loss_scaling: bool = True  # fp16 only; no-op for bf16
+    custom_white_list: tuple = ()
+    custom_black_list: tuple = ()
+
+
+@dataclass
+class RecomputeConfig:
+    """Reference: strategy.recompute / recompute_configs."""
+    enable: bool = False
+    checkpoints: tuple = ()      # layer names; empty = full
+    policy: str = "full"         # "full" | "dots_saveable" | "nothing_saveable"
+
+
+@dataclass
+class ShardingConfig:
+    """Reference: strategy.sharding / sharding_configs (ZeRO stages)."""
+    enable: bool = False
+    stage: int = 1               # 1: opt-state, 2: +grads, 3: +params
+    degree: int = 1
+    offload: bool = False        # opt-state to pinned_host (trainer/sharding)
+    comm_overlap: bool = False   # reduce-scatter overlaps backward compute
+                                 # (reference dygraph_sharding_optimizer:470;
+                                 # maps to XLA async collectives, overlap.py)
+
+
+@dataclass
+class PipelineConfig:
+    """Reference: strategy.pipeline / pipeline_configs."""
+    enable: bool = False
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # accepted; XLA schedule subsumes it
+
+
+@dataclass
+class TensorParallelConfig:
+    """Reference: strategy.tensor_parallel / tensor_parallel_configs."""
+    enable: bool = False
+    tensor_parallel_degree: int = 1
+    mp_async_allreduce: bool = False  # overlap TP bwd allreduce with dW
+                                      # matmul (reference mp_layers.py:458;
+                                      # maps to XLA async collectives)
+
+
+@dataclass
+class HybridConfig:
+    """Reference: strategy.hybrid_configs — axis degrees for fleet.init."""
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    ep_degree: int = 1
+
+
+@dataclass
+class DistributedStrategy:
+    amp: AmpConfig = field(default_factory=AmpConfig)
+    recompute: RecomputeConfig = field(default_factory=RecomputeConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    hybrid_configs: HybridConfig = field(default_factory=HybridConfig)
+    gradient_merge_micro_steps: int = 1
+    find_unused_parameters: bool = False   # accepted for parity; meaningless here
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    # The reference wrapper lets users assign dicts to sub-configs
+    # (strategy.hybrid_configs = {"dp_degree": 2, ...}); mirror that.
+    def __setattr__(self, name, value):
+        current = self.__dict__.get(name)
+        if isinstance(value, dict) and hasattr(current, "__dataclass_fields__"):
+            for k, v in value.items():
+                if k in current.__dataclass_fields__:
+                    setattr(current, k, v)
+                else:
+                    raise ValueError(f"{name} has no field {k!r}")
+            return
+        if name not in self.__dataclass_fields__ and name != "extras" and \
+                not name.startswith("_") and "extras" in self.__dict__:
+            self.extras[name] = value
+            return
+        object.__setattr__(self, name, value)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    def __repr__(self):
+        on = [n for n in ("amp", "recompute", "sharding", "pipeline",
+                          "tensor_parallel")
+              if getattr(getattr(self, n), "enable", False)]
+        return (f"DistributedStrategy(enabled={on}, "
+                f"hybrid={asdict(self.hybrid_configs)})")

@@ -1,0 +1,130 @@
+"""Hybrid-parallel topology facade.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology:61 (N-D rank coordinate math over axes
+["data","pipe","sharding","sep","model"]) and HybridCommunicateGroup:174
+(per-axis comm groups + rank queries). On TPU both are thin views over the
+one HybridMesh: coordinates are mesh indices, "comm groups" are axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import HybridMesh, current_mesh, AXES_ORDER
+from .communication import Group
+
+# reference axis name → mesh axis name
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "fsdp", "sep": "sep",
+               "model": "tp", "dp": "dp", "pp": "pp", "fsdp": "fsdp",
+               "tp": "tp", "mp": "tp"}
+
+
+class CommunicateTopology:
+    """Coordinate math over the hybrid axes (reference: topology.py:61)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
+                                                            "sharding", "sep",
+                                                            "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = int(np.prod(dims))
+        self._coord_of = {}
+        coords = np.indices(dims).reshape(len(dims), -1).T
+        for rank, c in enumerate(coords):
+            self._coord_of[rank] = tuple(int(v) for v in c)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **axis_coords) -> int:
+        coord = tuple(axis_coords[n] for n in self._parallel_names)
+        for rank, c in self._coord_of.items():
+            if c == coord:
+                return rank
+        raise ValueError(f"no rank at {axis_coords}")
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._coord_of[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate along ``axis_name`` equals index."""
+        ai = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coord_of.items() if c[ai] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along ``axis_name`` (all other
+        coords fixed) — the reference's per-axis comm group construction."""
+        ai = self._parallel_names.index(axis_name)
+        groups: Dict[Tuple, List[int]] = {}
+        for r, c in self._coord_of.items():
+            key = c[:ai] + c[ai + 1:]
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """Axis-size/rank queries shaped like the reference (topology.py:174),
+    backed by the active HybridMesh."""
+
+    def __init__(self, hybrid_mesh: Optional[HybridMesh] = None):
+        self._hm = hybrid_mesh
+
+    @property
+    def hm(self) -> HybridMesh:
+        hm = self._hm or current_mesh()
+        if hm is None:
+            raise RuntimeError("no active HybridMesh")
+        return hm
+
+    def topology(self) -> CommunicateTopology:
+        shape = dict(self.hm.mesh.shape)
+        names = ["data", "pipe", "sharding", "sep", "model"]
+        dims = [shape.get(_AXIS_ALIAS[n], 1) for n in names]
+        return CommunicateTopology(names, dims)
+
+    # degree queries (reference names)
+    def get_data_parallel_world_size(self) -> int:
+        return self.hm.get_data_parallel_world_size()
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.hm.get_model_parallel_world_size()
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.hm.get_pipe_parallel_world_size()
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.hm.get_sharding_parallel_world_size()
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self.hm.get_sep_parallel_world_size()
+
+    # group handles (axis-name Groups; the mesh is the communicator)
+    def get_data_parallel_group(self) -> Group:
+        return Group(("dp", "fsdp"), self.hm.mesh)
+
+    def get_model_parallel_group(self) -> Group:
+        return Group("tp", self.hm.mesh)
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group("pp", self.hm.mesh)
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group("fsdp", self.hm.mesh)
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group("sep", self.hm.mesh)
+
+    def get_check_parallel_group(self) -> Group:
+        return Group(tuple(self.hm.mesh.axis_names), self.hm.mesh)

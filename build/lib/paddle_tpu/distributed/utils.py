@@ -1,0 +1,58 @@
+"""paddle.distributed.utils parity (reference:
+python/paddle/distributed/utils/ — moe_utils.py global_scatter:20 /
+global_gather:153 and process helpers).
+
+TPU note on the MoE all-to-alls: the reference's global_scatter/gather
+move RAGGED per-(rank, expert) token buckets over NCCL. The TPU-native
+MoE path (parallel/moe.py) does not need them — sort-based dispatch emits
+dense [e, capacity, d] tensors whose all-to-alls GSPMD inserts at the ep
+sharding boundary — so these functions exist for recipe compatibility:
+exact for single-process groups (every expert is local: the data does not
+move), and multi-rank calls raise with the MoELayer migration pointer
+rather than pretending to ship ragged buffers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _world(group):
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        return int(group.nranks)
+    return 1
+
+
+def _check_counts(x, local_count, global_count):
+    lc = jnp.asarray(local_count)
+    gc = jnp.asarray(global_count)
+    if lc.shape != gc.shape:
+        raise ValueError(f"local_count {lc.shape} != global_count {gc.shape}")
+    return lc, gc
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Send per-expert token buckets to their owner ranks
+    (reference: moe_utils.py:20). Single-process: all experts are local
+    and local_count == global_count, so the buckets stay put — identity."""
+    lc, gc = _check_counts(x, local_count, global_count)
+    if _world(group) > 1:
+        raise NotImplementedError(
+            "multi-rank global_scatter: use parallel.moe.MoELayer — its "
+            "sort-based dense dispatch lets GSPMD emit the expert "
+            "all-to-alls (docs/DESIGN_DECISIONS.md MoE entry)")
+    return jnp.asarray(x)
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference: moe_utils.py:153)."""
+    lc, gc = _check_counts(x, local_count, global_count)
+    if _world(group) > 1:
+        raise NotImplementedError(
+            "multi-rank global_gather: use parallel.moe.MoELayer — its "
+            "sort-based dense dispatch lets GSPMD emit the expert "
+            "all-to-alls (docs/DESIGN_DECISIONS.md MoE entry)")
+    return jnp.asarray(x)

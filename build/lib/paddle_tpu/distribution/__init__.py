@@ -1,0 +1,952 @@
+"""paddle_tpu.distribution — probability distributions (reference:
+python/paddle/distribution/: Distribution base, Normal/Uniform/Bernoulli/
+Categorical/Beta/Dirichlet/Gumbel/Laplace/LogNormal/Multinomial/Exponential,
+kl_divergence registry, TransformedDistribution).
+
+TPU-native: sampling is explicit-PRNG (jax.random) — ``sample`` draws a key
+from the framework's seeded RNG stream when none is given, keeping the
+imperative reference API while staying reproducible under jit when a key is
+passed. Math uses jax.scipy; everything is jit/vmap-compatible.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng as _rng
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "Poisson", "StudentT",
+    "kl_divergence", "register_kl",
+]
+
+
+def _next_key(seed: Optional[jax.Array] = None):
+    if seed is not None:
+        return seed
+    return _rng.next_key()
+
+
+class Distribution:
+    """Base class (reference: distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=(), key=None):
+        raise NotImplementedError
+
+    def rsample(self, shape=(), key=None):
+        """Reparameterized sample; default falls back to sample where the
+        pathwise gradient exists naturally (location-scale families)."""
+        return self.sample(shape, key=key)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, dtype=jnp.result_type(float))
+        self.scale = jnp.asarray(scale, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    @property
+    def stddev(self):
+        return jnp.broadcast_to(self.scale, self.batch_shape)
+
+    def sample(self, shape=(), key=None):
+        eps = jax.random.normal(_next_key(key), self._extend(shape))
+        return self.loc + self.scale * eps
+
+    rsample = sample
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+    def icdf(self, q):
+        return self.loc + self.scale * math.sqrt(2) * jax.scipy.special.erfinv(
+            2 * q - 1)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, dtype=jnp.result_type(float))
+        self.high = jnp.asarray(high, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def sample(self, shape=(), key=None):
+        u = jax.random.uniform(_next_key(key), self._extend(shape))
+        return self.low + (self.high - self.low) * u
+
+    rsample = sample
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = jnp.asarray(probs, dtype=jnp.result_type(float))
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = jnp.asarray(logits, dtype=jnp.result_type(float))
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.bernoulli(_next_key(key), self.probs,
+                                    self._extend(shape)).astype(jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return v * jax.nn.log_sigmoid(self.logits) + \
+            (1 - v) * jax.nn.log_sigmoid(-self.logits)
+
+    def entropy(self):
+        p = self.probs
+        return -(p * jnp.log(jnp.clip(p, 1e-12)) +
+                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = jnp.asarray(logits, dtype=jnp.result_type(float))
+        else:
+            self.logits = jnp.log(jnp.clip(
+                jnp.asarray(probs, dtype=jnp.result_type(float)), 1e-38))
+        self._log_norm = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return jnp.exp(self._log_norm)
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no scalar mean")
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(_next_key(key), self.logits,
+                                      shape=tuple(shape) + self.batch_shape)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, dtype=jnp.int32)
+        return jnp.take_along_axis(self._log_norm, value[..., None],
+                                   axis=-1).squeeze(-1)
+
+    def entropy(self):
+        p = jnp.exp(self._log_norm)
+        return -jnp.sum(p * self._log_norm, axis=-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, dtype=jnp.result_type(float))
+        self.beta = jnp.asarray(beta, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+    def sample(self, shape=(), key=None):
+        return jax.random.beta(_next_key(key), self.alpha, self.beta,
+                               self._extend(shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = jnp.asarray(value)
+        return ((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration,
+                                         dtype=jnp.result_type(float))
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(-1, keepdims=True)
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(-1, keepdims=True)
+        m = self.concentration / a0
+        return m * (1 - m) / (a0 + 1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.dirichlet(_next_key(key), self.concentration,
+                                    tuple(shape) + self.batch_shape)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        return (jnp.sum((a - 1) * jnp.log(value), -1)
+                + gammaln(a.sum(-1)) - jnp.sum(gammaln(a), -1))
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        return (jnp.sum(gammaln(a), -1) - gammaln(a0)
+                + (a0 - k) * digamma(a0) - jnp.sum((a - 1) * digamma(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, dtype=jnp.result_type(float))
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / self.rate ** 2
+
+    def sample(self, shape=(), key=None):
+        return jax.random.exponential(_next_key(key),
+                                      self._extend(shape)) / self.rate
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return jnp.log(self.rate) - self.rate * value
+
+    def entropy(self):
+        return jnp.broadcast_to(1.0 - jnp.log(self.rate), self.batch_shape)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(concentration,
+                                         dtype=jnp.result_type(float))
+        self.rate = jnp.asarray(rate, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+    def sample(self, shape=(), key=None):
+        return jax.random.gamma(_next_key(key), self.concentration,
+                                self._extend(shape)) / self.rate
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a, r = self.concentration, self.rate
+        return (a * jnp.log(r) + (a - 1) * jnp.log(value) - r * value
+                - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        a = self.concentration
+        return (a - jnp.log(self.rate) + gammaln(a) + (1 - a) * digamma(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,...} (reference: distribution/geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, dtype=jnp.result_type(float))
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+    def sample(self, shape=(), key=None):
+        u = jax.random.uniform(_next_key(key), self._extend(shape),
+                               minval=1e-12)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        q = 1 - p
+        return -(q * jnp.log(jnp.clip(q, 1e-12)) +
+                 p * jnp.log(jnp.clip(p, 1e-12))) / p
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, dtype=jnp.result_type(float))
+        self.scale = jnp.asarray(scale, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def sample(self, shape=(), key=None):
+        return self.loc + self.scale * jax.random.gumbel(
+            _next_key(key), self._extend(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1.5772156649015329,
+                                self.batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, dtype=jnp.result_type(float))
+        self.scale = jnp.asarray(scale, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+    def sample(self, shape=(), key=None):
+        return self.loc + self.scale * jax.random.laplace(
+            _next_key(key), self._extend(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return -jnp.abs(value - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, dtype=jnp.result_type(float))
+        self.scale = jnp.asarray(scale, dtype=jnp.result_type(float))
+        self._normal = Normal(self.loc, self.scale)
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        return (jnp.exp(self.scale ** 2) - 1) * jnp.exp(
+            2 * self.loc + self.scale ** 2)
+
+    def sample(self, shape=(), key=None):
+        return jnp.exp(self._normal.sample(shape, key=key))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return self._normal.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self._normal.entropy() + self.loc
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, dtype=jnp.result_type(float))
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        k = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            _next_key(key), jnp.log(jnp.clip(self.probs, 1e-38)),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        return jax.nn.one_hot(draws, k).sum(0)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = jnp.asarray(value)
+        return (gammaln(self.total_count + 1.0) - jnp.sum(gammaln(v + 1.0), -1)
+                + jnp.sum(v * jnp.log(jnp.clip(self.probs, 1e-38)), -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, dtype=jnp.result_type(float))
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=(), key=None):
+        return jax.random.poisson(_next_key(key), self.rate,
+                                  self._extend(shape)).astype(jnp.float32)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return value * jnp.log(self.rate) - self.rate - gammaln(value + 1.0)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = jnp.asarray(df, dtype=jnp.result_type(float))
+        self.loc = jnp.asarray(loc, dtype=jnp.result_type(float))
+        self.scale = jnp.asarray(scale, dtype=jnp.result_type(float))
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        return jnp.where(self.df > 2, self.scale ** 2 * self.df / (self.df - 2),
+                         jnp.nan)
+
+    def sample(self, shape=(), key=None):
+        return self.loc + self.scale * jax.random.t(
+            _next_key(key), self.df, self._extend(shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        d = self.df
+        z = (value - self.loc) / self.scale
+        return (gammaln((d + 1) / 2) - gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+
+# ---------------------------------------------------------------------------
+# KL registry (reference: python/paddle/distribution/kl.py register_kl)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    a = p.probs * (jnp.log(jnp.clip(p.probs, 1e-12)) -
+                   jnp.log(jnp.clip(q.probs, 1e-12)))
+    b = (1 - p.probs) * (jnp.log(jnp.clip(1 - p.probs, 1e-12)) -
+                         jnp.log(jnp.clip(1 - q.probs, 1e-12)))
+    return a + b
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp = jnp.exp(p._log_norm)
+    return jnp.sum(pp * (p._log_norm - q._log_norm), -1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return jnp.log(p.rate / q.rate) + ratio - 1
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc) / q.scale
+    return (-jnp.log(scale_ratio) + scale_ratio *
+            jnp.exp(-loc_abs / scale_ratio) + loc_abs - 1)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    from jax.scipy.special import gammaln, digamma
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1, keepdims=True)
+    return (gammaln(a0.squeeze(-1)) - jnp.sum(gammaln(a), -1)
+            - gammaln(b.sum(-1)) + jnp.sum(gammaln(b), -1)
+            + jnp.sum((a - b) * (digamma(a) - digamma(a0)), -1))
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity batch (reference: python/paddle/distribution/{binomial.py,
+# cauchy.py,continuous_bernoulli.py,exponential_family.py,independent.py,
+# multivariate_normal.py,transformed_distribution.py,transform.py})
+# ---------------------------------------------------------------------------
+
+class ExponentialFamily(Distribution):
+    """Base for natural-parameter families (reference:
+    distribution/exponential_family.py): entropy via the Bregman identity
+    when _log_normalizer is differentiable."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+        lg, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nat))
+        ent = lg - sum(jnp.sum(n * g) for n, g in zip(nat, grads))
+        return ent + self._mean_carrier_measure
+
+
+class Binomial(Distribution):
+    """reference: distribution/binomial.py Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count)
+        self.probs = jnp.asarray(probs)
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            self.total_count.shape, self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), key=None):
+        n = jnp.broadcast_to(self.total_count, self._extend(shape))
+        p = jnp.broadcast_to(self.probs, self._extend(shape))
+        return jax.random.binomial(_next_key(key), n.astype(jnp.float32),
+                                   p).astype(jnp.int64)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        n = self.total_count.astype(jnp.float32)
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        eps = 1e-12
+        return (logc + v * jnp.log(self.probs + eps)
+                + (n - v) * jnp.log1p(-self.probs + eps))
+
+    def entropy(self):
+        # sum over the support (reference computes the full enumeration)
+        n_max = int(np.max(np.asarray(self.total_count)))
+        k = jnp.arange(n_max + 1, dtype=jnp.float32)
+        shape = (n_max + 1,) + (1,) * len(self._batch_shape)
+        lp = self.log_prob(k.reshape(shape))
+        mask = k.reshape(shape) <= self.total_count
+        return -jnp.sum(jnp.where(mask, jnp.exp(lp) * lp, 0.0), axis=0)
+
+
+class Cauchy(Distribution):
+    """reference: distribution/cauchy.py Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc)
+        self.scale = jnp.asarray(scale)
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), key=None):
+        z = jax.random.cauchy(_next_key(key), self._extend(shape))
+        return self.loc + self.scale * z
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (jnp.asarray(value) - self.loc) / self.scale
+        return (-jnp.log(jnp.pi) - jnp.log(self.scale)
+                - jnp.log1p(jnp.square(z)))
+
+    def cdf(self, value):
+        z = (jnp.asarray(value) - self.loc) / self.scale
+        return jnp.arctan(z) / jnp.pi + 0.5
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * jnp.pi * self.scale),
+                                self._batch_shape)
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: distribution/continuous_bernoulli.py — density
+    C(p) p^x (1-p)^(1-x) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(probs)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _outside_unstable(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _log_norm_const(self):
+        # C(p) = 2 atanh(1-2p) / (1-2p) for p != 0.5, else 2
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.4)
+        x = 1.0 - 2.0 * safe
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0
+                                 * jnp.square(p - 0.5)) * jnp.square(p - 0.5)
+        exact = jnp.log(2.0 * jnp.arctanh(x) / x)
+        return jnp.where(self._outside_unstable(), exact, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.4)
+        exact = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        taylor = 0.5 + (p - 0.5) / 3.0
+        return jnp.where(self._outside_unstable(), exact, taylor)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        eps = 1e-12
+        return (self._log_norm_const() + v * jnp.log(self.probs + eps)
+                + (1 - v) * jnp.log1p(-self.probs + eps))
+
+    def sample(self, shape=(), key=None):
+        # inverse-CDF of the continuous Bernoulli
+        u = jax.random.uniform(_next_key(key), self._extend(shape))
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.4)
+        num = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+               )
+        den = jnp.log(safe) - jnp.log1p(-safe)
+        icdf = num / den
+        return jnp.where(self._outside_unstable(),
+                         jnp.clip(icdf, 0.0, 1.0), u)
+
+    rsample = sample
+
+    def entropy(self):
+        # -E[log p(X)] with E[X] = self.mean (log p is linear in x)
+        return -(self._log_norm_const()
+                 + self.mean * jnp.log(self.probs + 1e-12)
+                 + (1 - self.mean) * jnp.log1p(-self.probs + 1e-12))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference:
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        self.base = base
+        self._rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(batch_shape=bs[:len(bs) - self._rank],
+                         event_shape=bs[len(bs) - self._rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key=key)
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key=key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self._rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return jnp.sum(ent, axis=tuple(range(-self._rank, 0)))
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py — parameterized by
+    loc + one of covariance/precision/scale_tril; Cholesky-based sampling
+    and log_prob (MXU-friendly triangular solves)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = jnp.asarray(loc)
+        if scale_tril is not None:
+            self._chol = jnp.asarray(scale_tril)
+        elif covariance_matrix is not None:
+            self._chol = jnp.linalg.cholesky(jnp.asarray(covariance_matrix))
+        elif precision_matrix is not None:
+            prec = jnp.asarray(precision_matrix)
+            self._chol = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("provide covariance_matrix, precision_matrix "
+                             "or scale_tril")
+        d = self.loc.shape[-1]
+        super().__init__(batch_shape=jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._chol.shape[:-2]),
+            event_shape=(d,))
+
+    @property
+    def covariance_matrix(self):
+        return self._chol @ jnp.swapaxes(self._chol, -1, -2)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return jnp.sum(jnp.square(self._chol), axis=-1)
+
+    def sample(self, shape=(), key=None):
+        z = jax.random.normal(_next_key(key), self._extend(shape))
+        return self.loc + jnp.einsum("...ij,...j->...i", self._chol, z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        diff = jnp.asarray(value) - self.loc
+        y = jax.scipy.linalg.solve_triangular(self._chol, diff[..., None],
+                                              lower=True)[..., 0]
+        d = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._chol, axis1=-2,
+                                                   axis2=-1)), axis=-1)
+        return (-0.5 * jnp.sum(jnp.square(y), axis=-1)
+                - half_logdet - 0.5 * d * jnp.log(2 * jnp.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self._chol, axis1=-2,
+                                                   axis2=-1)), axis=-1)
+        return 0.5 * d * (1 + jnp.log(2 * jnp.pi)) + half_logdet
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through invertible transforms (reference:
+    distribution/transformed_distribution.py). ``transforms`` expose
+    forward / inverse / forward_log_det_jacobian like the reference's
+    Transform API."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=(), key=None):
+        x = self.base.sample(shape, key=key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=(), key=None):
+        x = self.base.rsample(shape, key=key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = jnp.asarray(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return lp + self.base.log_prob(y)
+
+
+class Transform:
+    """Invertible map base (reference: distribution/transform.py)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc)
+        self.scale = jnp.asarray(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.asarray(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+import numpy as np  # noqa: E402 (Binomial.entropy host-side support bound)
+
+__all__ += ["ExponentialFamily", "Binomial", "Cauchy",
+            "ContinuousBernoulli", "Independent", "MultivariateNormal",
+            "TransformedDistribution", "Transform", "AffineTransform",
+            "ExpTransform", "SigmoidTransform"]

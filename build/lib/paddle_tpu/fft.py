@@ -1,0 +1,126 @@
+"""paddle_tpu.fft — spectral ops (reference: python/paddle/fft.py).
+
+Thin, signature-compatible layer over jnp.fft: XLA lowers FFTs natively on
+TPU. Norm-mode semantics ("backward"/"ortho"/"forward") and the paddle
+argument order (x, n, axis, norm) are preserved.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"norm must be backward|ortho|forward, got {norm!r}")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.fft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ifft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.rfft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.irfft2(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.hfft(jnp.fft.ifft(x, axis=axes[0], norm=_norm(norm)),
+                        n=(s[-1] if s else None), axis=axes[1], norm=_norm(norm))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return jnp.fft.ihfft(jnp.fft.fft(x, axis=axes[0], norm=_norm(norm)),
+                         n=(s[-1] if s else None), axis=axes[1], norm=_norm(norm))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    # paddle defines hfftn over the last axis after inverse over the rest
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    pre, last = axes[:-1], axes[-1]
+    y = jnp.fft.ifftn(x, axes=pre, norm=_norm(norm)) if pre else x
+    return jnp.fft.hfft(y, n=(s[-1] if s else None), axis=last, norm=_norm(norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    pre, last = axes[:-1], axes[-1]
+    y = jnp.fft.fftn(x, axes=pre, norm=_norm(norm)) if pre else x
+    return jnp.fft.ihfft(y, n=(s[-1] if s else None), axis=last, norm=_norm(norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fftshift(x, axes=None, name=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return jnp.fft.ifftshift(x, axes=axes)

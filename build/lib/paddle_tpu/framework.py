@@ -1,0 +1,85 @@
+"""Framework-level utilities: device control, save/load, jit.
+
+Reference: python/paddle/device/ (set_device), python/paddle/framework/io.py
+(save:721, load:960), python/paddle/jit/api.py (to_static:171).
+
+``jit.to_static`` maps onto jax.jit: the reference's SOT/AST graph capture is
+replaced by JAX tracing (every op here is already trace-friendly), so the
+decorator only manages static args and an optional AOT-lowered export.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import no_grad  # re-export
+
+
+_CURRENT_DEVICE = None
+
+
+def set_device(device: str):
+    """'tpu' | 'cpu' | 'tpu:N' (mirrors paddle.set_device)."""
+    global _CURRENT_DEVICE
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platform = {"gpu": "gpu", "tpu": "tpu", "cpu": "cpu", "xpu": "tpu"}.get(name)
+    if platform is None:
+        raise ValueError(f"unknown device {device}")
+    devs = jax.devices(platform)
+    _CURRENT_DEVICE = devs[idx]
+    jax.config.update("jax_default_device", _CURRENT_DEVICE)
+    return _CURRENT_DEVICE
+
+
+def get_device() -> str:
+    d = _CURRENT_DEVICE or jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    from .ops.registry import device_is_tpu
+    return any(device_is_tpu(d) for d in jax.devices())
+
+
+# -- save / load (reference: python/paddle/framework/io.py:721,960) ----------
+
+def _to_numpy_tree(obj):
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, obj)
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    """Pickle-based save of (nested) state dicts; jax Arrays stored as numpy.
+    The orbax-backed sharded checkpoint lives in paddle_tpu.checkpoint."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return jax.tree.map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, obj)
+
+
+# jit lives in paddle_tpu/jit/ (to_static + StableHLO export save/load)

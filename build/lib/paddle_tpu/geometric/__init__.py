@@ -1,0 +1,192 @@
+"""paddle_tpu.geometric — graph-NN message passing (reference:
+python/paddle/geometric/: message_passing/send_recv.py send_u_recv /
+send_ue_recv, math.py segment_sum/mean/max/min, sampling/neighbors.py).
+
+TPU-native: segment ops map to jax.ops.segment_* (XLA scatter-reduce);
+gather/scatter message passing is dense-indexable so it jits and shards.
+Neighbor sampling is host-side (data-dependent shapes don't belong in jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "sample_neighbors"]
+
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(data, segment_ids, num_segments=n)
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None,
+                 name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                              segment_ids, num_segments=n)
+    return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_max(data, segment_ids, num_segments=n)
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None,
+                name=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_min(data, segment_ids, num_segments=n)
+
+
+_REDUCERS = {"sum": segment_sum, "add": segment_sum, "mean": segment_mean,
+             "max": segment_max, "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather x at src, reduce onto dst (reference:
+    message_passing/send_recv.py send_u_recv)."""
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    msgs = x[src_index]
+    return fn(msgs, dst_index, num_segments=out_size or x.shape[0])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Node⊕edge message then reduce (reference send_ue_recv):
+    message = x[src] (+|*|-|/) y[edge]."""
+    msgs = x[src_index]
+    ops = {"add": jnp.add, "mul": jnp.multiply, "sub": jnp.subtract,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op must be one of {sorted(ops)}")
+    msgs = ops[message_op](msgs, y)
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return fn(msgs, dst_index, num_segments=out_size or x.shape[0])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     seed: Optional[int] = None):
+    """Uniform neighbor sampling over CSC graph storage (reference:
+    geometric/sampling/neighbors.py). Host-side numpy — output shapes are
+    data-dependent. Returns (edge_src, edge_dst, sample_index)."""
+    rs = np.random.RandomState(seed)
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    srcs, dsts = [], []
+    for node in np.asarray(input_nodes):
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        neigh = row[beg:end]
+        if sample_size >= 0 and len(neigh) > sample_size:
+            neigh = rs.choice(neigh, size=sample_size, replace=False)
+        srcs.extend(int(v) for v in neigh)
+        dsts.extend([int(node)] * len(neigh))
+    uniq = np.unique(np.concatenate([np.asarray(input_nodes),
+                                     np.asarray(srcs, np.int64)])
+                     if srcs else np.asarray(input_nodes))
+    return (np.asarray(srcs, np.int64), np.asarray(dsts, np.int64), uniq)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference:
+    geometric/reindex.py reindex_graph): x = center nodes, neighbors =
+    concatenated neighbor lists, count = per-center neighbor counts.
+    Returns (reindexed_src, reindexed_dst, out_nodes). Host-side numpy —
+    output size is data-dependent (the reference's CPU path likewise)."""
+    x = np.asarray(x)
+    neighbors = np.asarray(neighbors)
+    count = np.asarray(count)
+    # local id order: center nodes first, then first-seen unique neighbors
+    seen = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(map(int, x))
+    for v in neighbors:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([seen[int(v)] for v in neighbors], np.int64)
+    dst = np.repeat(np.arange(len(x), dtype=np.int64), count)
+    return reindex_src, dst, np.asarray(out_nodes, np.int64)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count lists share one
+    id space (reference: geometric/reindex.py reindex_heter_graph)."""
+    x = np.asarray(x)
+    neigh_cat = np.concatenate([np.asarray(n) for n in neighbors])
+    count_cat = np.concatenate([np.asarray(c) for c in count])
+    seen = {int(v): i for i, v in enumerate(x)}
+    out_nodes = list(map(int, x))
+    for v in neigh_cat:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([seen[int(v)] for v in neigh_cat], np.int64)
+    dsts = []
+    for c in count:
+        dsts.append(np.repeat(np.arange(len(x), dtype=np.int64),
+                              np.asarray(c)))
+    dst = np.concatenate(dsts)
+    return reindex_src, dst, np.asarray(out_nodes, np.int64)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from BOTH endpoints (reference:
+    geometric/message_passing/send_recv.py send_uv):
+    out[e] = x[src[e]] op y[dst[e]] — no reduction."""
+    ops = {"add": jnp.add, "mul": jnp.multiply, "sub": jnp.subtract,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op must be one of {sorted(ops)}")
+    return ops[message_op](jnp.asarray(x)[jnp.asarray(src_index)],
+                           jnp.asarray(y)[jnp.asarray(dst_index)])
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, return_eids: bool = False,
+                              seed: Optional[int] = None, name=None):
+    """Weight-proportional neighbor sampling without replacement
+    (reference: geometric/sampling/neighbors.py weighted_sample_neighbors).
+    Host-side numpy like sample_neighbors."""
+    rs = np.random.RandomState(seed)
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    w = np.asarray(edge_weight, np.float64)
+    srcs, dsts, eids = [], [], []
+    for node in np.asarray(input_nodes):
+        beg, end = int(colptr[node]), int(colptr[node + 1])
+        neigh = row[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size >= 0 and len(neigh) > sample_size:
+            p = w[beg:end]
+            p = p / p.sum()
+            pick = rs.choice(len(neigh), size=sample_size, replace=False,
+                             p=p)
+            neigh, ids = neigh[pick], ids[pick]
+        srcs.extend(int(v) for v in neigh)
+        dsts.extend([int(node)] * len(neigh))
+        eids.extend(int(e) for e in ids)
+    out = (np.asarray(srcs, np.int64), np.asarray(dsts, np.int64))
+    if return_eids:
+        return out + (np.asarray(eids, np.int64),)
+    return out
+
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "send_uv",
+            "weighted_sample_neighbors"]

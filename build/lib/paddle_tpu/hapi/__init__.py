@@ -1,0 +1,16 @@
+"""paddle_tpu.hapi — high-level Model API (fit/evaluate/predict).
+
+Reference: python/paddle/hapi/model.py (Model:1054, fit:1756) + callbacks
+(python/paddle/hapi/callbacks.py). The training step is one jitted
+functional update (params/opt-state pytrees, loss from the Layer functional
+bridge); callbacks and metrics run host-side between steps.
+"""
+
+from .model import Model
+from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+                        LRSchedulerCallback, History)
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "History"]
+
+from .summary import summary  # noqa: E402

@@ -1,0 +1,313 @@
+"""Callbacks for hapi.Model.fit (reference: python/paddle/hapi/callbacks.py:
+Callback protocol, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+LRScheduler)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "History"]
+
+
+class Callback:
+    """Hook points mirror the reference's Callback."""
+
+    def __init__(self):
+        self.model = None
+        self.params: Dict = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params: Dict):
+        self.params = params
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback], model=None, params=None):
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            if model is not None:
+                cb.set_model(model)
+            if params is not None:
+                cb.set_params(params)
+
+    def _call(self, name, *args, **kwargs):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: self._call(name, *a, **k)
+        raise AttributeError(name)
+
+
+class History(Callback):
+    """Records logs per epoch (implicit callback, like keras/hapi)."""
+
+    def on_train_begin(self, logs=None):
+        self.history: Dict[str, List] = {}
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ProgBarLogger(Callback):
+    """Prints step/epoch progress with loss, metrics, and ips
+    (reference: ProgBarLogger; ips reporting from profiler/timer.py)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.perf_counter()
+        self._samples = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._samples += logs.get("batch_size", 0)
+        if self.verbose and step % self.log_freq == 0:
+            dt = time.perf_counter() - self._t0
+            ips = self._samples / dt if dt > 0 else 0.0
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in logs.items()
+                               if isinstance(v, (int, float)) and k != "batch_size")
+            print(f"Epoch {self._epoch} step {step}: {items} - {ips:.1f} samples/s",
+                  file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, (int, float)))
+            print(f"Epoch {epoch} done: {items}", file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save of model+optimizer (reference: ModelCheckpoint)."""
+
+    def __init__(self, save_dir: str, save_freq: int = 1):
+        super().__init__()
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference: EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0,
+                 baseline: Optional[float] = None, save_best_model: bool = False,
+                 save_dir: Optional[str] = None):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = self.baseline if self.baseline is not None else (
+            float("inf") if self.mode == "min" else -float("inf"))
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            import warnings
+            warnings.warn(
+                f"EarlyStopping monitor '{self.monitor}' not found in logs "
+                f"(available: {sorted((logs or {}).keys())}); doing nothing",
+                stacklevel=2)
+            return
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.model is not None:
+                self.model.save(os.path.join(self.save_dir or ".", "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                if self.model is not None:
+                    self.model.stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    """Steps the optimizer's LR scheduler per epoch or per batch
+    (reference: callbacks.LRScheduler)."""
+
+    def __init__(self, by_step: bool = False):
+        super().__init__()
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "lr_scheduler", None) if opt else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a metric stops improving (reference:
+    python/paddle/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor: str = "loss", factor: float = 0.1,
+                 patience: int = 10, verbose: int = 1, mode: str = "auto",
+                 min_delta: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._mode = ("min" if mode == "auto" and "acc" not in monitor
+                      else ("max" if mode == "auto" else mode))
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self._mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cool > 0:
+            self._cool -= 1
+            self._wait = 0
+        if self._better(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"Epoch {epoch}: reducing learning rate "
+                              f"from {old:.6g} to {new:.6g}.")
+            self._cool = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logger with the VisualDL callback surface (reference:
+    python/paddle/callbacks.py VisualDL). The visualdl package is not in
+    this image; scalars append to a JSONL the trace viewer and tests can
+    read (documented substitution)."""
+
+    def __init__(self, log_dir: str = "./log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": tag, "value": float(value),
+                                "step": int(step)}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}",
+                            v[0] if isinstance(v, (list, tuple)) else v,
+                            self._step)
+            except (TypeError, ValueError):
+                pass
+        self._step += 1
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"eval/{k}",
+                            v[0] if isinstance(v, (list, tuple)) else v,
+                            self._step)
+            except (TypeError, ValueError):
+                pass
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference: python/paddle/callbacks.py
+    WandbCallback). wandb is not installed in this offline image; if
+    import fails the callback degrades to the VisualDL JSONL sink."""
+
+    def __init__(self, project=None, name=None, dir=None, mode="offline",
+                 **kwargs):
+        try:
+            import wandb  # noqa: F401
+            self._wandb = wandb
+            self._run = wandb.init(project=project, name=name, dir=dir,
+                                   mode=mode, **kwargs)
+        except ImportError:
+            self._wandb = None
+            self._sink = VisualDL(log_dir=dir or "./wandb-offline")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb is not None:
+            self._run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self._sink.on_train_batch_end(step, logs)
+
+    def on_eval_end(self, logs=None):
+        if self._wandb is not None:
+            self._run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self._sink.on_eval_end(logs)

@@ -1,0 +1,270 @@
+"""hapi.Model — train/eval/predict driver over a Layer.
+
+Reference: python/paddle/hapi/model.py (Model:1054, .prepare, .fit:1756,
+.evaluate, .predict, .save/.load, .train_batch/.eval_batch). TPU-native
+core: one jitted functional train step (params + opt slots as donated
+pytrees), host-side metrics/callbacks between steps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.dataloader import DataLoader
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+from .callbacks import CallbackList, History, LRSchedulerCallback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _split_batch(batch, n_labels: int):
+    """Split a collated batch into (inputs, labels); batch may be a single
+    array, tuple/list, or dict with 'label'-suffixed keys."""
+    if isinstance(batch, dict):
+        labels = tuple(v for k, v in batch.items() if "label" in k)
+        inputs = tuple(v for k, v in batch.items() if "label" not in k)
+        return inputs, labels
+    if not isinstance(batch, (tuple, list)):
+        return (batch,), ()
+    batch = tuple(batch)
+    if n_labels == 0:
+        return batch, ()
+    return batch[:-n_labels], batch[-n_labels:]
+
+
+class Model:
+    """``Model(net).prepare(opt, loss, metrics); model.fit(data)``."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._input_specs = inputs
+        self._label_specs = labels
+        self._n_labels = len(labels) if labels is not None else 1
+        self._optimizer: Optional[Optimizer] = None
+        self._loss: Optional[Callable] = None
+        self._metrics: List = []
+        self.stop_training = False
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self._params = None
+        self._named = {}
+        self._opt_state = None
+        self._step = 0
+
+    # -- configuration -----------------------------------------------------
+
+    def prepare(self, optimizer: Optional[Optimizer] = None,
+                loss: Optional[Callable] = None,
+                metrics: Optional[Sequence] = None):
+        self._optimizer = optimizer
+        self._loss = loss
+        # reference accepts a single Metric or a list (hapi/model.py:1556)
+        if metrics is None:
+            metrics = []
+        elif not isinstance(metrics, (list, tuple)):
+            metrics = [metrics]
+        self._metrics = list(metrics)
+        self._params = self.network.raw_parameters()
+        self._named = dict(self.network.named_parameters())
+        if optimizer is not None:
+            self._opt_state = optimizer.init_state(self._params)
+        # new optimizer/loss closures: drop any previously-jitted steps
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+        return self
+
+    # -- jitted steps ------------------------------------------------------
+
+    def _build_steps(self):
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+
+        def forward(params, inputs):
+            return net.functional_call(params, *inputs)
+
+        def train_step(params, opt_state, inputs, labels, lr):
+            def objective(p):
+                out = forward(p, inputs)
+                preds = out if isinstance(out, tuple) else (out,)
+                return loss_fn(*preds, *labels)
+            loss, grads = jax.value_and_grad(objective)(params)
+            new_params, new_opt = opt.apply_gradients(params, grads,
+                                                      opt_state, lr=lr)
+            return new_params, new_opt, loss
+
+        def eval_step(params, inputs, labels):
+            out = forward(params, inputs)
+            preds = out if isinstance(out, tuple) else (out,)
+            loss = loss_fn(*preds, *labels) if loss_fn is not None else jnp.zeros(())
+            return loss, preds
+
+        self._train_fn = jax.jit(train_step, donate_argnums=(0, 1))
+        self._eval_fn = jax.jit(eval_step)
+        self._pred_fn = jax.jit(forward)
+
+    # -- batch-level API (reference: train_batch/eval_batch/predict_batch) --
+
+    def train_batch(self, inputs, labels=None):
+        if self._train_fn is None:
+            self._build_steps()
+        inputs = tuple(jnp.asarray(x) for x in
+                       (inputs if isinstance(inputs, (tuple, list)) else [inputs]))
+        labels = tuple(jnp.asarray(y) for y in
+                       (labels if isinstance(labels, (tuple, list)) else
+                        ([labels] if labels is not None else [])))
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        self._params, self._opt_state, loss = self._train_fn(
+            self._params, self._opt_state, inputs, labels, lr)
+        self._step += 1
+        self._sync_network()
+        return float(loss)
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_fn is None:
+            self._build_steps()
+        inputs = tuple(jnp.asarray(x) for x in
+                       (inputs if isinstance(inputs, (tuple, list)) else [inputs]))
+        labels = tuple(jnp.asarray(y) for y in
+                       (labels if isinstance(labels, (tuple, list)) else
+                        ([labels] if labels is not None else [])))
+        loss, preds = self._eval_fn(self._params, inputs, labels)
+        return float(loss), preds
+
+    def predict_batch(self, inputs):
+        if self._pred_fn is None:
+            self._build_steps()
+        inputs = tuple(jnp.asarray(x) for x in
+                       (inputs if isinstance(inputs, (tuple, list)) else [inputs]))
+        return self._pred_fn(self._params, inputs)
+
+    def _sync_network(self):
+        for k, v in self._params.items():
+            self._named[k].value = v
+
+    # -- loops -------------------------------------------------------------
+
+    def _as_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            callbacks: Optional[Sequence] = None, verbose: int = 1,
+            shuffle: bool = True):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit"
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        if epochs > 1 and iter(loader) is loader:
+            raise ValueError(
+                "train_data is a one-shot iterator but epochs > 1; pass a "
+                "Dataset/DataLoader (re-iterable) for multi-epoch fit")
+        history = History()
+        cbs = list(callbacks or [])
+        if not any(isinstance(cb, LRSchedulerCallback) for cb in cbs):
+            # reference behavior: hapi installs a per-epoch LRScheduler
+            # callback by default (hapi/callbacks.py config_callbacks)
+            cbs.append(LRSchedulerCallback(by_step=False))
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        cbs.append(history)
+        cbl = CallbackList(cbs, model=self,
+                           params={"epochs": epochs, "verbose": verbose})
+        self.stop_training = False
+        cbl.on_train_begin()
+        for epoch in range(epochs):
+            cbl.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(loader):
+                cbl.on_train_batch_begin(step)
+                inputs, labels = _split_batch(batch, self._n_labels)
+                loss = self.train_batch(inputs, labels)
+                losses.append(loss)
+                bs = int(np.shape(inputs[0])[0]) if inputs else 0
+                cbl.on_train_batch_end(step, {"loss": loss, "batch_size": bs})
+                if self.stop_training:
+                    break
+            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbl.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbl.on_train_end()
+        return history.history
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 0,
+                 callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = _split_batch(batch, self._n_labels)
+            loss, preds = self.eval_batch(inputs, labels)
+            losses.append(loss)
+            for m in self._metrics:
+                if not labels:
+                    continue
+                args = m.compute(preds[0], labels[0])
+                m.update(*args) if isinstance(args, tuple) else m.update(args)
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1):
+        loader = self._as_loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            # labeled datasets: drop trailing labels (the reference's predict
+            # honors only the declared inputs); unlabeled: take all
+            n = (self._n_labels if isinstance(batch, (tuple, list))
+                 and len(batch) > self._n_labels else 0)
+            inputs, _ = _split_batch(batch, n)
+            out = self.predict_batch(inputs)
+            outs.append(jax.tree.map(np.asarray, out))
+        return outs
+
+    # -- persistence (reference: Model.save/load) ---------------------------
+
+    def save(self, path: str, training: bool = True):
+        from ..framework import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave({"opt_state": self._opt_state, "step": self._step},
+                  path + ".pdopt")
+
+    def load(self, path: str, reset_optimizer: bool = False):
+        import os
+        from ..framework import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        self._params = self.network.raw_parameters()
+        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+            st = fload(path + ".pdopt")
+            self._opt_state = st["opt_state"]
+            self._step = st["step"]
+        self._train_fn = None  # params identity changed; rebuild jits lazily
+        self._eval_fn = None
+        self._pred_fn = None
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None):
+        n = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{len(list(self.network.parameters()))} tensors, {n:,} params"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n}

@@ -1,0 +1,30 @@
+"""paddle_tpu.incubate — incubating APIs (reference: python/paddle/incubate/).
+
+Hosts the fused-op functional surface (incubate.nn.functional) mirroring the
+reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
+"""
+
+from . import nn
+from . import asp
+from . import operators
+from . import autograd
+from . import optimizer
+from . import autotune
+from . import checkpoint
+from . import distributed
+from . import tensor
+
+__all__ = ["nn", "asp", "operators"]
+
+# -- round-3 parity batch ---------------------------------------------------
+from ..geometric import segment_sum, segment_mean, segment_max, segment_min
+from .operators import (softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+                        graph_send_recv)
+from .extras import (identity_loss, graph_khop_sampler, graph_reindex,
+                     graph_sample_neighbors, LookAhead, ModelAverage)
+
+__all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min",
+            "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+            "graph_send_recv", "identity_loss", "graph_khop_sampler",
+            "graph_reindex", "graph_sample_neighbors", "LookAhead",
+            "ModelAverage"]

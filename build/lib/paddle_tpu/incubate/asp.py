@@ -1,0 +1,122 @@
+"""ASP — automatic 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/: calculate_density, prune_model, decorate,
+ASPHelper with per-param masks; utils.py check_mask_2d/get_mask_2d_best).
+
+TPU note: the MXU has no 2:4 sparse mode (that's an NVIDIA Ampere tensor-
+core feature), so on TPU ASP is a *model-compression* tool: masks enforce
+the sparsity pattern during fine-tuning (mask applied after each optimizer
+step, as the reference's OptimizerWithSparsityGuarantee does) and the
+resulting weights compress 2x for storage/serving.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["calculate_density", "create_mask", "check_sparsity",
+           "prune_model", "ASPHelper", "decorate"]
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference asp/utils.py calculate_density)."""
+    arr = np.asarray(x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(w, n: int = 2, m: int = 4):
+    """Best n:m mask along the last axis by magnitude (reference
+    get_mask_2d_best / get_mask_1d): keep the n largest of every m."""
+    w = jnp.asarray(w)
+    last = w.shape[-1]
+    if last % m != 0:
+        raise ValueError(f"last dim {last} not divisible by m={m}")
+    groups = w.reshape(*w.shape[:-1], last // m, m)
+    rank = jnp.argsort(jnp.argsort(-jnp.abs(groups), axis=-1), axis=-1)
+    mask = (rank < n).astype(w.dtype)
+    return mask.reshape(w.shape)
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    """True iff every group of m along the last axis has <= n non-zeros."""
+    arr = np.asarray(w)
+    if arr.shape[-1] % m != 0:
+        return False
+    groups = arr.reshape(*arr.shape[:-1], arr.shape[-1] // m, m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def prune_model(model, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, jnp.ndarray]:
+    """Apply n:m masks to all Linear weights (reference asp.prune_model).
+    Returns the name→mask dict for ASPHelper to keep enforcing."""
+    from ..nn.common import Linear
+    masks: Dict[str, jnp.ndarray] = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            p = sub._parameters["weight"]
+            mask = create_mask(p.value, n, m)
+            p.value = p.value * mask
+            masks[f"{name}.weight" if name else "weight"] = mask
+    return masks
+
+
+class ASPHelper:
+    """Keeps masks sticky across optimizer steps (reference
+    OptimizerWithSparsityGuarantee: mask re-applied after each step)."""
+
+    def __init__(self, model, n: int = 2, m: int = 4):
+        self.model = model
+        self.n, self.m = n, m
+        self.masks: Dict[str, jnp.ndarray] = {}
+
+    def prune(self):
+        self.masks = prune_model(self.model, self.n, self.m)
+        return self.masks
+
+    def apply_masks(self):
+        """Re-zero pruned slots (call after optimizer.step)."""
+        from ..nn.common import Linear
+        for name, sub in self.model.named_sublayers(include_self=True):
+            if isinstance(sub, Linear):
+                key = f"{name}.weight" if name else "weight"
+                mask = self.masks.get(key)
+                if mask is not None:
+                    p = sub._parameters["weight"]
+                    p.value = p.value * mask
+
+    def mask_grads(self, grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """Zero gradients of pruned slots so masked weights stay zero even
+        with momentum/weight-decay optimizers."""
+        out = dict(grads)
+        for key, mask in self.masks.items():
+            if key in out:
+                out[key] = out[key] * mask
+        return out
+
+
+def decorate(optimizer, model=None, n: int = 2, m: int = 4):
+    """Wrap an optimizer so step() re-applies masks (reference
+    asp.decorate)."""
+    helper = ASPHelper(model, n, m) if model is not None else None
+
+    class _SparseOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+            self.helper = helper
+
+        def step(self, grads=None, *args, **kwargs):
+            if self.helper is not None and grads is not None:
+                grads = self.helper.mask_grads(grads)
+            out = self._inner.step(grads, *args, **kwargs)
+            if self.helper is not None:
+                self.helper.apply_masks()
+            return out
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _SparseOptimizer(optimizer)

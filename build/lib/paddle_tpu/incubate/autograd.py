@@ -1,0 +1,207 @@
+"""paddle.incubate.autograd parity: functional AD (vjp/jvp/Jacobian/
+Hessian) and the prim-mode API.
+
+Reference: python/paddle/incubate/autograd/{functional.py,primapi.py,
+utils.py}. TPU redesign: jax IS the primitive system — every traced op
+lands in the jaxpr primitive set with registered transpose/jvp rules, so
+``enable_prim``/``disable_prim`` are state shims kept for recipe parity
+(the reference uses them to switch program lowering into primitive ops for
+higher-order AD; here higher-order AD always works).
+
+Jacobian/Hessian follow the reference's flatten-and-concatenate contract
+(functional.py:170: multiple inputs are flattened and concatenated, batch
+dim retained with ``is_batched``) and are index-sliceable like the lazily
+evaluated originals; evaluation here is jax.jacrev over the flattened
+function (one pass, cached).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import jvp, vjp  # functional duals (autograd/__init__.py)
+
+_PRIM_ENABLED = False
+
+
+def enable_prim():
+    """Prim-mode switch (reference: utils.py). jax always differentiates
+    through primitives, so this only flips the introspection flag."""
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False
+
+
+def prim_enabled() -> bool:
+    return _PRIM_ENABLED
+
+
+def _as_seq(xs):
+    return tuple(xs) if isinstance(xs, (tuple, list)) else (xs,)
+
+
+def forward_grad(func_or_outputs, inputs, grad_inputs=None):
+    """Forward-mode gradient (reference: primapi.py:25 — static prim JVP).
+
+    Functional form: pass the FUNCTION and its inputs (the static
+    program/Value form has no meaning without a legacy IR; the traced
+    function is the program)."""
+    if not callable(func_or_outputs):
+        raise TypeError(
+            "forward_grad(outputs, inputs) operated on static-graph Values "
+            "in the reference; here pass (func, inputs[, tangents]) — the "
+            "traced function is the program (docs/DESIGN_DECISIONS.md)")
+    xs = _as_seq(inputs)
+    vs = (_as_seq(grad_inputs) if grad_inputs is not None
+          else tuple(jnp.ones_like(x) for x in xs))
+    _, tangents = jax.jvp(lambda *a: func_or_outputs(*a), xs, vs)
+    return tangents
+
+
+def grad(func_or_outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradient (reference: primapi.py:108), functional form."""
+    if not callable(func_or_outputs):
+        raise TypeError(
+            "grad(outputs, inputs) operated on static-graph Values in the "
+            "reference; here pass (func, inputs[, cotangents]) — the traced "
+            "function is the program (docs/DESIGN_DECISIONS.md)")
+    xs = _as_seq(inputs)
+    out, pullback = jax.vjp(lambda *a: func_or_outputs(*a), *xs)
+    v = grad_outputs if grad_outputs is not None else jax.tree.map(
+        jnp.ones_like, out)
+    gs = pullback(v)
+    return gs if len(gs) > 1 else gs[0]
+
+
+def _flatten_inputs(xs, is_batched):
+    """Concatenate inputs into one flat (batched) vector, returning the
+    vector and a rebuild function — the reference's flatten contract."""
+    xs = _as_seq(xs)
+    if is_batched:
+        b = xs[0].shape[0]
+        parts = [x.reshape(b, -1) for x in xs]
+        sizes = [p.shape[1] for p in parts]
+        flat = jnp.concatenate(parts, axis=1)
+
+        def rebuild(v):
+            out, off = [], 0
+            for x, n in zip(xs, sizes):
+                out.append(v[:, off:off + n].reshape(x.shape))
+                off += n
+            return out
+    else:
+        parts = [x.reshape(-1) for x in xs]
+        sizes = [p.shape[0] for p in parts]
+        flat = jnp.concatenate(parts)
+
+        def rebuild(v):
+            out, off = [], 0
+            for x, n in zip(xs, sizes):
+                out.append(v[off:off + n].reshape(x.shape))
+                off += n
+            return out
+    return flat, rebuild
+
+
+class Jacobian:
+    """Sliceable Jacobian matrix (reference: functional.py:170).
+
+    Rows = flattened outputs, cols = flattened inputs; with
+    ``is_batched=True`` the leading axis is the batch and indexing is
+    ``J[:, i, j]``. Evaluated once with jax.jacrev on first access and
+    cached (the reference evaluates lazily row-wise and caches likewise).
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = _as_seq(xs)
+        self._batched = is_batched
+        self._mat = None
+
+    def _flat_func(self, flat, rebuild):
+        out = self._func(*rebuild(flat))
+        out = _as_seq(out)
+        if self._batched:
+            b = out[0].shape[0]
+            return jnp.concatenate([o.reshape(b, -1) for o in out], axis=1)
+        return jnp.concatenate([o.reshape(-1) for o in out])
+
+    def _evaluate(self):
+        if self._mat is None:
+            flat, rebuild = _flatten_inputs(self._xs, self._batched)
+            jac = jax.jacrev(lambda v: self._flat_func(v, rebuild))(flat)
+            if self._batched:
+                # jac: [b, out, b, in] — keep the diagonal batch pairs
+                b = flat.shape[0]
+                idx = jnp.arange(b)
+                jac = jac[idx, :, idx, :]         # [b, out, in]
+            self._mat = jac
+        return self._mat
+
+    @property
+    def shape(self):
+        return self._evaluate().shape
+
+    def __getitem__(self, idx):
+        return self._evaluate()[idx]
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        return np.asarray(self._evaluate(), dtype)
+
+
+class Hessian:
+    """Sliceable Hessian of a SCALAR-output function (reference:
+    functional.py:257 — implemented there as Jacobian of the gradient)."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        xs = _as_seq(xs)
+
+        def grad_fn(*a):
+            def scalar(*b):
+                out = func(*b)
+                out = _as_seq(out)[0]
+                if is_batched:
+                    if out.ndim > 1 and out.shape[-1] != 1:
+                        raise ValueError(
+                            "Hessian requires func to return a scalar per "
+                            f"batch element, got shape {out.shape}")
+                    return jnp.sum(out)
+                if out.size != 1:
+                    raise ValueError("Hessian requires a scalar-output func, "
+                                     f"got shape {out.shape}")
+                return out.reshape(())
+            return jax.grad(scalar, argnums=tuple(range(len(a))))(*a)
+
+        self._jac = Jacobian(grad_fn, xs, is_batched=is_batched)
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+    def __getitem__(self, idx):
+        return self._jac[idx]
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        return np.asarray(self._jac._evaluate(), dtype)
+
+
+def prim2orig(*args, **kwargs):
+    """Reference: primx.py prim2orig lowers primitive ops back to original
+    ops in a legacy-IR block. No legacy IR exists here."""
+    raise NotImplementedError(
+        "prim2orig rewrites the legacy static IR; paddle_tpu programs are "
+        "jaxprs and stay in primitive form (docs/DESIGN_DECISIONS.md)")
+
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad",
+           "prim2orig"]

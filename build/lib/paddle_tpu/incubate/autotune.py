@@ -1,0 +1,46 @@
+"""paddle.incubate.autotune parity (reference:
+python/paddle/incubate/autotune.py set_config — kernel/layout/dataloader
+autotuning toggles feeding phi/kernels/autotune/switch_autotune.h).
+
+TPU mapping: "kernel" tuning is the Pallas block-size autotune DB
+(ops/pallas/autotune.py + tools/tune_kernels.py); enable=False flips the
+PT_DISABLE_PALLAS kill-switch so dispatch stays on stock XLA. "layout" and
+"dataloader" tuning are XLA/input-pipeline concerns recorded for
+introspection (get_config) — XLA already autotunes layouts."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Union
+
+_config = {"kernel": {"enable": True},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+__all__ = ["set_config", "get_config"]
+
+
+def set_config(config: Optional[Union[dict, str]] = None) -> None:
+    """Accepts the reference's dict (or a path to its JSON file)."""
+    global _config
+    if config is None:
+        _config = {k: {"enable": True} for k in _config}
+    else:
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        for key, val in config.items():
+            if key not in _config:
+                raise ValueError(f"unknown autotune domain {key!r}; "
+                                 f"known: {sorted(_config)}")
+            _config[key].update(val if isinstance(val, dict)
+                                else {"enable": bool(val)})
+    if _config["kernel"].get("enable", True):
+        os.environ.pop("PT_DISABLE_PALLAS", None)
+    else:
+        os.environ["PT_DISABLE_PALLAS"] = "1"
+
+
+def get_config() -> dict:
+    return {k: dict(v) for k, v in _config.items()}

@@ -1,0 +1,7 @@
+"""paddle.incubate.checkpoint module-path parity (reference:
+python/paddle/base/incubate/checkpoint/auto_checkpoint.py TrainEpochRange
+:278); implementation in paddle_tpu/checkpoint/auto_checkpoint.py."""
+
+from ..checkpoint.auto_checkpoint import TrainEpochRange, train_epoch_range
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]

@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed parity (reference hosts the MoE model
+package here: python/paddle/incubate/distributed/models/moe)."""
+from . import models

@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed.models.moe module-path parity (reference:
+moe_layer.py:263 MoELayer + gate/). TPU implementation (sort-based
+dispatch, dropless grouped matmul): paddle_tpu.parallel.moe."""
+
+from .....parallel.moe import (MoELayer, MoEMLP, top_k_gating, top_k_routing)
+
+__all__ = ["MoELayer", "MoEMLP", "top_k_gating", "top_k_routing"]

@@ -1,0 +1,173 @@
+"""incubate long tail: LookAhead/ModelAverage optimizers, khop sampling,
+identity_loss (reference: python/paddle/incubate/{optimizer/lookahead.py,
+optimizer/modelaverage.py,operators/graph_khop_sampler.py,nn/loss.py}).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def identity_loss(x, reduction: str = "none"):
+    """Marks a tensor as a loss (reference: incubate/nn/loss.py
+    identity_loss — IPU integration op). Functionally a reduction."""
+    arr = jnp.asarray(x)
+    if reduction in ("none", 2):
+        return arr
+    if reduction in ("sum", 0):
+        return jnp.sum(arr)
+    if reduction in ("mean", 1):
+        return jnp.mean(arr)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids: bool = False,
+                       name=None):
+    """K-hop neighborhood sampling (reference:
+    incubate/operators/graph_khop_sampler.py): repeated uniform neighbor
+    sampling, then reindex to local ids. Host-side numpy (data-dependent
+    shapes). Returns (edge_src, edge_dst, sample_index, reindex_nodes
+    [, edge_eids])."""
+    from ..geometric import sample_neighbors, reindex_graph
+    frontier = np.asarray(input_nodes)
+    all_src, all_dst = [], []
+    for size in sample_sizes:
+        src, dst, uniq = sample_neighbors(row, colptr, frontier,
+                                          sample_size=size)
+        all_src.append(src)
+        all_dst.append(dst)
+        frontier = uniq
+    src_cat = (np.concatenate(all_src) if all_src
+               else np.asarray([], np.int64))
+    dst_cat = (np.concatenate(all_dst) if all_dst
+               else np.asarray([], np.int64))
+    # reindex over the union
+    counts = np.zeros(len(np.asarray(input_nodes)), np.int64)
+    # build per-center counts for reindex: recompute by grouping dst
+    centers = np.asarray(input_nodes)
+    order = {int(v): i for i, v in enumerate(centers)}
+    neigh_by_center = [[] for _ in centers]
+    for s, d in zip(src_cat, dst_cat):
+        if int(d) in order:
+            neigh_by_center[order[int(d)]].append(int(s))
+    flat = [v for lst in neigh_by_center for v in lst]
+    counts = np.asarray([len(lst) for lst in neigh_by_center], np.int64)
+    r_src, r_dst, nodes = reindex_graph(centers, np.asarray(flat, np.int64),
+                                        counts)
+    out = (r_src, r_dst, centers, nodes)
+    if return_eids:
+        out = out + (np.arange(len(r_src), dtype=np.int64),)
+    return out
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable: bool = False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size: int = -1,
+                           return_eids: bool = False,
+                           flag_perm_buffer: bool = False, name=None):
+    from ..geometric import sample_neighbors
+    src, dst, _ = sample_neighbors(row, colptr, input_nodes,
+                                   sample_size=sample_size)
+    # reference returns (out_neighbors, out_count[, out_eids]) in CSC terms
+    centers = np.asarray(input_nodes)
+    count = np.asarray([(dst == int(c)).sum() for c in centers], np.int64)
+    if return_eids:
+        return src, count, np.arange(len(src), dtype=np.int64)
+    return src, count
+
+
+class LookAhead:
+    """Lookahead wrapper: k fast steps, then slow-weights interpolation
+    (reference: incubate/optimizer/lookahead.py LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+
+    def step(self, grads=None):
+        self.inner_optimizer.step(grads)
+        self._step += 1
+        bound = self.inner_optimizer._bound_params
+        params = {k: jnp.asarray(p.value) for k, p in bound.items()}
+        if self._slow is None:
+            self._slow = params
+        if self._step % self.k == 0:
+            self._slow = {k: s + self.alpha * (params[k] - s)
+                          for k, s in self._slow.items()}
+            for k, p in bound.items():
+                p.value = self._slow[k]
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval (reference:
+    incubate/optimizer/modelaverage.py ModelAverage). Paddle keeps
+    windowed sums; the TPU version keeps the same
+    sum_1/sum_2/sum_3 accounting collapsed into one running sum."""
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._layer = parameters if hasattr(parameters, "state_dict") \
+            else None
+        self._sum = None
+        self._n = 0
+        self._backup = None
+
+    def step(self, layer=None):
+        layer = layer or self._layer
+        state = {k: jnp.asarray(v) for k, v in layer.state_dict().items()}
+        if self._sum is None:
+            self._sum = state
+            self._n = 1
+        else:
+            window = max(self.min_w,
+                         min(self.max_w, int(self._n * self.rate) + 1))
+            if self._n >= window:  # restart window like the reference
+                self._sum = state
+                self._n = 1
+            else:
+                self._sum = {k: self._sum[k] + v for k, v in state.items()}
+                self._n += 1
+
+    def apply(self, executor=None, need_restore: bool = True, layer=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            tgt = layer or self._layer
+            self._backup = {k: jnp.asarray(v)
+                            for k, v in tgt.state_dict().items()}
+            avg = {k: v / self._n for k, v in self._sum.items()}
+            tgt.set_state_dict(avg)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    tgt.set_state_dict(self._backup)
+
+        return guard()
+
+    def restore(self, executor=None, layer=None):
+        tgt = layer or self._layer
+        if self._backup is not None:
+            tgt.set_state_dict(self._backup)

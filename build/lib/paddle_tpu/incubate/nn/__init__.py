@@ -1,0 +1,17 @@
+"""paddle_tpu.incubate.nn (reference: python/paddle/incubate/nn/)."""
+
+from . import functional
+from . import layer
+from . import attn_bias
+from . import loss
+from . import memory_efficient_attention
+from .layer import (FusedLinear, FusedDropout, FusedDropoutAdd,
+                    FusedBiasDropoutResidualLayerNorm,
+                    FusedMultiHeadAttention, FusedFeedForward,
+                    FusedTransformerEncoderLayer, FusedMultiTransformer,
+                    FusedEcMoe)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedLinear", "FusedDropout", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedEcMoe"]

@@ -1,0 +1,242 @@
+"""Structured attention biases (reference:
+python/paddle/incubate/nn/attn_bias.py — the xformers-style AttentionBias
+hierarchy feeding memory_efficient_attention).
+
+TPU redesign: these are host-side SETUP objects, so the interval
+bookkeeping stays numpy; ``materialize`` returns a dense additive bias for
+the XLA path exactly like the reference, and the BlockDiagonal family
+additionally exposes ``to_segment_ids()`` — the packed-varlen form the
+Pallas flash kernel consumes natively (segment-id masking instead of an
+O(s^2) bias in HBM). memory_efficient_attention routes AttentionBias
+instances accordingly (functional/__init__.py).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["AttentionBias", "LowerTriangularMask",
+           "LowerTriangularMaskWithTensorBias", "SeqLenInfo",
+           "PaddedSeqLenInfo", "BlockDiagonalMask",
+           "BlockDiagonalCausalMask",
+           "BlockDiagonalCausalWithOffsetPaddedKeysMask"]
+
+_NEG_INF = float("-inf")
+
+
+class AttentionBias(ABC):
+    @abstractmethod
+    def materialize(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class LowerTriangularMask(AttentionBias):
+    """Causal mask as an additive bias: -inf strictly above the diagonal."""
+
+    def materialize(self, shape, dtype=jnp.float32):
+        m = np.triu(np.full(shape[-2:], _NEG_INF, np.float32), k=1)
+        return jnp.broadcast_to(jnp.asarray(m), shape).astype(dtype)
+
+    def add_bias(self, bias):
+        return LowerTriangularMaskWithTensorBias(bias)
+
+
+class LowerTriangularMaskWithTensorBias(LowerTriangularMask):
+    def __init__(self, bias):
+        self._bias = bias
+
+    def materialize(self, shape, dtype=jnp.float32):
+        return (super().materialize(shape, dtype)
+                + jnp.asarray(self._bias, dtype))
+
+
+@dataclass
+class SeqLenInfo:
+    """Cumulative-offset view of packed variable-length sequences
+    (reference: attn_bias.py SeqLenInfo — the cu_seqlens analogue)."""
+
+    seqstart: jnp.ndarray
+    max_seqlen: int
+    seqstart_py: List[int]
+
+    def intervals(self):
+        yield from zip(self.seqstart_py, self.seqstart_py[1:])
+
+    @classmethod
+    def from_seqlens(cls, seqlens: Sequence[int]) -> "SeqLenInfo":
+        starts = [0]
+        for s in seqlens:
+            starts.append(starts[-1] + int(s))
+        return cls(seqstart=jnp.asarray(starts, jnp.int32),
+                   max_seqlen=max(seqlens) if len(seqlens) else 0,
+                   seqstart_py=starts)
+
+    def split(self, x, batch_sizes: Optional[Sequence[int]] = None):
+        if x.shape[0] != 1 or self.seqstart_py[-1] != x.shape[1]:
+            raise ValueError(f"expected [1, {self.seqstart_py[-1]}, ...], "
+                             f"got {x.shape}")
+        if batch_sizes is None:
+            batch_sizes = [1] * (len(self.seqstart_py) - 1)
+        out, it = [], 0
+        for bs in batch_sizes:
+            start = self.seqstart_py[it]
+            end = self.seqstart_py[it + bs]
+            out.append(x[:, start:end].reshape(bs, -1, *x.shape[2:]))
+            it += bs
+        return out
+
+    def segment_ids(self) -> np.ndarray:
+        """[total] int32: which packed sequence owns each position.
+        Positions no interval covers (PaddedSeqLenInfo gaps) get -1, which
+        matches no query id — padding keys stay masked on the segment-id
+        fast path exactly as in materialize()."""
+        total = self.seqstart_py[-1]
+        ids = np.full((total,), -1, np.int32)
+        for i, (s, e) in enumerate(self.intervals()):
+            ids[s:e] = i
+        return ids
+
+
+@dataclass
+class PaddedSeqLenInfo(SeqLenInfo):
+    """Fixed-stride layout with per-sequence true lengths (decode-time
+    padded KV; reference: attn_bias.py PaddedSeqLenInfo)."""
+
+    seqlen: jnp.ndarray = None
+    seqlen_py: Sequence[int] = ()
+
+    def intervals(self):
+        for (start, _), length in zip(
+                zip(self.seqstart_py, self.seqstart_py[1:]), self.seqlen_py):
+            yield start, start + length
+
+    @classmethod
+    def from_seqlens(cls, seqlens):
+        raise NotImplementedError(
+            "use SeqLenInfo.from_seqlens or "
+            "PaddedSeqLenInfo.from_seqlens_padded")
+
+    @classmethod
+    def from_seqlens_padded(cls, seqlens: Sequence[int], padding: int):
+        if any(s > padding for s in seqlens):
+            raise ValueError(f"seqlen > padding {padding}")
+        starts = list(range(0, len(seqlens) * padding + 1, padding))
+        return cls(seqstart=jnp.asarray(starts, jnp.int32),
+                   max_seqlen=max(seqlens) if len(seqlens) else 0,
+                   seqstart_py=starts,
+                   seqlen=jnp.asarray(list(seqlens), jnp.int32),
+                   seqlen_py=list(seqlens))
+
+    def split(self, x, batch_sizes=None):
+        raise NotImplementedError("padded layouts do not split")
+
+
+@dataclass
+class BlockDiagonalMask(AttentionBias):
+    """Packed-sequence attention: query block i sees only key block i
+    (reference: attn_bias.py:126). TPU-native form: segment ids."""
+
+    q_seqinfo: SeqLenInfo
+    k_seqinfo: SeqLenInfo
+    _batch_sizes: Optional[Sequence[int]] = None
+
+    def _block(self, qlen, klen):
+        return np.zeros((qlen, klen), np.float32)
+
+    def materialize(self, shape, dtype=jnp.float32):
+        if shape[-1] != self.k_seqinfo.seqstart_py[-1] or \
+                shape[-2] != self.q_seqinfo.seqstart_py[-1]:
+            raise ValueError(f"shape {shape} != packed totals "
+                             f"({self.q_seqinfo.seqstart_py[-1]}, "
+                             f"{self.k_seqinfo.seqstart_py[-1]})")
+        m = np.full(shape[-2:], _NEG_INF, np.float32)
+        for (qs, qe), (ks, ke) in zip(self.q_seqinfo.intervals(),
+                                      self.k_seqinfo.intervals()):
+            m[qs:qe, ks:ke] = self._block(qe - qs, ke - ks)
+        return jnp.broadcast_to(jnp.asarray(m), shape).astype(dtype)
+
+    @classmethod
+    def from_seqlens(cls, q_seqlen, kv_seqlen=None):
+        if kv_seqlen is not None and len(q_seqlen) != len(kv_seqlen):
+            raise ValueError("q/kv seqlen count mismatch")
+        q = SeqLenInfo.from_seqlens(q_seqlen)
+        k = q if kv_seqlen is None or list(q_seqlen) == list(kv_seqlen) \
+            else SeqLenInfo.from_seqlens(kv_seqlen)
+        return cls(q_seqinfo=q, k_seqinfo=k)
+
+    @classmethod
+    def from_tensor_list(cls, tensors):
+        batch_sizes = [t.shape[0] for t in tensors]
+        seqlens = [t.shape[1] for t in tensors for _ in range(t.shape[0])]
+        bd = cls.from_seqlens(seqlens)
+        bd._batch_sizes = batch_sizes
+        packed = jnp.concatenate(
+            [jnp.reshape(t, (1, -1, *t.shape[2:])) for t in tensors], axis=1)
+        return bd, packed
+
+    def split_queries(self, tensor):
+        return self.q_seqinfo.split(tensor, self._batch_sizes)
+
+    def split_kv(self, tensor):
+        return self.k_seqinfo.split(tensor, self._batch_sizes)
+
+    def split(self, tensor):
+        if self.q_seqinfo is not self.k_seqinfo:
+            raise ValueError("q/k layouts differ; use split_queries/split_kv")
+        return self.q_seqinfo.split(tensor, self._batch_sizes)
+
+    def make_causal(self) -> "BlockDiagonalCausalMask":
+        return BlockDiagonalCausalMask(q_seqinfo=self.q_seqinfo,
+                                       k_seqinfo=self.k_seqinfo,
+                                       _batch_sizes=self._batch_sizes)
+
+    @property
+    def causal(self) -> bool:
+        return False
+
+    def to_segment_ids(self):
+        """(q_seg [1, sq], kv_seg [1, sk]) int32 — the flash kernel's
+        packed-varlen masking form (no dense bias in HBM)."""
+        return (jnp.asarray(self.q_seqinfo.segment_ids())[None],
+                jnp.asarray(self.k_seqinfo.segment_ids())[None])
+
+
+@dataclass
+class BlockDiagonalCausalMask(BlockDiagonalMask):
+    def _block(self, qlen, klen):
+        return np.triu(np.full((qlen, klen), _NEG_INF, np.float32), k=1)
+
+    @property
+    def causal(self) -> bool:
+        return True
+
+
+@dataclass
+class BlockDiagonalCausalWithOffsetPaddedKeysMask(AttentionBias):
+    """Decode-phase mask: per-sequence padded keys with true lengths and a
+    causal offset (reference: attn_bias.py:226)."""
+
+    q_seqinfo: SeqLenInfo
+    k_seqinfo: PaddedSeqLenInfo
+    causal_diagonal: Optional[jnp.ndarray] = None
+
+    def materialize(self, shape, dtype=jnp.float32):
+        if shape[-1] != self.k_seqinfo.seqstart_py[-1] or \
+                shape[-2] != self.q_seqinfo.seqstart_py[-1]:
+            raise ValueError(f"shape {shape} mismatches packed totals")
+        m = np.full(shape[-2:], _NEG_INF, np.float32)
+        diags = (np.asarray(self.causal_diagonal)
+                 if self.causal_diagonal is not None else None)
+        for i, ((qs, qe), (ks, ke)) in enumerate(zip(
+                self.q_seqinfo.intervals(), self.k_seqinfo.intervals())):
+            qlen, klen = qe - qs, ke - ks
+            off = int(diags[i]) if diags is not None else klen - qlen
+            blk = np.triu(np.full((qlen, klen), _NEG_INF, np.float32),
+                          k=1 + off)
+            m[qs:qe, ks:ke] = blk
+        return jnp.broadcast_to(jnp.asarray(m), shape).astype(dtype)

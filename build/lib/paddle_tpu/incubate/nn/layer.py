@@ -1,0 +1,336 @@
+"""paddle.incubate.nn fused layer classes.
+
+Reference: python/paddle/incubate/nn/layer/{fused_transformer.py,
+fused_linear.py,fused_dropout_add.py,fused_dropout_nd.py,fused_ec_moe.py}
+— Layer wrappers over the fused CUDA transformer kernels.
+
+TPU redesign: the same layer semantics (pre/post-LN placement, packed QKV,
+residual+dropout fusion points) expressed over this repo's fused
+functional surface (incubate.nn.functional fused_linear / fused_layer_norm
+/ bias_act) and the flash-attention dispatch — XLA fuses the epilogues the
+reference hand-fused in CUDA. Parity oracle in tests: the unfused
+nn.TransformerEncoderLayer path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from .functional import fused_bias_act, fused_layer_norm, fused_linear
+
+__all__ = ["FusedLinear", "FusedDropout", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer", "FusedEcMoe"]
+
+
+class FusedLinear(Layer):
+    """reference: fused_linear.py — Linear through the fused matmul+bias
+    epilogue; ``transpose_weight`` stores W as [out, in]."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None,
+                 transpose_weight: bool = False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        init_w = weight_attr if isinstance(weight_attr, I.Initializer) \
+            else None
+        self.weight = self.create_parameter(shape, initializer=init_w)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedDropout(Layer):
+    """reference: fused_dropout_nd.py — dropout with optional shared axes."""
+
+    def __init__(self, p: float = 0.5, axis=None,
+                 mode: str = "upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.axis, self.mode = p, axis, mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training,
+                         mode=self.mode)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: fused_dropout_add.py — y + dropout(x) in one site."""
+
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train",
+                 name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x, y):
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: fused_transformer.py FusedBiasDropoutResidualLayerNorm —
+    out = LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim: int, dropout_rate: float = 0.5,
+                 weight_attr=None, bias_attr=None, epsilon: float = 1e-5,
+                 name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        h = F.dropout(x + self.linear_bias, p=self.dropout_rate,
+                      training=self.training)
+        return fused_layer_norm(residual + h, self.ln_scale, self.ln_bias,
+                                epsilon=self.epsilon)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference: fused_transformer.py FusedMultiHeadAttention — packed-QKV
+    self-attention with the residual/dropout/LN fusion points."""
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout_rate: float = 0.5, attn_dropout_rate: float = 0.5,
+                 kdim=None, vdim=None, normalize_before: bool = False,
+                 need_weights: bool = False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon: float = 1e-5,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        if (kdim is not None and kdim != embed_dim) or \
+                (vdim is not None and vdim != embed_dim):
+            raise ValueError("FusedMultiHeadAttention is self-attention "
+                             "only (kdim/vdim must equal embed_dim), like "
+                             "the reference")
+        if need_weights:
+            raise ValueError("need_weights=True is unsupported, like the "
+                             "reference kernel")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        if self.head_dim * num_heads != embed_dim:
+            raise ValueError("embed_dim must divide num_heads")
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        # packed qkv: [3, n_heads, head_dim, embed] like the reference
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim],
+            default_initializer=I.XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], default_initializer=I.XavierUniform())
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "cached decode: use models.llama decode paths / "
+                "inference.serving (docs/DESIGN_DECISIONS.md)")
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = fused_layer_norm(x, self.pre_ln_scale, self.pre_ln_bias,
+                                 epsilon=self.epsilon)
+        b, s, _ = x.shape
+        # packed projection: [b, s, 3, h, hd]
+        qkv = jnp.einsum("bse,thde->bsthd", x,
+                         self.qkv_weight.astype(x.dtype)) \
+            + self.qkv_bias.astype(x.dtype)
+        q, k, v = (qkv[:, :, i] for i in range(3))      # [b, s, h, hd]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = out.reshape(b, s, self.embed_dim)
+        out = jnp.matmul(out, self.linear_weight.astype(x.dtype)) \
+            + self.linear_bias.astype(x.dtype)
+        out = residual + F.dropout(out, p=self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = fused_layer_norm(out, self.ln_scale, self.ln_bias,
+                                   epsilon=self.epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """reference: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, epsilon: float = 1e-5,
+                 activation: str = "relu", act_dropout_rate=None,
+                 normalize_before: bool = False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks: int = 1, ring_id: int = -1,
+                 name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [d_model], initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "cached decode: use models.llama decode paths / "
+                "inference.serving (docs/DESIGN_DECISIONS.md)")
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = fused_layer_norm(x, self.ln_scale, self.ln_bias,
+                                 epsilon=self.epsilon)
+        h = jnp.matmul(x, self.linear1_weight.astype(x.dtype))
+        h = fused_bias_act(h, self.linear1_bias.astype(x.dtype),
+                           act_method=self.activation)
+        h = F.dropout(h, p=self.act_dropout_rate, training=self.training)
+        h = jnp.matmul(h, self.linear2_weight.astype(x.dtype)) \
+            + self.linear2_bias.astype(x.dtype)
+        out = residual + F.dropout(h, p=self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = fused_layer_norm(out, self.ln_scale, self.ln_bias,
+                                   epsilon=self.epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: fused_transformer.py FusedTransformerEncoderLayer —
+    FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, activation: str = "relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        attn_do = (attn_dropout_rate if attn_dropout_rate is not None
+                   else dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_do, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "cached decode: use models.llama decode paths / "
+                "inference.serving (docs/DESIGN_DECISIONS.md)")
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """reference: fused_transformer.py FusedMultiTransformer — the
+    inference-oriented pre-LN decoder stack with per-layer packed params.
+    TPU shape: ``num_layers`` fused encoder blocks in normalize_before
+    mode with causal attention; the serving-scale decode paths live in
+    models/llama.py (dense + paged KV) and inference/serving.py."""
+
+    def __init__(self, embed_dim: int, num_heads: int, dim_feedforward: int,
+                 num_layers: int = 1, dropout_rate: float = 0.0,
+                 activation: str = "gelu", normalize_before: bool = True,
+                 epsilon: float = 1e-5, **unused):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError("FusedMultiTransformer is pre-LN only, like "
+                             "the reference")
+        from ...nn.layer import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        if caches is not None or time_step is not None:
+            raise NotImplementedError(
+                "cached decode: use models.llama decode paths / "
+                "inference.serving (docs/DESIGN_DECISIONS.md)")
+        b, s, _ = src.shape
+        if attn_mask is None:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            attn_mask = (cols <= rows)[None, None]
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x
+
+
+class FusedEcMoe(Layer):
+    """reference: fused_ec_moe.py — expert-choice MoE as two batched
+    matmuls over all experts, combined by the (softmaxed) gate."""
+
+    def __init__(self, hidden_size: int, inter_size: int, num_experts: int,
+                 act_type: str = "gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"act_type must be gelu|relu, got {act_type!r}")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            [num_experts, hidden_size, inter_size],
+            default_initializer=I.XavierUniform())
+        self.bmm_bias0 = self.create_parameter([num_experts, 1, inter_size],
+                                               is_bias=True)
+        self.bmm_weight1 = self.create_parameter(
+            [num_experts, inter_size, hidden_size],
+            default_initializer=I.XavierUniform())
+        self.bmm_bias1 = self.create_parameter([num_experts, 1, hidden_size],
+                                               is_bias=True)
+
+    def forward(self, x, gate):
+        """x: [b, s, d]; gate: [b, s, e] logits. Every token runs every
+        expert (the reference kernel's dense EC formulation) and the
+        softmaxed gate mixes the outputs."""
+        probs = jax.nn.softmax(gate.astype(jnp.float32), axis=-1)
+        h = jnp.einsum("bsd,edi->ebsi", x, self.bmm_weight0.astype(x.dtype))
+        h = h + self.bmm_bias0[:, None].astype(x.dtype)
+        h = F.gelu(h) if self.act_type == "gelu" else F.relu(h)
+        y = jnp.einsum("ebsi,eid->ebsd", h, self.bmm_weight1.astype(x.dtype))
+        y = y + self.bmm_bias1[:, None].astype(x.dtype)
+        return jnp.einsum("ebsd,bse->bsd", y.astype(jnp.float32),
+                          probs).astype(x.dtype)

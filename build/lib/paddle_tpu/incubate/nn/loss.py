@@ -1,0 +1,5 @@
+"""Reference path incubate/nn/loss.py (identity_loss:21); implementation
+in incubate/extras.py."""
+from ..extras import identity_loss
+
+__all__ = ["identity_loss"]

@@ -1,0 +1,6 @@
+"""Reference path incubate/nn/memory_efficient_attention.py; the function
+lives on the fused functional surface (flash-attention dispatch with
+AttentionBias routing)."""
+from .functional import memory_efficient_attention
+
+__all__ = ["memory_efficient_attention"]

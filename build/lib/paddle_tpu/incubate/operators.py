@@ -1,0 +1,44 @@
+"""incubate.operators parity (reference: python/paddle/incubate/operators/
+— fused/graph helper ops whose CUDA kernels exist for fusion; on TPU the
+jnp compositions fuse under XLA, so these are API-surface adapters).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference:
+    incubate/operators/softmax_mask_fuse.py:20, kernel
+    fused_softmax_mask_kernel.cu). x: [b, h, sq, sk]; mask broadcastable
+    additive float (large negative = masked)."""
+    return jax.nn.softmax(x.astype(jnp.float32)
+                          + mask.astype(jnp.float32), axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal (upper-triangle-masked) softmax (reference:
+    incubate/operators/softmax_mask_fuse_upper_triangle.py:20)."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    logits = jnp.where(cols <= rows, x.astype(jnp.float32), -jnp.inf)
+    return jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type: str = "sum",
+                    out_size: Optional[int] = None, name=None):
+    """Gather-scatter message passing (reference:
+    incubate/operators/graph_send_recv.py:39 — superseded upstream by
+    paddle.geometric.send_u_recv, which this delegates to)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv"]

@@ -1,0 +1,31 @@
+"""paddle.incubate.optimizer module-path parity (reference:
+python/paddle/incubate/optimizer/ — lookahead.py, modelaverage.py,
+lbfgs.py, functional/{bfgs,lbfgs}.py). The GPU-era wrappers
+(DistributedFusedLamb, PipelineOptimizer, GradientMergeOptimizer,
+RecomputeOptimizer) are superseded by the TPU designs they wrapped:
+gradient merge = Trainer(accumulate_steps=), recompute =
+distributed.recompute policies, fused comm = GSPMD — __getattr__ names
+the replacement instead of importing silently-broken shims."""
+
+from ..extras import LookAhead, ModelAverage
+from ...optimizer.lbfgs import LBFGS
+from . import functional
+
+_REPLACED = {
+    "PipelineOptimizer": "parallel.pipeline schedules (1F1B/VPP)",
+    "GradientMergeOptimizer": "Trainer(accumulate_steps=N) lax.scan merge",
+    "RecomputeOptimizer": "paddle_tpu.distributed.recompute policies",
+    "DistributedFusedLamb": "optimizer.Lamb under GSPMD (fusion is XLA's)",
+    "LarsMomentumOptimizer": "optimizer.Momentum with lars_coeff knobs",
+}
+
+
+def __getattr__(name):
+    if name in _REPLACED:
+        raise AttributeError(
+            f"{name} is replaced on TPU by {_REPLACED[name]} "
+            f"(docs/DESIGN_DECISIONS.md)")
+    raise AttributeError(name)
+
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS", "functional"]

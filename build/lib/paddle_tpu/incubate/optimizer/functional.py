@@ -1,0 +1,67 @@
+"""paddle.incubate.optimizer.functional parity: whole-vector quasi-Newton
+minimizers (reference: functional/bfgs.py:27 minimize_bfgs,
+functional/lbfgs.py:27 minimize_lbfgs — Nocedal & Wright Algorithm 6.1
+with strong-Wolfe line search).
+
+TPU redesign: jax.scipy.optimize.minimize provides the compiled
+while-loop BFGS/L-BFGS cores (zoom line search, jit-safe); these wrappers
+adapt signatures and return the reference's result tuples."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.optimize import minimize as _jax_minimize
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _prep(objective_func, initial_position, dtype, line_search_fn,
+          initial_inverse_hessian_estimate):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only line_search_fn='strong_wolfe' is supported "
+            f"(got {line_search_fn!r}) — same restriction as the reference")
+    if initial_inverse_hessian_estimate is not None:
+        raise NotImplementedError(
+            "initial_inverse_hessian_estimate: the compiled core starts "
+            "from identity; precondition by reparameterizing x instead")
+    x0 = jnp.asarray(initial_position, dtype=jnp.dtype(dtype))
+    return objective_func, x0
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters: int = 50,
+                  tolerance_grad: float = 1e-7,
+                  tolerance_change: float = 1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn: str = "strong_wolfe",
+                  max_line_search_iters: int = 50,
+                  initial_step_length: float = 1.0,
+                  dtype: str = "float32", name=None):
+    """Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate) — reference
+    functional/bfgs.py:27."""
+    f, x0 = _prep(objective_func, initial_position, dtype, line_search_fn,
+                  initial_inverse_hessian_estimate)
+    r = _jax_minimize(f, x0, method="BFGS",
+                      options={"maxiter": max_iters, "gtol": tolerance_grad,
+                               "line_search_maxiter": max_line_search_iters})
+    return (r.success, r.nfev, r.x, r.fun, r.jac, r.hess_inv)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size: int = 100,
+                   max_iters: int = 50, tolerance_grad: float = 1e-7,
+                   tolerance_change: float = 1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn: str = "strong_wolfe",
+                   max_line_search_iters: int = 50,
+                   initial_step_length: float = 1.0,
+                   dtype: str = "float32", name=None):
+    """Returns (is_converge, num_func_calls, position, objective_value,
+    objective_gradient) — reference functional/lbfgs.py:27."""
+    f, x0 = _prep(objective_func, initial_position, dtype, line_search_fn,
+                  initial_inverse_hessian_estimate)
+    r = _jax_minimize(f, x0, method="l-bfgs-experimental-do-not-rely-on-this",
+                      options={"maxiter": max_iters, "gtol": tolerance_grad,
+                               "maxcor": history_size})
+    return (r.success, r.nfev, r.x, r.fun, r.jac)

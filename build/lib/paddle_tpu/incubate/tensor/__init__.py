@@ -1,0 +1,6 @@
+"""paddle.incubate.tensor parity (reference:
+python/paddle/incubate/tensor/math.py — segment reductions)."""
+from . import math
+from .math import (segment_sum, segment_mean, segment_max, segment_min)
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

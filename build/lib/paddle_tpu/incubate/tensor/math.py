@@ -1,0 +1,6 @@
+"""Reference: python/paddle/incubate/tensor/math.py; implementations are
+the jax.ops.segment_* wrappers in paddle_tpu.geometric."""
+
+from ...geometric import segment_max, segment_mean, segment_min, segment_sum
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

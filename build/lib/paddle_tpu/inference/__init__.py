@@ -1,0 +1,20 @@
+"""paddle_tpu.inference — deployment engine (reference:
+paddle/fluid/inference/ AnalysisPredictor, api at
+paddle_inference_api.h / python paddle.inference.{Config,create_predictor}).
+
+TPU-native redesign: the reference's IR-pass pipeline + engine offload
+collapses into XLA AOT — a Predictor wraps a jit.save'd export (StableHLO)
+or a live Layer jitted on first run. The name/handle API
+(get_input_names/get_input_handle/run) is preserved so serving code ports,
+but handles are zero-copy device arrays rather than LoDTensors. LLM serving
+(KV-cache generation loops, greedy/top-k/top-p) lives in
+paddle_tpu.inference.generation.
+"""
+
+from .predictor import Config, Predictor, create_predictor
+from . import generation
+from .generation import GenerationConfig, generate
+from .serving import ContinuousBatchingEngine
+
+__all__ = ["Config", "Predictor", "create_predictor", "generation",
+           "GenerationConfig", "generate", "ContinuousBatchingEngine"]

@@ -1,0 +1,170 @@
+"""Predictor (reference: paddle/fluid/inference/api/analysis_predictor.cc +
+python/paddle/inference/wrapper.py Config/create_predictor surface)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+class Config:
+    """Inference config (reference paddle.inference.Config shape). GPU/IR
+    toggles are accepted for portability and ignored where XLA already does
+    the equivalent (IR optimization == XLA pipeline)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._device = "tpu"
+        self._memory_pool_mb = None
+        self._ir_optim = True
+
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def enable_use_gpu(self, memory_pool_mb: int = 100, device_id: int = 0):
+        self._device = "accelerator"  # resolves to whatever chip exists
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        pass  # XLA buffer assignment already does liveness-based reuse
+
+
+class _Handle:
+    """Input/output handle (reference ZeroCopyTensor): stages a host array
+    for the next run / exposes the last output."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    @property
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    """Runs a jit.save'd export or a live Layer (reference
+    AnalysisPredictor::Run zero-copy path)."""
+
+    def __init__(self, config: Config = None, layer=None, input_names=None):
+        self.config = config or Config()
+        self._inputs: Dict[str, _Handle] = {}
+        self._outputs: Dict[str, _Handle] = {}
+        self._input_names: List[str] = list(input_names or [])
+        # device routing applies to LIVE layers only: a jit.save'd export
+        # was lowered for its recorded device — re-routing its inputs would
+        # mix committed devices and fail, so the loaded path keeps jax's
+        # default placement
+        self._device = (self._resolve_device(self.config._device)
+                        if layer is not None else None)
+        if layer is not None:
+            self._fn = self._wrap_layer(layer)
+        elif self.config.model_path:
+            from ..jit import load
+            translated = load(self.config.model_path)
+            self._fn = lambda *args: translated(*args)
+            if not self._input_names:
+                n_inputs = len(translated.input_specs)
+                if translated._with_params:
+                    n_inputs -= len(jax.tree.leaves(translated._params))
+                self._input_names = [f"x{i}" for i in range(max(n_inputs, 1))]
+        else:
+            raise ValueError("Predictor needs a Config with model_path or a "
+                             "live layer")
+        if not self._input_names:
+            self._input_names = ["x0"]
+        for n in self._input_names:
+            self._inputs[n] = _Handle(n)
+
+    @staticmethod
+    def _resolve_device(kind: str):
+        """Map the Config device selection to a concrete jax device —
+        the reference's enable_use_gpu/disable_gpu actually routes
+        execution; accepting-and-ignoring it would silently run inference
+        on the wrong chip."""
+        try:
+            if kind == "cpu":
+                return jax.devices("cpu")[0]
+            return jax.devices()[0]
+        except RuntimeError:
+            return None
+
+    def _place(self, args):
+        if self._device is None:
+            return args
+        return [jax.device_put(a, self._device) for a in args]
+
+    def _wrap_layer(self, layer):
+        if hasattr(layer, "functional"):
+            params = layer.raw_parameters()
+            fn = jax.jit(lambda p, *args: layer.functional_call(p, *args))
+            if self._device is not None:
+                params = jax.device_put(params, self._device)
+            return lambda *args: fn(params, *args)
+        return jax.jit(layer)
+
+    def warmup(self, *example_args):
+        """Pre-compile for the given example shapes (reference analogue:
+        AnalysisPredictor's first-run engine build, surfaced explicitly so
+        serving can pay compilation before traffic)."""
+        self._fn(*self._place(list(example_args)))
+        return self
+
+    # -- reference API surface --------------------------------------------
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _Handle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs.keys()) or ["out0"]
+
+    def get_output_handle(self, name: str) -> _Handle:
+        return self._outputs[name]
+
+    def run(self) -> List[np.ndarray]:
+        args = [self._inputs[n]._value for n in self._input_names]
+        if any(a is None for a in args):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._fn(*self._place(args))
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = {}
+        results = []
+        for i, o in enumerate(outs):
+            h = _Handle(f"out{i}")
+            h._value = np.asarray(o)
+            self._outputs[h.name] = h
+            results.append(h._value)
+        return results
+
+    def __call__(self, *args):
+        """Direct functional run (modern convenience path)."""
+        return self._fn(*self._place(list(args)))
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

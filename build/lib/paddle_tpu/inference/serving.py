@@ -1,0 +1,308 @@
+"""Continuous-batching serving engine over the paged-KV decode path.
+
+Reference capability: the block/paged KV-cache serving stack
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and the
+fleet dist-inference helpers). The reference exposes the kernel; serving
+systems built on it (vLLM-style) add a page allocator + request scheduler.
+This module is that scheduler, TPU-shaped:
+
+- ONE compiled decode step over ``max_batch`` fixed slots (static shapes;
+  no recompilation as requests come and go). Inactive slots write their
+  K/V into a reserved garbage page and their sampled token is ignored.
+- A host-side free-list page allocator over a global pool. Prompt pages
+  are claimed at admission; decode pages are claimed LAZILY when a
+  sequence's position crosses a page boundary, so short completions never
+  reserve worst-case memory (the point of paged attention).
+- Recompute-style preemption: if the pool is exhausted when a running
+  sequence needs its next page, the most recently admitted active slot is
+  evicted back to the queue (pages freed, generated tokens kept for
+  replay) — vLLM's "recompute" policy, which on TPU is just a re-prefill.
+- Prefill runs per-slot with the prompt padded up to a page multiple
+  (bucketed → bounded executable count); the first-token logits are taken
+  at the true last-prompt index.
+
+The engine is exact: greedy outputs match ``generate_scan`` per request
+regardless of batching/preemption interleaving (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generation import GenerationConfig, _sample_logits
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray                  # [L] int32
+    max_new_tokens: int
+    generated: List[int] = field(default_factory=list)
+    done: bool = False
+    slot: int = -1                      # active slot, -1 = queued/finished
+
+
+class ContinuousBatchingEngine:
+    """vLLM-style continuous batching over a model exposing the paged-KV
+    trio (``alloc_paged_caches`` / ``prefill_paged`` / ``decode_step_paged``
+    on its core, e.g. ``LlamaForCausalLM``)."""
+
+    def __init__(self, model, max_batch: int = 8, page_size: int = 128,
+                 max_len: int = 2048, num_pages: Optional[int] = None,
+                 generation_config: Optional[GenerationConfig] = None):
+        self.model = model
+        self.core = getattr(model, "model", model)
+        self.cfg = generation_config or GenerationConfig()
+        self.max_batch = max_batch
+        self.page_size = page_size
+        self.max_len = max_len
+        self.pages_per_seq = -(-max_len // page_size)
+        # pool: page 0 is the reserved garbage page for inactive slots
+        total = (num_pages if num_pages is not None
+                 else max_batch * self.pages_per_seq) + 1
+        pools, _ = self.core.alloc_paged_caches(
+            1, total * page_size, page_size)
+        self.pools = pools
+        self._total_pages = total - 1
+        self._free: List[int] = list(range(total - 1, 0, -1))  # stack; 0 kept
+        self.tables = np.zeros((max_batch, self.pages_per_seq), np.int32)
+        self.pos = np.zeros((max_batch,), np.int32)
+        self._slots: List[Optional[_Request]] = [None] * max_batch
+        self._queue: List[_Request] = []
+        self._requests: Dict[int, _Request] = {}
+        self._rid = itertools.count()
+        self._params = (model.raw_parameters()
+                        if hasattr(model, "raw_parameters") else {})
+        self._key = jax.random.PRNGKey(self.cfg.seed)
+        self._prefill_cache: Dict[int, object] = {}
+        self._decode_fn = None
+        self._logits = None                # device [max_batch, vocab]
+        self.preemptions = 0
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, input_ids, max_new_tokens: Optional[int] = None) -> int:
+        """Queue one request; returns its id."""
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        new = (max_new_tokens if max_new_tokens is not None
+               else self.cfg.max_new_tokens)
+        if len(ids) == 0:
+            raise ValueError("empty prompt")
+        if new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {new}")
+        if len(ids) + new > self.max_len:
+            raise ValueError(f"prompt {len(ids)} + max_new {new} exceeds "
+                             f"engine max_len {self.max_len}")
+        if -(-len(ids) // self.page_size) > self._total_pages:
+            raise ValueError(f"prompt needs more pages than the pool holds "
+                             f"({self._total_pages}); raise num_pages")
+        req = _Request(next(self._rid), ids, new)
+        self._requests[req.rid] = req
+        self._queue.append(req)
+        return req.rid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    def step(self) -> List[tuple]:
+        """Admit what fits, decode one token for every active slot.
+        Returns [(rid, token), ...] emitted this step."""
+        self._admit()
+        return self._decode()
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive until all submitted requests complete; returns
+        {rid: np.ndarray of generated tokens} for the requests finished by
+        this call and RELEASES them (a long-lived engine must not retain
+        every request it ever served)."""
+        while self.has_work():
+            self.step()
+        out = {rid: np.asarray(r.generated, np.int32)
+               for rid, r in self._requests.items() if r.done}
+        for rid in out:
+            del self._requests[rid]
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        return {"free_pages": len(self._free),
+                "active": sum(s is not None for s in self._slots),
+                "queued": len(self._queue),
+                "preemptions": self.preemptions}
+
+    # -- page allocator -----------------------------------------------------
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        if len(self._free) < n:
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def _free_slot(self, slot: int):
+        req = self._slots[slot]
+        # free every held page (page 0 == unset): counting from pos would
+        # leak a boundary page granted earlier in the same scheduling pass
+        self._free.extend(int(p) for p in self.tables[slot] if p != 0)
+        self.tables[slot] = 0
+        self.pos[slot] = 0
+        self._slots[slot] = None
+        if req is not None:
+            req.slot = -1
+
+    # -- admission / prefill ------------------------------------------------
+
+    def _bucket(self, L: int) -> int:
+        return -(-L // self.page_size) * self.page_size
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_cache.get(bucket)
+        if fn is not None:
+            return fn
+        core, model = self.core, self.model
+        head = model.logits if hasattr(model, "logits") else (lambda h: h)
+
+        def run(params, ids, pools, tables1, last_idx):
+            ctx = model._bind(params) if hasattr(model, "_bind") else None
+            with ctx if ctx is not None else _null():
+                hidden, pools = core.prefill_paged(ids, pools, tables1)
+                logits = head(hidden[0, last_idx, :])
+            return logits, pools
+
+        fn = jax.jit(run, donate_argnums=(2,))
+        self._prefill_cache[bucket] = fn
+        return fn
+
+    def _admit(self):
+        while self._queue:
+            slot = next((i for i, s in enumerate(self._slots) if s is None),
+                        None)
+            if slot is None:
+                return
+            req = self._queue[0]
+            L = len(req.prompt) + len(req.generated)
+            need = -(-self._bucket(L) // self.page_size)
+            pages = self._alloc_pages(need)
+            if pages is None:
+                if not any(s is not None for s in self._slots):
+                    # nothing running that could ever free pages: a replay
+                    # grew past the pool (the submit-time check covers only
+                    # the original prompt)
+                    raise RuntimeError(
+                        f"request {req.rid} needs {need} pages but the pool "
+                        f"holds {self._total_pages}; raise num_pages")
+                return                       # wait for pages to free up
+            self._queue.pop(0)
+            # replay = prompt + anything generated before a preemption
+            toks = np.concatenate([req.prompt,
+                                   np.asarray(req.generated, np.int32)])
+            bucket = self._bucket(L)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :L] = toks
+            self.tables[slot, :len(pages)] = pages
+            self.pos[slot] = L
+            self._slots[slot] = req
+            req.slot = slot
+            logits, self.pools = self._prefill_fn(bucket)(
+                self._params, jnp.asarray(ids), self.pools,
+                jnp.asarray(self.tables[slot:slot + 1]),
+                jnp.int32(L - 1))
+            self._set_slot_logits(slot, logits)
+
+    def _set_slot_logits(self, slot: int, logits):
+        if self._logits is None:
+            vocab = logits.shape[-1]
+            self._logits = jnp.zeros((self.max_batch, vocab), logits.dtype)
+        self._logits = self._logits.at[slot].set(logits)
+
+    # -- decode -------------------------------------------------------------
+
+    def _build_decode(self):
+        core, model, cfg = self.core, self.model, self.cfg
+        head = model.logits if hasattr(model, "logits") else (lambda h: h)
+
+        def run(params, logits, pos, pools, tables, active, key):
+            ctx = model._bind(params) if hasattr(model, "_bind") else None
+            with ctx if ctx is not None else _null():
+                tok = _sample_logits(logits.astype(jnp.float32), cfg, key)
+                tok = jnp.where(active, tok, 0)
+                h, pools = core.decode_step_paged(tok, pos, pools, tables)
+                new_logits = head(h[:, 0, :])
+            return tok, new_logits, pools
+
+        return jax.jit(run, donate_argnums=(3,))
+
+    def _ensure_decode_pages(self):
+        """Claim next pages for slots about to cross a page boundary;
+        preempt (recompute policy) when the pool is dry."""
+        for slot in range(self.max_batch):
+            if self._slots[slot] is None:
+                continue
+            pos = int(self.pos[slot])
+            if pos % self.page_size != 0:
+                continue                      # not at a boundary
+            pidx = pos // self.page_size
+            if pidx >= self.pages_per_seq:
+                raise RuntimeError("sequence exceeded engine max_len")
+            if self.tables[slot, pidx] != 0:
+                continue                      # already holds this page
+            page = self._alloc_pages(1)
+            while page is None:
+                victim = max((i for i in range(self.max_batch)
+                              if self._slots[i] is not None and i != slot),
+                             key=lambda i: self._slots[i].rid,
+                             default=None)
+                if victim is None:
+                    raise RuntimeError("page pool too small for one request")
+                self.preemptions += 1
+                vreq = self._slots[victim]
+                self._free_slot(victim)
+                self._queue.insert(0, vreq)
+                page = self._alloc_pages(1)
+            self.tables[slot, pidx] = page[0]
+
+    def _decode(self) -> List[tuple]:
+        active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_slots:
+            return []
+        self._ensure_decode_pages()
+        # a preemption may have emptied every slot
+        active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_slots:
+            return []
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        active = np.zeros((self.max_batch,), bool)
+        active[active_slots] = True
+        self._key, sub = jax.random.split(self._key)
+        tok, self._logits, self.pools = self._decode_fn(
+            self._params, self._logits, jnp.asarray(self.pos), self.pools,
+            jnp.asarray(self.tables), jnp.asarray(active), sub)
+        tok_host = np.asarray(tok)
+        emitted = []
+        for slot in active_slots:
+            req = self._slots[slot]
+            t = int(tok_host[slot])
+            req.generated.append(t)
+            emitted.append((req.rid, t))
+            self.pos[slot] += 1
+            eos = self.cfg.eos_token_id
+            if (len(req.generated) >= req.max_new_tokens
+                    or (eos is not None and t == eos)):
+                req.done = True
+                self._free_slot(slot)
+        return emitted
+
+
+class _null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+__all__ = ["ContinuousBatchingEngine"]

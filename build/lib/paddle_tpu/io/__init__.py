@@ -1,0 +1,21 @@
+"""paddle_tpu.io — data input pipeline.
+
+Reference: python/paddle/io/ (Dataset family, samplers, DataLoader with
+multiprocess workers and shared-memory transfer — SURVEY.md §2.4 io/data).
+TPU redesign notes in dataloader.py.
+"""
+
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, ConcatDataset, Subset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler, SubsetRandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info, WorkerInfo
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn",
+]

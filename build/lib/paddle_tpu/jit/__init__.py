@@ -90,21 +90,6 @@ def to_static(function=None, input_spec=None, full_graph: bool = True,
             return fn
         if hasattr(fn, "functional"):
             layer = fn
-            if not full_graph:
-                # AST-convert forward's Python control flow to lax ops
-                # (reference: jit/dy2static AST transformer path). The
-                # converted forward goes on a shallow COPY (shared
-                # parameter storage) so the original layer's eager
-                # behavior is untouched.
-                import copy
-                import types
-                from . import dy2static as _d2s
-                fwd = type(layer).forward
-                if not getattr(fwd, "__dy2static__", False):
-                    proxy = copy.copy(layer)
-                    proxy.forward = types.MethodType(_d2s.convert(fwd),
-                                                     proxy)
-                    layer = proxy
             pure, _ = _layer_pure(layer)
             jitted = jax.jit(pure)
 
@@ -115,9 +100,6 @@ def to_static(function=None, input_spec=None, full_graph: bool = True,
             call.__jitted__ = jitted
             call.__input_spec__ = input_spec
             return call
-        if not full_graph and not getattr(fn, "__dy2static__", False):
-            from . import dy2static as _d2s
-            fn = _d2s.convert(fn)
         jitted = jax.jit(fn, static_argnums=static_argnums)
         jitted.__input_spec__ = input_spec
         return jitted

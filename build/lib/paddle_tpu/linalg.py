@@ -1,0 +1,191 @@
+"""paddle_tpu.linalg — linear-algebra namespace (reference:
+python/paddle/linalg.py re-exporting tensor/linalg.py). Dense decompositions
+lower to XLA's native QR/SVD/Eig kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import (norm, matrix_power, cholesky, inverse as inv, pinv,
+                     solve, svd, qr, eigh, det, slogdet, matrix_rank)
+
+__all__ = [
+    "norm", "matrix_power", "cholesky", "inv", "pinv", "solve", "svd", "qr",
+    "eigh", "det", "slogdet", "matrix_rank", "eig", "eigvals", "eigvalsh",
+    "lstsq", "lu", "triangular_solve", "cholesky_solve", "multi_dot", "cov",
+    "corrcoef", "matmul", "cross", "dot", "householder_product",
+]
+
+inverse = inv
+
+
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False, name=None):
+    # Pivots are 1-based per the reference contract (paddle.linalg.lu docs;
+    # lu_unpack subtracts 1), while jax.scipy returns 0-based.
+    if not pivot:
+        raise NotImplementedError(
+            "paddle_tpu.linalg.lu: pivot=False (unpivoted LU) is not "
+            "supported; XLA's LU is always partially pivoted.")
+    import jax.scipy.linalg as jsl
+    lu_mat, piv = jsl.lu_factor(x)
+    piv = (piv + 1).astype(jnp.int32)
+    if get_infos:
+        # one info per matrix in the batch, like the reference
+        return lu_mat, piv, jnp.zeros(jnp.shape(x)[:-2], jnp.int32)
+    return lu_mat, piv
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(x, y, lower=not upper, trans=int(transpose),
+                                unit_diagonal=unitriangular)
+
+
+def cholesky_solve(x, y, upper: bool = False, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.cho_solve((y, not upper), x)
+
+
+def multi_dot(arrays, name=None):
+    return jnp.linalg.multi_dot(arrays)
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def corrcoef(x, rowvar: bool = True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def cross(x, y, axis: int = 9, name=None):
+    axis = -1 if axis == 9 else axis
+    return jnp.cross(x, y, axis=axis)
+
+
+def dot(x, y, name=None):
+    return jnp.dot(x, y)
+
+
+def householder_product(x, tau, name=None):
+    """Q from householder reflectors (geqrf convention)."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros((i,), x.dtype), jnp.ones((1,), x.dtype),
+                             x[..., i + 1:, i]])
+        q = q - tau[..., i] * (q @ v[:, None]) @ v[None, :]
+    return q[..., :, :n] if m >= n else q
+
+
+# -- round-3 parity batch ---------------------------------------------------
+
+def cond(x, p=None, name=None):
+    """Condition number (reference: tensor/linalg.py cond): defaults to
+    2-norm (sigma_max/sigma_min); supports p in {fro, nuc, inf, -inf, 1,
+    -1, 2, -2}."""
+    arr = jnp.asarray(x)
+    if p is None or p == 2:
+        s = jnp.linalg.svd(arr, compute_uv=False)
+        return s[..., 0] / s[..., -1]
+    if p == -2:
+        s = jnp.linalg.svd(arr, compute_uv=False)
+        return s[..., -1] / s[..., 0]
+    return (jnp.linalg.norm(arr, ord=p, axis=(-2, -1))
+            * jnp.linalg.norm(jnp.linalg.inv(arr), ord=p, axis=(-2, -1)))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata: bool = True,
+              unpack_pivots: bool = True, name=None):
+    """Split packed LU into (P, L, U) (reference: tensor/linalg.py
+    lu_unpack; kernel lu_unpack_kernel). Pivots are 1-based like the
+    reference."""
+    a = jnp.asarray(lu_data)
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+    if unpack_pivots:
+        piv = jnp.asarray(lu_pivots).astype(jnp.int32) - 1   # 0-based
+        batch_shape = piv.shape[:-1]
+        piv2 = piv.reshape(-1, piv.shape[-1])                # [B, k]
+        B = piv2.shape[0]
+        perm = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32),
+                                (B, m))
+        rows = jnp.arange(B)
+        for i in range(piv2.shape[-1]):
+            j = piv2[:, i]                                   # [B]
+            pi = perm[:, i]
+            pj = perm[rows, j]
+            perm = perm.at[:, i].set(pj)
+            perm = perm.at[rows, j].set(pi)
+        P = jax.nn.one_hot(perm, m, dtype=a.dtype)           # [B, m, m]
+        P = jnp.swapaxes(P, -1, -2).reshape(*batch_shape, m, m)
+    return P, L, U
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference: tensor/linalg.py matrix_exp)."""
+    return jax.scipy.linalg.expm(jnp.asarray(x))
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2, name=None):
+    """Randomized low-rank PCA (reference: tensor/linalg.py pca_lowrank,
+    Halko et al. subspace iteration — MXU-friendly: all work is matmul/QR).
+    Returns (U, S, V) with V [n, q]."""
+    from .core.rng import rng_tracker, GLOBAL_STREAM
+    arr = jnp.asarray(x)
+    m, n = arr.shape[-2], arr.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        arr = arr - jnp.mean(arr, axis=-2, keepdims=True)
+    key = rng_tracker().next_key(GLOBAL_STREAM) \
+        if rng_tracker().has(GLOBAL_STREAM) else jax.random.key(0)
+    omega = jax.random.normal(key, (n, q), arr.dtype)
+    y = arr @ omega
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = arr.T @ qmat
+        qz, _ = jnp.linalg.qr(z)
+        y = arr @ qz
+        qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ arr                         # [q, n]
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ ub
+    return u, s, vt.T
+
+
+__all__ += ["cond", "lu_unpack", "matrix_exp", "pca_lowrank"]

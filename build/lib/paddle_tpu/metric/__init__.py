@@ -1,0 +1,173 @@
+"""paddle_tpu.metric — streaming metrics.
+
+Reference: python/paddle/metric/metrics.py (Metric base, Accuracy, Precision,
+Recall, Auc). Same accumulate/reset/compute protocol; math in numpy on host
+(metrics are cheap relative to the device step and stay out of the jit)."""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Streaming metric protocol (reference: metrics.py Metric)."""
+
+    def __init__(self, name: str = None):
+        self._name = name or type(self).__name__.lower()
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label):
+        """Optional pre-processing hook run on (pred, label) before update."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metrics.py Accuracy)."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,), name: str = "acc"):
+        super().__init__(name)
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] != 1:
+            label = label.argmax(-1)
+        label = label.reshape(-1)
+        idx = np.argsort(-pred.reshape(len(label), -1), axis=-1)[:, :self.maxk]
+        correct = idx == label[:, None]
+        return correct
+
+    def update(self, correct):
+        correct = _np(correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[:, :k].any(-1).sum()
+            self.count[i] += len(correct)
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+
+class Precision(Metric):
+    """Binary precision: TP / (TP + FP)."""
+
+    def __init__(self, name: str = "precision"):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(Metric):
+    """Binary recall: TP / (TP + FN)."""
+
+    def __init__(self, name: str = "recall"):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(Metric):
+    """ROC-AUC via thresholded confusion histogram (reference: metrics.py Auc
+    with num_thresholds buckets)."""
+
+    def __init__(self, num_thresholds: int = 4095, name: str = "auc"):
+        super().__init__(name)
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1)
+        self._neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2:          # [N, 2] probabilities → P(class=1)
+            preds = preds[:, -1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1).astype(np.int64)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._pos, idx[labels == 1], 1)
+        np.add.at(self._neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        # integrate TPR over FPR from the highest threshold down
+        pos = self._pos[::-1].cumsum()
+        neg = self._neg[::-1].cumsum()
+        tot_pos, tot_neg = pos[-1], neg[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: python/paddle/metric/metrics.py
+    accuracy): input [N, C] scores, label [N, 1] or [N] int."""
+    import jax.numpy as jnp
+    pred = jnp.asarray(input)
+    lab = jnp.asarray(label).reshape(-1)
+    topk = jnp.argsort(-pred, axis=-1)[:, :k]
+    hit = jnp.any(topk == lab[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+__all__.append("accuracy")

@@ -1,0 +1,11 @@
+"""paddle_tpu.models — model zoo for the BASELINE.json capability configs."""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaDecoderLayer, LlamaAttention, LlamaMLP,
+                    LlamaForCausalLMPipe)
+from .moe_lm import MoEConfig, MoEForCausalLM, MoEDecoderLayer
+from .ernie import ErnieConfig, ErnieForCausalLM
+from .dit import DiTConfig, DiT, DiTBlock, timestep_embedding
+from .vision import (ResNet, resnet18, resnet50, OCRRecConfig, OCRRecModel,
+                     OCRDetModel, DBHead)
+from . import diffusion  # noqa: E402  (DDPM/DDIM/rectified-flow schedulers)

@@ -1,0 +1,151 @@
+"""BERT-family encoder (reference ecosystem: PaddleNLP bert modeling over
+this repo's nn.TransformerEncoder — in-repo substrate:
+python/paddle/nn/layer/transformer.py).
+
+TPU notes: post-norm encoder stack with fused QKV-capable MHA underneath
+(flash attention path on TPU), additive [B,1,1,S] padding masks (broadcast
+against [B,H,S,S] logits), gelu FFNs — all one XLA program under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    dtype: Optional[str] = None
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=512, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+        base.update(kw)
+        return cls(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size,
+                                                weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(nn.Layer):
+    """Encoder + pooler (tanh over [CLS])."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = nn.TransformerEncoder(
+            lambda: nn.TransformerEncoderLayer(
+                cfg.hidden_size, cfg.num_attention_heads,
+                cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+                activation="gelu",
+                attn_dropout=cfg.attention_probs_dropout_prob,
+                dtype=cfg.dtype),
+            cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    @staticmethod
+    def attention_mask_from_ids(input_ids, pad_token_id: int):
+        """[B, S] ids → additive [B, 1, 1, S] mask (-inf at padding)."""
+        pad = input_ids == pad_token_id
+        return jnp.where(pad[:, None, None, :], -jnp.inf, 0.0)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            attention_mask = self.attention_mask_from_ids(
+                input_ids, self.cfg.pad_token_id)
+        elif attention_mask.ndim == 2:  # [B, S] 1/0 convention
+            attention_mask = jnp.where(attention_mask[:, None, None, :] > 0,
+                                       0.0, -jnp.inf)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(x, src_mask=attention_mask)
+        pooled = jnp.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = nn.LayerNorm(cfg.hidden_size)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], initializer=I.Constant(0.0), is_bias=True)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids=token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        # tied decoder: embeddings^T
+        table = self.bert.embeddings.word_embeddings.weight
+        logits = jnp.matmul(h, jnp.swapaxes(table, 0, 1)) + self.decoder_bias
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
+                               ignore_index=-100)
+        return loss, logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, labels=None, token_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids=token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32), labels)
+        return loss, logits

@@ -1,0 +1,157 @@
+"""ERNIE / GPT-family dense decoder (the ERNIE-4.5 capability config).
+
+Capability target (BASELINE.json): ERNIE-4.5. Reference substrate: the
+fused transformer kernel set (incubate/nn/functional fused ops); ERNIE model
+recipes live in PaddleNLP — architecture here is the standard pre-LN GPT
+decoder ERNIE 3.x uses (LayerNorm + biases + gelu MLP + learned positions),
+with the ERNIE-4.5-class MoE variant provided through MoEConfig
+(models/moe_lm.py — ERNIE 4.5 is a mixture-of-experts family).
+
+TPU-first: same conventions as llama.py — fused QKV, big matmuls, fp32
+norms, GSPMD annotations on every weight.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from .moe_lm import MoEConfig
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+    recompute: str = "none"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "ErnieConfig":
+        return ErnieConfig(vocab_size=512, hidden_size=128,
+                           intermediate_size=384, num_hidden_layers=2,
+                           num_attention_heads=4,
+                           max_position_embeddings=256, **kw)
+
+    @staticmethod
+    def ernie45_moe(**kw) -> MoEConfig:
+        """ERNIE-4.5 is an MoE family → returns the MoE config
+        (use with models.MoEForCausalLM)."""
+        return MoEConfig(vocab_size=103424, hidden_size=2560,
+                         intermediate_size=12288, moe_intermediate_size=1536,
+                         num_hidden_layers=28, num_attention_heads=20,
+                         num_key_value_heads=4, num_experts=64,
+                         num_experts_per_tok=6, num_shared_experts=2,
+                         first_k_dense_replace=1, **kw)
+
+
+def _normal(std):
+    return I.Normal(0.0, std)
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.hidden_size
+        std = cfg.initializer_range
+        self.qkv = nn.Linear(d, 3 * d, weight_attr=_normal(std))
+        self.qkv._parameters["weight"].sharding = ("fsdp", "tp")
+        self.out = nn.Linear(d, d, weight_attr=_normal(std))
+        self.out._parameters["weight"].sharding = ("tp", "fsdp")
+
+    def forward(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        qkv = self.qkv(x).reshape(b, s, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        return self.out(out.reshape(b, s, d))
+
+
+class ErnieDecoderLayer(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        d = cfg.hidden_size
+        std = cfg.initializer_range
+        self.ln1 = nn.LayerNorm(d, epsilon=cfg.layer_norm_eps, dtype="float32")
+        self.attn = ErnieSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(d, epsilon=cfg.layer_norm_eps, dtype="float32")
+        self.fc1 = nn.Linear(d, cfg.intermediate_size, weight_attr=_normal(std))
+        self.fc1._parameters["weight"].sharding = ("fsdp", "tp")
+        self.fc2 = nn.Linear(cfg.intermediate_size, d, weight_attr=_normal(std))
+        self.fc2._parameters["weight"].sharding = ("tp", "fsdp")
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.fc2(F.gelu(self.fc1(self.ln2(x)), approximate=True))
+
+
+class ErnieForCausalLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        std = cfg.initializer_range
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(std), sharding=("tp", "fsdp"))
+        self.embed_positions = self.create_parameter(
+            [cfg.max_position_embeddings, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(std), sharding=(None, "fsdp"))
+        self.layers = nn.LayerList([ErnieDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps,
+                                 dtype="float32")
+        # tied head (GPT/ERNIE convention)
+        self.add_parameter("lm_head", None)
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        x = x + self.embed_positions[:s][None].astype(x.dtype)
+        if cfg.recompute == "full":
+            ckpt = jax.checkpoint(lambda lyr, h: lyr(h), static_argnums=(0,))
+            for layer in self.layers:
+                x = ckpt(layer, x)
+        else:
+            for layer in self.layers:
+                x = layer(x)
+        hidden = self.ln_f(x)
+        logits = jnp.matmul(hidden,
+                            jnp.swapaxes(self.embed_tokens, 0, 1)
+                            .astype(hidden.dtype))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32), labels,
+                               ignore_index=-100)
+        return loss, logits
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape)) for _, p in self.named_parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        cfg = self.cfg
+        n = self.num_params()  # embeddings tied = they ARE the head matmul
+        n -= cfg.max_position_embeddings * cfg.hidden_size
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn

@@ -1,0 +1,670 @@
+"""Llama-family decoder-only transformer.
+
+Capability target (BASELINE.json): Llama-3 8B/70B pretraining recipes.
+Reference model analogue: PaddleNLP's Llama on the reference's fused kernels
+(fused_rms_norm, fused_rope, flash_attention —
+python/paddle/incubate/nn/functional/, phi/kernels/fusion/gpu/).
+
+TPU-first design decisions:
+- bf16 activations, fp32 norm statistics; big fused matmuls for the MXU
+  (QKV fused into one projection, gate+up fused).
+- GSPMD sharding annotations on every Parameter (Megatron layout: column
+  parallel over "tp" for qkv/gate/up, row parallel for o/down; embeddings
+  vocab-sharded; all params additionally sharded over "fsdp" for ZeRO-3).
+  The same module runs 1-chip (annotations ignored) or on any mesh.
+- static-shape causal flash attention via ops.attention (Pallas on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops import rope as rope_ops
+from ..ops import norm as norm_ops
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+    # recompute (activation checkpointing) granularity:
+    #   "none"      — save all activations
+    #   "selective" — save projection/matmul outputs, recompute the cheap
+    #                 elementwise/attention-score work (reference analogue:
+    #                 recompute_granularity="core_attn" in the fleet
+    #                 recipes; policy = XLA-side dots_with_no_batch_dims)
+    #   "full"      — save only layer boundaries
+    recompute: str = "none"
+    # sequence parallel: shard activations along seq dim over "sep"
+    sequence_parallel: bool = False
+    # long-context attention over the sep axis: "ring" rotates K/V blocks
+    # (works for any head count, overlaps compute with ppermute) or
+    # "ulysses" all-to-alls heads for full-sequence local flash (cheaper
+    # comm when heads divide the axis; parallel/ulysses.py)
+    sp_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.recompute not in ("none", "selective", "full"):
+            raise ValueError(f"recompute must be 'none'|'selective'|'full', "
+                             f"got {self.recompute!r}")
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"sp_mode must be 'ring'|'ulysses', "
+                             f"got {self.sp_mode!r}")
+        if self.hidden_size % self.num_attention_heads:
+            raise ValueError("hidden_size must be divisible by num_attention_heads")
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError("num_attention_heads must be a multiple of "
+                             "num_key_value_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_hidden_layers=32,
+                           num_attention_heads=32, num_key_value_heads=8,
+                           max_position_embeddings=8192, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def llama3_70b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=8192,
+                           intermediate_size=28672, num_hidden_layers=80,
+                           num_attention_heads=64, num_key_value_heads=8,
+                           max_position_embeddings=8192, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        defaults = dict(vocab_size=512, hidden_size=128, intermediate_size=384,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=256)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+
+def _normal(std):
+    return I.Normal(0.0, std)
+
+
+def causal_lm_loss(logits, labels, ignore_index: int = -100):
+    """Token-weighted mean CE for causal-LM heads.
+
+    When a mesh with an active "tp" axis is present, computes the loss over
+    VOCAB-SHARDED logits via parallel_cross_entropy — the [b, s, vocab]
+    fp32 logits tensor (the single largest activation at Llama-3's 128K
+    vocab: b*s*128256*4 bytes) is never gathered or upcast whole; each tp
+    shard reduces its vocab slice and psums (reference:
+    c_softmax_with_cross_entropy_op.cu:1, surfaced at
+    fleet/layers/mpu/mp_layers.py:741). Otherwise the dense fp32 path.
+    """
+    from ..parallel.mesh import current_mesh
+    hm = current_mesh()
+    if (hm is not None and hm.axis_size("tp") > 1
+            and logits.shape[-1] % hm.axis_size("tp") == 0):
+        from ..parallel.mp_layers import parallel_cross_entropy
+        nll = parallel_cross_entropy(logits, labels,
+                                     ignore_index=ignore_index)
+        cnt = jnp.sum(labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll) / jnp.maximum(cnt, 1.0)
+    return F.cross_entropy(logits.astype(jnp.float32), labels,
+                           ignore_index=ignore_index)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        d, hd = cfg.hidden_size, cfg.head_dim
+        n_h, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
+        std = cfg.initializer_range
+        # fused QKV: [d, (n_h + 2*n_kv) * hd], column-parallel over tp
+        self.qkv_proj = self.create_parameter(
+            [d, (n_h + 2 * n_kv) * hd], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("fsdp", "tp"))
+        # output proj: row-parallel over tp
+        self.o_proj = self.create_parameter(
+            [n_h * hd, d], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("tp", "fsdp"))
+
+    def _qkv_rope(self, x, cos, sin, position_ids=None):
+        """Fused QKV projection + head split + rotary embedding — shared by
+        every forward/prefill/decode variant (dense and paged)."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
+        q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
+        q = q.reshape(b, s, n_h, hd)
+        k = k.reshape(b, s, n_kv, hd)
+        v = v.reshape(b, s, n_kv, hd)
+        q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, position_ids)
+        return q, k, v
+
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+        cfg = self.cfg
+        b, s, d = x.shape
+        n_h, hd = cfg.num_attention_heads, cfg.head_dim
+        q, k, v = self._qkv_rope(x, cos, sin, position_ids)
+        out = self._sp_attention(q, k, v, attn_mask)
+        if out is None:
+            if cfg.use_flash_attention:
+                out = F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, is_causal=True,
+                    training=self.training)
+            else:
+                from ..ops.attention import _sdpa_xla
+                out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        return jnp.matmul(out, self.o_proj.astype(x.dtype))
+
+    def _sp_attention(self, q, k, v, attn_mask):
+        """Long-context path over the "sep" axis (SURVEY §5): the K/V ring
+        of flash blocks or Ulysses head all-to-all — never a dense [s, s]
+        score tensor. Returns None when sequence parallelism is inactive."""
+        cfg = self.cfg
+        if not cfg.sequence_parallel or attn_mask is not None:
+            return None
+        from ..parallel.mesh import current_mesh
+        hm = current_mesh()
+        if hm is None or hm.axis_size("sep") <= 1:
+            return None
+        if cfg.sp_mode == "ulysses":
+            from ..parallel.ulysses import (ulysses_attention,
+                                            ulysses_supported)
+            if ulysses_supported(cfg.num_attention_heads,
+                                 cfg.num_key_value_heads,
+                                 hm.axis_size("sep")):
+                return ulysses_attention(q, k, v, causal=True)
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, causal=True)
+
+    # -- KV-cache inference paths ------------------------------------------
+
+    def prefill(self, x, cos, sin, max_len: int):
+        """Full-sequence forward that also materializes a dense KV cache
+        [b, max_len, n_kv, hd] holding the prompt's keys/values (inference
+        analogue of the reference's fused multi-transformer prefill)."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        q, k, v = self._qkv_rope(x, cos[:s], sin[:s])
+        from ..ops.attention import _sdpa_xla
+        out = _sdpa_xla(q, k, v, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+        k_cache = jnp.zeros((b, max_len, n_kv, hd), k.dtype).at[:, :s].set(k)
+        v_cache = jnp.zeros((b, max_len, n_kv, hd), v.dtype).at[:, :s].set(v)
+        return out, (k_cache, v_cache)
+
+    def decode(self, x, cos, sin, pos, kv_cache):
+        """One-token step: x [b, 1, d], pos [b] current position; scatters
+        the new k/v into the cache and attends over positions <= pos
+        (dense-cache decode, reference masked_multihead_attention shape)."""
+        cfg = self.cfg
+        b = x.shape[0]
+        n_h, n_kv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        k_cache, v_cache = kv_cache
+        q, k, v = self._qkv_rope(x, cos, sin, pos.reshape(b, 1))
+        b_idx = jnp.arange(b)
+        k_cache = k_cache.at[b_idx, pos].set(k[:, 0])
+        v_cache = v_cache.at[b_idx, pos].set(v[:, 0])
+        if n_kv != n_h:
+            rep = n_h // n_kv
+            k_full = jnp.repeat(k_cache, rep, axis=2)
+            v_full = jnp.repeat(v_cache, rep, axis=2)
+        else:
+            k_full, v_full = k_cache, v_cache
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum("bhd,bthd->bht", q[:, 0].astype(jnp.float32),
+                            k_full.astype(jnp.float32)) * scale
+        t_idx = jnp.arange(k_cache.shape[1])[None, None, :]
+        logits = jnp.where(t_idx <= pos[:, None, None], logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bht,bthd->bhd", p, v_full.astype(jnp.float32))
+        out = out.astype(x.dtype).reshape(b, 1, n_h * hd)
+        return jnp.matmul(out, self.o_proj.astype(x.dtype)), (k_cache, v_cache)
+
+
+    # -- paged-KV (vLLM-style) inference paths ------------------------------
+
+    def prefill_paged(self, x, cos, sin, k_pool, v_pool, tables):
+        """Prompt pass writing K/V into head-major page pools
+        [H_kv, num_pages, page_size, hd] via ``tables`` [b, max_pages]
+        (reference capability: block_multi_head_attention_kernel.cu's
+        prefill write path). Prompt length is padded up to a page multiple
+        inside the pool; padded slots sit beyond seq_len and are never
+        unmasked before being overwritten by decode steps."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        page = k_pool.shape[2]
+        q, k, v = self._qkv_rope(x, cos[:s], sin[:s])
+        from ..ops.attention import _sdpa_xla
+        out = _sdpa_xla(q, k, v, causal=True)
+        out = out.reshape(b, s, n_h * hd)
+        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+
+        np_ = -(-s // page)                       # pages holding the prompt
+        pad = np_ * page - s
+        def scatter(pool, new):
+            padded = jnp.pad(new, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            # [b, np_, page, n_kv, hd] -> [n_kv, b*np_, page, hd]
+            tiles = jnp.transpose(
+                padded.reshape(b, np_, page, n_kv, hd), (3, 0, 1, 2, 4)
+            ).reshape(n_kv, b * np_, page, hd)
+            return pool.at[:, tables[:, :np_].reshape(-1)].set(
+                tiles.astype(pool.dtype))
+        return out, scatter(k_pool, k), scatter(v_pool, v)
+
+    def decode_paged(self, x, cos, sin, pos, k_pool, v_pool, tables):
+        """One-token step over the page pools: writes the new K/V into the
+        page slot for position ``pos`` and attends via the Pallas paged
+        kernel (XLA gather fallback off-TPU)."""
+        from ..ops.pallas.paged_attention import (paged_decode_attention,
+                                                 paged_decode_supported,
+                                                 paged_decode_xla)
+        from ..ops.registry import backend_kind
+        cfg = self.cfg
+        b = x.shape[0]
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        page = k_pool.shape[2]
+        q, k, v = self._qkv_rope(x, cos, sin, pos.reshape(b, 1))
+        b_idx = jnp.arange(b)
+        phys = tables[b_idx, pos // page]          # [b]
+        off = pos % page
+        k_pool = k_pool.at[:, phys, off].set(
+            jnp.swapaxes(k[:, 0], 0, 1).astype(k_pool.dtype))
+        v_pool = v_pool.at[:, phys, off].set(
+            jnp.swapaxes(v[:, 0], 0, 1).astype(v_pool.dtype))
+        q2 = q[:, 0]                               # [b, n_h, hd]
+        if backend_kind() == "tpu" and paged_decode_supported(q2, k_pool):
+            out = paged_decode_attention(q2, k_pool, v_pool, tables, pos)
+        else:
+            out = paged_decode_xla(q2, k_pool, v_pool, tables, pos)
+        out = out.reshape(b, 1, n_h * hd).astype(x.dtype)
+        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
+                k_pool, v_pool)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        d, m = cfg.hidden_size, cfg.intermediate_size
+        std = cfg.initializer_range
+        # fused gate+up: column-parallel; down: row-parallel
+        self.gate_up_proj = self.create_parameter(
+            [d, 2 * m], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("fsdp", "tp"))
+        self.down_proj = self.create_parameter(
+            [m, d], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("tp", "fsdp"))
+
+    def forward(self, x):
+        gu = jnp.matmul(x, self.gate_up_proj.astype(x.dtype))
+        g, u = jnp.split(gu, 2, axis=-1)
+        return jnp.matmul(F.silu(g) * u, self.down_proj.astype(x.dtype))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                          dtype="float32")
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps, dtype="float32")
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids,
+                               attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def prefill(self, x, cos, sin, max_len: int):
+        a, cache = self.self_attn.prefill(self.input_layernorm(x), cos, sin,
+                                          max_len)
+        h = x + a
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
+
+    def decode(self, x, cos, sin, pos, kv_cache):
+        a, cache = self.self_attn.decode(self.input_layernorm(x), cos, sin,
+                                         pos, kv_cache)
+        h = x + a
+        return h + self.mlp(self.post_attention_layernorm(h)), cache
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps, dtype="float32")
+        cos, sin = rope_ops.rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def _seq_shard(self, x):
+        """GSPMD sequence parallelism: constrain activations to be sharded
+        along seq over 'sep' (reference analogue: SegmentParallel sep axis +
+        sequence_parallel_utils scatter/gather, SURVEY.md §5 long-context)."""
+        if not self.cfg.sequence_parallel:
+            return x
+        from ..parallel.mesh import current_mesh
+        from jax.sharding import PartitionSpec, NamedSharding
+        hm = current_mesh()
+        if hm is None or hm.axis_size("sep") <= 1:
+            return x
+        sh = NamedSharding(hm.mesh, PartitionSpec(("dp", "fsdp"), "sep", None))
+        return jax.lax.with_sharding_constraint(x, sh)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = self.rope_cos, self.rope_sin
+        if position_ids is None:
+            # default positions 0..s-1: pre-slice so broadcasting is static
+            s = input_ids.shape[1]
+            cos, sin = cos[:s], sin[:s]
+        x = self._seq_shard(x)
+        if self.cfg.recompute in ("full", "selective"):
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if self.cfg.recompute == "selective" else None)
+            ckpt = jax.checkpoint(
+                lambda layer, h: layer(h, cos, sin, position_ids, attn_mask),
+                static_argnums=(0,), policy=policy)
+            for layer in self.layers:
+                x = self._seq_shard(ckpt(layer, x))
+        else:
+            for layer in self.layers:
+                x = self._seq_shard(layer(x, cos, sin, position_ids, attn_mask))
+        return self.norm(x)
+
+    # -- KV-cache inference paths ------------------------------------------
+
+    def prefill(self, input_ids, max_len: int):
+        """Prompt pass returning (hidden, caches): caches is a list of
+        per-layer (k_cache, v_cache) sized to max_len."""
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        caches = []
+        for layer in self.layers:
+            x, cache = layer.prefill(x, self.rope_cos, self.rope_sin, max_len)
+            caches.append(cache)
+        return self.norm(x), caches
+
+    def decode_step(self, token_ids, pos, caches):
+        """token_ids [b] → (hidden [b, 1, d], caches) one position forward."""
+        x = jnp.take(self.embed_tokens, token_ids[:, None], axis=0)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer.decode(x, self.rope_cos, self.rope_sin, pos, cache)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
+    # -- paged-KV (vLLM-style) inference paths ------------------------------
+
+    def alloc_paged_caches(self, batch: int, max_len: int,
+                           page_size: int = 128):
+        """Per-layer head-major page pools + the shared block table.
+        Pages are assigned contiguously per sequence (the allocator is the
+        caller's concern at serving scale; reference:
+        block_multi_head_attention's table-driven pool)."""
+        cfg = self.cfg
+        pages_per_seq = -(-max_len // page_size)
+        num_pages = batch * pages_per_seq
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        pools = [
+            (jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
+                        cfg.head_dim), dt),
+             jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
+                        cfg.head_dim), dt))
+            for _ in range(cfg.num_hidden_layers)]
+        tables = jnp.arange(num_pages, dtype=jnp.int32).reshape(
+            batch, pages_per_seq)
+        return pools, tables
+
+    def prefill_paged(self, input_ids, pools, tables):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.prefill_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
+
+    def decode_step_paged(self, token_ids, pos, pools, tables):
+        x = jnp.take(self.embed_tokens, token_ids[:, None], axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.decode_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                pos, kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = self.create_parameter(
+                [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
+                initializer=_normal(cfg.initializer_range),
+                sharding=("fsdp", "tp"))
+        else:
+            self.add_parameter("lm_head", None)
+
+    def logits(self, hidden):
+        w = (jnp.swapaxes(self.model.embed_tokens, 0, 1)
+             if self.cfg.tie_word_embeddings else self.lm_head)
+        return jnp.matmul(hidden, w.astype(hidden.dtype))
+
+    def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
+        hidden = self.model(input_ids, position_ids, attn_mask)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = causal_lm_loss(logits, labels)
+        return loss, logits
+
+    # -- size accounting (MFU calculator input) -----------------------------
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape)) for _, p in self.named_parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Model fwd+bwd FLOPs per token (PaLM appendix-B convention:
+        6*N_matmul + attention term 12*L*H*Q*T). The embedding gather is not
+        a matmul, so the table is excluded from N unless tied (tied weights
+        ARE the lm_head matmul). Reference analogue:
+        python/paddle/utils/flops.py per-op tables."""
+        cfg = self.cfg
+        n = self.num_params()
+        if not cfg.tie_word_embeddings:
+            n -= cfg.vocab_size * cfg.hidden_size  # gather-only table
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn
+
+
+class LlamaForCausalLMPipe(nn.Layer):
+    """Pipeline-parallel Llama.
+
+    Reference analogue: PaddleNLP's ``LlamaForCausalLMPipe`` built on the
+    fleet PipelineLayer/LayerDesc machinery (reference:
+    fleet/meta_parallel/parallel_layers/pp_layers.py:237 + 1F1B runtime
+    pipeline_parallel.py:440). TPU redesign: the decoder body is a
+    ``PipelineStack`` — stage-stacked weights sharded over the "pp" mesh
+    axis, microbatches advanced by XLA CollectivePermute (see
+    parallel/pipeline.py); embedding / final norm / lm_head run
+    GSPMD-replicated over "pp", which expresses the reference's
+    SharedLayerDesc embedding tie with zero extra machinery.
+    """
+
+    def __init__(self, cfg: LlamaConfig, num_stages: int = 1,
+                 num_microbatches: int = 1, pp_schedule: str = "gpipe",
+                 num_chunks: int = 1):
+        super().__init__()
+        from ..parallel.pipeline import PipelineStack
+        if pp_schedule not in PipelineStack.SCHEDULES:
+            raise ValueError(f"pp_schedule must be one of "
+                             f"{PipelineStack.SCHEDULES}, got {pp_schedule!r}")
+        self.cfg = cfg
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.pp_schedule = pp_schedule
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
+        self.decoder = PipelineStack(lambda: LlamaDecoderLayer(cfg),
+                                     num_layers=cfg.num_hidden_layers,
+                                     num_stages=num_stages,
+                                     num_microbatches=num_microbatches,
+                                     remat=(cfg.recompute == "full"),
+                                     schedule=("interleaved"
+                                               if pp_schedule == "interleaved"
+                                               else "gpipe"),
+                                     num_chunks=num_chunks)
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps, dtype="float32")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = self.create_parameter(
+                [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
+                initializer=_normal(cfg.initializer_range),
+                sharding=("fsdp", "tp"))
+        else:
+            self.add_parameter("lm_head", None)
+        cos, sin = rope_ops.rope_freqs(cfg.head_dim, cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = self.rope_cos[:s], self.rope_sin[:s]
+        x = self.decoder(x, cos, sin)
+        hidden = self.norm(x)
+        w = (jnp.swapaxes(self.embed_tokens, 0, 1)
+             if cfg.tie_word_embeddings else self.lm_head)
+        logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+        if labels is None:
+            return logits
+        loss = causal_lm_loss(logits, labels)
+        return loss, logits
+
+    def loss_and_grads(self, params, input_ids, labels):
+        """Fused 1F1B forward+backward over the pipeline (reference:
+        pipeline_parallel.py:440 forward_backward_pipeline). Returns
+        (mean_loss, grads) with grads matching ``params``' tree exactly —
+        the Trainer uses this in place of jax.value_and_grad when
+        pp_schedule == "1f1b", giving the 1F1B activation profile
+        (ring of <= 2*num_stages-1 microbatch inputs per stage instead of
+        all num_microbatches)."""
+        from ..parallel.pipeline import microbatch, unmicrobatch
+        from ..parallel.schedules import pipeline_1f1b
+        cfg = self.cfg
+        M, S = self.num_microbatches, self.num_stages
+        s_len = input_ids.shape[1]
+        cos, sin = self.rope_cos[:s_len], self.rope_sin[:s_len]
+        tied = cfg.tie_word_embeddings
+
+        prefix = "decoder.stack__"
+        stacked = {leaf: params[prefix + leaf.replace(".", "__")]
+                   for leaf in self.decoder._leaf_names}
+        staged = self.decoder.stage_trees(stacked)
+
+        head_params = {"norm_w": params["norm.weight"]}
+        if tied:
+            head_params["embed"] = params["embed_tokens"]
+        else:
+            head_params["lm_head"] = params["lm_head"]
+
+        def embed_fn(table):
+            return jnp.take(table, input_ids, axis=0)
+        x, embed_vjp = jax.vjp(embed_fn, params["embed_tokens"])
+        x_mb = microbatch(x, M)
+        t_mb = microbatch(labels, M)
+
+        stage = self.decoder.stage_fn(cos, sin)
+
+        def loss_head_fn(hp, h, tgt):
+            hidden = F.rms_norm(h, hp["norm_w"], cfg.rms_norm_eps)
+            w = (jnp.swapaxes(hp["embed"], 0, 1) if tied else hp["lm_head"])
+            logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+            # (token-summed loss, valid count): pipeline_1f1b normalizes by
+            # the GLOBAL count so unevenly-padded microbatches reproduce the
+            # unpipelined token-weighted mean exactly. causal_lm_loss keeps
+            # tp-sharded vocab un-gathered (parallel CE) when tp is active.
+            mean = causal_lm_loss(logits, tgt)
+            cnt = jnp.sum(tgt != -100).astype(jnp.float32)
+            return mean * jnp.maximum(cnt, 1.0), cnt
+
+        loss, g_stack, g_head, dx = pipeline_1f1b(
+            stage, staged, x_mb, t_mb, loss_head_fn, head_params,
+            num_stages=S, remat=self.decoder.remat, return_dx=True,
+            weighted_loss=True)
+
+        (d_emb_in,) = embed_vjp(unmicrobatch(dx).astype(x.dtype))
+        grads = {}
+        for leaf in self.decoder._leaf_names:
+            key = prefix + leaf.replace(".", "__")
+            grads[key] = g_stack[leaf].reshape(params[key].shape)
+        grads["embed_tokens"] = (g_head["embed"] + d_emb_in if tied
+                                 else d_emb_in)
+        grads["norm.weight"] = g_head["norm_w"]
+        if not tied:
+            grads["lm_head"] = g_head["lm_head"]
+        grads = {k: grads[k] for k in params}  # preserve tree order
+        return loss, grads
+
+    def load_from_unpipelined(self, model: "LlamaForCausalLM") -> None:
+        """Copy weights from a LlamaForCausalLM (stacking per-layer params) —
+        the Pipe-partition converter (reference analogue:
+        fleet/utils/pp_parallel_adaptor.py)."""
+        cfg = self.cfg
+        own = dict(self.named_parameters())
+        own["embed_tokens"].value = model.model.embed_tokens
+        self.norm.set_state_dict(model.model.norm.state_dict())
+        if not cfg.tie_word_embeddings:
+            own["lm_head"].value = model.lm_head
+        src = dict(model.named_parameters())
+        for leaf in self.decoder._leaf_names:
+            stacked = jnp.stack(
+                [src[f"model.layers.{i}.{leaf}"].value
+                 for i in range(cfg.num_hidden_layers)])
+            pname = "decoder.stack__" + leaf.replace(".", "__")
+            own[pname].value = self.decoder.pack_leaf(stacked)

@@ -1,0 +1,251 @@
+"""MoE causal language models: DeepSeekMoE / Qwen2-MoE family.
+
+Capability target (BASELINE.json configs): DeepSeekMoE, Qwen2-MoE.
+Reference substrate: the incubate MoE layer + global_scatter/gather
+(python/paddle/incubate/distributed/models/moe/moe_layer.py:263;
+SURVEY.md A.2) — the model classes themselves live in PaddleNLP, so this
+module defines the architecture from the published papers' shapes:
+
+- DeepSeekMoE: fine-grained routed experts + ALWAYS-on shared experts whose
+  output adds to the routed combine; first `first_k_dense_replace` layers
+  stay dense.
+- Qwen2-MoE: same skeleton (shared_expert + routed), top-4 routing, with a
+  sigmoid shared-expert gate.
+
+TPU-first: reuses LlamaAttention (fused QKV, flash attention) and the
+dense-layout MoE block (one batched einsum on the MXU; all-to-all dispatch
+appears from GSPMD sharding — parallel/moe.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops import rope as rope_ops
+from ..parallel.moe import MoELayer
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP, _normal
+
+
+@dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632          # dense-MLP size
+    moe_intermediate_size: int = 1408      # per-expert FFN size
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 16
+    num_experts_per_tok: int = 4
+    num_shared_experts: int = 1            # DeepSeekMoE shared experts
+    first_k_dense_replace: int = 1         # first k layers dense
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    shared_expert_gate: bool = False       # Qwen2-MoE sigmoid gate
+    dtype: str = "float32"
+    recompute: str = "none"
+    sequence_parallel: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def _as_llama(self) -> LlamaConfig:
+        """Attention/MLP sublayers are config-compatible with Llama's."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range,
+            use_flash_attention=self.use_flash_attention, dtype=self.dtype)
+
+    @staticmethod
+    def deepseek_moe_16b(**kw) -> "MoEConfig":
+        return MoEConfig(vocab_size=102400, hidden_size=2048,
+                         intermediate_size=10944, moe_intermediate_size=1408,
+                         num_hidden_layers=28, num_attention_heads=16,
+                         num_key_value_heads=16, num_experts=64,
+                         num_experts_per_tok=6, num_shared_experts=2,
+                         first_k_dense_replace=1, **kw)
+
+    @staticmethod
+    def qwen2_moe_a14b(**kw) -> "MoEConfig":
+        return MoEConfig(vocab_size=151936, hidden_size=3584,
+                         intermediate_size=18944, moe_intermediate_size=2560,
+                         num_hidden_layers=28, num_attention_heads=28,
+                         num_key_value_heads=4, num_experts=64,
+                         num_experts_per_tok=8, num_shared_experts=1,
+                         first_k_dense_replace=0, shared_expert_gate=True,
+                         **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "MoEConfig":
+        return MoEConfig(vocab_size=512, hidden_size=128,
+                         intermediate_size=256, moe_intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, num_shared_experts=1,
+                         first_k_dense_replace=1,
+                         max_position_embeddings=256, **kw)
+
+
+class SharedExpertMLP(nn.Layer):
+    """DeepSeekMoE's always-on shared expert(s): one SwiGLU MLP of width
+    num_shared * moe_ffn; Qwen2-MoE adds a sigmoid gate on its output."""
+
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        width = cfg.num_shared_experts * cfg.moe_intermediate_size
+        d = cfg.hidden_size
+        std = cfg.initializer_range
+        self.gate_up_proj = self.create_parameter(
+            [d, 2 * width], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("fsdp", "tp"))
+        self.down_proj = self.create_parameter(
+            [width, d], dtype=cfg.dtype, initializer=_normal(std),
+            sharding=("tp", "fsdp"))
+        if cfg.shared_expert_gate:
+            self.gate = self.create_parameter([d, 1], dtype="float32",
+                                              initializer=_normal(std))
+        else:
+            self.add_parameter("gate", None)
+
+    def forward(self, x):
+        gu = jnp.matmul(x, self.gate_up_proj.astype(x.dtype))
+        g, u = jnp.split(gu, 2, axis=-1)
+        out = jnp.matmul(F.silu(g) * u, self.down_proj.astype(x.dtype))
+        if self.cfg.shared_expert_gate:
+            gate = jax.nn.sigmoid(
+                jnp.matmul(x.astype(jnp.float32), self.gate))
+            out = out * gate.astype(out.dtype)
+        return out
+
+
+class MoEDecoderLayer(nn.Layer):
+    def __init__(self, cfg: MoEConfig, dense: bool = False):
+        super().__init__()
+        self.cfg = cfg
+        self.dense = dense
+        lcfg = cfg._as_llama()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                          dtype="float32")
+        self.self_attn = LlamaAttention(lcfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps,
+                                                   dtype="float32")
+        if dense:
+            self.mlp = LlamaMLP(lcfg)
+            self.add_sublayer("moe", None)
+            self.add_sublayer("shared_experts", None)
+        else:
+            self.add_sublayer("mlp", None)
+            self.moe = MoELayer(cfg.hidden_size, cfg.moe_intermediate_size,
+                                cfg.num_experts, top_k=cfg.num_experts_per_tok,
+                                capacity_factor=cfg.capacity_factor,
+                                dtype=cfg.dtype)
+            if cfg.num_shared_experts > 0:
+                self.shared_experts = SharedExpertMLP(cfg)
+            else:
+                self.add_sublayer("shared_experts", None)
+
+    def forward(self, x, cos, sin):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        z = self.post_attention_layernorm(h)
+        if self.dense:
+            return h + self.mlp(z), jnp.zeros((), jnp.float32)
+        routed, aux = self.moe(z)
+        if self.shared_experts is not None:
+            routed = routed + self.shared_experts(z)
+        return h + routed, aux
+
+
+class MoEForCausalLM(nn.Layer):
+    """DeepSeekMoE/Qwen2-MoE-style causal LM. forward returns
+    (loss, logits) with labels (loss = CE + aux_weight * load-balance aux),
+    logits otherwise."""
+
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = self.create_parameter(
+            [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=("tp", "fsdp"))
+        self.layers = nn.LayerList([
+            MoEDecoderLayer(cfg, dense=(i < cfg.first_k_dense_replace))
+            for i in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                               dtype="float32")
+        self.lm_head = self.create_parameter(
+            [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range),
+            sharding=("fsdp", "tp"))
+        cos, sin = rope_ops.rope_freqs(cfg.head_dim,
+                                       cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.cfg
+        s = input_ids.shape[1]
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        cos, sin = self.rope_cos[:s], self.rope_sin[:s]
+        aux_total = jnp.zeros((), jnp.float32)
+        if cfg.recompute == "full":
+            def run(layer, h):
+                return layer(h, cos, sin)
+            ckpt = jax.checkpoint(run, static_argnums=(0,))
+            for layer in self.layers:
+                x, aux = ckpt(layer, x)
+                aux_total = aux_total + aux
+        else:
+            for layer in self.layers:
+                x, aux = layer(x, cos, sin)
+                aux_total = aux_total + aux
+        hidden = self.norm(x)
+        logits = jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
+        if labels is None:
+            return logits
+        from .llama import causal_lm_loss
+        # vocab-parallel CE when tp is active (no gathered fp32 logits)
+        ce = causal_lm_loss(logits, labels)
+        loss = ce + cfg.aux_loss_weight * aux_total
+        return loss, logits
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape)) for _, p in self.named_parameters())
+
+    def num_activated_params(self) -> int:
+        """Per-token active params (MoE MFU accounting: only top_k experts +
+        shared experts + attention/dense count toward achieved FLOPs)."""
+        cfg = self.cfg
+        total = self.num_params()
+        per_expert = 3 * cfg.hidden_size * cfg.moe_intermediate_size
+        n_moe_layers = cfg.num_hidden_layers - cfg.first_k_dense_replace
+        inactive = (cfg.num_experts - cfg.num_experts_per_tok) * per_expert
+        return total - n_moe_layers * inactive
+
+    def flops_per_token(self, seq_len: int) -> float:
+        cfg = self.cfg
+        n = self.num_activated_params()
+        n -= cfg.vocab_size * cfg.hidden_size  # embedding gather
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        return 6 * n + attn

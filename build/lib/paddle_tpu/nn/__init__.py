@@ -1,0 +1,54 @@
+"""paddle_tpu.nn — module system + layers.
+
+Reference: python/paddle/nn/ (Layer base at nn/layer/layers.py; layer zoo
+under nn/layer/). See layer.py for the functional-bridge design that replaces
+the eager autograd engine.
+"""
+
+from . import functional
+from . import initializer
+from .layer import (Layer, Parameter, Buffer, Sequential, LayerList, LayerDict,
+                    set_default_dtype, get_default_dtype)
+from .common import (
+    Linear, Embedding, Dropout, LayerNorm, RMSNorm, BatchNorm, BatchNorm1D,
+    BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    GroupNorm, Conv1D, Conv2D, Conv3D, Conv2DTranspose, PixelShuffle, MaxPool2D, AvgPool2D, AdaptiveAvgPool2D,
+    Flatten, ReLU, GELU, SiLU, Sigmoid, Tanh, Softmax, LeakyReLU, Hardswish,
+    Hardsigmoid, Mish, CrossEntropyLoss, MSELoss, L1Loss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, NLLLoss,
+)
+
+from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN,
+                  LSTM, GRU)
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)
+
+# -- round-3 parity batch: activation/pool/loss/container long tail ---------
+from .layers_extras import (
+    Identity, CELU, ELU, GLU, Hardshrink, Hardtanh, LogSigmoid, LogSoftmax,
+    Maxout, ReLU6, SELU, Silu, Softplus, Softshrink, Softsign, Swish,
+    Tanhshrink, ThresholdedReLU, Softmax2D, PReLU, RReLU,
+    AvgPool1D, AvgPool3D, MaxPool1D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, ChannelShuffle, PixelUnshuffle,
+    Unflatten, Fold, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
+    AlphaDropout, Dropout2D, Dropout3D,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+    SpectralNorm, CosineSimilarity, PairwiseDistance, Bilinear,
+    ParameterList, Conv1DTranspose, Conv3DTranspose,
+    BCELoss, CosineEmbeddingLoss, HingeEmbeddingLoss, MarginRankingLoss,
+    PoissonNLLLoss, GaussianNLLLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, SoftMarginLoss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss, CTCLoss, RNNTLoss, HSigmoidLoss,
+    BiRNN, RNNCellBase, BeamSearchDecoder, dynamic_decode,
+)
+from ..optimizer.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                              ClipGradByValue)
+from . import utils
+from . import clip
+from . import decode
+from . import quant

@@ -1,0 +1,8 @@
+"""paddle.nn.clip module-path parity: the gradient-clip classes live in
+optimizer/clip.py (one implementation, shared by the optimizer plumbing);
+this module mirrors the reference import path python/paddle/nn/clip.py."""
+
+from ..optimizer.clip import (ClipGradBase, ClipGradByGlobalNorm,
+                              ClipGradByNorm, ClipGradByValue)
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]

@@ -1,0 +1,524 @@
+"""Common layers.
+
+Reference: python/paddle/nn/layer/{common.py,norm.py,conv.py,transformer.py,
+activation.py}. Weight layouts follow the reference: Linear weight is
+[in_features, out_features]; Conv2D weight is [out_c, in_c/groups, kh, kw].
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter, Buffer, get_default_dtype
+
+
+class Linear(Layer):
+    def __init__(self, in_features: int, out_features: int, bias_attr=True,
+                 weight_attr=None, name=None, dtype=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        # None -> create_parameter's chain: global initializer if set
+        # (set_global_initializer), else XavierUniform
+        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else None
+        self.weight = self.create_parameter([in_features, out_features],
+                                            dtype=dtype, initializer=init_w)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_features], dtype=dtype, is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, dtype=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        init_w = weight_attr if isinstance(weight_attr, I.Initializer) else None
+        self.weight = self.create_parameter([num_embeddings, embedding_dim],
+                                            default_initializer=I.Normal(0.0, 1.0),
+                                            dtype=dtype, initializer=init_w)
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train",
+                 rng_name: str = "global_seed"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+        self.rng_name = rng_name
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode,
+                         rng_name=self.rng_name)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=True, bias_attr=True, dtype=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(self.normalized_shape, dtype=dtype,
+                                                initializer=I.Constant(1.0))
+        else:
+            self.add_parameter("weight", None)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(self.normalized_shape, dtype=dtype,
+                                              is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    """Reference analogue: paddle.incubate.nn.functional.fused_rms_norm
+    wrapped as a layer (used by Llama/ERNIE blocks)."""
+
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6, dtype=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], dtype=dtype,
+                                            initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=True, bias_attr=True,
+                 data_format: str = "NCHW", dtype=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter([num_features], dtype=dtype,
+                                                initializer=I.Constant(1.0))
+        else:
+            self.add_parameter("weight", None)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_features], dtype=dtype, is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+        self.register_buffer("_mean", jnp.zeros([num_features], jnp.float32))
+        self.register_buffer("_variance", jnp.ones([num_features], jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            out, new_mean, new_var = F.batch_norm(
+                x, self._mean, self._variance, self.weight, self.bias,
+                training=True, momentum=self.momentum, epsilon=self.epsilon,
+                data_format=self.data_format)
+            # NOTE: buffer updates are side effects; under the functional
+            # bridge these persist only outside jit/grad traces — storing a
+            # tracer would leak it into later calls (trainer carries BN
+            # stats through state instead).
+            import jax as _jax
+            if not isinstance(new_mean, _jax.core.Tracer):
+                self._buffers["_mean"].value = new_mean
+                self._buffers["_variance"].value = new_var
+            return out
+        return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                            training=False, epsilon=self.epsilon,
+                            data_format=self.data_format)
+
+
+BatchNorm = BatchNorm2D
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int, epsilon: float = 1e-5,
+                 weight_attr=True, bias_attr=True, data_format: str = "NCHW",
+                 dtype=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter([num_channels], dtype=dtype,
+                                                initializer=I.Constant(1.0))
+        else:
+            self.add_parameter("weight", None)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_channels], dtype=dtype, is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias,
+                            self.epsilon, self.data_format)
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCHW", dtype=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], dtype=dtype,
+            default_initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCHW", dtype=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.output_padding = output_padding
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, k[0], k[1]], dtype=dtype,
+            default_initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels], dtype=dtype, is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding, self.dilation,
+                                  self.groups, self.data_format)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        from ..tensor import flatten as _flatten
+        return _flatten(x, self.start_axis, self.stop_axis)
+
+
+# activation layers ---------------------------------------------------------
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate: bool = False):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class SiLU(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Hardswish(Layer):
+    def forward(self, x):
+        return F.hardswish(x)
+
+
+class Hardsigmoid(Layer):
+    def forward(self, x):
+        return F.hardsigmoid(x)
+
+
+class Mish(Layer):
+    def forward(self, x):
+        return F.mish(x)
+
+
+# losses --------------------------------------------------------------------
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100, reduction: str = "mean",
+                 soft_label: bool = False, label_smoothing: float = 0.0):
+        super().__init__()
+        self.loss_weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.label_smoothing = label_smoothing
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits, labels, weight=self.loss_weight,
+                               ignore_index=self.ignore_index,
+                               reduction=self.reduction, soft_label=self.soft_label,
+                               label_smoothing=self.label_smoothing)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean", pos_weight=None):
+        super().__init__()
+        self.loss_weight = weight
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(
+            logit, label, self.loss_weight, self.reduction, self.pos_weight)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100, reduction: str = "mean"):
+        super().__init__()
+        self.loss_weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels):
+        return F.nll_loss(log_probs, labels, self.loss_weight, self.ignore_index,
+                          self.reduction)
+
+
+class BatchNorm1D(BatchNorm2D):
+    """BN over [N, C] or [N, C, L] (reference: nn.BatchNorm1D). The shared
+    functional core normalizes over all non-channel dims, so only the
+    accepted ranks differ from 2D."""
+
+    def forward(self, x):
+        if x.ndim not in (2, 3):
+            raise ValueError(f"BatchNorm1D expects rank 2 or 3, got {x.ndim}")
+        return super().forward(x)
+
+
+class BatchNorm3D(BatchNorm2D):
+    def forward(self, x):
+        if x.ndim != 5:
+            raise ValueError(f"BatchNorm3D expects rank 5, got {x.ndim}")
+        return super().forward(x)
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica BN (reference: nn.SyncBatchNorm backed by collective
+    kernels). Under GSPMD the batch axis is sharded and XLA computes the
+    jnp.mean/var reductions over the *global* batch automatically, so the
+    plain BN math is already synchronized; kept as a distinct class for
+    convert_sync_batchnorm parity.
+
+    reference: python/paddle/nn/layer/norm.py SyncBatchNorm
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively swap BatchNorm*D sublayers for SyncBatchNorm."""
+        if isinstance(layer, BatchNorm2D) and not isinstance(layer, SyncBatchNorm):
+            new = cls(layer.num_features, momentum=layer.momentum,
+                      epsilon=layer.epsilon, data_format=layer.data_format)
+            if layer.weight is not None:
+                new.weight.value = layer.weight.value
+            if layer.bias is not None:
+                new.bias.value = layer.bias.value
+            new._buffers["_mean"].value = layer._buffers["_mean"].value
+            new._buffers["_variance"].value = layer._buffers["_variance"].value
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class Conv1D(Layer):
+    """reference: nn.Conv1D (weight [out, in/groups, k])."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCL", dtype=None):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k], dtype=dtype,
+            default_initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(Layer):
+    """reference: nn.Conv3D (weight [out, in/groups, kd, kh, kw])."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias_attr=True, data_format: str = "NCDHW", dtype=None):
+        super().__init__()
+        k = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.data_format = data_format
+        fan_in = in_channels * k[0] * k[1] * k[2] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k], dtype=dtype,
+            default_initializer=I.KaimingUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], dtype=dtype, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)

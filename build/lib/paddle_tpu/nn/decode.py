@@ -1,0 +1,7 @@
+"""paddle.nn.decode module-path parity (python/paddle/nn/decode.py):
+BeamSearchDecoder/dynamic_decode are implemented with the RNN family in
+nn/layers_extras.py; re-exported here under the reference path."""
+
+from .layers_extras import BeamSearchDecoder, dynamic_decode
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]

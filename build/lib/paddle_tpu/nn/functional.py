@@ -1,0 +1,720 @@
+"""Functional ops: ``paddle_tpu.nn.functional``.
+
+TPU-native rebuild of the reference functional surface
+(reference: python/paddle/nn/functional/ — activation.py, common.py, conv.py,
+norm.py, loss.py, pooling.py, flash_attention.py). Everything here is a
+jnp/lax composition XLA can fuse; the hot fused kernels (flash attention,
+fused rms/layer norm, rope) dispatch through paddle_tpu.ops which selects a
+Pallas TPU kernel when available (reference analogues:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu,
+paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu,
+fusion/gpu/fused_rope_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.rng import rng_tracker, GLOBAL_STREAM, LOCAL_STREAM
+
+# ---------------------------------------------------------------------------
+# activations (reference: python/paddle/nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jnp.where(x >= 0, x, x * negative_slope)
+
+
+def elu(x, alpha: float = 1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def gelu(x, approximate: bool = False):
+    if approximate:
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.gelu(x, approximate=False)
+
+
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x, slope: float = 1.0 / 6.0, offset: float = 0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """SwiGLU used by Llama-style MLPs (reference:
+    python/paddle/incubate/nn/functional/swiglu — fused op)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return silu(x) * y
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding (reference: functional/common.py, functional/input.py)
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """x @ weight (+ bias). Weight layout [in, out], matching the reference
+    (python/paddle/nn/functional/common.py:linear)."""
+    from ..amp.auto_cast import maybe_cast_inputs
+    x, weight, bias = maybe_cast_inputs("linear", x, weight, bias)
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(ids, weight, padding_idx: Optional[int] = None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+def one_hot(x, num_classes: int, dtype=jnp.float32):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference: functional/common.py:dropout; RNG semantics follow
+# fleet/layers/mpu/random.py — "local" stream for TP regions)
+# ---------------------------------------------------------------------------
+
+def dropout(x, p: float = 0.5, axis=None, training: bool = True,
+            mode: str = "upscale_in_train", rng_name: str = GLOBAL_STREAM):
+    """``axis`` (reference: functional/common.py dropout): the mask is
+    drawn only along the listed axes and broadcast over the rest (e.g.
+    axis=0 drops whole rows). ``downscale_in_infer`` keeps train outputs
+    unscaled and multiplies by (1-p) at inference."""
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"mode must be 'upscale_in_train'|"
+                         f"'downscale_in_infer', got {mode!r}")
+    keep = 1.0 - p
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return (x * keep).astype(x.dtype)
+        return x
+    key = rng_tracker().next_key(rng_name)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(a + x.ndim if a < 0 else a for a in axes)
+        if any(a < 0 or a >= x.ndim for a in axes):
+            raise ValueError(f"dropout axis {axis} out of range for "
+                             f"rank-{x.ndim} input")
+        mask_shape = tuple(s if i in axes else 1
+                           for i, s in enumerate(x.shape))
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference: functional/norm.py + fused kernels under
+# paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu)
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon: float = 1e-5):
+    from ..ops import norm as _norm_ops
+    return _norm_ops.layer_norm(x, weight, bias, epsilon)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    from ..ops import norm as _norm_ops
+    return _norm_ops.rms_norm(x, weight, epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9, epsilon: float = 1e-5,
+               data_format: str = "NCHW"):
+    """Inference-style batch norm over N(+spatial) dims. Returns
+    (out, new_mean, new_var) when training so the Layer can update buffers."""
+    axis = 1 if data_format == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    if training:
+        mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    xn = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        xn = xn * weight.reshape(shape)
+    if bias is not None:
+        xn = xn + bias.reshape(shape)
+    xn = xn.astype(x.dtype)
+    if training:
+        return xn, new_mean.astype(running_mean.dtype), new_var.astype(running_var.dtype)
+    return xn
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None, epsilon: float = 1e-5,
+               data_format: str = "NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, num_groups, c // num_groups, *spatial).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + epsilon)
+    out = xg.reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    out = out.astype(x.dtype)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling (reference: functional/conv.py, functional/pooling.py —
+# these map directly onto lax.conv_general_dilated / reduce_window which XLA
+# tiles onto the MXU)
+# ---------------------------------------------------------------------------
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1,
+           data_format: str = "NCHW"):
+    """weight layout [out_c, in_c/groups, kh, kw] (reference conv2d layout)."""
+    from ..amp.auto_cast import maybe_cast_inputs
+    x, weight, bias = maybe_cast_inputs("conv2d", x, weight, bias)
+    stride = _norm_tuple(stride, 2)
+    dilation = _norm_tuple(dilation, 2)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, 2)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+                                    else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1,
+           data_format: str = "NCL"):
+    if data_format == "NLC":
+        x = jnp.swapaxes(x, 1, 2)
+    x4 = x[..., None]  # NCL -> NCL1
+    w4 = weight[..., None]
+    s = _norm_tuple(stride, 1)
+    d = _norm_tuple(dilation, 1)
+    p = padding if isinstance(padding, str) else _norm_tuple(padding, 1)
+    pad2 = p if isinstance(p, str) else (p[0], 0)
+    out = conv2d(x4, w4, bias, stride=(s[0], 1), padding=pad2, dilation=(d[0], 1),
+                 groups=groups, data_format="NCHW")[..., 0]
+    if data_format == "NLC":
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups: int = 1, data_format: str = "NCHW"):
+    """weight layout [in_c, out_c/groups, kh, kw] (reference layout)."""
+    stride = _norm_tuple(stride, 2)
+    dilation = _norm_tuple(dilation, 2)
+    p = _norm_tuple(padding, 2)
+    op = _norm_tuple(output_padding, 2)
+    kh, kw = weight.shape[2], weight.shape[3]
+    # transposed conv = lhs-dilated conv with flipped kernel
+    pad = [
+        (dilation[0] * (kh - 1) - p[0], dilation[0] * (kh - 1) - p[0] + op[0]),
+        (dilation[1] * (kw - 1) - p[1], dilation[1] * (kw - 1) - p[1] + op[1]),
+    ]
+    w = jnp.flip(weight, axis=(2, 3))
+    if groups > 1:
+        ic, ocg = weight.shape[0], weight.shape[1]
+        w = w.reshape(groups, ic // groups, ocg, kh, kw)
+        w = jnp.moveaxis(w, 2, 1).reshape(groups * ocg, ic // groups, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+                                    else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW"):
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    p = _norm_tuple(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW",
+               exclusive: bool = True):
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    p = _norm_tuple(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window, strides, pads)
+    if exclusive and (p[0] or p[1]):
+        ones = jnp.ones(x.shape, jnp.float32)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        out = summed / counts
+    else:
+        out = summed / (k[0] * k[1])
+    return out.astype(x.dtype)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
+    out_hw = _norm_tuple(output_size, 2)
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
+        k = (h // out_hw[0], w // out_hw[1])
+        return avg_pool2d(x, k, stride=k, padding=0, data_format=data_format)
+    # general case: mean over computed bins (rare; small outputs)
+    axis_h = 2 if data_format == "NCHW" else 1
+    outs = []
+    for i in range(out_hw[0]):
+        h0, h1 = (i * h) // out_hw[0], -(-((i + 1) * h) // out_hw[0])
+        row = []
+        for j in range(out_hw[1]):
+            w0, w1 = (j * w) // out_hw[1], -(-((j + 1) * w) // out_hw[1])
+            sl = [slice(None)] * x.ndim
+            sl[axis_h] = slice(h0, h1)
+            sl[axis_h + 1] = slice(w0, w1)
+            row.append(jnp.mean(x[tuple(sl)], axis=(axis_h, axis_h + 1)))
+        outs.append(jnp.stack(row, axis=-1))
+    out = jnp.stack(outs, axis=-2)
+    if data_format == "NCHW":
+        return out
+    return jnp.moveaxis(out, 1, -1)
+
+
+def pad(x, paddings, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW"):
+    """paddings: flat [before,after] pairs for the trailing dims (paddle
+    convention for conv-style pads) or full per-dim list of pairs."""
+    if isinstance(paddings[0], (list, tuple)):
+        cfg = [tuple(p) for p in paddings]
+    else:
+        # flat [left,right,(top,bottom,...)] pairs apply to the spatial dims,
+        # last spatial dim first (paddle convention: [W, H, D] order)
+        n_spec = len(paddings) // 2
+        pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(n_spec)]
+        cfg = [(0, 0)] * x.ndim
+        if n_spec == x.ndim:
+            # full-rank flat list: pads first dim → last dim (paddle constant
+            # mode with len(pad) == 2*ndim)
+            cfg = pairs
+        else:
+            if x.ndim >= 3 and data_format.startswith("NC"):  # NCL/NCHW/NCDHW
+                spatial_dims = list(range(2, x.ndim))
+            elif x.ndim >= 3:                                 # NLC/NHWC/NDHWC
+                spatial_dims = list(range(1, x.ndim - 1))
+            else:  # low-rank tensors: pad trailing dims, last dim first
+                spatial_dims = list(range(x.ndim))
+            for i, dim in enumerate(reversed(spatial_dims[-n_spec:])):
+                cfg[dim] = pairs[i]
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: bool = False, data_format: str = "NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = _norm_tuple(scale_factor, 2)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = _norm_tuple(size, 2)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+    if data_format == "NCHW":
+        out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    else:
+        out = jax.image.resize(x, (n, size[0], size[1], c), method=method)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(logits, labels, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  label_smoothing: float = 0.0, axis: int = -1):
+    """Softmax cross entropy, computed in fp32 with the max-subtraction trick
+    (reference: c_softmax_with_cross_entropy / softmax_with_cross_entropy
+    kernels, paddle/phi/kernels/funcs/cross_entropy.cu)."""
+    logits = logits.astype(jnp.float32)
+    if axis != -1 and axis != logits.ndim - 1:
+        logits = jnp.moveaxis(logits, axis, -1)
+    n_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        target = labels.astype(jnp.float32)
+        loss = -jnp.sum(target * logp, axis=-1)
+        return _reduce(loss, reduction)
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == logits.ndim:  # [..., 1] style
+        labels = labels.squeeze(-1)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1).squeeze(-1)
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(logp, axis=-1)
+        nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+    if weight is not None:
+        w = jnp.take(weight, safe_labels)
+        nll = nll * w
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        if weight is not None:
+            denom = jnp.maximum(jnp.sum(jnp.where(valid, jnp.take(weight, safe_labels), 0.0)), 1e-8)
+        return jnp.sum(nll) / denom
+    return _reduce(nll, reduction)
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def nll_loss(log_probs, labels, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    labels = labels.astype(jnp.int32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(log_probs, safe[..., None], axis=-1).squeeze(-1)
+    if weight is not None:
+        nll = nll * jnp.take(weight, safe)
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(nll) / denom
+    return _reduce(nll, reduction)
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    return _reduce((input - label) ** 2, reduction)
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, 1.0))
+             + (1 - label) * jnp.log(jnp.clip(1 - input, eps, 1.0)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction: str = "mean",
+                                     pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: python/paddle/nn/functional/flash_attention.py:146
+# flash_attention, :441 scaled_dot_product_attention)
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True):
+    """[batch, seq, heads, head_dim] layout, matching the reference API
+    (python/paddle/nn/functional/flash_attention.py:441). Dispatches to the
+    Pallas flash-attention kernel on TPU via paddle_tpu.ops.attention."""
+    from ..amp.auto_cast import maybe_cast_inputs
+    query, key, value = maybe_cast_inputs("attention", query, key, value)
+    from ..ops import attention as attn_ops
+    return attn_ops.flash_attention(query, key, value, attn_mask=attn_mask,
+                                    dropout_p=dropout_p if training else 0.0,
+                                    causal=is_causal)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Attention restricted to a per-(batch, head) CSR sparsity pattern
+    (reference: python/paddle/nn/functional/sparse_attention.py:1, kernel
+    phi/kernels/gpu/sparse_attention — CUDA-only there; here an XLA
+    composition: the CSR pattern scatters into a boolean mask and the
+    masked softmax runs on the MXU. Correct for any pattern; for the
+    block-sparse patterns that actually pay off on TPU, prefer the flash
+    kernel's segment_ids or a dense mask).
+
+    query/key/value: [B, H, S, D]; sparse_csr_offset: [B, H, S+1] int32;
+    sparse_csr_columns: [B, H, nnz] int32. Optional key_padding_mask
+    [B, S] and attn_mask [S, S] follow the reference convention:
+    value 0 masks the position. Returns [B, H, S, D].
+    """
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    offset = jnp.asarray(sparse_csr_offset, jnp.int32)
+    columns = jnp.asarray(sparse_csr_columns, jnp.int32)
+    B, H, S, D = q.shape
+    nnz = columns.shape[-1]
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+
+    def one_mask(off, cols):
+        # row of the j-th stored element = # of offset entries <= j, minus 1
+        j = jnp.arange(nnz, dtype=jnp.int32)
+        rows = jnp.searchsorted(off, j, side="right") - 1
+        # rectangular [B, H, nnz] storage pads ragged heads: entries past
+        # this head's true nnz (off[-1]) must not scatter anywhere — route
+        # them out of bounds and drop
+        rows = jnp.where(j < off[-1], jnp.clip(rows, 0, S - 1), S)
+        return jnp.zeros((S, S), bool).at[rows, cols].set(True, mode="drop")
+
+    mask = jax.vmap(jax.vmap(one_mask))(offset, columns)      # [B,H,S,S]
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(key_padding_mask) != 0               # [B, S]
+        mask = mask & kpm[:, None, None, :]
+    if attn_mask is not None:
+        am = jnp.asarray(attn_mask) != 0                       # [S, S]
+        mask = mask & am[None, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    # rows with an empty pattern produce zeros, not NaN
+    has_any = jnp.any(mask, axis=-1, keepdims=True)
+    p = jax.nn.softmax(jnp.where(has_any, logits, 0.0), axis=-1)
+    p = jnp.where(has_any, p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = False,
+                    return_softmax: bool = False, training: bool = True):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def label_smooth(label, epsilon: float = 0.1):
+    n = label.shape[-1]
+    return (1 - epsilon) * label + epsilon / n
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (c * k[0] * k[1], c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")))
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    """weight [out_c, in_c/groups, kd, kh, kw] (reference conv3d)."""
+    from ..amp.auto_cast import maybe_cast_inputs
+    x, weight, bias = maybe_cast_inputs("conv3d", x, weight, bias)
+    stride = _norm_tuple(stride, 3)
+    dilation = _norm_tuple(dilation, 3)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _norm_tuple(padding, 3)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = [1, -1, 1, 1, 1] if data_format == "NCDHW" else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    """[N, C*r^2, H, W] → [N, C, H*r, W*r] (reference pixel_shuffle)."""
+    r = upscale_factor
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if c % (r * r):
+        raise ValueError(f"channels {c} not divisible by {r}^2")
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    out = x.reshape(n, c // (r * r), h * r, w * r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    out = x.reshape(n, c * r * r, h // r, w // r)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+# -- long tail (round-3 parity batch): activations, 1d/3d/adaptive pooling,
+#    unpool, grid ops, conv transposes, loss family remainder ---------------
+from .functional_extras import *   # noqa: F401,F403,E402

@@ -1,0 +1,1120 @@
+"""nn.functional long tail: activations, pooling (1d/3d/adaptive/unpool),
+spatial ops (grid_sample/affine_grid/fold), and the loss family remainder.
+
+Reference: python/paddle/nn/functional/{activation.py,pooling.py,vision.py,
+common.py,loss.py,distance.py} — TPU re-design notes inline: adaptive pools
+use a static [out, L] weight/mask matrix (MXU-friendly, exact for any
+size ratio); max-pool masks come from conv_general_dilated_patches; CTC is
+optax's log-domain recursion; RNNT is a lax.scan alpha recursion.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.rng import rng_tracker, GLOBAL_STREAM, LOCAL_STREAM
+from .functional import (_norm_tuple, _reduce, dropout, interpolate,
+                         log_softmax, sigmoid, softmax, softplus, tanh,
+                         binary_cross_entropy, cosine_similarity, relu, elu,
+                         leaky_relu)
+
+
+def _key():
+    return rng_tracker().next_key(GLOBAL_STREAM)
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(jnp.asarray(x), alpha=alpha)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    arr = jnp.asarray(x)
+    return scale * jnp.where(arr > 0, arr, alpha * jnp.expm1(arr))
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+def hardshrink(x, threshold: float = 0.5):
+    arr = jnp.asarray(x)
+    return jnp.where(jnp.abs(arr) > threshold, arr, 0.0)
+
+
+def softshrink(x, threshold: float = 0.5):
+    arr = jnp.asarray(x)
+    return jnp.where(arr > threshold, arr - threshold,
+                     jnp.where(arr < -threshold, arr + threshold, 0.0))
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+def softsign(x):
+    arr = jnp.asarray(x)
+    return arr / (1.0 + jnp.abs(arr))
+
+
+def tanhshrink(x):
+    arr = jnp.asarray(x)
+    return arr - jnp.tanh(arr)
+
+
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0):
+    arr = jnp.asarray(x)
+    return jnp.where(arr > threshold, arr, value)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    arr = jnp.asarray(x)
+    axis = axis % arr.ndim
+    c = arr.shape[axis]
+    if c % groups:
+        raise ValueError(f"maxout: channels {c} not divisible by {groups}")
+    new = arr.shape[:axis] + (c // groups, groups) + arr.shape[axis + 1:]
+    return jnp.max(arr.reshape(new), axis=axis + 1)
+
+
+def prelu(x, weight, data_format: str = "NCHW"):
+    arr = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    if w.size > 1 and arr.ndim > 1:
+        ch_axis = 1 if data_format == "NCHW" else arr.ndim - 1
+        shape = [1] * arr.ndim
+        shape[ch_axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(arr > 0, arr, w * arr)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+          training: bool = False):
+    arr = jnp.asarray(x)
+    if training:
+        a = jax.random.uniform(_key(), arr.shape, jnp.float32, lower, upper)
+        a = a.astype(arr.dtype)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(arr >= 0, arr, a * arr)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1):
+    arr = jnp.asarray(x)
+    g = jax.random.gumbel(_key(), arr.shape, jnp.float32).astype(arr.dtype)
+    y = softmax((arr + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.where(
+            jnp.arange(arr.shape[axis]).reshape(
+                [-1 if i == axis % arr.ndim else 1 for i in range(arr.ndim)])
+            == idx, 1.0, 0.0).astype(y.dtype)
+        y = lax.stop_gradient(onehot - y) + y   # straight-through
+    return y
+
+
+# inplace-spelled aliases (value semantics; see tensor/inplace.py)
+def relu_(x):
+    return relu(x)
+
+
+def elu_(x, alpha: float = 1.0):
+    return elu(x, alpha)
+
+
+def hardtanh_(x, min: float = -1.0, max: float = 1.0):
+    return hardtanh(x, min, max)
+
+
+def leaky_relu_(x, negative_slope: float = 0.01):
+    return leaky_relu(x, negative_slope)
+
+
+def softmax_(x, axis: int = -1):
+    return softmax(x, axis)
+
+
+def tanh_(x):
+    return tanh(x)
+
+
+def thresholded_relu_(x, threshold: float = 1.0, value: float = 0.0):
+    return thresholded_relu(x, threshold, value)
+
+
+# ---------------------------------------------------------------------------
+# pooling 1d/3d + adaptive + unpool (reference: nn/functional/pooling.py)
+# ---------------------------------------------------------------------------
+
+def _pool_nd(x, nd, kernel_size, stride, padding, reducer, init,
+             channel_last: bool, ceil_mode: bool = False):
+    k = _norm_tuple(kernel_size, nd)
+    s = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    p = _norm_tuple(padding, nd)
+    spatial = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
+    # ceil_mode: extend the trailing pad so the last partial window counts
+    extra = tuple(
+        ((-(-(spatial[i] + 2 * p[i] - k[i]) // s[i]) * s[i] + k[i])
+         - (spatial[i] + 2 * p[i])) if ceil_mode else 0
+        for i in range(nd))
+    extra = tuple(max(0, e) for e in extra)
+    sp_pads = tuple((p[i], p[i] + extra[i]) for i in range(nd))
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = ((0, 0),) + sp_pads + ((0, 0),)
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = ((0, 0), (0, 0)) + sp_pads
+    return lax.reduce_window(x, init, reducer, window, strides, pads)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format: str = "NCL"):
+    arr = jnp.asarray(x)
+    init = (-jnp.inf if jnp.issubdtype(arr.dtype, jnp.floating)
+            else jnp.iinfo(arr.dtype).min)
+    out = _pool_nd(arr, 1, kernel_size, stride, padding, lax.max, init,
+                   data_format == "NLC", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(arr, kernel_size, stride, padding,
+                                 data_format == "NLC", ceil_mode)
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format: str = "NCL"):
+    arr = jnp.asarray(x)
+    summed = _pool_nd(arr, 1, kernel_size, stride, padding, lax.add, 0.0,
+                      data_format == "NLC", ceil_mode)
+    if exclusive and (padding != 0 or ceil_mode):
+        ones = jnp.ones_like(arr)
+        count = _pool_nd(ones, 1, kernel_size, stride, padding, lax.add, 0.0,
+                         data_format == "NLC", ceil_mode)
+        return summed / count
+    k = _norm_tuple(kernel_size, 1)
+    return summed / k[0]
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format: str = "NCDHW"):
+    arr = jnp.asarray(x)
+    init = (-jnp.inf if jnp.issubdtype(arr.dtype, jnp.floating)
+            else jnp.iinfo(arr.dtype).min)
+    out = _pool_nd(arr, 3, kernel_size, stride, padding, lax.max, init,
+                   data_format == "NDHWC", ceil_mode)
+    if return_mask:
+        return out, _pool_argmax(arr, _norm_tuple(kernel_size, 3), stride,
+                                 padding, data_format == "NDHWC", ceil_mode)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format: str = "NCDHW"):
+    arr = jnp.asarray(x)
+    summed = _pool_nd(arr, 3, kernel_size, stride, padding, lax.add, 0.0,
+                      data_format == "NDHWC", ceil_mode)
+    if exclusive and (padding != 0 or ceil_mode):
+        count = _pool_nd(jnp.ones_like(arr), 3, kernel_size, stride, padding,
+                         lax.add, 0.0, data_format == "NDHWC", ceil_mode)
+        return summed / count
+    k = _norm_tuple(kernel_size, 3)
+    return summed / (k[0] * k[1] * k[2])
+
+
+def _pool_argmax(x, kernel, stride, padding, channel_last: bool,
+                 ceil_mode: bool = False):
+    """Flat (per-plane) argmax indices of each pooling window, the layout
+    max_unpool consumes (reference returns int indices into the padded-less
+    input plane). Works for 1-3 spatial dims via dilated patches."""
+    if channel_last:
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        x = jnp.transpose(x, perm)
+    nd = x.ndim - 2
+    k = _norm_tuple(kernel, nd)
+    s = _norm_tuple(stride if stride is not None else kernel, nd)
+    p = _norm_tuple(padding, nd)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    # trailing extra pad mirrors _pool_nd's ceil_mode so mask and values
+    # agree on the output grid
+    extra = tuple(
+        max(0, (-(-(spatial[i] + 2 * p[i] - k[i]) // s[i]) * s[i] + k[i])
+            - (spatial[i] + 2 * p[i])) if ceil_mode else 0
+        for i in range(nd))
+    sp_pads = tuple((p[i], p[i] + extra[i]) for i in range(nd))
+    # index plane, same padding as the values, pad value -1 never wins
+    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
+        spatial)
+    big_neg = jnp.float32(-1e30)
+    # finite pad: the patch extraction is an identity-kernel conv, and
+    # 0 * -inf = nan would poison whole windows; ip<0 masks pads anyway
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + sp_pads, constant_values=-1e30)
+    ip = jnp.pad(flat_idx, sp_pads, constant_values=-1)
+    # extract windows of both value and index and argmax per window
+    vpat = lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding="VALID")
+    # vpat: [n, c*prod(k), *out_spatial]
+    out_spatial = vpat.shape[2:]
+    kprod = int(np.prod(k))
+    vpat = vpat.reshape(n, c, kprod, *out_spatial)
+    ipat = lax.conv_general_dilated_patches(
+        ip[None, None], filter_shape=k, window_strides=s, padding="VALID")
+    ipat = ipat.reshape(1, 1, kprod, *out_spatial)
+    arg = jnp.argmax(jnp.where(ipat < 0, big_neg, vpat), axis=2,
+                     keepdims=True)
+    idx = jnp.take_along_axis(jnp.broadcast_to(
+        ipat, (n, c, kprod) + out_spatial), arg, axis=2)[:, :, 0]
+    return idx.astype(jnp.int32)
+
+
+def _max_unpool_nd(x, indices, nd, kernel_size, stride=None, padding=0,
+                   output_size=None, data_format="NCHW"):
+    arr = jnp.asarray(x)
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    k = _norm_tuple(kernel_size, nd)
+    s = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    p = _norm_tuple(padding, nd)
+    in_spatial = arr.shape[2:]
+    if output_size is None:
+        out_spatial = tuple((in_spatial[i] - 1) * s[i] - 2 * p[i] + k[i]
+                            for i in range(nd))
+    else:
+        out_spatial = tuple(output_size[-nd:])
+    n, c = arr.shape[0], arr.shape[1]
+    plane = int(np.prod(out_spatial))
+    flat = jnp.zeros((n, c, plane), arr.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(arr.reshape(n, c, -1))
+    return flat.reshape(n, c, *out_spatial)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                          output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size, data_format)
+
+
+def _adaptive_weights(in_size: int, out_size: int):
+    """Static [out, in] averaging matrix: row i covers
+    [floor(i*L/out), ceil((i+1)*L/out)) with uniform weights — exact for
+    non-divisible ratios, and the pooling becomes one (MXU) matmul."""
+    w = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)
+        w[i, lo:hi] = 1.0 / (hi - lo)
+    return jnp.asarray(w)
+
+
+def _adaptive_mask(in_size: int, out_size: int):
+    m = np.zeros((out_size, in_size), bool)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)
+        m[i, lo:hi] = True
+    return jnp.asarray(m)
+
+
+def _adaptive_avg(x, out_sizes, spatial_axes):
+    for ax, out in zip(spatial_axes, out_sizes):
+        w = _adaptive_weights(x.shape[ax], out)
+        x = jnp.moveaxis(jnp.tensordot(x, w, axes=[[ax], [1]]), -1, ax)
+    return x
+
+
+def _adaptive_max(x, out_sizes, spatial_axes, return_mask=False):
+    idx_planes = []
+    for ax, out in zip(spatial_axes, out_sizes):
+        m = _adaptive_mask(x.shape[ax], out)                 # [out, in]
+        moved = jnp.moveaxis(x, ax, -1)[..., None, :]        # [..., 1, in]
+        masked = jnp.where(m, moved, -jnp.inf)               # [..., out, in]
+        if return_mask:
+            idx_planes.append(jnp.argmax(masked, axis=-1))
+        x = jnp.moveaxis(jnp.max(masked, axis=-1), -1, ax)
+    return (x, idx_planes) if return_mask else x
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg(jnp.asarray(x), _norm_tuple(output_size, 1), (2,))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return _adaptive_avg(jnp.asarray(x), _norm_tuple(output_size, 3), axes)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max(jnp.asarray(x), _norm_tuple(output_size, 1), (2,),
+                        return_mask)
+    if return_mask:
+        return out[0], out[1][0].astype(jnp.int32)
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    arr = jnp.asarray(x)
+    sizes = _norm_tuple(output_size, 2)
+    if not return_mask:
+        return _adaptive_max(arr, sizes, (2, 3))
+    # flat-plane indices (H*W) like max_pool's mask layout
+    h, w = arr.shape[2], arr.shape[3]
+    mh, mw = _adaptive_mask(h, sizes[0]), _adaptive_mask(w, sizes[1])
+    # [n, c, oh, ow, h, w] masked view is too big; do it separably:
+    # argmax over w within each (oh row band, ow col band) needs joint
+    # search, so build [oh, h] x [ow, w] band mask lazily per output cell
+    vals = _adaptive_max(arr, sizes, (2, 3))
+    band = mh[:, None, :, None] & mw[None, :, None, :]  # [oh, ow, h, w]
+    scores = jnp.where(band, arr[:, :, None, None, :, :], -jnp.inf)
+    flat = scores.reshape(*scores.shape[:4], h * w)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    return vals, idx
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    arr = jnp.asarray(x)
+    sizes = _norm_tuple(output_size, 3)
+    if not return_mask:
+        return _adaptive_max(arr, sizes, (2, 3, 4))
+    # flat D*H*W indices (paddle mask layout): joint band search
+    d, h, w = arr.shape[2:]
+    md = _adaptive_mask(d, sizes[0])
+    mh = _adaptive_mask(h, sizes[1])
+    mw = _adaptive_mask(w, sizes[2])
+    vals = _adaptive_max(arr, sizes, (2, 3, 4))
+    band = (md[:, None, None, :, None, None]
+            & mh[None, :, None, None, :, None]
+            & mw[None, None, :, None, None, :])    # [od, oh, ow, d, h, w]
+    scores = jnp.where(band, arr[:, :, None, None, None, :, :, :], -jnp.inf)
+    flat = scores.reshape(*scores.shape[:5], d * h * w)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    return vals, idx
+
+
+# ---------------------------------------------------------------------------
+# spatial / vision ops (reference: nn/functional/vision.py, common.py)
+# ---------------------------------------------------------------------------
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW"):
+    arr = jnp.asarray(x)
+    if data_format == "NCHW":
+        n, c, h, w = arr.shape
+        if c % groups:
+            raise ValueError(f"channels {c} not divisible by groups {groups}")
+        return arr.reshape(n, groups, c // groups, h, w).swapaxes(1, 2) \
+            .reshape(n, c, h, w)
+    n, h, w, c = arr.shape
+    return arr.reshape(n, h, w, groups, c // groups).swapaxes(3, 4) \
+        .reshape(n, h, w, c)
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
+    p = _norm_tuple(padding, 4)  # [left, right, top, bottom]
+    arr = jnp.asarray(x)
+    if data_format == "NCHW":
+        pads = ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1]))
+    else:
+        pads = ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0))
+    return jnp.pad(arr, pads)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True):
+    """SELU-preserving dropout (reference nn/functional/common.py
+    alpha_dropout): dropped units take alpha', then affine-correct."""
+    if not training or p == 0.0:
+        return jnp.asarray(x)
+    arr = jnp.asarray(x)
+    alpha = 1.6732632423543772 * 1.0507009873554805
+    alpha_p = -alpha
+    keep = jax.random.bernoulli(_key(), 1.0 - p, arr.shape)
+    a = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, arr, alpha_p) + b
+
+
+def _dropout_channels(x, p, training, spatial_ndim):
+    if not training or p == 0.0:
+        return jnp.asarray(x)
+    arr = jnp.asarray(x)
+    mask_shape = arr.shape[:2] + (1,) * spatial_ndim
+    keep = jax.random.bernoulli(_key(), 1.0 - p, mask_shape)
+    return jnp.where(keep, arr / (1.0 - p), 0.0).astype(arr.dtype)
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCHW", name=None):
+    if data_format != "NCHW":
+        arr = jnp.moveaxis(jnp.asarray(x), -1, 1)
+        return jnp.moveaxis(_dropout_channels(arr, p, training, 2), 1, -1)
+    return _dropout_channels(x, p, training, 2)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCDHW", name=None):
+    if data_format != "NCDHW":
+        arr = jnp.moveaxis(jnp.asarray(x), -1, 1)
+        return jnp.moveaxis(_dropout_channels(arr, p, training, 3), 1, -1)
+    return _dropout_channels(x, p, training, 3)
+
+
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0,
+                        data_format: str = "NCHW"):
+    arr = jnp.asarray(x)
+    ch_axis = 1 if data_format.startswith("NC") else arr.ndim - 1
+    sq = jnp.square(arr)
+    moved = jnp.moveaxis(sq, ch_axis, -1)
+    pad_lo = (size - 1) // 2
+    pad_hi = size - 1 - pad_lo
+    padded = jnp.pad(moved, [(0, 0)] * (arr.ndim - 1) + [(pad_lo, pad_hi)])
+    windows = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                         for i in range(size)], axis=-1)
+    den = k + alpha / size * jnp.sum(windows, axis=-1)
+    return arr / jnp.moveaxis(den, -1, ch_axis) ** beta
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW"):
+    """Shift a fraction of channels one step along the segment (time) axis
+    (reference: nn/functional/extension.py temporal_shift)."""
+    arr = jnp.asarray(x)
+    if data_format == "NHWC":
+        arr = jnp.moveaxis(arr, -1, 1)
+    nt, c, h, w = arr.shape
+    n = nt // seg_num
+    v = arr.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.pad(v[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    right = jnp.pad(v[:, :-1, fold:2 * fold],
+                    ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([left, right, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    lengths = jnp.asarray(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    from ..core.dtype import convert_dtype
+    return (jnp.arange(m) < lengths[..., None]).astype(convert_dtype(dtype))
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (reference: nn/functional/extension.py
+    gather_tree / gather_tree_op): follow parent pointers from the last
+    step to recover full beams. ids/parents: [T, B, W]."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T = ids.shape[0]
+    w_idx = jnp.arange(ids.shape[2])
+
+    def body(beam, t):
+        # beam: [B, W] parent slot at step t+1; emit ids[t] gathered by it
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam, axis=1)
+        return prev, tok
+
+    init = jnp.broadcast_to(w_idx, ids.shape[1:])
+    _, toks = lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """theta [n, 2, 3] -> sampling grid [n, h, w, 2] (reference:
+    nn/functional/vision.py affine_grid)."""
+    theta = jnp.asarray(theta)
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gx, gy = jnp.meshgrid(xs, ys)                    # [h, w]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h, w, 3]
+    return jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32))
+
+
+def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = True):
+    """Sample x [n,c,h,w] at grid [n,gh,gw,2] (x,y in [-1,1]) (reference:
+    nn/functional/vision.py grid_sample; kernel grid_sample_kernel.cu).
+    Gather-based: 4 taps + bilinear weights, vectorized over the grid."""
+    arr = jnp.asarray(x)
+    g = jnp.asarray(grid).astype(jnp.float32)
+    n, c, h, w = arr.shape
+
+    def unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    gx = unnorm(g[..., 0], w)                        # [n, gh, gw]
+    gy = unnorm(g[..., 1], h)
+
+    def reflect(coord, size):
+        if align_corners:
+            span = 2.0 * (size - 1)
+            if size == 1:
+                return jnp.zeros_like(coord)
+            coord = jnp.abs(coord) % span
+            return jnp.where(coord > size - 1, span - coord, coord)
+        span = 2.0 * size
+        coord = jnp.abs(coord + 0.5) % span
+        return jnp.where(coord > size, span - coord, coord) - 0.5
+
+    if padding_mode == "reflection":
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+    if padding_mode in ("border", "reflection"):
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+
+    def tap(ix, iy):
+        """Gather arr[n, :, iy, ix] with zero padding for OOB."""
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        ni = jnp.arange(n)[:, None, None]
+        vals = arr[ni, :, iyc, ixc]                  # [n, gh, gw, c]
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    if mode == "nearest":
+        out = tap(jnp.round(gx).astype(jnp.int32),
+                  jnp.round(gy).astype(jnp.int32))
+        return jnp.moveaxis(out, -1, 1).astype(arr.dtype)
+
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+    out = (tap(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+           + tap(x1, y0) * (wx * (1 - wy))[..., None]
+           + tap(x0, y1) * ((1 - wx) * wy)[..., None]
+           + tap(x1, y1) * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1).astype(arr.dtype)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: inverse of unfold (reference: nn/functional/common.py fold).
+    x: [n, c*prod(k), L] -> [n, c, H, W] via scatter-add of patches."""
+    arr = jnp.asarray(x)
+    oh, ow = _norm_tuple(output_sizes, 2)
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    ph, pw = _norm_tuple(paddings, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    n, ck, L = arr.shape
+    c = ck // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if nh * nw != L:
+        raise ValueError(f"fold: L={L} != expected {nh}*{nw}")
+    patches = arr.reshape(n, c, kh, kw, nh, nw)
+    # output positions per (ki, li): row = li_h*sh + ki_h*dh - ph
+    rows = (np.arange(nh)[None, :] * sh
+            + np.arange(kh)[:, None] * dh - ph)     # [kh, nh]
+    cols = (np.arange(nw)[None, :] * sw
+            + np.arange(kw)[:, None] * dw - pw)     # [kw, nw]
+    valid_r = (rows >= 0) & (rows < oh)
+    valid_c = (cols >= 0) & (cols < ow)
+    rows_c = np.clip(rows, 0, oh - 1)
+    cols_c = np.clip(cols, 0, ow - 1)
+    mask = (valid_r[:, None, :, None] & valid_c[None, :, None, :])
+    patches = jnp.where(mask[None, None], patches, 0.0)
+    out = jnp.zeros((n, c, oh, ow), arr.dtype)
+    ridx = jnp.asarray(rows_c)[:, None, :, None]     # [kh, 1, nh, 1]
+    cidx = jnp.asarray(cols_c)[None, :, None, :]     # [1, kw, 1, nw]
+    ridx = jnp.broadcast_to(ridx, (kh, kw, nh, nw))
+    cidx = jnp.broadcast_to(cidx, (kh, kw, nh, nw))
+    out = out.at[:, :, ridx, cidx].add(patches)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode: str = "nearest",
+             align_corners: bool = False, data_format: str = "NCHW",
+             name=None):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear form out[n, o] = x1[n, i] W[o, i, j] x2[n, j] (reference:
+    nn/functional/common.py bilinear)."""
+    out = jnp.einsum("ni,oij,nj->no", jnp.asarray(x1), jnp.asarray(weight),
+                     jnp.asarray(x2))
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1)
+    return out
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None):
+    diff = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(diff), axis=-1, keepdims=keepdim)
+    else:
+        out = jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1,
+                                keepdims=keepdim), 1.0 / p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conv transpose 1d/3d (reference: nn/functional/conv.py)
+# ---------------------------------------------------------------------------
+
+def _conv_transpose_nd(x, weight, bias, nd, stride, padding, output_padding,
+                       dilation, groups, data_format):
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    p = _norm_tuple(padding, nd)
+    op = _norm_tuple(output_padding, nd)
+    kdims = weight.shape[2:]
+    pad = [(dilation[i] * (kdims[i] - 1) - p[i],
+            dilation[i] * (kdims[i] - 1) - p[i] + op[i]) for i in range(nd)]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if groups > 1:
+        ic, ocg = weight.shape[0], weight.shape[1]
+        w = w.reshape(groups, ic // groups, ocg, *kdims)
+        w = jnp.moveaxis(w, 2, 1).reshape(groups * ocg, ic // groups, *kdims)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    spatial = "DHW"[3 - nd:]
+    fmt_in = "NC" + spatial if data_format.startswith("NC") else \
+        "N" + spatial + "C"
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (fmt_in, "OI" + spatial, fmt_in))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups).astype(x.dtype)
+    if bias is not None:
+        bshape = ([1, -1] + [1] * nd if data_format.startswith("NC")
+                  else [1] + [1] * nd + [-1])
+        out = out + jnp.asarray(bias).reshape(bshape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(jnp.asarray(x), jnp.asarray(weight), bias, 1,
+                              stride, padding, output_padding, dilation,
+                              groups, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(jnp.asarray(x), jnp.asarray(weight), bias, 3,
+                              stride, padding, output_padding, dilation,
+                              groups, data_format)
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats: bool = True,
+                  momentum: float = 0.9, eps: float = 1e-5,
+                  data_format: str = "NCHW", name=None):
+    """Per-(n, c) spatial normalization (reference: nn/functional/norm.py
+    instance_norm)."""
+    arr = jnp.asarray(x)
+    if data_format.startswith("NC"):
+        red = tuple(range(2, arr.ndim))
+        ch_shape = [1, -1] + [1] * (arr.ndim - 2)
+    else:
+        red = tuple(range(1, arr.ndim - 1))
+        ch_shape = [1] + [1] * (arr.ndim - 2) + [-1]
+    mean = jnp.mean(arr, axis=red, keepdims=True)
+    var = jnp.var(arr, axis=red, keepdims=True)
+    out = (arr - mean) / jnp.sqrt(var + eps)
+    if weight is not None:
+        out = out * jnp.asarray(weight).reshape(ch_shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(ch_shape)
+    return out.astype(arr.dtype)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def square_error_cost(input, label):
+    return jnp.square(jnp.asarray(input) - jnp.asarray(label))
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    p = jnp.asarray(input)
+    y = jnp.asarray(label)
+    return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+
+def soft_margin_loss(input, label, reduction: str = "mean", name=None):
+    loss = jnp.log1p(jnp.exp(-jnp.asarray(label) * jnp.asarray(input)))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean", name=None):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean", name=None):
+    cos = cosine_similarity(jnp.asarray(input1), jnp.asarray(input2), axis=1)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean", name=None):
+    loss = jnp.maximum(
+        0.0, -jnp.asarray(label) * (jnp.asarray(input) - jnp.asarray(other))
+        + margin)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean", name=None):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + epsilon)
+    if full:  # Stirling approximation for y! (reference adds it for y > 1)
+        stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None):
+    mu = jnp.asarray(input)
+    y = jnp.asarray(label)
+    var = jnp.maximum(jnp.asarray(variance), epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction: str = "mean", name=None):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).astype(jnp.int32)
+    n, c = x.shape
+    correct = jnp.take_along_axis(x, y[:, None], axis=1)    # [n, 1]
+    diff = jnp.maximum(0.0, margin - correct + x)
+    if p != 1:
+        diff = diff ** p
+    if weight is not None:
+        diff = diff * jnp.asarray(weight)[y][:, None]
+    onehot = jax.nn.one_hot(y, c, dtype=x.dtype)
+    loss = jnp.sum(diff * (1 - onehot), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean",
+                        name=None):
+    d_pos = pairwise_distance(input, positive, p, epsilon)
+    d_neg = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        d_neg = jnp.minimum(d_neg,
+                            pairwise_distance(positive, negative, p, epsilon))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin: float = 1.0,
+                                      swap: bool = False,
+                                      reduction: str = "mean", name=None):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(loss, reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """N-pair loss (reference: nn/functional/loss.py npair_loss): CE over
+    anchor·positiveᵀ similarities + L2 on the embeddings."""
+    a = jnp.asarray(anchor)
+    p = jnp.asarray(positive)
+    y = jnp.asarray(labels).reshape(-1)
+    logits = a @ p.T                                  # [n, n]
+    same = (y[:, None] == y[None, :]).astype(logits.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(logits, axis=1), axis=1))
+    l2 = jnp.mean(jnp.sum(a * a, 1) + jnp.sum(p * p, 1)) * 0.25 * l2_reg
+    return ce + l2
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    """Dice loss over the last (class-prob) axis (reference:
+    nn/functional/loss.py dice_loss): label is int class ids."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    if y.ndim == x.ndim and y.shape[-1] == 1:
+        y = y[..., 0]
+    onehot = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+    x = x.reshape(x.shape[0], -1)
+    onehot = onehot.reshape(onehot.shape[0], -1)
+    inter = jnp.sum(x * onehot, axis=1)
+    union = jnp.sum(x, axis=1) + jnp.sum(onehot, axis=1)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum", name=None):
+    x = jnp.asarray(logit)
+    y = jnp.asarray(label)
+    p = jax.nn.sigmoid(x)
+    ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer)
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean",
+             norm_by_times: bool = False):
+    """CTC (reference: nn/functional/loss.py ctc_loss over warpctc). Uses
+    optax's log-domain forward recursion; layout adapted from paddle's
+    [T, B, V] logits to optax's [B, T, V] + padding masks."""
+    import optax
+    lp = jnp.asarray(log_probs)
+    if lp.ndim != 3:
+        raise ValueError("log_probs must be [max_T, batch, vocab]")
+    lp_bt = jnp.moveaxis(lp, 0, 1)                   # [B, T, V]
+    y = jnp.asarray(labels)                          # [B, U]
+    in_len = jnp.asarray(input_lengths).reshape(-1)
+    lab_len = jnp.asarray(label_lengths).reshape(-1)
+    t_pad = (jnp.arange(lp_bt.shape[1])[None, :] >= in_len[:, None]) \
+        .astype(lp_bt.dtype)
+    u_pad = (jnp.arange(y.shape[1])[None, :] >= lab_len[:, None]) \
+        .astype(lp_bt.dtype)
+    per_seq = optax.ctc_loss(lp_bt, t_pad, y, u_pad, blank_id=blank)
+    if norm_by_times:
+        per_seq = per_seq / jnp.maximum(in_len.astype(per_seq.dtype), 1.0)
+    if reduction == "mean":
+        # paddle: divide each by its label length, then mean
+        return jnp.mean(per_seq / jnp.maximum(lab_len.astype(per_seq.dtype),
+                                              1.0))
+    return _reduce(per_seq, reduction)
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank: int = 0,
+              fastemit_lambda: float = 0.0, reduction: str = "mean",
+              name=None):
+    """RNN-T transducer loss (reference: nn/functional/loss.py rnnt_loss
+    over warprnnt). Log-domain alpha recursion over the T axis with a
+    lax.scan; each step advances the [B, U+1] frontier — O(T·U) work, MXU
+    untouched (memory-bound by design, like the reference kernel)."""
+    lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)  # [B, T, U1, V]
+    y = jnp.asarray(labels).astype(jnp.int32)              # [B, U]
+    b, t_max, u1, _ = lp.shape
+    in_len = jnp.asarray(input_lengths).reshape(-1)
+    lab_len = jnp.asarray(label_lengths).reshape(-1)
+    neg_inf = jnp.float32(-1e30)
+
+    blank_lp = lp[..., blank]                               # [B, T, U1]
+    y_pad = jnp.pad(y, ((0, 0), (0, u1 - y.shape[1])))
+    emit_lp = jnp.take_along_axis(
+        lp, y_pad[:, None, :, None], axis=-1)[..., 0]       # [B, T, U1]
+
+    u_range = jnp.arange(u1)
+
+    def time_step(alpha, t):
+        # alpha carries alpha[t-1, :] ([B, U1]); produce alpha[t, :].
+        # Graves recursion: alpha(t,u) = logaddexp(alpha(t-1,u)+blank(t-1,u),
+        #                                          alpha(t,u-1)+emit(t,u-1))
+        via_blank = jnp.where(t == 0, alpha,
+                              alpha + blank_lp[:, jnp.maximum(t - 1, 0)])
+        emit_t = emit_lp[:, t]
+
+        def u_step(prev, u):
+            cur = jnp.where(u == 0, via_blank[:, 0],
+                            jnp.logaddexp(via_blank[:, u],
+                                          prev + emit_t[:, u - 1]))
+            return cur, cur
+
+        _, cols = lax.scan(u_step, jnp.full((b,), neg_inf), u_range)
+        new_alpha = jnp.moveaxis(cols, 0, 1)                # [B, U1]
+        # frames beyond this sequence's length keep alpha frozen at
+        # alpha[in_len-1, :]
+        active = (t < in_len)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alpha0 = jnp.full((b, u1), neg_inf).at[:, 0].set(0.0)
+    alpha, _ = lax.scan(time_step, alpha0, jnp.arange(t_max))
+    # terminate from (T-1, U) with one final blank
+    final_blank = jnp.take_along_axis(
+        blank_lp[jnp.arange(b), jnp.maximum(in_len - 1, 0)],
+        lab_len[:, None], axis=1)[:, 0]
+    ll = jnp.take_along_axis(alpha, lab_len[:, None], axis=1)[:, 0] \
+        + final_blank
+    per_seq = -ll
+    if reduction == "mean":
+        return jnp.mean(per_seq)
+    return _reduce(per_seq, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse: bool = False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: nn/functional/loss.py hsigmoid_loss; kernel
+    phi/kernels/cpu/hsigmoid_loss_kernel.cc). Tree node k has children
+    2k+1/2k+2; class c's path is the root-to-leaf walk of leaf (c +
+    num_classes - 1)."""
+    x = jnp.asarray(input)                            # [n, d]
+    y = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    w = jnp.asarray(weight)                           # [num_classes-1, d]
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+    if path_table is None:
+        # host-side static path construction for all classes, then gather
+        tbl = np.zeros((num_classes, depth), np.int32)
+        code = np.zeros((num_classes, depth), np.float32)
+        valid = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            steps = []
+            while node > 0:
+                parent = (node - 1) // 2
+                steps.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for d_i, (p_n, bit) in enumerate(reversed(steps)):
+                tbl[c, d_i] = p_n
+                code[c, d_i] = bit
+                valid[c, d_i] = 1.0
+        path_table = jnp.asarray(tbl)[y]              # [n, depth]
+        path_code = jnp.asarray(code)[y]
+        mask = jnp.asarray(valid)[y]
+    else:
+        path_table = jnp.asarray(path_table)
+        path_code = jnp.asarray(path_code).astype(x.dtype)
+        mask = (path_table >= 0).astype(x.dtype)
+        path_table = jnp.maximum(path_table, 0)
+    wn = w[path_table]                                # [n, depth, d]
+    logits = jnp.einsum("nd,ntd->nt", x, wn)
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[path_table]
+    # code bit 1 -> right child: target = bit
+    ce = -(path_code * jax.nn.log_sigmoid(logits)
+           + (1 - path_code) * jax.nn.log_sigmoid(-logits))
+    return jnp.sum(ce * mask, axis=1, keepdims=True)
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0,
+                         margin2: float = 0.5, margin3: float = 0.0,
+                         scale: float = 64.0, group=None,
+                         return_softmax: bool = False,
+                         reduction: str = "mean"):
+    """ArcFace/CosFace-style margin softmax (reference:
+    nn/functional/loss.py margin_cross_entropy; kernel
+    phi/kernels/gpu/margin_cross_entropy_kernel.cu): logits are cos(theta),
+    the target class gets cos(m1*theta + m2) - m3, then scaled CE. The
+    TP/sharded-class variant composes with parallel_cross_entropy."""
+    x = jnp.asarray(logits)
+    y = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    target = jnp.take_along_axis(x, y[:, None], axis=1)[:, 0]
+    theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+    mod = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(y, c, dtype=x.dtype)
+    adj = x * (1 - onehot) + mod[:, None] * onehot
+    adj = adj * scale
+    logp = jax.nn.log_softmax(adj, axis=1)
+    loss = -jnp.take_along_axis(logp, y[:, None], axis=1)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def class_center_sample(label, num_classes: int, num_samples: int,
+                        group=None):
+    """Sample negative class centers plus all positives (reference:
+    nn/functional/common.py class_center_sample, PartialFC): returns
+    (remapped_label, sampled_class_index). Positive classes always kept;
+    negatives fill up to num_samples by hashed priority — jit-friendly
+    (static output size num_samples)."""
+    y = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    present = jnp.zeros((num_classes,), jnp.bool_).at[y].set(True)
+    # priority: positives first (rank 0), then seeded hash order
+    rnd = jax.random.uniform(_key(), (num_classes,))
+    prio = jnp.where(present, -1.0, rnd)
+    order = jnp.argsort(prio)                        # positives lead
+    sampled = jnp.sort(order[:num_samples])          # ascending class ids
+    # remap: position of each label in `sampled` (paddle semantics)
+    remap = jnp.searchsorted(sampled, y).astype(jnp.int32)
+    return remap, sampled
+
+
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and getattr(_v, "__module__", None) == __name__]

@@ -1,0 +1,239 @@
+"""Parameter initializers.
+
+Reference: python/paddle/nn/initializer/ (Constant, Normal, TruncatedNormal,
+Uniform, XavierNormal/Uniform, KaimingNormal/Uniform, Assign). Initializers
+draw from the global RNG tracker (core/rng.py) so model construction is
+reproducible via ``paddle_tpu.seed``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import rng_tracker, GLOBAL_STREAM
+
+
+def _key():
+    tr = rng_tracker()
+    if not tr.has(GLOBAL_STREAM):
+        tr.add(GLOBAL_STREAM, 0)
+    return tr.next_key(GLOBAL_STREAM)
+
+
+def _fan_in_out(shape: Sequence[int]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c/groups, *k]: fan = channels * receptive field
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(self.value, dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        x = jax.random.truncated_normal(_key(), -2.0, 2.0, shape, dtype=jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=self.low, maxval=self.high)
+        return x.astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32) * std
+        return x.astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, negative_slope: float = 0.0, nonlinearity: str = "leaky_relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fan_in_out(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        limit = gain * math.sqrt(3.0 / fan_in)
+        x = jax.random.uniform(_key(), shape, dtype=jnp.float32,
+                               minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, negative_slope: float = 0.0, nonlinearity: str = "leaky_relu"):
+        self.a = negative_slope
+
+    def __call__(self, shape, dtype):
+        fan_in, _ = _fan_in_out(shape)
+        gain = math.sqrt(2.0 / (1 + self.a ** 2))
+        std = gain / math.sqrt(fan_in)
+        x = jax.random.normal(_key(), shape, dtype=jnp.float32) * std
+        return x.astype(dtype)
+
+
+class Orthogonal(Initializer):
+    """(Semi-)orthogonal matrix init via QR of a gaussian (reference:
+    nn/initializer/orthogonal.py; Saxe et al. 2013). For rank>2 the
+    trailing dims are flattened."""
+
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal needs at least 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        flat = (max(rows, cols), min(rows, cols))
+        a = jax.random.normal(_key(), flat, jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        # sign correction makes the distribution uniform over O(n)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference: nn/initializer/dirac.py):
+    within each group, out-channel j passes through in-channel j at the
+    spatial center for j < min(out_c/groups, in_c); remaining out-channels
+    stay zero. Requires a 3-5D shape [out, in, *spatial]."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        if not 3 <= len(shape) <= 5:
+            raise ValueError(f"Dirac needs a 3-5D conv weight, got {shape}")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups:
+            raise ValueError("out_channels must divide by groups")
+        w = np.zeros(shape, np.float32)
+        per = out_c // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for j in range(min(per, in_c)):
+                w[(g * per + j, j) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for transposed conv (reference:
+    nn/initializer/Bilinear): each spatial tap gets the separable linear
+    interpolation weight."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear needs a 4D conv weight, got {shape}")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy = 1 - np.abs(np.arange(kh) / fh - ch)
+        xx = 1 - np.abs(np.arange(kw) / fw - cw)
+        tap = np.outer(yy, xx).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for o in range(shape[0]):
+            for i in range(shape[1]):
+                w[o, i] = tap
+        return jnp.asarray(w, dtype)
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Recommended init gain per nonlinearity (reference:
+    nn/initializer/initializer.py calculate_gain)."""
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "conv1d_transpose": 1.0,
+             "conv2d_transpose": 1.0, "conv3d_transpose": 1.0,
+             "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None
+                                                 else 0.01) ** 2)),
+             "selu": 3.0 / 4.0}
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}; "
+                         f"one of {sorted(gains)}")
+    return gains[nonlinearity]
+
+
+_GLOBAL_INIT = [None, None]          # [weight_init, bias_init]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default parameter initializers framework-wide
+    (reference: nn/initializer/__init__.py set_global_initializer; pass
+    None, None to reset). Layer.create_parameter consults this."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def _global_default(is_bias: bool):
+    return _GLOBAL_INIT[1] if is_bias else _GLOBAL_INIT[0]

@@ -1,0 +1,506 @@
+"""Layer: the module system.
+
+TPU-native analogue of the reference's ``paddle.nn.Layer``
+(reference: python/paddle/nn/layer/layers.py — parameter/buffer/sublayer
+registration, state_dict, hooks, train/eval mode) re-designed for JAX's
+functional model: a Layer holds parameters as pytree leaves and exposes a
+*functional bridge* (``functional_call`` / ``functional``) that temporarily
+binds an external params pytree and runs ``forward`` — so the same
+dygraph-looking module code works under ``jax.jit`` / ``jax.grad`` /
+``shard_map`` without a separate "apply" definition.
+
+Differences from the reference, by design:
+- No GradNode graph / autograd engine (reference paddle/fluid/eager/): JAX
+  vjp/jvp provide autodiff over the functional bridge.
+- Parameters are immutable jax Arrays; "in-place" updates replace the leaf.
+- Sharding metadata lives on the Parameter wrapper (``dims_mapping``-like
+  PartitionSpec), consumed by paddle_tpu.parallel when placing the model on a
+  Mesh (reference analogue: DistTensor's TensorDistAttr,
+  paddle/phi/core/distributed/auto_parallel/dist_attr.h).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype_mod
+from ..core.rng import rng_tracker
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    """Mirrors ``paddle.set_default_dtype``."""
+    global _default_dtype
+    _default_dtype = _dtype_mod.convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+class Parameter:
+    """A trainable leaf: jax Array + metadata.
+
+    Reference analogue: ``paddle.base.framework.Parameter`` / EagerParamBase
+    (python/paddle/base/framework.py) — holds trainable flag, optimize
+    attributes, and (here) the sharding PartitionSpec used by the parallel
+    layer instead of DistTensor dist_attr.
+    """
+
+    __slots__ = ("value", "trainable", "sharding", "name", "is_distributed")
+
+    def __init__(self, value: jax.Array, trainable: bool = True,
+                 sharding: Optional[Tuple] = None, name: str = ""):
+        self.value = value
+        self.trainable = trainable
+        # PartitionSpec-like tuple of mesh-axis names (or None) per dim.
+        self.sharding = sharding
+        self.name = name
+        # set True by tensor-parallel layers: this param is already a local
+        # shard along a TP axis (reference: param.is_distributed in mp_layers).
+        self.is_distributed = False
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, trainable={self.trainable}, "
+                f"sharding={self.sharding})")
+
+
+class Buffer:
+    """Non-trainable persistent state (reference: Layer.register_buffer)."""
+
+    __slots__ = ("value", "persistable", "name")
+
+    def __init__(self, value: jax.Array, persistable: bool = True, name: str = ""):
+        self.value = value
+        self.persistable = persistable
+        self.name = name
+
+
+class Layer:
+    """Base module. See module docstring for the functional-bridge design."""
+
+    def __init__(self):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+
+    # -- registration ------------------------------------------------------
+
+    def create_parameter(self, shape, dtype=None, initializer=None,
+                         trainable: bool = True, is_bias: bool = False,
+                         sharding: Optional[Tuple] = None,
+                         default_initializer=None) -> Parameter:
+        """Create (but not yet attach) a Parameter. Assign it to an attribute
+        to register it, mirroring the reference's create_parameter +
+        add_parameter flow (python/paddle/nn/layer/layers.py).
+
+        Precedence: ``initializer`` (user/model-explicit, wins always) >
+        the set_global_initializer override > ``default_initializer``
+        (the layer's curated default) > Xavier/zeros."""
+        from . import initializer as init_mod
+        dtype = _dtype_mod.convert_dtype(dtype) if dtype is not None else _default_dtype
+        from ..base import LazyGuard
+        if LazyGuard._active:
+            # abstract init: shape/dtype only, no weight materialization
+            value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                         jnp.dtype(dtype))
+            return Parameter(value, trainable=trainable, sharding=sharding)
+        if initializer is None:
+            initializer = init_mod._global_default(is_bias)
+        if initializer is None:
+            initializer = default_initializer
+        if initializer is None:
+            initializer = init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
+        value = initializer(shape, dtype)
+        return Parameter(value, trainable=trainable, sharding=sharding)
+
+    def add_parameter(self, name: str, param: Optional[Parameter]) -> Optional[Parameter]:
+        self._parameters[name] = param
+        return param
+
+    def register_buffer(self, name: str, value, persistable: bool = True) -> None:
+        if value is not None and not isinstance(value, Buffer):
+            value = Buffer(jnp.asarray(value), persistable=persistable)
+        self._buffers[name] = value
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # -- attribute protocol ------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Parameter):
+            if not value.name:
+                value.name = name
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Buffer):
+            if not value.name:
+                value.name = name
+            self._buffers[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            # plain attribute; shadow any previous registration
+            for d in (self._parameters, self._buffers, self._sub_layers):
+                d.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        d = self.__dict__
+        params = d.get("_parameters")
+        if params is not None and name in params:
+            p = params[name]
+            return None if p is None else p.value
+        bufs = d.get("_buffers")
+        if bufs is not None and name in bufs:
+            b = bufs[name]
+            return None if b is None else b.value
+        subs = d.get("_sub_layers")
+        if subs is not None and name in subs:
+            return subs[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for d in (self._parameters, self._buffers, self._sub_layers):
+            if name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ---------------------------------------------------------
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix: str = ""
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            if p is not None:
+                yield (f"{prefix}.{name}" if prefix else name), p
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_parameters(prefix=sp)
+
+    def parameters(self) -> List[Parameter]:
+        out = []
+        for n, p in self.named_parameters():
+            # Stamp the dotted path (deliberate mutation on read): list-form
+            # optimizer binding keys by p.name, and those keys must match
+            # the dotted grads layer_grad/raw_parameters of THIS root
+            # produce. Names are relative to the queried root, so an
+            # optimizer built from a CONCATENATION of sublayer lists can
+            # collide — Optimizer.__init__ rejects that loudly.
+            p.name = n
+            out.append(p)
+        return out
+
+    def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, Buffer]]:
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sp = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_buffers(prefix=sp)
+
+    def buffers(self) -> List[Buffer]:
+        return [b for _, b in self.named_buffers()]
+
+    # -- state dict --------------------------------------------------------
+
+    def state_dict(self, include_non_persistable_buffer: bool = False
+                   ) -> Dict[str, jax.Array]:
+        """Flat name → Array dict (reference: Layer.state_dict)."""
+        out: Dict[str, jax.Array] = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p.value
+        for name, b in self.named_buffers():
+            if b.persistable or include_non_persistable_buffer:
+                out[name] = b.value
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        own_params = dict(self.named_parameters())
+        own_bufs = dict(self.named_buffers())
+        missing = []
+        for name, value in state_dict.items():
+            value = jnp.asarray(value)
+            if name in own_params:
+                p = own_params[name]
+                if tuple(p.value.shape) != tuple(value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: have {tuple(p.value.shape)}, "
+                        f"loading {tuple(value.shape)}")
+                p.value = value.astype(p.value.dtype)
+            elif name in own_bufs:
+                own_bufs[name].value = value
+            else:
+                missing.append(name)
+        if strict and missing:
+            raise KeyError(f"Unexpected keys in state_dict: {missing}")
+
+    load_dict = set_state_dict
+
+    # -- functional bridge -------------------------------------------------
+
+    def raw_parameters(self) -> Dict[str, jax.Array]:
+        """Trainable leaves as a flat dict pytree — the thing you grad over."""
+        return OrderedDict((n, p.value) for n, p in self.named_parameters()
+                           if p.trainable)
+
+    def raw_state(self) -> Dict[str, jax.Array]:
+        """All leaves (params + buffers)."""
+        out = OrderedDict((n, p.value) for n, p in self.named_parameters())
+        for n, b in self.named_buffers():
+            out[n] = b.value
+        return out
+
+    @contextlib.contextmanager
+    def _bind(self, leaves: Dict[str, jax.Array]):
+        """Temporarily swap in external leaf values (tracers under jit)."""
+        params = dict(self.named_parameters())
+        bufs = dict(self.named_buffers())
+        saved: List[Tuple[Any, jax.Array]] = []
+        try:
+            for name, v in leaves.items():
+                tgt = params.get(name) or bufs.get(name)
+                if tgt is None:
+                    raise KeyError(f"functional_call: unknown leaf {name!r}")
+                saved.append((tgt, tgt.value))
+                tgt.value = v
+            yield
+        finally:
+            for tgt, old in saved:
+                tgt.value = old
+
+    def functional_call(self, leaves: Dict[str, jax.Array], *args, **kwargs):
+        """Run forward with ``leaves`` bound in place of stored values.
+
+        This is the jit/grad entry point:
+            params = layer.raw_parameters()
+            loss = jax.grad(lambda p: layer.functional_call(p, x).sum())(params)
+        """
+        with self._bind(leaves):
+            return self(*args, **kwargs)
+
+    def functional(self) -> Callable:
+        """Return ``fn(params, *args, **kwargs)`` — a pure function view."""
+        def fn(leaves, *args, **kwargs):
+            return self.functional_call(leaves, *args, **kwargs)
+        return fn
+
+    # -- hooks (reference: Layer.register_forward_{pre,post}_hook) ---------
+
+    def register_forward_pre_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- mode / dtype ------------------------------------------------------
+
+    def train(self) -> "Layer":
+        object.__setattr__(self, "training", True)
+        for l in self.sublayers():
+            object.__setattr__(l, "training", True)
+        return self
+
+    def eval(self) -> "Layer":
+        object.__setattr__(self, "training", False)
+        for l in self.sublayers():
+            object.__setattr__(l, "training", False)
+        return self
+
+    def to(self, dtype=None, device=None) -> "Layer":
+        """Cast floating-point leaves (reference: Layer.to / amp O2 cast)."""
+        if dtype is not None:
+            dt = _dtype_mod.convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                if jnp.issubdtype(p.value.dtype, jnp.floating):
+                    p.value = p.value.astype(dt)
+            for _, b in self.named_buffers():
+                if jnp.issubdtype(b.value.dtype, jnp.floating):
+                    b.value = b.value.astype(dt)
+        if device is not None:
+            for _, p in self.named_parameters():
+                p.value = jax.device_put(p.value, device)
+            for _, b in self.named_buffers():
+                b.value = jax.device_put(b.value, device)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- call --------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        lines = []
+        extra = self.extra_repr()
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub)
+            sub_repr = ("\n  ".join(sub_repr.split("\n")))
+            lines.append(f"({name}): {sub_repr}")
+        body = ""
+        if extra and not lines:
+            body = extra
+        elif lines:
+            body = "\n  " + "\n  ".join(([extra] if extra else []) + lines) + "\n"
+        return f"{type(self).__name__}({body})"
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, registry):
+        self.id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+        self._registry = registry
+
+    def remove(self):
+        self._registry.pop(self.id, None)
+
+
+# -- containers (reference: python/paddle/nn/layer/container.py) ------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and not isinstance(layers[0], Layer):
+            layers = tuple(layers[0])
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                name, l = l
+            else:
+                name = str(i)
+            self.add_sublayer(name, l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx if idx >= 0 else len(self) + idx)]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for k, v in (sublayers.items() if isinstance(sublayers, dict) else sublayers):
+                self.add_sublayer(k, v)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def __len__(self):
+        return len(self._sub_layers)

@@ -1,0 +1,732 @@
+"""nn Layer long tail: wrappers over functional_extras plus the container /
+decoder pieces (ParameterList, BiRNN, BeamSearchDecoder, SpectralNorm).
+
+Reference: python/paddle/nn/layer/{activation.py,pooling.py,loss.py,
+common.py,norm.py,rnn.py,container.py} — each class keeps the reference's
+constructor signature; forward delegates to the functional op.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import rng_tracker, GLOBAL_STREAM
+from .layer import Layer, Parameter
+from . import functional as F
+from . import functional_extras as FE
+from . import initializer as I
+
+
+# ---------------------------------------------------------------------------
+# simple activation layers
+# ---------------------------------------------------------------------------
+
+def _act_layer(name, fn, params=()):
+    """Build a Layer subclass whose forward calls ``fn(x, *ctor_args)``."""
+
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        vals = list(args)
+        for i, (pname, default) in enumerate(params):
+            if i < len(vals):
+                setattr(self, "_" + pname, vals[i])
+            else:
+                setattr(self, "_" + pname, kwargs.get(pname, default))
+
+    def forward(self, x):
+        return fn(x, *[getattr(self, "_" + p) for p, _ in params])
+
+    cls = type(name, (Layer,), {"__init__": __init__, "forward": forward})
+    return cls
+
+
+Identity = _act_layer("Identity", lambda x: jnp.asarray(x))
+CELU = _act_layer("CELU", FE.celu, params=[("alpha", 1.0)])
+ELU = _act_layer("ELU", F.elu, params=[("alpha", 1.0)])
+GLU = _act_layer("GLU", F.glu, params=[("axis", -1)])
+Hardshrink = _act_layer("Hardshrink", FE.hardshrink,
+                        params=[("threshold", 0.5)])
+Hardtanh = _act_layer("Hardtanh", FE.hardtanh,
+                      params=[("min", -1.0), ("max", 1.0)])
+LogSigmoid = _act_layer("LogSigmoid", FE.log_sigmoid)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, params=[("axis", -1)])
+Maxout = _act_layer("Maxout", FE.maxout,
+                    params=[("groups", 2), ("axis", 1)])
+ReLU6 = _act_layer("ReLU6", F.relu6)
+SELU = _act_layer("SELU", FE.selu,
+                  params=[("scale", 1.0507009873554805),
+                          ("alpha", 1.6732632423543772)])
+Silu = _act_layer("Silu", F.silu)
+Softplus = _act_layer("Softplus", F.softplus,
+                      params=[("beta", 1.0), ("threshold", 20.0)])
+Softshrink = _act_layer("Softshrink", FE.softshrink,
+                        params=[("threshold", 0.5)])
+Softsign = _act_layer("Softsign", FE.softsign)
+Swish = _act_layer("Swish", F.silu)
+Tanhshrink = _act_layer("Tanhshrink", FE.tanhshrink)
+ThresholdedReLU = _act_layer("ThresholdedReLU", FE.thresholded_relu,
+                             params=[("threshold", 1.0), ("value", 0.0)])
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 weight_attr=None, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], initializer=I.Constant(init))
+
+    def forward(self, x):
+        return FE.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0,
+                 name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return FE.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# pooling / padding / shuffle layers
+# ---------------------------------------------------------------------------
+
+def _pool_layer(name, fn, nd_defaults):
+    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+        Layer.__init__(self)
+        self._args = (kernel_size, stride, padding)
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return fn(x, self._args[0], self._args[1], self._args[2],
+                  **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+AvgPool1D = _pool_layer("AvgPool1D", FE.avg_pool1d, 1)
+AvgPool3D = _pool_layer("AvgPool3D", FE.avg_pool3d, 3)
+MaxPool1D = _pool_layer("MaxPool1D", FE.max_pool1d, 1)
+MaxPool3D = _pool_layer("MaxPool3D", FE.max_pool3d, 3)
+
+
+def _adaptive_layer(name, fn):
+    def __init__(self, output_size, **kwargs):
+        Layer.__init__(self)
+        self._output_size = output_size
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return fn(x, self._output_size, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+AdaptiveAvgPool1D = _adaptive_layer("AdaptiveAvgPool1D",
+                                    FE.adaptive_avg_pool1d)
+AdaptiveAvgPool3D = _adaptive_layer("AdaptiveAvgPool3D",
+                                    FE.adaptive_avg_pool3d)
+AdaptiveMaxPool1D = _adaptive_layer("AdaptiveMaxPool1D",
+                                    FE.adaptive_max_pool1d)
+AdaptiveMaxPool2D = _adaptive_layer("AdaptiveMaxPool2D",
+                                    FE.adaptive_max_pool2d)
+AdaptiveMaxPool3D = _adaptive_layer("AdaptiveMaxPool3D",
+                                    FE.adaptive_max_pool3d)
+
+
+def _unpool_layer(cls_name, fn):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        Layer.__init__(self)
+        self._a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, osz = self._a
+        return fn(x, indices, k, stride=s, padding=p, output_size=osz)
+
+    return type(cls_name, (Layer,),
+                {"__init__": __init__, "forward": forward})
+
+
+MaxUnPool1D = _unpool_layer("MaxUnPool1D", FE.max_unpool1d)
+MaxUnPool2D = _unpool_layer("MaxUnPool2D", FE.max_unpool2d)
+MaxUnPool3D = _unpool_layer("MaxUnPool3D", FE.max_unpool3d)
+
+
+class _PadNd(Layer):
+    _nd = 2
+
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        self._padding = padding
+        self._mode = mode
+        self._value = value
+
+    def forward(self, x):
+        return F.pad(x, self._padding, mode=self._mode, value=self._value)
+
+
+class Pad1D(_PadNd):
+    _nd = 1
+
+
+class Pad2D(_PadNd):
+    _nd = 2
+
+
+class Pad3D(_PadNd):
+    _nd = 3
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._padding = padding
+        self._data_format = data_format
+
+    def forward(self, x):
+        return FE.zeropad2d(x, self._padding, self._data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups: int, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return FE.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW",
+                 name=None):
+        super().__init__()
+        self._factor = downscale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor, self._data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis: int, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = shape
+
+    def forward(self, x):
+        from ..tensor.extras import unflatten
+        return unflatten(x, self._axis, self._shape)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return FE.fold(x, *self._a)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self._a)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest",
+                 align_corners: bool = False, align_mode: int = 0,
+                 data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._a = (size, scale_factor, mode, align_corners, data_format)
+
+    def forward(self, x):
+        size, sf, mode, ac, df = self._a
+        return FE.upsample(x, size=size, scale_factor=sf, mode=mode,
+                           align_corners=ac, data_format=df)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW", name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="bilinear", align_corners=True,
+                         data_format=data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW", name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="nearest", data_format=data_format)
+
+
+# ---------------------------------------------------------------------------
+# dropout variants / norms
+# ---------------------------------------------------------------------------
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return FE.alpha_dropout(x, self._p, training=self.training)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._p = p
+        self._data_format = data_format
+
+    def forward(self, x):
+        return FE.dropout2d(x, self._p, training=self.training,
+                            data_format=self._data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW",
+                 name=None):
+        super().__init__()
+        self._p = p
+        self._data_format = data_format
+
+    def forward(self, x):
+        return FE.dropout3d(x, self._p, training=self.training,
+                            data_format=self._data_format)
+
+
+class _InstanceNormNd(Layer):
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 momentum: float = 0.9, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is not False:
+            self.scale = self.create_parameter(
+                [num_features], initializer=I.Constant(1.0))
+        else:
+            self.scale = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_features], initializer=I.Constant(0.0), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return FE.instance_norm(
+            x,
+            weight=self.scale if self.scale is not None else None,
+            bias=self.bias if self.bias is not None else None,
+            eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormNd):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 1.0, data_format: str = "NCHW", name=None):
+        super().__init__()
+        self._a = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return FE.local_response_norm(x, *self._a)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor via power iteration
+    (reference: nn/layer/norm.py SpectralNorm; kernel
+    phi/kernels/impl/spectral_norm_kernel_impl.h). Returns W / sigma(W).
+    u/v vectors are persistent buffers updated on each forward."""
+
+    def __init__(self, weight_shape, axis: int = 0, power_iters: int = 1,
+                 epsilon: float = 1e-12, dtype="float32"):
+        super().__init__()
+        self._axis = axis
+        self._power_iters = power_iters
+        self._eps = epsilon
+        h = int(weight_shape[axis])
+        w = int(np.prod(weight_shape)) // h
+        key = rng_tracker().next_key(GLOBAL_STREAM) \
+            if rng_tracker().has(GLOBAL_STREAM) else jax.random.key(0)
+        k1, k2 = jax.random.split(key)
+        self.register_buffer("weight_u", jax.random.normal(k1, (h,)))
+        self.register_buffer("weight_v", jax.random.normal(k2, (w,)))
+
+    def forward(self, weight):
+        w = jnp.asarray(weight)
+        h = w.shape[self._axis]
+        mat = jnp.moveaxis(w, self._axis, 0).reshape(h, -1)
+        u = self.weight_u
+        v = self.weight_v
+        for _ in range(self._power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self._eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self._eps)
+        sigma = u @ mat @ v
+        if not isinstance(u, jax.core.Tracer):  # persist only eagerly —
+            # under jit the iteration re-runs from the saved buffers
+            self.register_buffer("weight_u", jax.lax.stop_gradient(u))
+            self.register_buffer("weight_v", jax.lax.stop_gradient(v))
+        return w / sigma
+
+
+# ---------------------------------------------------------------------------
+# similarity / distance / misc
+# ---------------------------------------------------------------------------
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self._axis = axis
+        self._eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self._axis, eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self._a = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return FE.pairwise_distance(x, y, *self._a)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features])
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x1, x2):
+        return FE.bilinear(x1, x2, self.weight,
+                           self.bias if self.bias is not None
+                           else None)
+
+
+class ParameterList(Layer):
+    """Indexed parameter container (reference: nn/layer/container.py
+    ParameterList)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._n = 0
+        if parameters is not None:
+            for p in parameters:
+                self.append(p)
+
+    def append(self, parameter):
+        if not isinstance(parameter, Parameter):
+            parameter = Parameter(jnp.asarray(parameter))
+        self.add_parameter(str(self._n), parameter)
+        self._n += 1
+        return self
+
+    def __getitem__(self, idx):
+        if not -self._n <= idx < self._n:
+            raise IndexError(
+                f"index {idx} out of range for ParameterList of length "
+                f"{self._n}")
+        return self._parameters[str(idx % self._n)]
+
+    def __len__(self):
+        return self._n
+
+    def __iter__(self):
+        return iter(self._parameters[str(i)] for i in range(self._n))
+
+
+# ---------------------------------------------------------------------------
+# conv transpose layers
+# ---------------------------------------------------------------------------
+
+class _ConvTransposeNd(Layer):
+    _nd = 1
+    _fn = staticmethod(FE.conv1d_transpose)
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, groups: int = 1,
+                 dilation=1, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        from .functional import _norm_tuple
+        k = _norm_tuple(kernel_size, self._nd)
+        self._a = (stride, padding, output_padding, dilation, groups)
+        fan_in = in_channels * int(np.prod(k))
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *k],
+            initializer=I.Uniform(-bound, bound))
+        self.bias = (self.create_parameter(
+            [out_channels], initializer=I.Uniform(-bound, bound),
+            is_bias=True) if bias_attr is not False else None)
+
+    def forward(self, x):
+        s, p, op, d, g = self._a
+        return self._fn(x, self.weight,
+                        self.bias if self.bias is not None else None,
+                        stride=s, padding=p, output_padding=op, groups=g,
+                        dilation=d)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    _nd = 1
+    _fn = staticmethod(FE.conv1d_transpose)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    _nd = 3
+    _fn = staticmethod(FE.conv3d_transpose)
+
+
+# ---------------------------------------------------------------------------
+# loss layers
+# ---------------------------------------------------------------------------
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean", name=None):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, weight=self._weight,
+                                      reduction=self._reduction)
+
+
+def _loss_layer(cls_name, fn, params):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._kw = {}
+        for i, (p, d) in enumerate(params):
+            if i < len(args):
+                self._kw[p] = args[i]
+            else:
+                self._kw[p] = kwargs.get(p, d)
+
+    def forward(self, *args):
+        return fn(*args, **self._kw)
+
+    return type(cls_name, (Layer,),
+                {"__init__": __init__, "forward": forward})
+
+
+CosineEmbeddingLoss = _loss_layer(
+    "CosineEmbeddingLoss", FE.cosine_embedding_loss,
+    [("margin", 0.0), ("reduction", "mean")])
+HingeEmbeddingLoss = _loss_layer(
+    "HingeEmbeddingLoss", FE.hinge_embedding_loss,
+    [("margin", 1.0), ("reduction", "mean")])
+MarginRankingLoss = _loss_layer(
+    "MarginRankingLoss", FE.margin_ranking_loss,
+    [("margin", 0.0), ("reduction", "mean")])
+PoissonNLLLoss = _loss_layer(
+    "PoissonNLLLoss", FE.poisson_nll_loss,
+    [("log_input", True), ("full", False), ("epsilon", 1e-8),
+     ("reduction", "mean")])
+GaussianNLLLoss = _loss_layer(
+    "GaussianNLLLoss", FE.gaussian_nll_loss,
+    [("full", False), ("epsilon", 1e-6), ("reduction", "mean")])
+MultiLabelSoftMarginLoss = _loss_layer(
+    "MultiLabelSoftMarginLoss", FE.multi_label_soft_margin_loss,
+    [("weight", None), ("reduction", "mean")])
+MultiMarginLoss = _loss_layer(
+    "MultiMarginLoss", FE.multi_margin_loss,
+    [("p", 1), ("margin", 1.0), ("weight", None), ("reduction", "mean")])
+SoftMarginLoss = _loss_layer(
+    "SoftMarginLoss", FE.soft_margin_loss, [("reduction", "mean")])
+TripletMarginLoss = _loss_layer(
+    "TripletMarginLoss", FE.triplet_margin_loss,
+    [("margin", 1.0), ("p", 2.0), ("epsilon", 1e-6), ("swap", False),
+     ("reduction", "mean")])
+TripletMarginWithDistanceLoss = _loss_layer(
+    "TripletMarginWithDistanceLoss", FE.triplet_margin_with_distance_loss,
+    [("distance_function", None), ("margin", 1.0), ("swap", False),
+     ("reduction", "mean")])
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self._blank = blank
+        self._reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times: bool = False):
+        return FE.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                           blank=self._blank, reduction=self._reduction,
+                           norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank: int = 0, fastemit_lambda: float = 0.0,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self._a = (blank, fastemit_lambda, reduction)
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        blank, fe, red = self._a
+        return FE.rnnt_loss(logits, labels, input_lengths, label_lengths,
+                            blank=blank, fastemit_lambda=fe, reduction=red)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom: bool = False,
+                 is_sparse: bool = False, name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size],
+            initializer=I.Uniform(-bound, bound))
+        self.bias = (self.create_parameter(
+            [num_classes - 1], initializer=I.Uniform(-bound, bound),
+            is_bias=True) if bias_attr is not False else None)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return FE.hsigmoid_loss(
+            input, label, self._num_classes, self.weight,
+            self.bias if self.bias is not None else None,
+            path_table=path_table, path_code=path_code)
+
+
+# ---------------------------------------------------------------------------
+# recurrent extras: BiRNN, RNNCellBase, beam search decoding
+# ---------------------------------------------------------------------------
+
+from .rnn import RNN, _CellBase as RNNCellBase  # re-export base
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference: nn/layer/rnn.py
+    BiRNN): concat of forward and time-reversed backward passes."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self._fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self._bw(inputs, st_bw, sequence_length)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over a cell (reference: nn/decode.py
+    BeamSearchDecoder). Tracks log-probs per beam; step = cell forward +
+    top-k over (beam x vocab); finished beams propagate EOS."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, token, states):
+        x = (self.embedding_fn(token) if self.embedding_fn is not None
+             else jax.nn.one_hot(token, getattr(self.cell, "input_size")))
+        out, new_states = self.cell(x, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num: int = 32,
+                   output_time_major: bool = False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Unrolled beam search driving a BeamSearchDecoder (reference:
+    nn/decode.py dynamic_decode). Host-side loop (max_step_num is static);
+    each step is jittable cell compute. Returns (ids [b, T, beam] or
+    time-major, final scores)."""
+    d = decoder
+    b = kwargs.get("batch_size", 1)
+    if inits is not None:
+        leaves = jax.tree.leaves(inits)
+        if leaves:
+            b = leaves[0].shape[0]
+    w = d.beam_size
+    # tile states to [b*w, ...]
+    states = (jax.tree.map(lambda s: jnp.repeat(s, w, axis=0), inits)
+              if inits is not None else None)
+    token = jnp.full((b * w,), d.start_token, jnp.int32)
+    log_probs = jnp.tile(
+        jnp.asarray([0.0] + [-1e9] * (w - 1), jnp.float32), (b,))  # [b*w]
+    finished = jnp.zeros((b * w,), bool)
+    steps = []
+    for _ in range(max_step_num):
+        logits, new_states = d._logits(token, states)
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)            # [b*w, v]
+        # finished beams only extend with end_token at zero cost
+        fin_mask = jnp.full((v,), -1e9).at[d.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], fin_mask[None, :], logp)
+        total = (log_probs[:, None] + logp).reshape(b, w * v)
+        top_lp, top_idx = jax.lax.top_k(total, w)              # [b, w]
+        beam_src = top_idx // v                                # [b, w]
+        token = (top_idx % v).reshape(-1).astype(jnp.int32)
+        gather = (jnp.arange(b)[:, None] * w + beam_src).reshape(-1)
+        states = jax.tree.map(lambda s: s[gather], new_states)
+        finished = finished[gather] | (token == d.end_token)
+        log_probs = top_lp.reshape(-1)
+        steps.append(token.reshape(b, w))
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(steps, axis=0)                             # [T, b, w]
+    if not output_time_major:
+        ids = jnp.moveaxis(ids, 0, 1)                          # [b, T, w]
+    return ids, log_probs.reshape(b, w)

@@ -1,0 +1,77 @@
+"""paddle.nn.quant module-path parity (python/paddle/nn/quant/): the QAT
+layer set and quantize helpers live in paddle_tpu.quantization (observers,
+fake-quant STE, int8 MXU matmul); re-exported here under the reference
+path. The reference's FloatFunctionalLayer wrappers (add/matmul/... as
+layers for quant graph capture) are provided as thin Layer shims."""
+
+import jax.numpy as jnp
+
+from .layer import Layer
+from .quantized_linear import (weight_quantize, weight_dequantize,
+                               weight_only_linear, llm_int8_linear)
+from ..quantization import (QAT, PTQ, QuantConfig, quanter,
+                            BaseQuanter, BaseObserver)
+
+
+class FloatFunctionalLayer(Layer):
+    """Functional-op-as-layer so PTQ/QAT can observe activations at
+    arbitrary op sites (reference: nn/quant/functional_layers.py)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def _functional(fn):
+    return lambda: FloatFunctionalLayer(fn)
+
+
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:])
+    return x.reshape(shape)
+
+
+add = _functional(jnp.add)
+subtract = _functional(jnp.subtract)
+multiply = _functional(jnp.multiply)
+divide = _functional(jnp.divide)
+matmul = _functional(jnp.matmul)
+reshape = _functional(jnp.reshape)
+flatten = _functional(_flatten)
+concat = _functional(jnp.concatenate)
+transpose = _functional(jnp.transpose)
+
+__all__ = ["QAT", "PTQ", "QuantConfig", "quanter", "BaseQuanter",
+           "BaseObserver", "FloatFunctionalLayer", "add", "subtract",
+           "multiply", "divide", "matmul", "reshape", "flatten", "concat",
+           "transpose", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear"]
+
+
+class Stub(Layer):
+    """Observer placeholder (reference: nn/quant/stub.py): identity in the
+    float graph. An explicit ``observer`` quanter is invoked in-place so
+    the site calibrates during PTQ/QAT passes that run the float model;
+    without one the Stub marks the site and passes through."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None:
+            observe = getattr(self._observer, "observe", None)
+            if observe is not None:
+                observe(x)           # calibration side channel; x unchanged
+            else:
+                return self._observer(x)   # quanter: fake-quant in place
+        return x
+
+
+__all__.append("Stub")

@@ -1,0 +1,239 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell/LSTMCell/GRUCell, the RNN sequence wrapper, and the
+multi-layer SimpleRNN/LSTM/GRU with bidirectional support).
+
+TPU-native: the time loop is ``lax.scan`` (one compiled step, unrolled by
+XLA onto the MXU — never a Python loop over timesteps); gate matmuls are
+fused into single [d, 4h]/[d, 3h] projections; state is explicit (initial
+states in, final states out) so the layers jit/vmap/grad cleanly.
+Batch-first [B, T, D] by default like the reference (time_major=False).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU"]
+
+
+class _CellBase(Layer):
+    def __init__(self, input_size: int, hidden_size: int, n_gates: int,
+                 activation=None, dtype=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            [input_size, n_gates * hidden_size], dtype=dtype, initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, n_gates * hidden_size], dtype=dtype, initializer=init)
+        self.bias_ih = self.create_parameter([n_gates * hidden_size],
+                                             dtype=dtype, initializer=init)
+        self.bias_hh = self.create_parameter([n_gates * hidden_size],
+                                             dtype=dtype, initializer=init)
+
+    def _gates(self, x, h):
+        return (x @ self.weight_ih + self.bias_ih
+                + h @ self.weight_hh + self.bias_hh)
+
+
+class SimpleRNNCell(_CellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference SimpleRNNCell)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh", dtype=None):
+        super().__init__(input_size, hidden_size, 1, dtype=dtype)
+        self.activation = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, x, states=None):
+        h = states if states is not None else jnp.zeros(
+            (x.shape[0], self.hidden_size), x.dtype)
+        h_new = self.activation(self._gates(x, h))
+        return h_new, h_new
+
+    def init_state(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+class LSTMCell(_CellBase):
+    """i,f,g,o gate order (reference LSTMCell). states = (h, c)."""
+
+    def __init__(self, input_size: int, hidden_size: int, dtype=None):
+        super().__init__(input_size, hidden_size, 4, dtype=dtype)
+
+    def forward(self, x, states=None):
+        if states is None:
+            states = self.init_state(x.shape[0], x.dtype)
+        h, c = states
+        gates = self._gates(x, h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    def init_state(self, batch, dtype):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+
+class GRUCell(_CellBase):
+    """r,z,c gate order with the reference's (and cuDNN's) candidate form:
+    c = tanh(W_ic x + b_ic + r * (W_hc h + b_hc))."""
+
+    def __init__(self, input_size: int, hidden_size: int, dtype=None):
+        super().__init__(input_size, hidden_size, 3, dtype=dtype)
+
+    def forward(self, x, states=None):
+        h = states if states is not None else jnp.zeros(
+            (x.shape[0], self.hidden_size), x.dtype)
+        xg = x @ self.weight_ih + self.bias_ih
+        hg = h @ self.weight_hh + self.bias_hh
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h_new = (1.0 - z) * c + z * h
+        return h_new, h_new
+
+    def init_state(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+
+def _reverse_sequence(x_tbd, sequence_length):
+    """Reverse each sequence within its own length (tf.reverse_sequence):
+    x is [T, B, D]; padding positions stay in place."""
+    T = x_tbd.shape[0]
+    t = jnp.arange(T)[:, None]                       # [T, 1]
+    lens = jnp.asarray(sequence_length)[None, :]     # [1, B]
+    src = jnp.where(t < lens, lens - 1 - t, t)       # [T, B]
+    return jnp.take_along_axis(x_tbd, src[:, :, None], axis=0)
+
+
+class RNN(Layer):
+    """Sequence wrapper running a cell over time with lax.scan
+    (reference: nn.RNN). Returns (outputs, final_states).
+
+    ``sequence_length`` masks padded timesteps: the state freezes at each
+    sequence's true end (final states match the reference), padded outputs
+    are zeros, and is_reverse reverses each sequence within its own length.
+    """
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)  # [T,B,D]
+        if self.is_reverse:
+            x = (_reverse_sequence(x, sequence_length)
+                 if sequence_length is not None else x[::-1])
+        batch = x.shape[1]
+        state = (initial_states if initial_states is not None
+                 else self.cell.init_state(batch, x.dtype))
+        seq_len = (jnp.asarray(sequence_length)
+                   if sequence_length is not None else None)
+
+        def step(carry, inp):
+            prev_state, t = carry
+            x_t = inp
+            out, new_state = self.cell(x_t, prev_state)
+            if seq_len is not None:
+                active = (t < seq_len)[:, None]
+                new_state = jax.tree.map(
+                    lambda n, p: jnp.where(active, n, p), new_state,
+                    prev_state)
+                out = jnp.where(active, out, jnp.zeros_like(out))
+            return (new_state, t + 1), out
+
+        (final_state, _), outs = jax.lax.scan(step, (state, jnp.int32(0)), x)
+        if self.is_reverse:
+            outs = (_reverse_sequence(outs, sequence_length)
+                    if sequence_length is not None else outs[::-1])
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final_state
+
+
+class _MultiLayerRNN(Layer):
+    """num_layers × (optionally bidirectional) stack (reference SimpleRNN/
+    LSTM/GRU 'direction' = forward|bidirect)."""
+
+    _cell_cls = None
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, dtype=None, **cell_kwargs):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"bad direction {direction!r}")
+        self.bidirectional = direction != "forward"
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        layers_f, layers_b = [], []
+        in_size = input_size
+        for _ in range(num_layers):
+            layers_f.append(RNN(self._cell_cls(in_size, hidden_size,
+                                               dtype=dtype, **cell_kwargs),
+                                time_major=True))
+            if self.bidirectional:
+                layers_b.append(RNN(self._cell_cls(in_size, hidden_size,
+                                                   dtype=dtype, **cell_kwargs),
+                                    is_reverse=True, time_major=True))
+            in_size = hidden_size * (2 if self.bidirectional else 1)
+        from .layer import LayerList
+        self.layers_f = LayerList(layers_f)
+        self.layers_b = LayerList(layers_b) if self.bidirectional else None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        finals = []
+        for li in range(self.num_layers):
+            init = initial_states[li] if initial_states is not None else None
+            if self.bidirectional:
+                init_f, init_b = init if init is not None else (None, None)
+                out_f, st_f = self.layers_f[li](
+                    x, initial_states=init_f, sequence_length=sequence_length)
+                out_b, st_b = self.layers_b[li](
+                    x, initial_states=init_b, sequence_length=sequence_length)
+                x = jnp.concatenate([out_f, out_b], axis=-1)
+                finals.append((st_f, st_b))
+            else:
+                x, st_f = self.layers_f[li](
+                    x, initial_states=init, sequence_length=sequence_length)
+                finals.append(st_f)
+            if self.dropout > 0 and self.training and li < self.num_layers - 1:
+                # inter-layer dropout (reference: the dropout arg of
+                # SimpleRNN/LSTM/GRU applies between stacked layers)
+                from . import functional as F
+                x = F.dropout(x, p=self.dropout, training=True)
+        outs = x if self.time_major else jnp.swapaxes(x, 0, 1)
+        return outs, finals
+
+
+class SimpleRNN(_MultiLayerRNN):
+    _cell_cls = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    _cell_cls = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    _cell_cls = GRUCell

@@ -1,0 +1,300 @@
+"""Transformer layers (reference: python/paddle/nn/layer/transformer.py —
+MultiHeadAttention, TransformerEncoderLayer/Encoder,
+TransformerDecoderLayer/Decoder, Transformer).
+
+TPU-native: attention routes through the framework's
+scaled_dot_product_attention (Pallas flash attention on TPU, XLA fallback);
+projections are single fused matmuls; the decoder's incremental cache
+follows the (k, v) tuple convention so generation loops can carry it.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .common import Dropout, LayerNorm, Linear
+from .layer import Layer, LayerList
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
+           "TransformerEncoder", "TransformerDecoderLayer",
+           "TransformerDecoder", "Transformer"]
+
+
+#: incremental self-attn cache / precomputed cross-attn K,V (reference:
+#: MultiHeadAttention.Cache / .StaticCache in transformer.py)
+Cache = collections.namedtuple("Cache", ["k", "v"])
+StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+
+class MultiHeadAttention(Layer):
+    """reference: transformer.py MultiHeadAttention. Supports self- and
+    cross-attention. ``cache=Cache(k, v)`` appends incremental decoding
+    state; ``cache=StaticCache(k, v)`` reuses precomputed encoder-memory
+    projections (cross attention never recomputes them per step).
+    ``need_weights=True`` returns (out, weights)."""
+
+    Cache = Cache
+    StaticCache = StaticCache
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 kdim: Optional[int] = None, vdim: Optional[int] = None,
+                 need_weights: bool = False, dtype=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.q_proj = Linear(embed_dim, embed_dim, dtype=dtype)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim, dtype=dtype)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim, dtype=dtype)
+        self.out_proj = Linear(embed_dim, embed_dim, dtype=dtype)
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.q_proj(query))
+        new_cache = None
+        if isinstance(cache, StaticCache):
+            k, v = cache.k, cache.v          # memory K/V computed once
+            new_cache = cache
+        else:
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value))
+            if cache is not None:
+                k = jnp.concatenate([cache[0], k], axis=1)
+                v = jnp.concatenate([cache[1], v], axis=1)
+                new_cache = Cache(k, v)
+        if self.need_weights:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(self.head_dim, jnp.float32))
+            logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) * scale
+            if attn_mask is not None:
+                logits = logits + attn_mask
+            weights = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhst,bthd->bshd", weights,
+                             v.astype(jnp.float32)).astype(q.dtype)
+        else:
+            weights = None
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=False,
+                dropout_p=self.dropout, training=self.training)
+        b, s, _, _ = out.shape
+        out = self.out_proj(out.reshape(b, s, self.embed_dim))
+        outs = (out,)
+        if self.need_weights:
+            outs = outs + (weights,)
+        if cache is not None:
+            outs = outs + (new_cache,)
+        return outs[0] if len(outs) == 1 else outs
+
+    def gen_cache(self, key, value=None, type=None):
+        """Cache builders (reference gen_cache): ``type=StaticCache``
+        precomputes K/V projections of the given memory; default returns an
+        empty incremental Cache."""
+        if type is StaticCache or type == "static":
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value if value is not None else key))
+            return StaticCache(k, v)
+        b = key.shape[0]
+        z = jnp.zeros((b, 0, self.num_heads, self.head_dim), key.dtype)
+        return Cache(z, z)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout: Optional[float] = None,
+                 act_dropout: Optional[float] = None,
+                 normalize_before: bool = False, dtype=None):
+        super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before, dtype=dtype)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None
+            else dropout, dtype=dtype)
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(act_dropout if act_dropout is not None
+                                else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = self.self_attn(x, attn_mask=src_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.dropout2(self.activation(self.linear1(y))))
+        y = residual + self.dropout1(y)  # residual dropout on the FFN output
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        if callable(encoder_layer_fn) and not isinstance(encoder_layer_fn,
+                                                         Layer):
+            layers = [encoder_layer_fn() for _ in range(num_layers)]
+        else:
+            # reference semantics: clones are RE-CONSTRUCTED with fresh
+            # init (deepcopy would give every layer identical weights)
+            proto = encoder_layer_fn
+            layers = [proto] + [type(proto)(**proto._config)
+                                for _ in range(num_layers - 1)]
+        self.layers = LayerList(layers)
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=src_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 normalize_before: bool = False, dtype=None):
+        super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation,
+                            normalize_before=normalize_before, dtype=dtype)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            dtype=dtype)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                             dtype=dtype)
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout = Dropout(dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        if cache is not None:
+            self_cache, static_cache = cache
+            sa, new_self_cache = self.self_attn(x, attn_mask=tgt_mask,
+                                                cache=self_cache)
+        else:
+            static_cache = None
+            sa = self.self_attn(x, attn_mask=tgt_mask)
+        x = residual + self.dropout(sa)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        if static_cache is not None:
+            ca, _ = self.cross_attn(y, memory, memory, attn_mask=memory_mask,
+                                    cache=static_cache)
+        else:
+            ca = self.cross_attn(y, memory, memory, attn_mask=memory_mask)
+        y = residual + self.dropout(ca)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = residual + self.dropout(self.linear2(self.dropout(self.activation(
+            self.linear1(z)))))
+        if not self.normalize_before:
+            z = self.norm3(z)
+        if cache is not None:
+            return z, (new_self_cache, static_cache)
+        return z
+
+    def gen_cache(self, memory):
+        """(incremental self-attn Cache, precomputed cross-attn StaticCache)
+        — the reference TransformerDecoderLayer.gen_cache pair."""
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory, type=StaticCache))
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        if callable(decoder_layer_fn) and not isinstance(decoder_layer_fn,
+                                                         Layer):
+            layers = [decoder_layer_fn() for _ in range(num_layers)]
+        else:
+            import copy
+            layers = [decoder_layer_fn] + [copy.deepcopy(decoder_layer_fn)
+                                           for _ in range(num_layers - 1)]
+        self.layers = LayerList(layers)
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        x = tgt
+        for layer in self.layers:
+            x = layer(x, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        if self.norm is not None:
+            x = self.norm(x)
+        return x
+
+
+class Transformer(Layer):
+    """Full encoder-decoder (reference: nn.Transformer)."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu", normalize_before: bool = False,
+                 dtype=None):
+        super().__init__()
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation,
+                                            normalize_before=normalize_before,
+                                            dtype=dtype),
+            num_encoder_layers)
+        self.decoder = TransformerDecoder(
+            lambda: TransformerDecoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation,
+                                            normalize_before=normalize_before,
+                                            dtype=dtype),
+            num_decoder_layers)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length: int):
+        """Additive causal mask (reference convention: 0 on/below diag,
+        -inf above)."""
+        return jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,
+                         -jnp.inf)

@@ -1,0 +1,200 @@
+"""paddle.nn.utils parity: weight_norm / spectral_norm reparameterizations
+and parameter<->vector transforms.
+
+Reference: python/paddle/nn/utils/{weight_norm_hook.py,spectral_norm_hook.py,
+transform_parameters.py,clip_grad_norm_.py,clip_grad_value_.py}. Same
+forward-pre-hook design on this Layer system: the original ``weight``
+Parameter is replaced by the reparameterized leaves (weight_g/weight_v, or
+power-iteration buffers) and a hook recomputes the effective weight INSIDE
+the traced forward, so gradients flow to the new leaves under
+jax.grad/functional_call exactly as the reference's dygraph hooks do.
+
+clip_grad_norm_/clip_grad_value_ take grads explicitly: parameters carry no
+.grad here (grads are functional; docs/DESIGN_DECISIONS.md eager-tape
+entry), so the grads dict/list IS the argument, and the clipped grads are
+returned.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Buffer, Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except_dim(w, dim: int):
+    """L2 norm over all axes except ``dim`` (kept, size preserved for
+    broadcast); dim=-1/None means norm over everything."""
+    if dim is None or dim < 0:
+        return jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w.astype(jnp.float32) ** 2, axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """Reparameterize ``layer.<name>`` as magnitude * direction
+    (reference: weight_norm_hook.py): w = g * v / ||v||, with g and v the
+    new trainable leaves."""
+    if getattr(layer, f"_wn_hook_{name}", None) is not None:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    if name not in layer._parameters:
+        raise ValueError(f"layer has no parameter {name!r}")
+    p = layer._parameters[name]
+    w0 = p.value
+    g0 = _norm_except_dim(w0, dim).astype(w0.dtype)
+    del layer._parameters[name]
+    setattr(layer, name + "_g", Parameter(g0, name=name + "_g"))
+    setattr(layer, name + "_v", Parameter(w0, name=name + "_v"))
+
+    def hook(lyr, args):
+        v = getattr(lyr, name + "_v")
+        g = getattr(lyr, name + "_g")
+        w = (g.astype(jnp.float32) * v.astype(jnp.float32)
+             / jnp.maximum(_norm_except_dim(v, dim), 1e-12)).astype(v.dtype)
+        object.__setattr__(lyr, name, w)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, f"_wn_hook_{name}", (handle, dim))
+    hook(layer, ())          # effective weight available before first call
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g/v back into a plain Parameter and drop the hook."""
+    state = getattr(layer, f"_wn_hook_{name}", None)
+    if state is None:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    handle, dim = state
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    w = (g.astype(jnp.float32) * v.astype(jnp.float32)
+         / jnp.maximum(_norm_except_dim(v, dim), 1e-12)).astype(v.dtype)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    object.__delattr__(layer, f"_wn_hook_{name}")
+    if name in layer.__dict__:
+        object.__delattr__(layer, name)
+    setattr(layer, name, Parameter(w, name=name))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0) -> Layer:
+    """Spectral normalization (reference: spectral_norm_hook.py): the
+    effective weight is w / sigma_max(w), with sigma estimated by power
+    iteration carried in u/v BUFFERS (updated eagerly, like BatchNorm's
+    running stats; stop_gradient'd inside the trace)."""
+    if name not in layer._parameters:
+        raise ValueError(f"layer has no parameter {name!r}")
+    p = layer._parameters[name]
+    w0 = p.value
+    mat0 = jnp.moveaxis(w0, dim, 0).reshape(w0.shape[dim], -1)
+    h, w_ = mat0.shape
+    key = jax.random.PRNGKey(0)
+    u0 = jax.random.normal(key, (h,), jnp.float32)
+    u0 = u0 / jnp.maximum(jnp.linalg.norm(u0), eps)
+    del layer._parameters[name]
+    setattr(layer, name + "_orig", Parameter(w0, name=name + "_orig"))
+    setattr(layer, name + "_u", Buffer(u0, name=name + "_u"))
+
+    def hook(lyr, args):
+        w = getattr(lyr, name + "_orig")
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1) \
+            .astype(jnp.float32)
+        u = getattr(lyr, name + "_u")
+        for _ in range(max(1, n_power_iterations)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        if lyr.training:
+            lyr._buffers[name + "_u"].value = u
+        sigma = u @ (mat @ v)
+        object.__setattr__(lyr, name, (w.astype(jnp.float32) / sigma)
+                           .astype(w.dtype))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters: Iterable) -> jnp.ndarray:
+    """Flatten parameters into one vector (reference:
+    transform_parameters.py)."""
+    vals = [p.value if isinstance(p, Parameter) else jnp.asarray(p)
+            for p in parameters]
+    if not vals:
+        raise ValueError("no parameters given")
+    return jnp.concatenate([v.reshape(-1).astype(jnp.float32) for v in vals])
+
+
+def vector_to_parameters(vec, parameters: Iterable) -> None:
+    """Write a flat vector back into parameters (in place)."""
+    off = 0
+    for p in parameters:
+        tgt = p.value if isinstance(p, Parameter) else jnp.asarray(p)
+        n = int(math.prod(tgt.shape)) if tgt.shape else 1
+        chunk = vec[off:off + n].reshape(tgt.shape).astype(tgt.dtype)
+        off += n
+        if isinstance(p, Parameter):
+            p.value = chunk
+        else:
+            raise TypeError("vector_to_parameters needs Parameter objects "
+                            "to write into")
+    if off != vec.shape[0]:
+        raise ValueError(f"vector length {vec.shape[0]} != total parameter "
+                         f"size {off}")
+
+
+def _grad_list(parameters, grads):
+    if grads is None:
+        raise ValueError(
+            "parameters carry no .grad in paddle_tpu (grads are functional):"
+            " pass them explicitly — clip_grad_norm_(params, max_norm, "
+            "grads=grads_dict_or_list); the clipped grads are returned")
+    if isinstance(grads, dict):
+        return list(grads.keys()), list(grads.values()), True
+    return None, list(grads), False
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False, grads=None):
+    """Global-norm clip over explicit grads (reference:
+    clip_grad_norm_.py). Returns (total_norm, clipped_grads) — the second
+    element replaces the reference's in-place .grad mutation."""
+    keys, gs, is_dict = _grad_list(parameters, grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in gs]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in gs])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(f"non-finite total norm {total}")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    clipped = [(g * scale).astype(g.dtype) for g in gs]
+    out = dict(zip(keys, clipped)) if is_dict else clipped
+    return total, out
+
+
+def clip_grad_value_(parameters, clip_value: float, grads=None):
+    """Elementwise value clip over explicit grads (reference:
+    clip_grad_value_.py); returns the clipped grads."""
+    keys, gs, is_dict = _grad_list(parameters, grads)
+    clipped = [jnp.clip(g, -clip_value, clip_value) for g in gs]
+    return dict(zip(keys, clipped)) if is_dict else clipped

@@ -1,0 +1,193 @@
+"""Minimal ONNX protobuf WIRE-FORMAT writer (and reader, for tests).
+
+Reference analogue: python/paddle/onnx/export.py (which delegates to the
+external paddle2onnx wheel). This environment has no ``onnx`` package, so
+the exporter serializes ModelProto by hand: protobuf wire format is just
+(field_number << 3 | wire_type) tags + varints/length-delimited bytes —
+about a page of code for the message subset ONNX needs. Field numbers are
+from the public onnx.proto3 schema (ONNX IR spec, Apache-2.0).
+
+Only the fields the exporter emits are implemented:
+
+  ModelProto:   ir_version(1)=varint, opset_import(8)=OperatorSetIdProto,
+                producer_name(2)=str, producer_version(3)=str,
+                graph(7)=GraphProto
+  GraphProto:   node(1)*, name(2), initializer(5)*, input(11)*, output(12)*
+  NodeProto:    input(1)*str, output(2)*str, name(3), op_type(4),
+                attribute(5)*
+  AttributeProto: name(1), f(2), i(3), s(4), t(5), floats(7), ints(8),
+                type(20)
+  TensorProto:  dims(1)*, data_type(2), raw_data(9), name(8)
+  ValueInfoProto: name(1), type(2=TypeProto)
+  TypeProto:    tensor_type(1) -> {elem_type(1), shape(2=TensorShapeProto)}
+  TensorShapeProto: dim(1)* -> {dim_value(1) | dim_param(2)}
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# onnx TensorProto.DataType enum (public spec)
+DT = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+      "int64": 7, "bool": 9, "float16": 10, "float64": 11, "uint32": 12,
+      "uint64": 13, "bfloat16": 16}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_FLOATS, AT_INTS = 1, 2, 3, 4, 6, 7
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _str_field(field: int, s: str) -> bytes:
+    return _len_field(field, s.encode())
+
+
+def _int_field(field: int, n: int) -> bytes:
+    return _tag(field, 0) + _varint(n)
+
+
+def _packed_ints(field: int, vals: Sequence[int]) -> bytes:
+    body = b"".join(_varint(v) for v in vals)
+    return _len_field(field, body)
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = DT[str(arr.dtype)] if str(arr.dtype) in DT else DT["float32"]
+    if str(arr.dtype) not in DT:
+        arr = arr.astype(np.float32)
+    out = _packed_ints(1, arr.shape)
+    out += _int_field(2, dt)
+    out += _str_field(8, name)
+    out += _len_field(9, arr.tobytes())
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    out = _str_field(1, name)
+    if isinstance(value, bool):
+        out += _tag(3, 0) + _varint(int(value)) + _int_field(20, AT_INT)
+    elif isinstance(value, int):
+        out += _tag(3, 0) + _varint(value) + _int_field(20, AT_INT)
+    elif isinstance(value, float):
+        out += _tag(2, 5) + struct.pack("<f", value) + _int_field(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode()) + _int_field(20, AT_STRING)
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, tensor_proto(name + "_value", value))
+        out += _int_field(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        out += _len_field(7, b"".join(struct.pack("<f", v) for v in value))
+        out += _int_field(20, AT_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        out += _packed_ints(8, [int(v) for v in value])
+        out += _int_field(20, AT_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Optional[Dict] = None) -> bytes:
+    out = b"".join(_str_field(1, i) for i in inputs)
+    out += b"".join(_str_field(2, o) for o in outputs)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _len_field(5, attribute(k, v))
+    return out
+
+
+def _shape_proto(shape: Sequence[int]) -> bytes:
+    dims = b""
+    for d in shape:
+        dims += _len_field(1, _int_field(1, int(d)))
+    return dims
+
+
+def value_info(name: str, dtype: str, shape: Sequence[int]) -> bytes:
+    tt = _int_field(1, DT.get(dtype, 1)) + _len_field(2, _shape_proto(shape))
+    tp = _len_field(1, tt)
+    return _str_field(1, name) + _len_field(2, tp)
+
+
+def graph(nodes: List[bytes], name: str, inputs: List[bytes],
+          outputs: List[bytes], initializers: List[bytes]) -> bytes:
+    out = b"".join(_len_field(1, n) for n in nodes)
+    out += _str_field(2, name)
+    out += b"".join(_len_field(5, t) for t in initializers)
+    out += b"".join(_len_field(11, i) for i in inputs)
+    out += b"".join(_len_field(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 17,
+          producer: str = "paddle_tpu") -> bytes:
+    opset_b = _str_field(1, "") + _int_field(2, opset)
+    out = _int_field(1, 8)                       # ir_version 8
+    out += _str_field(2, producer)
+    out += _str_field(3, "0.1")
+    out += _len_field(7, graph_bytes)
+    out += _len_field(8, opset_b)
+    return out
+
+
+# -- tiny reader (round-trip validation in tests) ---------------------------
+
+def parse_message(data: bytes) -> Dict[int, list]:
+    """Decode one protobuf message into {field: [values]} (nested messages
+    stay as bytes)."""
+    out: Dict[int, list] = {}
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 2:
+            n, i = _read_varint(data, i)
+            v = data[i:i + n]
+            i += n
+        elif wire == 5:
+            v = struct.unpack("<f", data[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", data[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    n = shift = 0
+    while True:
+        b = data[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7

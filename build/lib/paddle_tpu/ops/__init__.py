@@ -1,0 +1,21 @@
+"""paddle_tpu.ops — the op library.
+
+TPU-native replacement for the reference's PHI kernel library
+(reference: paddle/phi/kernels/ — 415K LoC of CUDA/C++). Here most ops are
+jnp/lax compositions that XLA fuses; the hot set (flash attention, fused
+norms, rope, MoE dispatch) has Pallas TPU kernels under ops/pallas/ selected
+at dispatch time (ops/registry.py) — the analogue of PHI's KernelFactory
+(backend,dtype)-keyed dispatch (paddle/phi/core/kernel_factory.h:314) reduced
+to the one decision XLA doesn't make for us: hand-written kernel vs compiler.
+"""
+
+from . import attention, norm, rope
+from .registry import dispatch, register_kernel, backend_kind
+
+# Pallas TPU kernels register themselves for backend "tpu" on import; the
+# XLA compositions above remain the "any" fallback and the test oracle.
+try:
+    from .pallas import flash_attention as _pallas_flash_attention  # noqa: F401
+    from .pallas import fused_norm as _pallas_fused_norm  # noqa: F401
+except ImportError:  # pragma: no cover — jaxlib without pallas
+    pass

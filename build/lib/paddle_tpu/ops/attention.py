@@ -1,0 +1,88 @@
+"""Attention ops.
+
+Reference analogues: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FA2 via
+dynload, varlen at :91), python/paddle/nn/functional/flash_attention.py.
+
+Layout convention matches the reference flash_attention API:
+  q: [batch, q_seq, num_heads, head_dim]
+  k/v: [batch, kv_seq, num_kv_heads, head_dim]   (GQA when kv_heads < heads)
+
+The XLA fallback computes softmax in fp32 (as FA does). The Pallas TPU
+flash-attention kernel registers itself for backend 'tpu' on import
+(ops/pallas/flash_attention.py); XLA path remains the reference oracle for
+tests.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_kernel, dispatch
+from ..core.rng import rng_tracker, GLOBAL_STREAM
+
+
+def _expand_kv(k, heads):
+    """Broadcast kv heads for GQA: [b, s, kvh, d] -> [b, s, h, d]."""
+    kvh = k.shape[2]
+    if kvh == heads:
+        return k
+    rep = heads // kvh
+    return jnp.repeat(k, rep, axis=2)
+
+
+@register_kernel("flash_attention", "any")
+def _sdpa_xla(q, k, v, attn_mask=None, dropout_p: float = 0.0, causal: bool = False,
+              scale: Optional[float] = None, segment_ids=None):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if segment_ids is not None:
+        # packed-varlen masking (the flash kernel's native form): equal-id
+        # positions attend; fold into the boolean mask for the XLA path
+        q_seg, kv_seg = (segment_ids if isinstance(segment_ids, (tuple, list))
+                         else (segment_ids, segment_ids))
+        seg = (jnp.asarray(q_seg)[:, :, None]
+               == jnp.asarray(kv_seg)[:, None, :])[:, None]   # [b,1,sq,sk]
+        if attn_mask is None:
+            attn_mask = seg
+        elif attn_mask.dtype == jnp.bool_:
+            attn_mask = attn_mask & seg
+        else:
+            attn_mask = attn_mask + jnp.where(seg, 0.0, -jnp.inf).astype(
+                attn_mask.dtype)
+    k = _expand_kv(k, h)
+    v = _expand_kv(v, h)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [b, h, sq, sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        # bottom-right aligned causal mask (FA convention for sq != sk)
+        qi = jnp.arange(sq)[:, None] + (sk - sq)
+        ki = jnp.arange(sk)[None, :]
+        cmask = ki <= qi
+        logits = jnp.where(cmask[None, None], logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0:
+        key = rng_tracker().next_key(GLOBAL_STREAM)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, attn_mask=None, dropout_p: float = 0.0,
+                    causal: bool = False, scale: Optional[float] = None,
+                    segment_ids=None):
+    impl = dispatch("flash_attention")
+    return impl(q, k, v, attn_mask=attn_mask, dropout_p=dropout_p, causal=causal,
+                scale=scale, segment_ids=segment_ids)

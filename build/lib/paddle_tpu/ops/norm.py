@@ -1,0 +1,61 @@
+"""Fused normalization ops.
+
+Reference analogues: paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu
+(fused residual-add + RMS/LayerNorm) and
+python/paddle/incubate/nn/functional/{fused_rms_norm,fused_layer_norm}.py.
+
+On TPU the stats are computed in fp32 (numerics match the reference's
+fp32 accumulation) and XLA fuses the whole normalization into neighbouring
+ops; a Pallas kernel is registered for the RMS-norm hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_kernel, dispatch
+
+
+@register_kernel("layer_norm", "any")
+def _layer_norm_xla(x, weight, bias, epsilon):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dt)
+
+
+@register_kernel("rms_norm", "any")
+def _rms_norm_xla(x, weight, epsilon):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(dt)
+
+
+def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5):
+    return dispatch("layer_norm")(x, weight, bias, epsilon)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    return dispatch("rms_norm")(x, weight, epsilon)
+
+
+def fused_add_rms_norm(x, residual, weight, epsilon: float = 1e-6):
+    """Residual-add + RMS norm, returning (normed, new_residual) — mirrors the
+    reference's fused_layernorm residual contract
+    (paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu)."""
+    h = x + residual
+    return rms_norm(h, weight, epsilon), h

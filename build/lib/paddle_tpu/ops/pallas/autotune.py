@@ -1,0 +1,141 @@
+"""Kernel autotuning cache (block-size selection per shape/device).
+
+Reference analogue: the PHI runtime autotuner —
+paddle/phi/kernels/autotune/auto_tune_base.h (TuneBase::Run candidate
+timing), cache.h (AutoTuneCache keyed on algorithm+shape), and
+switch_autotune.h (step-gated tuning) — plus CINN's persistent tuning DB
+(paddle/cinn/auto_schedule/database/). TPU redesign: Pallas kernels have a
+tiny discrete config space (block_q, block_k), so instead of an in-process
+exhaustive timer on first call (bad under jit: retrace per config), tuning
+is OFFLINE (tools/tune_kernels.py sweeps on real hardware) and the result
+is a JSON database consulted at dispatch time:
+
+    key = op | device_kind | dtype | bucketed shape signature
+
+Shapes bucket to powers of two so one sweep covers a family; lookups fall
+back to the nearest recorded bucket, then to the built-in defaults. A
+user-writable overlay (PT_TUNE_DB env or ~/.cache/paddle_tpu/) is merged
+over the shipped DB so `tools/tune_kernels.py --write` results win.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+_SHIPPED = os.path.join(os.path.dirname(__file__), "tune_db.json")
+
+
+def _user_db_path() -> str:
+    env = os.environ.get("PT_TUNE_DB")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "tune_db.json")
+
+
+class TuneDB:
+    """Merged shipped + user kernel-config database."""
+
+    def __init__(self):
+        self._db: Dict[str, dict] = {}
+        self._loaded = False
+        self._dirty = False
+
+    def _load(self):
+        if self._loaded:
+            return
+        for path in (_SHIPPED, _user_db_path()):
+            try:
+                with open(path) as f:
+                    self._db.update(json.load(f))
+            except (OSError, ValueError):
+                pass
+        self._loaded = True
+
+    @staticmethod
+    def bucket(n: int) -> int:
+        """Round up to the next power of two (min 128)."""
+        b = 128
+        while b < n:
+            b <<= 1
+        return b
+
+    @staticmethod
+    def key(op: str, device_kind: str, dtype: str, **dims) -> str:
+        sig = ",".join(f"{k}={TuneDB.bucket(v) if k.startswith('s') else v}"
+                       for k, v in sorted(dims.items()))
+        return f"{op}|{device_kind.lower().replace(' ', '_')}|{dtype}|{sig}"
+
+    def lookup(self, key: str) -> Optional[dict]:
+        self._load()
+        return self._db.get(key)
+
+    def record(self, key: str, config: dict):
+        self._load()
+        self._db[key] = config
+        self._dirty = True
+
+    def save(self, path: Optional[str] = None):
+        path = path or _user_db_path()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # merge-over-existing so concurrent tuners don't clobber each other
+        merged = {}
+        try:
+            with open(path) as f:
+                merged = json.load(f)
+        except (OSError, ValueError):
+            pass
+        merged.update(self._db)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        self._dirty = False
+
+
+_DB = TuneDB()
+
+
+def _default_blocks(sq: int, sk: int) -> Tuple[int, int]:
+    """Heuristic when the DB has no entry: the v5-chip sweep (round 3)
+    showed larger blocks amortize the per-step grid overhead — bq=512/
+    bk=1024 ran ~2.8x faster than 128/128 at s=2048 — so pick the largest
+    candidate that divides the sequence (divisibility is required for the
+    pallas path to be selected at all)."""
+    bq = next((c for c in (512, 256, 128) if sq % c == 0), 128)
+    bk = next((c for c in (1024, 512, 256, 128) if sk % c == 0), 128)
+    return bq, bk
+
+
+def flash_attention_config(sq: int, sk: int, d: int,
+                           dtype: str, causal: bool) -> Tuple[int, int]:
+    """(block_q, block_k) for a flash-attention call: tuned if the DB has
+    this (bucketed) shape on this device, else shape-aware defaults.
+    Batch and head count are deliberately NOT part of the key: they scale
+    the parallel grid dims, not the per-block working set the block sizes
+    tile, so one sweep covers all (b, h)."""
+    from ..registry import backend_kind
+    if backend_kind() != "tpu":
+        return 128, 128
+    try:
+        import jax
+        kind = getattr(jax.devices()[0], "device_kind", "tpu")
+    except Exception:
+        kind = "tpu"
+    key = TuneDB.key("flash_attention", kind, dtype,
+                     sq=sq, sk=sk, d=d, causal=int(causal))
+    hit = _DB.lookup(key)
+    if hit and sq % int(hit["block_q"]) == 0 and sk % int(hit["block_k"]) == 0:
+        return int(hit["block_q"]), int(hit["block_k"])
+    return _default_blocks(sq, sk)
+
+
+def get_db() -> TuneDB:
+    return _DB
+
+
+__all__ = ["TuneDB", "get_db", "flash_attention_config"]

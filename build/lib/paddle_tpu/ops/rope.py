@@ -1,0 +1,65 @@
+"""Rotary position embedding.
+
+Reference analogue: paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu and
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py.
+
+Implements the NEOX/Llama rotate-half convention on [b, s, h, d] tensors;
+cos/sin are computed once per (seq, dim) and broadcast — XLA fuses the
+elementwise rotation into adjacent matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, max_seq: int, base: float = 10000.0,
+               scaling_factor: float = 1.0, dtype=jnp.float32):
+    """Precompute (cos, sin) tables [max_seq, head_dim]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32) / scaling_factor
+    freqs = jnp.outer(t, inv_freq)                 # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [s, d]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
+    """q,k: [b, s, h, d]; cos/sin: [max_seq, d] or [s, d].
+
+    Mirrors fused_rotary_position_embedding(use_neox_rotary_style=True).
+    """
+    s = q.shape[1]
+    if position_ids is not None:
+        cos = cos[position_ids]          # [b, s, d]
+        sin = sin[position_ids]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[:s][None, :, None, :]  # [1, s, 1, d]
+        sin = sin[:s][None, :, None, :]
+    cos = cos.astype(q.dtype)
+    sin = sin.astype(q.dtype)
+    q_out = q * cos + _rotate_half(q) * sin
+    k_out = k * cos + _rotate_half(k) * sin
+    return q_out, k_out
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """API-parity wrapper (reference:
+    python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py).
+    Note argument order (sin, cos) follows the reference."""
+    if cos is None or sin is None:
+        raise ValueError("cos/sin tables required")
+    if cos.ndim == 4:  # reference passes [1, s, 1, d]
+        cos = cos[0, :, 0, :]
+        sin = sin[0, :, 0, :]
+    q_out, k_out = apply_rotary_pos_emb(q, k, cos, sin, position_ids)
+    return q_out, k_out, v

@@ -1,0 +1,10 @@
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
+
+Reference: python/paddle/optimizer/ (Optimizer base at optimizer.py:103).
+"""
+
+from . import lr
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+from .lbfgs import LBFGS
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad,
+                        RMSProp, Adadelta, Lamb, Rprop)

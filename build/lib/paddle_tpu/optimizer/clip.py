@@ -1,0 +1,57 @@
+"""Gradient clipping.
+
+Reference: python/paddle/nn/clip.py — ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm. Global-norm clipping accumulates the squared norm in
+fp32 across the whole grad pytree (the distributed-aware variant lives in
+parallel/hybrid_optimizer.py, mirroring HybridParallelClipGrad,
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:44).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads: dict) -> dict:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max: float, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            return (g.astype(jnp.float32) * factor).astype(g.dtype)
+        return jax.tree.map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def global_norm(self, grads):
+        leaves = jax.tree.leaves(grads)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        return jnp.sqrt(sq)
+
+    def __call__(self, grads):
+        gnorm = self.global_norm(grads)
+        factor = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype), grads)

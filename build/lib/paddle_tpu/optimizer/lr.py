@@ -1,0 +1,316 @@
+"""Learning-rate schedulers.
+
+Reference: python/paddle/optimizer/lr.py (~20 schedulers; LRScheduler base
+with ``step()``/``get_lr()``/``state_dict()``). Semantics match: ``step()``
+advances ``last_epoch`` and recomputes ``last_lr``; optimizers read
+``scheduler.get_last_lr()`` each step (host-side scalar — passed into the
+jitted update as an argument, so changing lr never retraces).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+
+class LRScheduler:
+    def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.last_lr = learning_rate
+        self.step()  # paddle initializes by stepping to epoch 0
+
+    def get_lr(self) -> float:
+        raise NotImplementedError
+
+    def step(self, epoch: Optional[int] = None) -> None:
+        if epoch is None:
+            self.last_epoch += 1
+        else:
+            self.last_epoch = epoch
+        self.last_lr = self.get_lr()
+
+    def get_last_lr(self) -> float:
+        return self.last_lr
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+        self.last_lr = state["last_lr"]
+
+    # paddle compat
+    set_dict = set_state_dict
+    state_keys = state_dict
+
+    def __call__(self) -> float:
+        return self.last_lr
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model: int, warmup_steps: int, learning_rate: float = 1.0,
+                 last_epoch: int = -1, verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        step = max(self.last_epoch, 1)
+        return (self.base_lr * self.d_model ** -0.5 *
+                min(step ** -0.5, step * self.warmup_steps ** -1.5))
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float],
+                 last_epoch: int = -1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def get_lr(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.last_epoch < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * math.exp(-self.gamma * self.last_epoch)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.gamma ** self.last_epoch
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
+                 verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int, end_lr: float = 0.0001,
+                 power: float = 1.0, cycle: bool = False, last_epoch: int = -1,
+                 verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        step = self.last_epoch
+        if self.cycle:
+            div = math.ceil(step / self.decay_steps) if step > 0 else 1
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            step = min(step, decay_steps)
+        return ((self.base_lr - self.end_lr) *
+                (1 - step / decay_steps) ** self.power + self.end_lr)
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float, last_epoch: int = -1, verbose=False):
+        self.lr_after = learning_rate  # float or LRScheduler
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(start_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch < self.warmup_steps:
+            return (self.end_lr - self.start_lr) * self.last_epoch / max(
+                self.warmup_steps, 1) + self.start_lr
+        if isinstance(self.lr_after, LRScheduler):
+            self.lr_after.step(self.last_epoch - self.warmup_steps)
+            return self.lr_after.get_last_lr()
+        return self.lr_after
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int, eta_min: float = 0.0,
+                 last_epoch: int = -1, verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return (self.eta_min + (self.base_lr - self.eta_min) *
+                (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int, gamma: float = 0.1,
+                 last_epoch: int = -1, verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones: Sequence[int],
+                 gamma: float = 0.1, last_epoch: int = -1, verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        n = sum(1 for m in self.milestones if self.last_epoch >= m)
+        return self.base_lr * self.gamma ** n
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda, last_epoch: int = -1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.lr_lambda(self.last_epoch)
+
+
+class ReduceOnPlateau(LRScheduler):
+    def __init__(self, learning_rate: float, mode: str = "min", factor: float = 0.1,
+                 patience: int = 10, threshold: float = 1e-4,
+                 threshold_mode: str = "rel", cooldown: int = 0, min_lr: float = 0,
+                 epsilon: float = 1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.epsilon = epsilon
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self.base_lr = learning_rate
+        self.last_lr = learning_rate
+        self.last_epoch = 0
+
+    def _better(self, a, b):
+        if self.mode == "min":
+            if self.threshold_mode == "rel":
+                return a < b * (1 - self.threshold)
+            return a < b - self.threshold
+        if self.threshold_mode == "rel":
+            return a > b * (1 + self.threshold)
+        return a > b + self.threshold
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return
+        m = float(metrics)
+        self.last_epoch += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad = 0
+        if self.best is None or self._better(m, self.best):
+            self.best = m
+            self.num_bad = 0
+        else:
+            self.num_bad += 1
+        if self.num_bad > self.patience:
+            new_lr = max(self.last_lr * self.factor, self.min_lr)
+            if self.last_lr - new_lr > self.epsilon:
+                self.last_lr = new_lr
+            self.cooldown_counter = self.cooldown
+            self.num_bad = 0
+
+    def get_lr(self):
+        return self.last_lr
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate: float, total_steps: int,
+                 divide_factor: float = 25.0, end_learning_rate: float = 0.0001,
+                 phase_pct: float = 0.3, anneal_strategy: str = "cos",
+                 three_phase: bool = False, last_epoch: int = -1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        self.anneal = anneal_strategy
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def _anneal(self, start, end, pct):
+        if self.anneal == "cos":
+            return end + (start - end) / 2.0 * (math.cos(math.pi * pct) + 1)
+        return (end - start) * pct + start
+
+    def get_lr(self):
+        step = min(self.last_epoch, self.total_steps)
+        up_steps = int(self.phase_pct * self.total_steps)
+        if step <= up_steps:
+            return self._anneal(self.initial_lr, self.max_lr, step / max(up_steps, 1))
+        down = (step - up_steps) / max(self.total_steps - up_steps, 1)
+        return self._anneal(self.max_lr, self.end_lr, down)
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate: float, max_learning_rate: float,
+                 step_size_up: int, step_size_down: Optional[int] = None,
+                 mode: str = "triangular", exp_gamma: float = 1.0,
+                 scale_fn=None, scale_mode: str = "cycle", last_epoch: int = -1,
+                 verbose=False):
+        self.base_lr_ = base_learning_rate
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down if step_size_down is not None else step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.up + self.down
+        cycle = math.floor(1 + self.last_epoch / total)
+        x = self.last_epoch - (cycle - 1) * total
+        pct = x / self.up if x <= self.up else 1 - (x - self.up) / self.down
+        scale = 1.0
+        if self.mode == "triangular2":
+            scale = 1 / (2 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            scale = self.exp_gamma ** self.last_epoch
+        return self.base_lr_ + (self.max_lr - self.base_lr_) * pct * scale
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate: float, T_0: int, T_mult: int = 1,
+                 eta_min: float = 0.0, last_epoch: int = -1, verbose=False):
+        self.T_0 = T_0
+        self.T_mult = T_mult
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = self.last_epoch
+        T_i = self.T_0
+        while t >= T_i:
+            t -= T_i
+            T_i *= self.T_mult
+        return self.eta_min + (self.base_lr - self.eta_min) * (
+            1 + math.cos(math.pi * t / T_i)) / 2

@@ -1,0 +1,17 @@
+"""paddle_tpu.parallel — GSPMD parallelism (reference analogue:
+python/paddle/distributed/ — fleet topology, auto_parallel api, collectives,
+mp/sp layers, MoE, and the long-context attention the TPU build adds)."""
+
+from .mesh import HybridMesh, current_mesh, init_parallel_env, AXES_ORDER
+from .api import (shard_tensor, reshard, shard_layer, shard_optimizer_state,
+                  param_spec_tree, Shard, Replicate, Partial, Placement)
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                        RowParallelLinear, ParallelCrossEntropy,
+                        parallel_cross_entropy, scatter_seq, gather_seq,
+                        ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+from .moe import MoELayer, MoEMLP, top_k_gating
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention, ulysses_supported
+from .pipeline import (LayerDesc, SharedLayerDesc, SegmentLayers,
+                       PipelineStack, PipelineLayer, pipeline_spmd,
+                       microbatch, unmicrobatch)

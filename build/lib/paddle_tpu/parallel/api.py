@@ -1,0 +1,187 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:118,
+reshard:282, shard_layer:381, shard_optimizer:710). On TPU the DistTensor +
+37 C++ SPMD rules + reshard machinery (paddle/phi/infermeta/spmd_rules/,
+phi/core/distributed/auto_parallel/reshard/) collapse into GSPMD: a
+NamedSharding annotation on the array; XLA propagates shardings and inserts
+collectives. ``reshard`` is a device_put / with_sharding_constraint; the
+placement-pair registry of the reference (reshard_function_registry.cc) is
+XLA's job here.
+
+Placement vocabulary mirrors the reference's (placement_types.h:36):
+Shard(dim), Replicate(), Partial() — translated to PartitionSpec entries.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer, Parameter
+from .mesh import HybridMesh, current_mesh
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Partial(Placement):
+    """Pending-reduction placement (reference: placement_types.h:36).
+    GSPMD only materializes partial sums inside collectives, so a
+    user-visible Partial tensor has no XLA representation — requesting one
+    raises rather than silently replicating."""
+
+    def __repr__(self):
+        return "Partial()"
+
+
+def _placements_to_spec(ndim: int, mesh: Mesh, placements: Sequence[Placement]
+                        ) -> PartitionSpec:
+    """Map per-mesh-axis placements (reference convention: placements[i] is
+    the placement along mesh axis i) to a per-tensor-dim PartitionSpec."""
+    axis_names = list(mesh.axis_names)
+    dims: List[Optional[List[str]]] = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Partial):
+            raise NotImplementedError(
+                "Partial placement has no standalone GSPMD representation; "
+                "reduce explicitly (psum inside shard_map) or use "
+                "Shard/Replicate")
+        if isinstance(pl, Shard):
+            name = axis_names[axis_idx]
+            if dims[pl.dim] is None:
+                dims[pl.dim] = [name]
+            else:
+                dims[pl.dim].append(name)
+    entries = [tuple(d) if d and len(d) > 1 else (d[0] if d else None)
+               for d in dims]
+    return PartitionSpec(*entries)
+
+
+def _resolve_mesh(mesh) -> Mesh:
+    if mesh is None:
+        hm = current_mesh()
+        if hm is None:
+            raise RuntimeError("no active mesh: use `with HybridMesh.build(...)`"
+                               " or pass mesh explicitly")
+        return hm.mesh
+    if isinstance(mesh, HybridMesh):
+        return mesh.mesh
+    return mesh
+
+
+def shard_tensor(x, mesh=None, placements: Sequence[Placement] = (),
+                 spec: Optional[PartitionSpec] = None):
+    """Place ``x`` on the mesh with the given placements (or PartitionSpec).
+
+    dist.shard_tensor analogue (api.py:118). Works eagerly (device_put) and
+    under jit (sharding constraint).
+    """
+    m = _resolve_mesh(mesh)
+    if spec is None:
+        spec = _placements_to_spec(jnp.ndim(x), m, placements)
+    sh = NamedSharding(m, spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)
+
+
+def reshard(x, mesh=None, placements: Sequence[Placement] = (),
+            spec: Optional[PartitionSpec] = None):
+    """Transition to new placements — reference reshard (api.py:282); every
+    (src,dst) placement pair of the C++ registry (SURVEY.md A.4) is handled
+    by XLA's resharding (all-gather / all-to-all / slice as needed)."""
+    return shard_tensor(x, mesh, placements, spec)
+
+
+def _clean_spec(entries, mesh: Mesh) -> PartitionSpec:
+    """Drop axis names the mesh doesn't have or that have size 1 (e.g. a tp
+    annotation on a dp-only mesh) — one definition shared by shard_layer and
+    param_spec_tree so their results can never diverge."""
+    if not entries:
+        return PartitionSpec()
+    cleaned = []
+    for e in entries:
+        if e is None:
+            cleaned.append(None)
+        elif isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e
+                         if a in mesh.axis_names and mesh.shape[a] > 1)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(e if (e in mesh.axis_names and mesh.shape[e] > 1)
+                           else None)
+    return PartitionSpec(*cleaned)
+
+
+def shard_layer(layer: Layer, mesh=None,
+                shard_fn=None, input_fn=None, output_fn=None) -> Layer:
+    """Place every parameter of ``layer`` according to its Parameter.sharding
+    annotation (set by parallel layer builders / plan fns), replicating
+    unannotated ones. ``input_fn(inputs, mesh)`` / ``output_fn(outputs,
+    mesh)`` are installed as forward pre/post hooks, matching the reference
+    contract (dist.shard_layer, api.py:381)."""
+    m = _resolve_mesh(mesh)
+    for name, p in layer.named_parameters():
+        if shard_fn is not None:
+            shard_fn(name, p, m)
+        spec = _clean_spec(p.sharding, m)
+        p.value = jax.device_put(p.value, NamedSharding(m, spec))
+    for _, b in layer.named_buffers():
+        b.value = jax.device_put(b.value, NamedSharding(m, PartitionSpec()))
+    if input_fn is not None:
+        layer.register_forward_pre_hook(lambda lyr, inputs: input_fn(inputs, m))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, m))
+    return layer
+
+
+def shard_optimizer_state(state, params_spec: Dict[str, PartitionSpec], mesh=None):
+    """Shard optimizer slots/master weights like their parameters
+    (reference: dist.shard_optimizer, api.py:710)."""
+    m = _resolve_mesh(mesh)
+
+    def place(path_params: Dict[str, jax.Array], like: Dict[str, PartitionSpec]):
+        out = {}
+        for k, v in path_params.items():
+            spec = like.get(k, PartitionSpec())
+            out[k] = jax.device_put(v, NamedSharding(m, spec))
+        return out
+
+    new_state = dict(state)
+    if "master" in state:
+        new_state["master"] = place(state["master"], params_spec)
+    if "slots" in state:
+        new_slots = {}
+        for k, slots in state["slots"].items():
+            spec = params_spec.get(k, PartitionSpec())
+            # moment slots are param-shaped → same sharding as the param
+            new_slots[k] = {sk: jax.device_put(sv, NamedSharding(m, spec))
+                            for sk, sv in slots.items()}
+        new_state["slots"] = new_slots
+    return new_state
+
+
+def param_spec_tree(layer: Layer, mesh=None) -> Dict[str, PartitionSpec]:
+    """name → PartitionSpec for every trainable param (cleaned against mesh)."""
+    m = _resolve_mesh(mesh)
+    return {name: _clean_spec(p.sharding, m)
+            for name, p in layer.named_parameters() if p.trainable}

@@ -1,0 +1,140 @@
+"""Device mesh & hybrid topology.
+
+TPU-native analogue of the reference's hybrid-parallel topology
+(reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology:61 over axes ["data","pipe","sharding","sep","model"],
+HybridCommunicateGroup:174). On TPU the N-D rank topology IS a
+jax.sharding.Mesh: one mesh with named axes replaces all per-axis NCCL comm
+groups; XLA collectives ride ICI/DCN according to axis order (outermost =
+slowest-varying = DCN for multi-host meshes, per jax.make_mesh device order).
+
+Axis naming convention (mirrors fleet's): "dp" data, "fsdp" sharding/ZeRO,
+"pp" pipeline, "sep" sequence, "tp" model/tensor, "ep" expert.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES_ORDER = ("pp", "dp", "fsdp", "sep", "tp")  # outer→inner (DCN→ICI)
+
+_CURRENT: List["HybridMesh"] = []
+
+
+class HybridMesh:
+    """A named device mesh plus topology queries shaped like
+    HybridCommunicateGroup (get_model_parallel_world_size etc.)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.ep_degree = 1
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def build(dp: int = 1, fsdp: int = 1, tp: int = 1, pp: int = 1, sep: int = 1,
+              ep: int = 1, devices=None) -> "HybridMesh":
+        """Create a hybrid mesh. Axis sizes must multiply to the device count.
+
+        Mirrors fleet.init's strategy degrees (reference:
+        fleet/base/topology.py:64 axis order) but expressed as one Mesh.
+        The "ep" axis, when used, aliases the fsdp×tp submesh the way the
+        reference reuses comm groups for expert parallel.
+        """
+        devices = list(jax.devices()) if devices is None else list(devices)
+        sizes = {"pp": pp, "dp": dp, "fsdp": fsdp, "sep": sep, "tp": tp}
+        total = int(np.prod(list(sizes.values())))
+        if total != len(devices):
+            raise ValueError(
+                f"mesh degrees {sizes} multiply to {total} but {len(devices)} "
+                f"devices are available")
+        if ep != 1 and (dp * fsdp) % ep != 0:
+            raise ValueError(
+                f"ep={ep} must divide dp*fsdp={dp * fsdp}: expert parallelism "
+                f"reuses the data/sharding submesh (reference: fleet reuses "
+                f"comm groups for MoE's all-to-all)")
+        arr = np.array(devices).reshape([sizes[a] for a in AXES_ORDER])
+        mesh = Mesh(arr, AXES_ORDER)
+        hm = HybridMesh(mesh)
+        hm.ep_degree = ep
+        return hm
+
+    # -- topology queries (reference: HybridCommunicateGroup) ---------------
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape.get(name, 1)
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.axis_size("dp") * self.axis_size("fsdp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.axis_size("tp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.axis_size("pp")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.axis_size("fsdp")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self.axis_size("sep")
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self.ep_degree
+
+    @property
+    def nproc(self) -> int:
+        return self.mesh.size
+
+    # -- context ------------------------------------------------------------
+
+    def __enter__(self):
+        self.mesh.__enter__()
+        _CURRENT.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CURRENT.pop()
+        return self.mesh.__exit__(*exc)
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def __repr__(self):
+        return f"HybridMesh({dict(self.mesh.shape)})"
+
+
+def current_mesh() -> Optional[HybridMesh]:
+    if _CURRENT:
+        return _CURRENT[-1]
+    # fall back to jax's ambient mesh if one is active
+    try:
+        env_mesh = jax._src.mesh.thread_resources.env.physical_mesh
+        if env_mesh is not None and not env_mesh.empty:
+            return HybridMesh(env_mesh)
+    except Exception:
+        pass
+    return None
+
+
+def init_parallel_env(dp: int = 1, fsdp: int = 1, tp: int = 1, pp: int = 1,
+                      sep: int = 1) -> HybridMesh:
+    """Multi-host bootstrap + mesh creation.
+
+    Reference analogue: paddle.distributed.init_parallel_env
+    (python/paddle/distributed/parallel.py:943 — TCPStore rendezvous +
+    default ProcessGroup). On TPU, jax.distributed.initialize's coordination
+    service is the TCPStore equivalent; it is a no-op on single-host.
+    """
+    import os
+    if "JAX_COORDINATOR_ADDRESS" in os.environ and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass  # already initialized or single-process
+    return HybridMesh.build(dp=dp, fsdp=fsdp, tp=tp, pp=pp, sep=sep)

@@ -1,0 +1,214 @@
+"""Reshard-function registry (reference:
+paddle/phi/core/distributed/auto_parallel/reshard/ — per placement-pair
+functions {s_to_r, p_to_r, r_to_s, s_to_s, r_to_p} chosen by
+reshard_function_registry.cc, with nd_mesh_reshard_function.cc decomposing
+N-D transitions into 1-D steps; SURVEY.md A.4).
+
+TPU-native: layout-only transitions (Shard↔Replicate↔Shard) are a single
+``jax.device_put`` — GSPMD emits the all-gather/slice/all-to-all. What
+GSPMD can NOT express from sharding alone is **Partial** (pending-reduction)
+state, because a partial array's *values* differ per shard while its
+sharding says replicated. Those transitions run an explicit collective
+under shard_map here (p→r = psum, p→s = reduce_scatter), which is exactly
+the reference's reshard kernel division of labor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .api import Placement, Replicate, Shard, Partial, _resolve_mesh
+
+__all__ = ["ReshardFunction", "register_reshard_function",
+           "choose_reshard_function", "reshard_with_registry"]
+
+
+_REGISTRY: List["ReshardFunction"] = []
+
+
+def register_reshard_function(cls: Type["ReshardFunction"]):
+    _REGISTRY.append(cls())
+    return cls
+
+
+class ReshardFunction:
+    """One placement-pair transition (reference reshard_function.h)."""
+
+    def is_suitable(self, src: Placement, dst: Placement) -> bool:
+        raise NotImplementedError
+
+    def eval(self, x, mesh: Mesh, axis: str, src: Placement, dst: Placement,
+             dim_spec: List):
+        """Apply the transition over mesh axis ``axis``. ``dim_spec`` is the
+        current full PartitionSpec entries list (mutated by Shard moves)."""
+        raise NotImplementedError
+
+
+def _spec_from(entries) -> P:
+    return P(*entries)
+
+
+def _put(x, mesh, entries):
+    return jax.device_put(x, NamedSharding(mesh, _spec_from(entries)))
+
+
+@register_reshard_function
+class SToRReshardFunction(ReshardFunction):
+    """Shard→Replicate = all-gather (reference s_to_r_reshard_function.cc:72);
+    GSPMD inserts it from the sharding change."""
+
+    def is_suitable(self, src, dst):
+        return isinstance(src, Shard) and isinstance(dst, Replicate)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        dim_spec[src.dim] = _drop(dim_spec[src.dim], axis)
+        return _put(x, mesh, dim_spec)
+
+
+@register_reshard_function
+class RToSReshardFunction(ReshardFunction):
+    """Replicate→Shard = local slice (r_to_s_reshard_function.cc)."""
+
+    def is_suitable(self, src, dst):
+        return isinstance(src, Replicate) and isinstance(dst, Shard)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        dim_spec[dst.dim] = _add(dim_spec[dst.dim], axis)
+        return _put(x, mesh, dim_spec)
+
+
+@register_reshard_function
+class SToSReshardFunction(ReshardFunction):
+    """Shard(i)→Shard(j) = all-to-all (s_to_s_reshard_function.cc)."""
+
+    def is_suitable(self, src, dst):
+        return (isinstance(src, Shard) and isinstance(dst, Shard)
+                and src.dim != dst.dim)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        dim_spec[src.dim] = _drop(dim_spec[src.dim], axis)
+        dim_spec[dst.dim] = _add(dim_spec[dst.dim], axis)
+        return _put(x, mesh, dim_spec)
+
+
+@register_reshard_function
+class PToRReshardFunction(ReshardFunction):
+    """Partial→Replicate = all-reduce (p_to_r_reshard_function.cc): the one
+    transition GSPMD cannot infer — runs an explicit psum under shard_map."""
+
+    def is_suitable(self, src, dst):
+        return isinstance(src, Partial) and isinstance(dst, Replicate)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        from jax import shard_map
+        in_spec = _spec_from(dim_spec)
+
+        def _reduce(v):
+            return jax.lax.psum(v, axis)
+
+        # x holds per-shard partial values; treat the axis as "sharded" over
+        # a phantom leading view by mapping the full array per device
+        f = shard_map(_reduce, mesh=mesh, in_specs=in_spec,
+                      out_specs=in_spec, check_vma=False)
+        return f(x)
+
+
+@register_reshard_function
+class PToSReshardFunction(ReshardFunction):
+    """Partial→Shard = reduce-scatter (p_to_s_reshard_function.cc)."""
+
+    def is_suitable(self, src, dst):
+        return isinstance(src, Partial) and isinstance(dst, Shard)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        from jax import shard_map
+        in_spec = _spec_from(dim_spec)
+        out_entries = list(dim_spec)
+        out_entries[dst.dim] = _add(out_entries[dst.dim], axis)
+        out_spec = _spec_from(out_entries)
+
+        def _rs(v):
+            return jax.lax.psum_scatter(v, axis, scatter_dimension=dst.dim,
+                                        tiled=True)
+
+        f = shard_map(_rs, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                      check_vma=False)
+        out = f(x)
+        dim_spec[dst.dim] = out_entries[dst.dim]
+        return out
+
+
+@register_reshard_function
+class RToPReshardFunction(ReshardFunction):
+    """Replicate→Partial (r_to_p_reshard_function.cc): rank 0 of the axis
+    keeps the value, others zero — so a later p_to_r restores the original."""
+
+    def is_suitable(self, src, dst):
+        return isinstance(src, Replicate) and isinstance(dst, Partial)
+
+    def eval(self, x, mesh, axis, src, dst, dim_spec):
+        from jax import shard_map
+        in_spec = _spec_from(dim_spec)
+
+        def _zero_nonroot(v):
+            idx = jax.lax.axis_index(axis)
+            return jnp.where(idx == 0, v, jnp.zeros_like(v))
+
+        f = shard_map(_zero_nonroot, mesh=mesh, in_specs=in_spec,
+                      out_specs=in_spec, check_vma=False)
+        return f(x)
+
+
+def _drop(entry, axis):
+    if entry is None:
+        return None
+    if entry == axis:
+        return None
+    if isinstance(entry, tuple):
+        rest = tuple(a for a in entry if a != axis)
+        return rest if len(rest) > 1 else (rest[0] if rest else None)
+    return entry
+
+
+def _add(entry, axis):
+    if entry is None:
+        return axis
+    if isinstance(entry, tuple):
+        return entry + (axis,)
+    return (entry, axis)
+
+
+def choose_reshard_function(src: Placement, dst: Placement) -> ReshardFunction:
+    """reference reshard_function_registry.cc ChooseReshardFunction."""
+    for fn in _REGISTRY:
+        if fn.is_suitable(src, dst):
+            return fn
+    raise NotImplementedError(f"no reshard function for {src} -> {dst}")
+
+
+def reshard_with_registry(x, mesh, src_placements: Sequence[Placement],
+                          dst_placements: Sequence[Placement]):
+    """N-D transition as a sequence of per-axis 1-D steps (reference
+    nd_mesh_reshard_function.cc decomposition). Placements are per mesh
+    axis, in mesh.axis_names order."""
+    mesh = getattr(mesh, "mesh", mesh) or _resolve_mesh(mesh)
+    axis_names = list(mesh.axis_names)
+    if len(src_placements) != len(axis_names) or \
+            len(dst_placements) != len(axis_names):
+        raise ValueError(f"need one placement per mesh axis {axis_names}")
+    # current spec entries per tensor dim, from src placements
+    dim_spec: List = [None] * x.ndim
+    for axis, pl in zip(axis_names, src_placements):
+        if isinstance(pl, Shard):
+            dim_spec[pl.dim] = _add(dim_spec[pl.dim], axis)
+    x = _put(x, mesh, dim_spec)
+    for axis, s, d in zip(axis_names, src_placements, dst_placements):
+        if type(s) is type(d) and getattr(s, "dim", None) == getattr(d, "dim", None):
+            continue
+        fn = choose_reshard_function(s, d)
+        x = fn.eval(x, mesh, axis, s, d, dim_spec)
+    return x

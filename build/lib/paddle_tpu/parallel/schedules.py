@@ -1,0 +1,354 @@
+"""Pipeline schedules: explicit 1F1B and interleaved (circular/VPP).
+
+Reference analogue: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py — forward_backward_pipeline (1F1B, :440), the
+interleaved "virtual pipeline" scheduler (:906) and FThenB (:1489), plus
+the static pass python/paddle/distributed/passes/pipeline_scheduler_pass.py
+(:47-465). Those drive per-rank actor runtimes exchanging P2P sends; here
+the whole schedule is ONE jitted SPMD program over the stage-stacked
+representation of parallel/pipeline.py (stage axis sharded over "pp",
+stage-to-stage movement = jnp.roll → CollectivePermute on ICI).
+
+1F1B (``pipeline_1f1b``)
+------------------------
+Slot mapping — tick t, stage s:
+
+  F-slot: forward microbatch  m_f = t - s            (mask: 0 <= m_f < M)
+  B-slot: backward microbatch m_b = t - (2S-2-s)     (mask: 0 <= m_b < M)
+
+so stage S-1 runs B(m) in the same tick as F(m) — the defining 1F1B
+property; the backward wave then walks down one stage per tick. The
+T = M + 2(S-1) ticks are executed as THREE scans sharing one carry, so
+fill/drain ticks only pay for the slot that can be live:
+
+  fill   t in [0, S-1):         F-cell only (no B-slot is valid yet)
+  steady t in [S-1, M+S-1):     F-cell + loss head + B-cell
+  drain  t in [M+S-1, M+2S-2):  B-cell only (no F-slot is valid)
+
+Per-tick cost is therefore (S-1)·tF + M·(tF+tB) + (S-1)·tB — i.e. the
+classic (S-1)-bubble of the reference's 1F1B runtime
+(pipeline_parallel.py:440-580), not the 2(S-1) a single full-slot lockstep
+loop would pay. The two opposite-direction jnp.rolls in the steady body
+(F-activations s->s+1, B-cotangents s->s-1) lower to a pair of
+CollectivePermutes with no data dependence, which XLA schedules
+concurrently over the bidirectional ICI links — the SPMD analogue of the
+reference's fused ``send_forward_recv_backward`` pairs
+(pipeline_parallel.py:521,:544).
+
+Activation memory: stage INPUTS (``remat=True``, default) or full vjp
+RESIDUALS (``remat=False``) are saved in a ring of R = min(M, 2S-1) slots,
+so the live set is O(S), independent of M, versus M for
+GPipe-through-jax.grad. With ``remat=True`` the B-cell replays the stage
+forward under jax.vjp (the reference's recompute interval); with
+``remat=False`` the saved residuals are applied directly — no recompute,
+at 2S-1 microbatches of residual memory per stage (use when HBM allows,
+mirroring the reference's optional recompute).
+
+The loss head (final norm/projection + loss) runs once per tick,
+un-vmapped, on stage S-1's F-slot output (its B-slot microbatch equals its
+same-tick F-slot microbatch), so stage S-1 starts backward immediately and
+a heavy vocab projection costs 1× per tick, not S×.
+
+Interleaved / circular VPP (``pipeline_interleaved``)
+-----------------------------------------------------
+Megatron's virtual-pipeline: each physical stage holds V model chunks
+(params [V, S, ...]); microbatch m passes chunk 0 through stages 0..S-1,
+wraps back to stage 0 for chunk 1, etc. The wraparound IS jnp.roll's
+circularity, so the data motion is identical to the plain pipeline; only
+the per-stage chunk index varies by tick. Schedule: microbatches grouped
+S at a time; group g, local microbatch i, chunk v runs on stage s at tick
+t = g·VS + vS + i + s — dense (every stage busy every tick once full) and
+conflict-free (unique (i,v) per (s,t)). Total ticks MV + S - 1 of
+CHUNK-sized work vs the non-interleaved (M + S - 1) ticks of STAGE-sized
+(=V chunks) work: the fill/drain bubble shrinks from (S-1)·V to (S-1)
+chunk-times — the V× bubble reduction VPP exists for
+(pipeline_parallel.py:906). Differentiable; backward is FThenB through
+the scan (remat per chunk call).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def _tree_zeros(t):
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), t)
+
+
+def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
+                  loss_head_fn: Callable, head_params, *, num_stages: int,
+                  remat: bool = True, return_dx: bool = False,
+                  weighted_loss: bool = False):
+    """Fused forward+backward 1F1B pipeline step.
+
+    stage_fn(params_slice, h) -> h                      one stage's compute
+    stacked_params: pytree, leaves [S, ...] (sharded over "pp")
+    x_mb:       [M, mb, ...] stage-0 inputs (e.g. embedded hiddens)
+    targets_mb: [M, mb, ...] labels for the loss head
+    loss_head_fn(head_params, h, target) -> scalar mean loss per microbatch,
+        or, with ``weighted_loss=True``, a (loss_sum, weight) pair (e.g.
+        token-summed cross entropy + valid-token count) so the result is
+        the single GLOBAL weighted mean over all microbatches — identical
+        math to the unpipelined model even when padding (ignore_index) is
+        spread unevenly across microbatches.
+    head_params: pytree (replicated over pp), e.g. final norm + projection
+
+    The loss head runs ONCE per tick, un-vmapped: stage S-1 backwards
+    microbatch m in the very tick that forwarded it, so the head consumes
+    the F-slot output directly instead of being computed (masked) on every
+    stage — a heavy vocab projection costs 1×, not S×, per tick.
+
+    Returns (mean_loss, stacked_param_grads, head_grads); with
+    ``return_dx`` also the [M, mb, ...] fp32 cotangent of x_mb (already
+    mean-scaled), so the caller can continue backprop into the embedding.
+    This IS the backward — do not wrap in jax.grad.
+    """
+    S = num_stages
+    M = x_mb.shape[0]
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    R = min(M, 2 * S - 1)
+    sidx = jnp.arange(S)
+
+    if weighted_loss:
+        head2 = loss_head_fn
+    else:
+        head2 = lambda hp, h, tgt: (loss_head_fn(hp, h, tgt),
+                                    jnp.float32(1.0))
+
+    fin0 = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
+    bcot0 = jnp.zeros((S,) + x_mb.shape[1:], jnp.float32)
+    dx0 = jnp.zeros(x_mb.shape, jnp.float32)
+
+    # ---- F-cell: forward one stage, saving what backward will need ------
+    _stash = {}
+
+    def _fcell_res(p_s, h_s):
+        out, vjp_fn = jax.vjp(stage_fn, p_s, h_s)
+        leaves, td = jax.tree.flatten(vjp_fn)
+        _stash["td"] = td
+        _stash["out_dtype"] = out.dtype
+        return out, leaves
+
+    saved_td = saved_out_dtype = None
+    if remat:
+        # ring stores stage INPUTS; backward replays the stage under vjp
+        ring0 = [jnp.zeros((S, R) + x_mb.shape[1:], x_mb.dtype)]
+    else:
+        # ring stores vjp RESIDUALS (jax.vjp's pytree-registered closure,
+        # flattened); backward applies them with no recompute
+        _, leaf_sh = jax.eval_shape(
+            lambda P, H: jax.vmap(_fcell_res)(P, H), stacked_params, fin0)
+        saved_td = _stash["td"]          # trace-static closure structure
+        saved_out_dtype = _stash["out_dtype"]
+        ring0 = [jnp.zeros((s.shape[0], R) + tuple(s.shape[1:]), s.dtype)
+                 for s in leaf_sh]
+
+    carry0 = (fin0, bcot0, ring0, dx0, _tree_zeros(stacked_params),
+              _tree_zeros(head_params), jnp.float32(0.0), jnp.float32(0.0))
+
+    def ring_write(ring_s, h_s, idx, valid):
+        old = jax.lax.dynamic_index_in_dim(ring_s, idx, 0, keepdims=False)
+        new = jnp.where(valid, h_s, old)
+        return jax.lax.dynamic_update_index_in_dim(ring_s, new, idx, 0)
+
+    def ring_read(ring_s, idx):
+        return jax.lax.dynamic_index_in_dim(ring_s, idx, 0, keepdims=False)
+
+    def f_cell(fin, ring, t):
+        """Inject stage-0 input, run all stages forward, save backward
+        state into the ring. Returns (out_f, ring)."""
+        m_f = t - sidx                                   # [S]
+        valid_f = (m_f >= 0) & (m_f < M)
+        inj = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        fin = fin.at[0].set(inj)
+        slot = jnp.mod(m_f, R)
+        if remat:
+            ring = [jax.vmap(ring_write)(ring[0], fin, slot, valid_f)]
+            out_f = jax.vmap(stage_fn)(stacked_params, fin)
+        else:
+            out_f, leaves = jax.vmap(_fcell_res)(stacked_params, fin)
+            ring = [jax.vmap(ring_write)(r, l, slot, valid_f)
+                    for r, l in zip(ring, leaves)]
+        return out_f, ring
+
+    def bslot_remat(p_s, h_s, g):
+        """One stage's backward cell: recompute fwd under vjp, pull the
+        stage back along the (pre-masked) cotangent g."""
+        out, vjp_fn = jax.vjp(stage_fn, p_s, h_s)
+        dp, dh = vjp_fn(g.astype(out.dtype))
+        return dp, dh.astype(jnp.float32)
+
+    def bslot_saved(leaves_s, g):
+        vjp_fn = jax.tree.unflatten(saved_td, list(leaves_s))
+        dp, dh = vjp_fn(g.astype(saved_out_dtype))
+        return dp, dh.astype(jnp.float32)
+
+    def b_cell(bcot, ring, dx, gacc, t, g_loss=None):
+        """Run all stages backward along the (masked) cotangents; stage 0's
+        input-grad lands in dx. Returns (dh, dx, gacc)."""
+        m_b = t - (2 * S - 2 - sidx)                     # [S]
+        valid_b = (m_b >= 0) & (m_b < M)
+        slot = jnp.mod(m_b, R)
+        g = bcot if g_loss is None else bcot.at[S - 1].set(
+            g_loss.astype(jnp.float32))
+        g = g * valid_b.astype(jnp.float32).reshape(
+            (S,) + (1,) * (g.ndim - 1))
+        if remat:
+            h_b = jax.vmap(ring_read)(ring[0], slot)
+            dparams, dh = jax.vmap(bslot_remat)(stacked_params, h_b, g)
+        else:
+            leaves_b = [jax.vmap(ring_read)(r, slot) for r in ring]
+            dparams, dh = jax.vmap(bslot_saved)(leaves_b, g)
+        gacc = _tree_add(gacc, dparams)
+        # stage 0's input-grad is d x_mb[m_b[0]] — record for the caller
+        m0 = jnp.clip(m_b[0], 0, M - 1)
+        prev = jax.lax.dynamic_index_in_dim(dx, m0, 0, keepdims=False)
+        dx = jax.lax.dynamic_update_index_in_dim(
+            dx, jnp.where(valid_b[0], dh[0], prev), m0, 0)
+        return dh, dx, gacc
+
+    # ---- fill: t in [0, S-1) — only F-slots can be live -----------------
+    def fill_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        out_f, ring = f_cell(fin, ring, t)
+        fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
+        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+
+    # ---- steady: t in [S-1, M+S-1) — one F and one B per tick -----------
+    def steady_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        out_f, ring = f_cell(fin, ring, t)
+        # loss head (once, un-vmapped): stage S-1 backwards microbatch m in
+        # the very tick that forwarded it, so the head consumes this tick's
+        # F-slot output directly. m_b[S-1] = t-(S-1) is always valid here.
+        tgt = jax.lax.dynamic_index_in_dim(
+            targets_mb, jnp.clip(t - (S - 1), 0, M - 1), 0, keepdims=False)
+        (lsum, w), (g_head, g_loss) = jax.value_and_grad(
+            lambda hp, h: head2(hp, h, tgt), argnums=(0, 1),
+            has_aux=True)(head_params, out_f[S - 1])
+        lacc = lacc + lsum
+        wacc = wacc + w
+        hacc = _tree_add(hacc, g_head)
+        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t, g_loss)
+        # fused neighbor exchange: the two opposite-direction permutes are
+        # independent — XLA runs them concurrently over bidirectional ICI
+        # (reference's send_forward_recv_backward pairing).
+        fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
+        bcot = jnp.roll(dh, -1, axis=0)     # stage s -> s-1
+        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+
+    # ---- drain: t in [M+S-1, M+2S-2) — only B-slots can be live ---------
+    def drain_tick(carry, t):
+        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t)
+        bcot = jnp.roll(dh, -1, axis=0)
+        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+
+    carry, _ = jax.lax.scan(fill_tick, carry0, jnp.arange(S - 1))
+    carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(S - 1, M + S - 1))
+    carry, _ = jax.lax.scan(drain_tick, carry,
+                            jnp.arange(M + S - 1, M + 2 * S - 2))
+    (_, _, _, dx, gacc, hacc, lacc, wacc) = carry
+    inv_w = 1.0 / jnp.maximum(wacc, 1e-9)
+    scale = lambda t: jax.tree.map(lambda x: x * inv_w, t)
+    if return_dx:
+        return lacc * inv_w, scale(gacc), scale(hacc), dx * inv_w
+    return lacc * inv_w, scale(gacc), scale(hacc)
+
+
+def schedule_ticks(num_stages: int, num_microbatches: int) -> dict:
+    """Per-phase tick counts of ``pipeline_1f1b`` — the bubble math.
+
+    fill and drain each cost only ONE slot (tF resp. tB), so the bubble is
+    (S-1)(tF+tB) — the reference 1F1B's (S-1), not the 2(S-1) of a
+    uniform-tick lockstep loop."""
+    S, M = num_stages, num_microbatches
+    return {"fill": S - 1, "steady": M, "drain": S - 1,
+            "total": M + 2 * (S - 1),
+            "bubble_slot_pairs": S - 1}
+
+
+def pipeline_interleaved(stage_fn: Callable, stacked_params, x_mb, *,
+                         num_stages: int, num_chunks: int,
+                         remat: bool = True):
+    """Circular (interleaved/VPP) pipeline forward. Differentiable.
+
+    stage_fn(params_slice, h) -> h                   ONE chunk's compute
+    stacked_params: pytree, leaves [V, S, ...]; chunk v on stage s is the
+        virtual stage v*S + s (Megatron VPP placement).
+    x_mb: [M, mb, ...] with M a multiple of S.
+
+    Returns [M, mb, ...] outputs of the last virtual stage.
+    """
+    S, V = num_stages, num_chunks
+    M = x_mb.shape[0]
+    if M % S:
+        raise ValueError(f"interleaved schedule needs microbatches ({M}) "
+                         f"divisible by num_stages ({S})")
+    fwd = jax.checkpoint(stage_fn) if remat else stage_fn
+    sidx = jnp.arange(S)
+    G = V * S                      # ticks one group occupies per stage
+    # [V, S, ...] -> [S, V, ...] so the per-stage chunk gather is leading
+    p_sv = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), stacked_params)
+
+    def chunk_params(P, v):
+        # per-stage gather of chunk v_s: P [S, V, ...] -> [S, ...]
+        return jax.vmap(
+            lambda Ps, vi: jax.lax.dynamic_index_in_dim(
+                Ps, vi, 0, keepdims=False))(P, v)
+
+    def tick(carry, t):
+        h, outs = carry
+        u = t - sidx                                     # local time [S]
+        r = jnp.mod(u, G)
+        v = jnp.clip(r // S, 0, V - 1)                   # chunk per stage
+        valid = (u >= 0) & (u < M * V)
+        # inject at stage 0 when it starts chunk 0 of a new microbatch
+        r0 = jnp.mod(t, G)
+        inj_m = (t // G) * S + r0
+        do_inj = (r0 < S) & (inj_m < M)
+        inj = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(inj_m, 0, M - 1), 0, keepdims=False)
+        h = h.at[0].set(jnp.where(do_inj, inj, h[0]))
+        pv = jax.tree.map(lambda P: chunk_params(P, v), p_sv)
+        out = jax.vmap(fwd)(pv, h)
+        # mask invalid lanes so garbage never propagates into live ones
+        out = jnp.where(valid.reshape((S,) + (1,) * (out.ndim - 1)), out, h)
+        # drain stage S-1 when it finishes chunk V-1
+        uS = t - (S - 1)
+        rS = jnp.mod(uS, G)
+        m_d = (uS // G) * S + (rS - (V - 1) * S)
+        do_d = (uS >= 0) & (rS >= (V - 1) * S) & (m_d < M)
+        m_dc = jnp.clip(m_d, 0, M - 1)
+        prev = jax.lax.dynamic_index_in_dim(outs, m_dc, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(do_d, out[-1], prev), m_dc, 0)
+        h = jnp.roll(out, 1, axis=0)   # wraps S-1 -> 0: chunk v -> v+1
+        return (h, outs), None
+
+    h0 = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
+    outs0 = jnp.zeros_like(x_mb)
+    T = M * V + S - 1
+    (_, outs), _ = jax.lax.scan(tick, (h0, outs0), jnp.arange(T))
+    return outs
+
+
+def interleaved_ticks(num_stages: int, num_chunks: int,
+                      num_microbatches: int) -> Tuple[int, int]:
+    """(ticks, non_interleaved_chunk_ticks) — the bubble-reduction math."""
+    t = num_microbatches * num_chunks + num_stages - 1
+    t_plain = (num_microbatches + num_stages - 1) * num_chunks
+    return t, t_plain
+
+
+__all__ = ["pipeline_1f1b", "pipeline_interleaved", "interleaved_ticks",
+           "schedule_ticks"]

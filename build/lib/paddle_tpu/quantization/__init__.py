@@ -1,0 +1,30 @@
+"""paddle_tpu.quantization — QAT/PTQ (reference: python/paddle/quantization/:
+QuantConfig in config.py, QAT/PTQ in qat.py/ptq.py, observers under
+observer/, fake quanters under quanters/, plus the quantize/dequantize
+kernels in phi).
+
+TPU-native: fake-quant uses the straight-through estimator inside jax grad;
+converted int8 layers compute with jnp.dot(..., preferred_element_type=int32)
+— int8 matmul hits the MXU at 2x bf16 throughput, the reason PTQ matters on
+TPU at all. Observers are functional (scale state lives on the layer), so
+calibration runs under jit too.
+"""
+
+from .config import QuantConfig
+from .observers import (BaseObserver, AbsmaxObserver,
+                        MovingAverageAbsmaxObserver, PercentileObserver)
+from .quanters import (BaseQuanter, quanter, FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax,
+                       fake_quant, quantize_absmax, dequantize)
+from .qat import QAT, PTQ
+from .layers import QuantedLinear, QuantedConv2D, Int8Linear
+from .functional import quantize_linear, dequantize_linear, int8_matmul
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "PercentileObserver",
+    "FakeQuanterWithAbsMax", "FakeQuanterChannelWiseAbsMax",
+    "fake_quant", "quantize_absmax", "dequantize",
+    "QuantedLinear", "QuantedConv2D", "Int8Linear",
+    "quantize_linear", "dequantize_linear", "int8_matmul",
+]

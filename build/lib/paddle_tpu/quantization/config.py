@@ -1,0 +1,58 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py): declares
+which layers get quantized and with which activation/weight quanters."""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+
+class _LayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Layer→quanter mapping with the reference's three granularities:
+    by layer instance, by layer type, by layer (qual)name prefix."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default = _LayerConfig(activation, weight)
+        self._by_layer: list[tuple[object, _LayerConfig]] = []
+        self._by_type: list[tuple[Type, _LayerConfig]] = []
+        self._by_name: list[tuple[str, _LayerConfig]] = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer.append((l, _LayerConfig(activation, weight)))
+        return self
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._by_type.append((t, _LayerConfig(activation, weight)))
+        return self
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._by_name.append((n, _LayerConfig(activation, weight)))
+        return self
+
+    def config_for(self, layer, qualname: str = "") -> Optional[_LayerConfig]:
+        """Most-specific match wins: instance > name prefix > type > default."""
+        for l, cfg in self._by_layer:
+            if l is layer:
+                return cfg
+        for prefix, cfg in self._by_name:
+            if qualname.startswith(prefix):
+                return cfg
+        for t, cfg in self._by_type:
+            if isinstance(layer, t):
+                return cfg
+        if self.default.activation is not None or self.default.weight is not None:
+            return self.default
+        return None

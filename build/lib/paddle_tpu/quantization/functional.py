@@ -1,0 +1,38 @@
+"""Quantized compute ops. int8 matmul accumulating in int32 runs on the MXU
+(the performance payoff of PTQ on TPU); quantize/dequantize_linear mirror the
+reference's ONNX-style linear-quant kernels (phi quantize_linear)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def quantize_linear(x, scale, zero_point=0, bit_length: int = 8,
+                    axis=None, name=None):
+    """x → int-k: round(x/scale) + zero_point (symmetric default).
+    ``axis`` selects per-channel scales of that dim."""
+    qmax = 2 ** (bit_length - 1) - 1
+    if axis is not None:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        scale = jnp.reshape(scale, shape)
+    q = jnp.clip(jnp.round(x / scale) + zero_point, -qmax - 1, qmax)
+    return q.astype(jnp.int8 if bit_length == 8 else jnp.int32)
+
+
+def dequantize_linear(q, scale, zero_point=0, axis=None, name=None):
+    if axis is not None:
+        shape = [1] * q.ndim
+        shape[axis] = -1
+        scale = jnp.reshape(scale, shape)
+    return (q.astype(jnp.float32) - zero_point) * scale
+
+
+def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32):
+    """int8 @ int8 → int32 accumulate → rescale to float.
+
+    On TPU this is one MXU pass at double bf16 throughput; XLA fuses the
+    trailing rescale. w_scale may be per-tensor or per-out-channel [N]."""
+    acc = jnp.dot(x_q.astype(jnp.int8), w_q.astype(jnp.int8),
+                  preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * (x_scale * w_scale)).astype(out_dtype)

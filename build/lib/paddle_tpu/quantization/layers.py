@@ -1,0 +1,82 @@
+"""Quantized layer wrappers (reference: paddle/nn/quant/ QuantedLinear /
+QuantedConv2D produced by QAT.quantize, and the converted inference layers)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.common import Linear, Conv2D
+from .quanters import (FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax,
+                       fake_quant)
+from .functional import quantize_linear, int8_matmul
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper: fake-quant activations (per-tensor EMA scale) and weights
+    (per-out-channel) around the dense matmul."""
+
+    def __init__(self, layer: Linear, q_config):
+        super().__init__()
+        self.in_features = layer.in_features
+        self.out_features = layer.out_features
+        # adopt the Parameter objects themselves (attribute access on the
+        # donor layer yields raw arrays, which would not be trainable here)
+        self.add_parameter("weight", layer._parameters["weight"])
+        self.add_parameter("bias", layer._parameters.get("bias"))
+        self.activation_quanter = (q_config.activation() if q_config.activation
+                                   else FakeQuanterWithAbsMax())
+        self.weight_quanter = (q_config.weight() if q_config.weight
+                               else FakeQuanterChannelWiseAbsMax(channel_axis=-1))
+
+    def forward(self, x):
+        x = self.activation_quanter(x, update=self.training)
+        w = self.weight_quanter(self.weight)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer: Conv2D, q_config):
+        super().__init__()
+        self._inner = layer
+        self.activation_quanter = (q_config.activation() if q_config.activation
+                                   else FakeQuanterWithAbsMax())
+        # conv weight is [out, in/g, kh, kw] → channel axis 0
+        self.weight_quanter = (q_config.weight() if q_config.weight
+                               else FakeQuanterChannelWiseAbsMax(channel_axis=0))
+
+    def forward(self, x):
+        x = self.activation_quanter(x, update=self.training)
+        w = self.weight_quanter(self._inner.weight)
+        return F.conv2d(x, w, self._inner.bias, self._inner.stride,
+                        self._inner.padding, self._inner.dilation,
+                        self._inner.groups, self._inner.data_format)
+
+
+class Int8Linear(Layer):
+    """Converted inference layer: weights stored int8 (per-out-channel
+    scales), activations quantized on the fly with the calibrated scale, the
+    matmul runs int8×int8→int32 on the MXU."""
+
+    def __init__(self, weight, bias, act_scale: float, quant_bits: int = 8):
+        super().__init__()
+        qmax = float(2 ** (quant_bits - 1) - 1)
+        w = jnp.asarray(weight)
+        w_absmax = jnp.max(jnp.abs(w), axis=0)          # per out-channel [N]
+        self.w_scale = jnp.maximum(w_absmax, 1e-8) / qmax
+        w_q = quantize_linear(w, self.w_scale[None, :], bit_length=quant_bits)
+        self.register_buffer("weight_q", w_q)
+        self.bias = bias
+        self.act_scale = float(act_scale)
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        x_q = quantize_linear(x, self.act_scale, bit_length=self.quant_bits)
+        shape = x_q.shape
+        out = int8_matmul(x_q.reshape(-1, shape[-1]), self.weight_q,
+                          self.act_scale, self.w_scale, out_dtype=jnp.float32)
+        out = out.reshape(*shape[:-1], -1)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

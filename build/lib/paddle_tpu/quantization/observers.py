@@ -1,0 +1,94 @@
+"""Calibration observers (reference: python/paddle/quantization/observer/ —
+AbsmaxObserver, HistObserver, KLObserver...). Each observer watches
+activations during calibration forwards and produces a quantization scale."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BaseObserver:
+    """Stateful scale estimator. ``observe(x)`` updates running statistics
+    (host-side — calibration runs eagerly); ``scale()`` returns the final
+    per-tensor scale for the given bit width."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def observe(self, x) -> None:
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        raise NotImplementedError
+
+    def zero_point(self) -> int:
+        return 0  # symmetric throughout (TPU int8 path is symmetric)
+
+
+class AbsmaxObserver(BaseObserver):
+    """max |x| over all calibration batches (observer/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def observe(self, x):
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(x))))
+
+    def scale(self):
+        return max(self._absmax, 1e-8) / self._qmax
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of per-batch absmax (observer/ema.py shape)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._state = None
+
+    def observe(self, x):
+        cur = float(jnp.max(jnp.abs(x)))
+        if self._state is None:
+            self._state = cur
+        else:
+            self._state = (self.moving_rate * self._state
+                           + (1 - self.moving_rate) * cur)
+
+    def scale(self):
+        return max(self._state or 0.0, 1e-8) / self._qmax
+
+
+class PercentileObserver(BaseObserver):
+    """Clip to a |x| percentile — robust to outliers (observer/hist.py role).
+    Keeps a bounded reservoir of sampled absolute values."""
+
+    def __init__(self, quant_bits: int = 8, percentile: float = 99.9,
+                 sample_size: int = 1 << 16):
+        super().__init__(quant_bits)
+        self.percentile = percentile
+        self.sample_size = sample_size
+        self._samples: list[np.ndarray] = []
+        self._count = 0
+
+    def observe(self, x):
+        flat = np.abs(np.asarray(x)).reshape(-1)
+        if flat.size > 4096:
+            rs = np.random.RandomState(self._count)
+            flat = flat[rs.randint(0, flat.size, 4096)]
+        self._samples.append(flat)
+        self._count += 1
+        total = sum(s.size for s in self._samples)
+        if total > self.sample_size:
+            merged = np.concatenate(self._samples)
+            rs = np.random.RandomState(0)
+            self._samples = [merged[rs.randint(0, merged.size,
+                                               self.sample_size // 2)]]
+
+    def scale(self):
+        if not self._samples:
+            return 1.0 / self._qmax
+        merged = np.concatenate(self._samples)
+        return max(float(np.percentile(merged, self.percentile)), 1e-8) / self._qmax

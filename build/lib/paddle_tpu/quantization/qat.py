@@ -1,0 +1,97 @@
+"""QAT / PTQ drivers (reference: python/paddle/quantization/{qat.py,ptq.py}).
+
+QAT.quantize(model) swaps Linear/Conv2D sublayers for fake-quant wrappers
+(train with STE gradients). PTQ.quantize installs observers, calibration
+forwards collect scales, PTQ.convert produces int8 inference layers."""
+
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.common import Linear, Conv2D
+from .config import QuantConfig
+from .observers import AbsmaxObserver
+from .layers import QuantedLinear, QuantedConv2D, Int8Linear
+
+
+def _walk_swap(model: Layer, fn, prefix: str = ""):
+    for name, sub in list(model._sub_layers.items()):
+        qual = f"{prefix}.{name}" if prefix else name
+        replaced = fn(sub, qual)
+        if replaced is not None:
+            model._sub_layers[name] = replaced
+        else:
+            _walk_swap(sub, fn, qual)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (qat.py)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def swap(layer, qual):
+            cfg = self.q_config.config_for(layer, qual)
+            if cfg is None:
+                return None
+            if isinstance(layer, Linear):
+                return QuantedLinear(layer, cfg)
+            if isinstance(layer, Conv2D):
+                return QuantedConv2D(layer, cfg)
+            return None
+
+        return _walk_swap(model, swap)
+
+
+class _ObservedLinear(Layer):
+    def __init__(self, layer: Linear, observer):
+        super().__init__()
+        self._inner = layer
+        self.observer = observer
+
+    def forward(self, x):
+        self.observer.observe(x)
+        return self._inner(x)
+
+
+class PTQ:
+    """Post-training quantization driver (ptq.py): quantize → run
+    calibration batches → convert."""
+
+    def __init__(self, q_config: QuantConfig = None,
+                 observer_factory=AbsmaxObserver):
+        self.q_config = q_config or QuantConfig(activation=True, weight=True)
+        self.observer_factory = observer_factory
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def swap(layer, qual):
+            cfg = self.q_config.config_for(layer, qual)
+            if cfg is None:
+                return None
+            if isinstance(layer, Linear):
+                return _ObservedLinear(layer, self.observer_factory())
+            return None
+
+        return _walk_swap(model, swap)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def swap(layer, qual):
+            if isinstance(layer, _ObservedLinear):
+                return Int8Linear(layer._inner.weight, layer._inner.bias,
+                                  act_scale=layer.observer.scale())
+            return None
+
+        return _walk_swap(model, swap)

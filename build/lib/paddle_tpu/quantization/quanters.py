@@ -1,0 +1,104 @@
+"""Fake quanters (reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver): simulate int-k rounding in float during QAT,
+with straight-through gradients."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_absmax(x, scale, quant_bits: int = 8):
+    """Round x/scale into the signed int-k grid (returns float holding ints)."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+    return jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+
+
+def dequantize(q, scale):
+    return q * scale
+
+
+def fake_quant(x, scale, quant_bits: int = 8):
+    """Quantize-dequantize with a straight-through estimator: forward sees
+    the rounded value, backward sees identity (the reference's
+    FakeQuantAbsMax kernel pair)."""
+    y = dequantize(quantize_absmax(x, scale, quant_bits), scale)
+    return x + jax.lax.stop_gradient(y - x)
+
+
+class FakeQuanterWithAbsMax:
+    """Per-tensor QAT quanter with an EMA-calibrated scale
+    (quanters/abs_max.py). Call as a function inside a layer forward."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+        self._scale = None
+
+    def update_scale(self, x) -> float:
+        cur = float(jnp.max(jnp.abs(jax.lax.stop_gradient(x)))) / self._qmax
+        cur = max(cur, 1e-8)
+        if self._scale is None:
+            self._scale = cur
+        else:
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * cur)
+        return self._scale
+
+    @property
+    def scale(self):
+        return self._scale if self._scale is not None else 1.0
+
+    def __call__(self, x, update: bool = True):
+        scale = self.update_scale(x) if update else self.scale
+        return fake_quant(x, scale, self.quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMax:
+    """Per-output-channel weight quanter (quanters channel-wise variant).
+    ``channel_axis`` is the output-channel dim of the weight."""
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = -1):
+        self.quant_bits = quant_bits
+        self.channel_axis = channel_axis
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def scales(self, w):
+        axes = tuple(i for i in range(w.ndim)
+                     if i != (self.channel_axis % w.ndim))
+        absmax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+        return jnp.maximum(absmax, 1e-8) / self._qmax
+
+    def __call__(self, w, update: bool = True):
+        return fake_quant(w, self.scales(w), self.quant_bits)
+
+
+class BaseQuanter:
+    """Abstract quanter base (reference: python/paddle/quantization/
+    base_quanter.py BaseQuanter): scales()/zero_points()/quant_axis()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+def quanter(name: str):
+    """Class decorator registering a quanter factory by name (reference:
+    python/paddle/quantization/factory.py quanter)."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls._quanter_name = name
+        return cls
+    return deco
+
+
+_QUANTER_REGISTRY = {}

@@ -1,0 +1,202 @@
+"""Legacy ``paddle.reader`` decorators (reference:
+python/paddle/reader/decorator.py — generator-combinator style data
+pipelines kept for backward compatibility; paddle.io.DataLoader is the
+modern path, as here).
+
+TPU note: these are pure host-side generator transforms; the threaded
+variants use a thread pool (numpy releases the GIL) rather than fork —
+fork is unsafe next to an initialized XLA runtime (io/dataloader.py has
+the same policy).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "batch"]
+
+
+def cache(reader):
+    """Materialize the reader's items once; replay from memory after."""
+    all_data = []
+    filled = []
+
+    def new_reader():
+        if not filled:
+            staged = list(reader())   # commit only after a FULL pass: a
+            all_data[:] = staged      # flaky first pass must not leave
+            filled.append(True)       # partial items that replay duplicated
+        yield from all_data
+
+    return new_reader
+
+
+def map_readers(func: Callable, *readers):
+    """Zip several readers and map ``func`` over the item tuples."""
+
+    def new_reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return new_reader
+
+
+def shuffle(reader, buf_size: int):
+    """Buffered shuffle: fill ``buf_size`` items, emit in random order."""
+
+    def new_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return new_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def new_reader():
+        for r in readers:
+            yield from r()
+
+    return new_reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into flat tuples (reference compose semantics: each
+    reader's tuple outputs are concatenated)."""
+
+    def _as_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def new_reader():
+        its = [r() for r in readers]
+        # zip_longest + sentinel detects raggedness in EVERY ordering (a
+        # plain zip consumes one extra item from earlier readers, hiding an
+        # off-by-one-longer predecessor from any post-loop probe)
+        for items in itertools.zip_longest(*its, fillvalue=_SENTINEL):
+            ragged = any(i is _SENTINEL for i in items)
+            if ragged:
+                if check_alignment:
+                    raise RuntimeError("compose: readers of different "
+                                       "length")
+                return        # unchecked mode truncates at the shortest
+            yield sum((_as_tuple(i) for i in items), ())
+
+    return new_reader
+
+
+_SENTINEL = object()
+
+
+def buffered(reader, size: int):
+    """Read ahead up to ``size`` items on a background thread. Source
+    exceptions propagate to the consumer (silent truncation of training
+    data is the worst failure mode a loader can have), and an abandoned
+    generator releases the fill thread instead of leaking it blocked on a
+    full queue."""
+
+    def new_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        stop = threading.Event()
+
+        def put_or_stop(msg):
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def fill():
+            try:
+                for item in reader():
+                    if not put_or_stop((False, item)):
+                        return
+                put_or_stop((True, None))
+            except BaseException as e:         # noqa: BLE001 — re-raised
+                put_or_stop((True, e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                done, item = q.get()
+                if done:
+                    if item is not None:
+                        raise item
+                    break
+                yield item
+        finally:
+            stop.set()
+
+    return new_reader
+
+
+def firstn(reader, n: int):
+    """Only the first ``n`` items."""
+
+    def new_reader():
+        yield from itertools.islice(reader(), n)
+
+    return new_reader
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """Map ``mapper`` over the reader with ``process_num`` worker THREADS
+    (the reference uses processes; fork is unsafe beside a live XLA
+    runtime — io/dataloader.py note) and a ``buffer_size`` queue.
+    ``order=True`` preserves input order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def new_reader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            pending = []
+            it = reader()
+            for item in it:
+                pending.append(pool.submit(mapper, item))
+                if len(pending) >= buffer_size:
+                    if order:
+                        yield pending.pop(0).result()
+                    else:
+                        done = next((i for i, f in enumerate(pending)
+                                     if f.done()), 0)
+                        yield pending.pop(done).result()
+            for f in pending:
+                yield f.result()
+
+    return new_reader
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Group items into lists of ``batch_size`` (reference:
+    python/paddle/batch.py — the legacy pre-DataLoader batcher)."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def new_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return new_reader

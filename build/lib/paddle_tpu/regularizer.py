@@ -1,0 +1,56 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay attached per-parameter via ParamAttr or globally on the
+optimizer's weight_decay).
+
+TPU-native: a regularizer is a pure penalty-gradient function the
+optimizer adds before its update (our Optimizer's weight_decay slot takes
+L2Decay's coefficient directly; L1Decay contributes sign(p))."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+    def grad(self, param):
+        """Penalty gradient to add to the parameter's gradient."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|p|) (reference: regularizer.py L1Decay)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        return self.coeff * jnp.sum(jnp.abs(param))
+
+    def grad(self, param):
+        return self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(p^2); grad contribution coeff * p
+    (reference: regularizer.py L2Decay — what optimizer weight_decay
+    floats mean)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        return 0.5 * self.coeff * jnp.sum(param * param)
+
+    def grad(self, param):
+        return self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

@@ -1,0 +1,146 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (stft at :246, istft at :423, frame /
+overlap_add in the same module — CPU/GPU kernels frame_op/overlap_add_op).
+TPU-native: framing is a strided gather and the DFTs are jnp.fft (XLA's
+FFT lowering); everything jits and differentiates.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames: [..., seq] -> [..., frame_length, n_frames]
+    (axis=-1 convention of the reference; axis=0 puts frames first)."""
+    x = jnp.asarray(x)
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    # axis=0 selects the frames-first layout; for 1-D input axis 0 IS the
+    # last axis, but the layouts still differ ([nf, fl] vs [fl, nf])
+    frames_first = (axis == 0)
+    seq = x.shape[0] if frames_first else x.shape[-1]
+    if frame_length > seq:
+        raise ValueError(f"frame_length {frame_length} > sequence {seq}")
+    n_frames = 1 + (seq - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [nf, fl]
+    if frames_first:
+        return x[idx]                              # [nf, fl, ...]
+    frames = x[..., idx]                           # [..., nf, fl]
+    return jnp.swapaxes(frames, -1, -2)            # [..., fl, nf]
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of ``frame``: axis=-1 takes [..., frame_length, n_frames]
+    -> [..., seq]; axis=0 takes [n_frames, frame_length, ...] -> [seq, ...]
+    (reference overlap_add_op layouts)."""
+    x = jnp.asarray(x)
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    if axis != 0:
+        fl, nf = x.shape[-2], x.shape[-1]
+        frames = jnp.swapaxes(x, -1, -2)           # [..., nf, fl]
+    else:
+        # normalize to trailing-frame layout, overlap-add, move seq back
+        fl, nf = x.shape[1], x.shape[0]
+        frames = jnp.moveaxis(x, (0, 1), (-2, -1))  # [..., nf, fl]
+    lead = frames.shape[:-2]
+    seq = (nf - 1) * hop_length + fl
+    out = jnp.zeros((*lead, seq), x.dtype)
+    starts = jnp.arange(nf) * hop_length
+    idx = starts[:, None] + jnp.arange(fl)[None, :]
+    out = out.at[..., idx].add(frames)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)             # [seq, ...]
+    return out
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform (reference: signal.py:246). Returns
+    [..., n_fft//2 + 1, n_frames] (onesided real input) or
+    [..., n_fft, n_frames]."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    is_complex = jnp.iscomplexobj(x)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex input")
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:                         # center-pad to n_fft
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = frame(x, n_fft, hop_length, axis=-1)  # [..., n_fft, nf]
+    frames = frames * window[:, None]
+    fft = (jnp.fft.rfft if (onesided and not is_complex) else jnp.fft.fft)(
+        jnp.swapaxes(frames, -1, -2), n=n_fft, axis=-1)   # [..., nf, bins]
+    if normalized:
+        fft = fft / math.sqrt(n_fft)
+    return jnp.swapaxes(fft, -1, -2)               # [..., bins, nf]
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference:
+    signal.py:423 — least-squares overlap-add inversion)."""
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+
+    expected_bins = n_fft // 2 + 1 if onesided else n_fft
+    if x.shape[-2] != expected_bins:
+        raise ValueError(f"istft: spectrum has {x.shape[-2]} frequency bins "
+                         f"but n_fft={n_fft} implies {expected_bins}")
+    spec = jnp.swapaxes(x, -1, -2)                 # [..., nf, bins]
+    if normalized:
+        spec = spec * math.sqrt(n_fft)
+    if onesided:
+        if return_complex:
+            raise ValueError("return_complex=True requires onesided=False "
+                             "(a onesided spectrum inverts to a real "
+                             "signal)")
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window                       # [..., nf, n_fft]
+    sig = overlap_add(jnp.swapaxes(frames, -1, -2), hop_length, axis=-1)
+    # window-envelope normalization (sum of squared windows per sample)
+    nf = x.shape[-1]
+    env_frames = jnp.broadcast_to((window * window)[:, None], (n_fft, nf))
+    env = overlap_add(env_frames, hop_length, axis=-1)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

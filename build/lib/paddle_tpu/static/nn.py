@@ -1,0 +1,528 @@
+"""paddle.static.nn — program-building layer functions.
+
+Reference: python/paddle/static/nn/{common.py,control_flow.py} (fc,
+conv2d, embedding, norms, cond/while_loop/case ops appended to a
+ProgramDesc). TPU redesign over the trace-based static facade: each
+builder returns a ``_LazyVar`` whose build closure applies the same math
+the dynamic layers use; parameters are created ON FIRST TRACE (input
+shapes become known) with deterministic per-name numpy init and cached on
+the Program (``prog._nn_params``) so re-traces and ``append_backward``'s
+parameter_list see one consistent set. Control flow lowers to
+lax.cond/lax.switch/lax.while_loop — the user's branch/body functions run
+at trace time on jax values, which every paddle_tpu op accepts.
+
+The LoD sequence_* family and the parameter-server embeddings
+(sparse_embedding, nce, row_conv, data_norm, continuous_value_model) are
+PS/LoD-era and raise with the design-ledger pointer, consistent with the
+reader/dataset legacy substitutions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import _LazyVar, default_main_program
+
+__all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+           "conv3d_transpose", "batch_norm", "layer_norm", "instance_norm",
+           "group_norm", "prelu", "spectral_norm", "bilinear_tensor_product",
+           "deform_conv2d", "cond", "case", "switch_case", "while_loop",
+           "py_func", "static_pylayer", "sequence_conv", "sequence_softmax",
+           "sequence_pool", "sequence_concat", "sequence_first_step",
+           "sequence_last_step", "sequence_slice", "sequence_expand",
+           "sequence_expand_as", "sequence_pad", "sequence_unpad",
+           "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+           "sequence_reverse", "sparse_embedding", "nce", "row_conv",
+           "data_norm"]
+
+
+def _as_lazy(x):
+    if not isinstance(x, _LazyVar):
+        raise TypeError(f"static.nn builders take static vars "
+                        f"(static.data results), got {type(x).__name__}")
+    return x
+
+
+def _param(prog, name: str, shape, init: str = "xavier",
+           scale: float = 1.0):
+    """Deterministic per-(program, name) parameter, created at trace time
+    once the input shape is known and cached on THAT program (builders
+    close over their var's program — default_main_program() at trace time
+    would alias every program onto the global default). The seed is a
+    process-stable CRC over (name, shape): python hash() is salted per
+    process, which would diverge data-parallel replicas."""
+    import zlib
+    store = prog.__dict__.setdefault("_nn_params", {})
+    if name not in store:
+        seed = zlib.crc32(repr((name,) + tuple(int(s) for s in shape))
+                          .encode()) % (2 ** 31)
+        rs = np.random.RandomState(seed)
+        if init == "zeros":
+            v = np.zeros(shape, np.float32)
+        elif init == "ones":
+            v = np.ones(shape, np.float32)
+        elif init == "normal":
+            v = rs.normal(0.0, scale, shape).astype(np.float32)
+        else:  # xavier-uniform over the last two dims
+            fan_in = shape[0] if len(shape) > 1 else shape[-1]
+            fan_out = shape[-1]
+            bound = math.sqrt(6.0 / (fan_in + fan_out))
+            v = rs.uniform(-bound, bound, shape).astype(np.float32)
+        # store NUMPY: a jnp array materialized inside one jit trace is a
+        # tracer and must not leak into the next trace's closure
+        store[name] = v
+    return jnp.asarray(store[name])
+
+
+def _unique(prefix: str) -> str:
+    prog = default_main_program()
+    counts = prog.__dict__.setdefault("_nn_name_counts", {})
+    counts[prefix] = counts.get(prefix, 0) + 1
+    return f"{prefix}_{counts[prefix]}"
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None, name=None):
+    """reference: static/nn/common.py fc — flatten trailing dims, matmul,
+    bias, optional activation."""
+    x = _as_lazy(x)
+    prog = x._program
+    pname = name or _unique("fc")
+    nfd = num_flatten_dims
+
+    def build(v):
+        lead = v.shape[:nfd]
+        in_dim = int(np.prod(v.shape[nfd:]))
+        flat = v.reshape(*lead, in_dim)
+        w = _param(prog, f"{pname}.w_0", (in_dim, size))
+        out = jnp.matmul(flat, w.astype(flat.dtype))
+        if bias_attr is not False:
+            out = out + _param(prog, f"{pname}.b_0", (size,), "zeros")
+        if activation:
+            from ..nn import functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    return x.apply(build, pname)
+
+
+def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """reference: static/nn/common.py embedding."""
+    input = _as_lazy(input)
+    prog = input._program
+    pname = _unique("embedding")
+
+    def build(ids):
+        table = _param(prog, f"{pname}.w_0", tuple(size), "normal", 0.02)
+        if padding_idx is not None:
+            table = table.at[padding_idx].set(0.0)
+        return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+    return input.apply(build, pname)
+
+
+def _conv_nd(x, num_filters, filter_size, stride, padding, dilation, groups,
+             bias_attr, nd, transpose=False, output_padding=0, name=None):
+    x = _as_lazy(x)
+    prog = x._program
+    pname = name or _unique("conv%dd%s" % (nd, "_t" if transpose else ""))
+    if filter_size is None:
+        raise NotImplementedError(
+            "conv*_transpose with output_size-derived filter_size: pass "
+            "filter_size explicitly (output shape follows from "
+            "filter/stride/padding on TPU)")
+    ks = ((filter_size,) * nd if isinstance(filter_size, int)
+          else tuple(filter_size))
+    st = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    dl = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
+
+    def build(v):
+        from ..nn import functional as F
+        c_in = v.shape[1]
+        if transpose:
+            w = _param(prog, f"{pname}.w_0",
+                       (c_in, num_filters // groups) + ks)
+            fn = {2: F.conv2d_transpose, 3: F.conv3d_transpose}[nd]
+            out = fn(v, w, stride=st, padding=padding,
+                     output_padding=output_padding, groups=groups,
+                     dilation=dl)
+        else:
+            w = _param(prog, f"{pname}.w_0",
+                       (num_filters, c_in // groups) + ks)
+            fn = {2: F.conv2d, 3: F.conv3d}[nd]
+            out = fn(v, w, stride=st, padding=padding, dilation=dl,
+                     groups=groups)
+        if bias_attr is not False:
+            b = _param(prog, f"{pname}.b_0", (num_filters,), "zeros")
+            out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+
+    return x.apply(build, pname)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
+                   dilation, groups, bias_attr, nd=2, name=name)
+    if act:
+        from ..nn import functional as F
+        out = out.apply(getattr(F, act), act)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
+                   dilation, groups, bias_attr, nd=3, name=name)
+    if act:
+        from ..nn import functional as F
+        out = out.apply(getattr(F, act), act)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
+                   dilation, groups, bias_attr, nd=2, transpose=True,
+                   name=name)
+    if act:
+        from ..nn import functional as F
+        out = out.apply(getattr(F, act), act)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
+                   dilation, groups, bias_attr, nd=3, transpose=True,
+                   name=name)
+    if act:
+        from ..nn import functional as F
+        out = out.apply(getattr(F, act), act)
+    return out
+
+
+def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+               data_layout: str = "NCHW", name=None, **_ignored):
+    """Normalizes over batch+spatial per channel. The static facade traces
+    a pure function, so train-mode uses BATCH statistics (the running-stat
+    update is an optimizer-step side effect in the reference's executor;
+    is_test=True reuses the batch stats too — document-level substitution)."""
+    input = _as_lazy(input)
+    prog = input._program
+    pname = name or _unique("batch_norm")
+
+    def build(v):
+        ch = v.shape[1]
+        axes = (0,) + tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        shape = (1, ch) + (1,) * (v.ndim - 2)
+        out = out * _param(prog, f"{pname}.w_0", (ch,), "ones").reshape(shape) \
+            + _param(prog, f"{pname}.b_0", (ch,), "zeros").reshape(shape)
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    return input.apply(build, pname)
+
+
+def layer_norm(input, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    input = _as_lazy(input)
+    prog = input._program
+    pname = name or _unique("layer_norm")
+
+    def build(v):
+        axes = tuple(range(begin_norm_axis, v.ndim))
+        nshape = tuple(int(s) for s in v.shape[begin_norm_axis:])
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        if scale:
+            out = out * _param(prog, f"{pname}.w_0", nshape, "ones")
+        if shift:
+            out = out + _param(prog, f"{pname}.b_0", nshape, "zeros")
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    return input.apply(build, pname)
+
+
+def instance_norm(input, epsilon: float = 1e-5, param_attr=None,
+                  bias_attr=None, name=None):
+    input = _as_lazy(input)
+    prog = input._program
+    pname = name or _unique("instance_norm")
+
+    def build(v):
+        ch = v.shape[1]
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        shape = (1, ch) + (1,) * (v.ndim - 2)
+        return out * _param(prog, f"{pname}.w_0", (ch,), "ones").reshape(shape) \
+            + _param(prog, f"{pname}.b_0", (ch,), "zeros").reshape(shape)
+
+    return input.apply(build, pname)
+
+
+def group_norm(input, groups: int, epsilon: float = 1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout: str = "NCHW",
+               name=None):
+    input = _as_lazy(input)
+    prog = input._program
+    pname = name or _unique("group_norm")
+
+    def build(v):
+        n, c = v.shape[0], v.shape[1]
+        g = v.reshape(n, groups, c // groups, *v.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = (1, c) + (1,) * (v.ndim - 2)
+        out = out * _param(prog, f"{pname}.w_0", (c,), "ones").reshape(shape) \
+            + _param(prog, f"{pname}.b_0", (c,), "zeros").reshape(shape)
+        if act:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    return input.apply(build, pname)
+
+
+def prelu(x, mode: str = "all", param_attr=None, data_format: str = "NCHW",
+          name=None):
+    x = _as_lazy(x)
+    prog = x._program
+    pname = name or _unique("prelu")
+
+    def build(v):
+        if mode == "all":
+            a = _param(prog, f"{pname}.w_0", (1,), "zeros") + 0.25
+        elif mode == "channel":
+            ch = v.shape[1]
+            a = (_param(prog, f"{pname}.w_0", (ch,), "zeros") + 0.25).reshape(
+                (1, ch) + (1,) * (v.ndim - 2))
+        else:  # element
+            a = _param(prog, f"{pname}.w_0", tuple(v.shape[1:]), "zeros") + 0.25
+        return jnp.where(v >= 0, v, a * v)
+
+    return x.apply(build, pname)
+
+
+def spectral_norm(weight, dim: int = 0, power_iters: int = 1,
+                  eps: float = 1e-12, name=None):
+    weight = _as_lazy(weight)
+
+    def build(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), jnp.float32) / math.sqrt(mat.shape[0])
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (mat @ v)
+        return w / sigma
+
+    return weight.apply(build, name or "spectral_norm")
+
+
+def bilinear_tensor_product(x, y, size: int, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    x = _as_lazy(x)
+    prog = x._program
+    pname = name or _unique("bilinear")
+    yb = _LazyVar._lift(y)
+    xb = x._build
+
+    def build(env):
+        xv, yv = xb(env), yb(env)
+        w = _param(prog, f"{pname}.w_0", (size, xv.shape[-1], yv.shape[-1]))
+        out = jnp.einsum("bi,kij,bj->bk", xv, w, yv)
+        if bias_attr is not False:
+            out = out + _param(prog, f"{pname}.b_0", (size,), "zeros")
+        return out
+
+    return _LazyVar(x._program, build, pname)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    x = _as_lazy(x)
+    prog = x._program
+    pname = name or _unique("deform_conv2d")
+    ob = _LazyVar._lift(offset)
+    mb = _LazyVar._lift(mask) if mask is not None else None
+    xb = x._build
+    ks = ((filter_size, filter_size) if isinstance(filter_size, int)
+          else tuple(filter_size))
+
+    def build(env):
+        from ..vision.ops import deform_conv2d as _dc
+        xv = xb(env)
+        w = _param(prog, f"{pname}.w_0",
+                   (num_filters, xv.shape[1] // groups) + ks)
+        b = (None if bias_attr is False
+             else _param(prog, f"{pname}.b_0", (num_filters,), "zeros"))
+        return _dc(xv, ob(env), w, bias=b,
+                   mask=mb(env) if mb is not None else None,
+                   stride=stride, padding=padding, dilation=dilation)
+
+    return _LazyVar(x._program, build, pname)
+
+
+# -- control flow (reference: static/nn/control_flow.py) --------------------
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """lax.cond over the traced program; branch fns run at trace time on
+    jax values (every paddle_tpu op accepts them)."""
+    pb = _LazyVar._lift(pred)
+    prog = (pred._program if isinstance(pred, _LazyVar)
+            else default_main_program())
+
+    def build(env):
+        return jax.lax.cond(jnp.asarray(pb(env)).reshape(()).astype(bool),
+                            lambda _: true_fn(), lambda _: false_fn(), 0)
+
+    return _LazyVar(prog, build, name or "cond")
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins chain of conds (reference: control_flow.py case):
+    folded into nested lax.cond at trace time; with no default, the LAST
+    branch runs when nothing matches (the reference's behavior)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    prog = default_main_program()
+    builds = [(_LazyVar._lift(p), fn) for p, fn in pred_fn_pairs]
+
+    def build(env):
+        def rec(i):
+            if i == len(builds) - 1 and default is None:
+                pb, fn = builds[i]
+                return jax.lax.cond(
+                    jnp.asarray(pb(env)).reshape(()).astype(bool),
+                    lambda _: fn(), lambda _: fn(), 0)
+            if i == len(builds):
+                return default()
+            pb, fn = builds[i]
+            return jax.lax.cond(
+                jnp.asarray(pb(env)).reshape(()).astype(bool),
+                lambda _: fn(), lambda _: rec(i + 1), 0)
+        return rec(0)
+
+    return _LazyVar(prog, build, name or "case")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """lax.switch (reference: control_flow.py switch_case)."""
+    ib = _LazyVar._lift(branch_index)
+    prog = (branch_index._program if isinstance(branch_index, _LazyVar)
+            else default_main_program())
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+    else:
+        keys = list(range(len(branch_fns)))
+        fns = list(branch_fns)
+
+    def build(env):
+        idx = jnp.asarray(ib(env)).reshape(()).astype(jnp.int32)
+        # map sparse keys onto dense switch slots; unknown -> default
+        table = {k: i for i, k in enumerate(keys)}
+        dense = -jnp.ones((max(keys) + 1,), jnp.int32)
+        for k, i in table.items():
+            dense = dense.at[k].set(i)
+        slot = jnp.where((idx >= 0) & (idx <= max(keys)),
+                         dense[jnp.clip(idx, 0, max(keys))], -1)
+        branches = [lambda _, f=f: f() for f in fns]
+        if default is not None:
+            branches.append(lambda _: default())
+            slot = jnp.where(slot < 0, len(fns), slot)
+        else:
+            slot = jnp.where(slot < 0, len(fns) - 1, slot)
+        return jax.lax.switch(slot, branches, 0)
+
+    return _LazyVar(prog, build, name or "switch_case")
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars,
+               is_test: bool = False, name=None):
+    """lax.while_loop; cond/body run on jax values at trace time
+    (reference: control_flow.py while_loop)."""
+    prog = default_main_program()
+    builds = [_LazyVar._lift(v) for v in loop_vars]
+
+    def build_all(env):
+        init = tuple(jnp.asarray(b(env)) for b in builds)
+        return jax.lax.while_loop(
+            lambda s: jnp.asarray(cond_fn(*s)).reshape(()).astype(bool),
+            lambda s: tuple(jnp.asarray(x) for x in body_fn(*s)), init)
+
+    # reference contract: returns a list of output vars matching loop_vars
+    out = []
+    for i in range(len(builds)):
+        out.append(_LazyVar(prog, (lambda env, i=i: build_all(env)[i]),
+                            f"{name or 'while_loop'}_{i}"))
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from . import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    raise NotImplementedError(
+        "static_pylayer: use paddle_tpu.autograd.PyLayer (custom_vjp) — "
+        "the traced program differentiates through it directly")
+
+
+def _ps_era(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{name} is LoD/parameter-server-era; no TPU backend "
+            f"(docs/DESIGN_DECISIONS.md: PS non-goal, LoD sequence ops "
+            f"superseded by padded batches + segment ids)")
+    fn.__name__ = name
+    return fn
+
+
+sequence_conv = _ps_era("sequence_conv")
+sequence_softmax = _ps_era("sequence_softmax")
+sequence_pool = _ps_era("sequence_pool")
+sequence_concat = _ps_era("sequence_concat")
+sequence_first_step = _ps_era("sequence_first_step")
+sequence_last_step = _ps_era("sequence_last_step")
+sequence_slice = _ps_era("sequence_slice")
+sequence_expand = _ps_era("sequence_expand")
+sequence_expand_as = _ps_era("sequence_expand_as")
+sequence_pad = _ps_era("sequence_pad")
+sequence_unpad = _ps_era("sequence_unpad")
+sequence_reshape = _ps_era("sequence_reshape")
+sequence_scatter = _ps_era("sequence_scatter")
+sequence_enumerate = _ps_era("sequence_enumerate")
+sequence_reverse = _ps_era("sequence_reverse")
+sparse_embedding = _ps_era("sparse_embedding")
+nce = _ps_era("nce")
+row_conv = _ps_era("row_conv")
+data_norm = _ps_era("data_norm")

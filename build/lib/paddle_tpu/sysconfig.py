@@ -1,0 +1,27 @@
+"""paddle.sysconfig parity (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building C++ extensions against the install)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Header directory for C++ extensions: the XLA FFI headers shipped
+    with jaxlib (what utils.cpp_extension compiles against — the PHI
+    header tree has no analogue here)."""
+    import jaxlib
+    base = os.path.dirname(jaxlib.__file__)
+    for cand in ("include", os.path.join("xla_extension", "include")):
+        p = os.path.join(base, cand)
+        if os.path.isdir(p):
+            return p
+    return base
+
+
+def get_lib() -> str:
+    """Shared-library directory (libtpu/PJRT plugins live under jaxlib)."""
+    import jaxlib
+    return os.path.dirname(jaxlib.__file__)

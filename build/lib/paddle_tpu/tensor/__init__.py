@@ -1,0 +1,1012 @@
+"""paddle_tpu.tensor — the paddle-shaped tensor-function surface.
+
+Reference: python/paddle/tensor/ (creation.py, math.py, linalg.py,
+manipulation.py, search.py, logic.py, random.py — 31K LoC). Functions are
+thin jnp/lax wrappers keeping the reference's names and argument
+conventions (e.g. ``axis`` not ``dim``, ``x``/``y`` operands, matmul
+transpose flags per tensor/linalg.py:151).
+
+Arrays are plain jax.Array — there is no wrapper Tensor class; XLA owns
+layout/placement. Dynamic-shape ops the reference supports via host fallback
+(masked_select, nonzero) are provided but documented as jit-unfriendly.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.rng import rng_tracker, GLOBAL_STREAM
+
+Tensor = jax.Array
+
+# -- creation (reference: tensor/creation.py) --------------------------------
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    arr = jnp.asarray(data, dtype=_dt.convert_dtype(dtype) if dtype else None)
+    if place is not None:
+        arr = jax.device_put(arr, place)
+    return arr
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(shape, _dt.convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(shape, _dt.convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return jnp.full(shape, fill_value, _dt.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=_dt.convert_dtype(dtype))
+
+
+def empty(shape, dtype="float32"):
+    return jnp.zeros(shape, _dt.convert_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+# -- random (reference: tensor/random.py; draws from the global RNG tracker) -
+
+def _key():
+    tr = rng_tracker()
+    if not tr.has(GLOBAL_STREAM):
+        tr.add(GLOBAL_STREAM, 0)
+    return tr.next_key()
+
+
+def rand(shape, dtype="float32"):
+    return jax.random.uniform(_key(), tuple(shape), _dt.convert_dtype(dtype))
+
+
+def randn(shape, dtype="float32"):
+    return jax.random.normal(_key(), tuple(shape), _dt.convert_dtype(dtype))
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(), tuple(shape), low, high,
+                              _dt.convert_dtype(dtype))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(_key(), tuple(shape), _dt.convert_dtype(dtype),
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return jax.random.normal(_key(), tuple(shape)) * std + mean
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(_key(), n).astype(_dt.convert_dtype(dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    if not replacement and num_samples > 1:
+        raise NotImplementedError("multinomial without replacement > 1 sample")
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    # categorical wants the sample count as leading dims broadcastable
+    # against the batch; draw (num_samples, *batch) then move it last
+    draws = jax.random.categorical(_key(), logits, axis=-1,
+                                   shape=(num_samples, *x.shape[:-1]))
+    return jnp.moveaxis(draws, 0, -1).astype(jnp.int64)
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(_key(), x).astype(x.dtype)
+
+
+# -- math (reference: tensor/math.py) ----------------------------------------
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = remainder = jnp.remainder
+pow = jnp.power
+maximum = jnp.maximum
+minimum = jnp.minimum
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sqrt = jnp.sqrt
+square = jnp.square
+abs = jnp.abs
+sign = jnp.sign
+floor = jnp.floor
+ceil = jnp.ceil
+round = jnp.round
+trunc = jnp.trunc
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+erf = jax.scipy.special.erf
+reciprocal = jnp.reciprocal
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+conj = jnp.conj
+real = jnp.real
+imag = jnp.imag
+angle = jnp.angle
+lerp = lambda x, y, w: x + w * (y - x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=_dt.convert_dtype(dtype) if dtype else None,
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim,
+                    dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=_dt.convert_dtype(dtype) if dtype else None)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+# -- logic / compare (reference: tensor/logic.py) ----------------------------
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+# -- linalg (reference: tensor/linalg.py; matmul at :151) --------------------
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def t(x):
+    return jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro" or p == 2:
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=2 if not isinstance(axis, (tuple, list)) else "fro",
+                               axis=axis if not isinstance(axis, list) else tuple(axis),
+                               keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    return jnp.linalg.slogdet(x)
+
+
+def matrix_rank(x, tol=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        min, max = float(jnp.min(x)), float(jnp.max(x))
+    hist, _ = jnp.histogram(x, bins=bins, range=(min, max))
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+# -- manipulation (reference: tensor/manipulation.py) ------------------------
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def concat(x, axis=0):
+    return jnp.concatenate(x, axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack(x, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    # paddle allows -1 for "rest"
+    if -1 in sections:
+        total = x.shape[axis]
+        known = builtins.sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else total - known for s in sections]
+    idx = np.cumsum(sections)[:-1]
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis if axis is None else tuple(np.atleast_1d(axis)))
+
+
+def unsqueeze(x, axis):
+    axes = tuple(np.atleast_1d(axis))
+    return jnp.expand_dims(x, axes)
+
+
+def expand(x, shape):
+    shape = [x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim
+             else s for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    stop = stop_axis if stop_axis >= 0 else x.ndim + stop_axis
+    shape = list(x.shape[:start_axis]) + [-1] + list(x.shape[stop + 1:])
+    return x.reshape(shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce == "add":
+        dim_idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+                   for d, s in enumerate(indices.shape)]
+        dim_idx[axis] = indices
+        return x.at[tuple(dim_idx)].add(jnp.broadcast_to(values, indices.shape))
+    raise ValueError(reduce)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def masked_select(x, mask):
+    """Dynamic output shape — host-side only; not jittable (reference keeps
+    this op on the dygraph path too)."""
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in idx)
+    return jnp.stack([jnp.asarray(i) for i in idx], axis=1)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def slice(x, axes, starts, ends):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def cast(x, dtype):
+    return x.astype(_dt.convert_dtype(dtype))
+
+
+def numel(x):
+    return int(np.prod(x.shape)) if x.shape else 1
+
+
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+# -- search (reference: tensor/search.py) ------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(_dt.convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(_dt.convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False, stable=False):
+    idx = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if not largest:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    val = jnp.take(sorted_x, k - 1, axis=axis)
+    ind = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return val, ind
+
+
+# -- breadth batch 2 (reference: python/paddle/tensor/{math,manipulation,
+#    search,stat}.py — long-tail op surface) --------------------------------
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
+                           side=side)
+    return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                        keepdims=keepdim, method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                           keepdims=keepdim, method=interpolation)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(jnp.asarray(y), jnp.asarray(x), axis=axis)
+    return jnp.trapezoid(jnp.asarray(y), dx=dx if dx is not None else 1.0,
+                         axis=axis)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    h, edges = jnp.histogramdd(jnp.asarray(x), bins=bins, range=ranges,
+                               density=density, weights=weights)
+    return h, edges
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is not None:
+        raise NotImplementedError("unique_consecutive over an axis: flatten "
+                                  "first (host-side ragged output)")
+    flat = arr.reshape(-1)
+    # data-dependent output size — host-side like the reference's CPU path
+    import numpy as _np
+    a = _np.asarray(flat)
+    if a.size == 0:
+        outs = [jnp.asarray(a)]
+        if return_inverse:
+            outs.append(jnp.asarray([], jnp.int64))
+        if return_counts:
+            outs.append(jnp.asarray([], jnp.int64))
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    change = _np.concatenate([[True], a[1:] != a[:-1]])
+    uniq = a[change]
+    outs = [jnp.asarray(uniq)]
+    if return_inverse:
+        outs.append(jnp.asarray(_np.cumsum(change) - 1, jnp.int64))
+    if return_counts:
+        idx = _np.flatnonzero(change)
+        outs.append(jnp.asarray(_np.diff(_np.append(idx, a.size)), jnp.int64))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = jnp.asarray(x)
+    idx = tuple(jnp.asarray(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    import builtins
+    x = jnp.asarray(x)
+    n = builtins.min(x.shape[axis1], x.shape[axis2])  # min() op shadows builtin
+    i = jnp.arange(n - builtins.abs(offset))
+    rows = i if offset >= 0 else i - offset
+    cols = i + offset if offset >= 0 else i
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    moved = moved.at[rows, cols].set(y)
+    return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    import builtins
+    x = jnp.asarray(x)
+    idx = [builtins.slice(None)] * x.ndim  # module-level slice() op shadows it
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, arr, axis=axis)
+    # index of the running argmax
+    eq = arr == vals
+    pos = jnp.arange(arr.shape[axis]).reshape(
+        [-1 if i == (axis % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, pos, -1),
+                                   axis=axis)
+    return vals, idx.astype(dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    arr = jnp.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, arr, axis=axis)
+    eq = arr == vals
+    pos = jnp.arange(arr.shape[axis]).reshape(
+        [-1 if i == (axis % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, pos, -1),
+                                   axis=axis)
+    return vals, idx.astype(dtype)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    arr = jnp.asarray(x, dtype=dtype)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, arr, axis=axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    arr = jnp.asarray(x)
+    moved = jnp.moveaxis(arr, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12),
+                      1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(jnp.asarray(x))
+    return m, e.astype(jnp.int32)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    return x + jnp.asarray(weight) * (y - x)
+
+
+def heaviside(x, y, name=None):
+    return jnp.heaviside(jnp.asarray(x), jnp.asarray(y))
+
+
+def nextafter(x, y, name=None):
+    return jnp.nextafter(jnp.asarray(x), jnp.asarray(y))
+
+
+def copysign(x, y, name=None):
+    return jnp.copysign(jnp.asarray(x), jnp.asarray(y))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(jnp.asarray(x), jnp.asarray(y))
+
+
+def hypot(x, y, name=None):
+    return jnp.hypot(jnp.asarray(x), jnp.asarray(y))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools as _it
+    import numpy as _np
+    a = _np.asarray(x).reshape(-1)
+    gen = (_it.combinations_with_replacement(range(a.size), r)
+           if with_replacement else _it.combinations(range(a.size), r))
+    idx = _np.asarray(list(gen), dtype=_np.int64).reshape(-1, r)
+    return jnp.asarray(a)[idx]
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis (reference Tensor.unfold)."""
+    arr = jnp.asarray(x)
+    n = (arr.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]      # [n, size]
+    out = jnp.take(arr, idx.reshape(-1), axis=axis)
+    shape = list(arr.shape)
+    shape[axis:axis + 1] = [n, size]
+    out = out.reshape(shape)
+    # paddle puts the window dim last
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(jnp.asarray(a)) for a in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+    return jsl.block_diag(*[jnp.asarray(a) for a in inputs])
+
+
+def cartesian_prod(x, name=None):
+    arrs = [jnp.asarray(a).reshape(-1) for a in x]
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    arr = jnp.asarray(x)
+    offset = int(offset)  # static: shapes derive from it (module-level `abs`
+    n = arr.shape[-1] + (offset if offset >= 0 else -offset)  # is jnp.abs)
+    out_shape = arr.shape[:-1] + (n, n)
+    out = jnp.zeros(out_shape, arr.dtype)
+    i = jnp.arange(arr.shape[-1])
+    rows = i if offset >= 0 else i - offset
+    cols = i + offset if offset >= 0 else i
+    out = out.at[..., rows, cols].set(arr)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+# -- elementwise long tail (reference: python/paddle/tensor/ops.py,
+#    math.py — neg:?, deg2rad, rad2deg, digamma, lgamma, logit, fmax, fmin,
+#    sigmoid re-export) --------------------------------------------------
+
+def neg(x, name=None):
+    return jnp.negative(jnp.asarray(x))
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(jnp.asarray(x))
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(jnp.asarray(x))
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(jnp.asarray(x))
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(jnp.asarray(x))
+
+
+def logit(x, eps=None, name=None):
+    arr = jnp.asarray(x)
+    if eps is not None:
+        arr = jnp.clip(arr, eps, 1.0 - eps)
+    return jnp.log(arr) - jnp.log1p(-arr)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(jnp.asarray(x), jnp.asarray(y))
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(jnp.asarray(x), jnp.asarray(y))
+
+
+# -- long-tail surface (extras) + inplace-spelled aliases --------------------
+from .extras import *          # noqa: F401,F403,E402
+from .inplace import *         # noqa: F401,F403,E402

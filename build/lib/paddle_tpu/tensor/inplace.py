@@ -1,0 +1,52 @@
+"""Inplace-suffixed (``op_``) variants of the tensor surface.
+
+Reference: python/paddle/tensor/ — paddle exposes ``x.op_()`` / ``paddle.op_``
+pairs that mutate storage and return the tensor. Arrays here are immutable
+jax.Array values, so the ``op_`` spellings are VALUE-SEMANTICS aliases: they
+compute the same result and return it (callers that rebind — the dominant
+paddle idiom ``x = x.tanh_()`` or chain — behave identically; true aliasing
+mutation is impossible under XLA and recorded as a design decision in
+docs/DESIGN_DECISIONS.md). Keeping the names lets reference code import-run.
+"""
+
+from __future__ import annotations
+
+import sys
+
+# base-name -> callable is resolved lazily against the package namespace so
+# this module can sit inside the tensor package without import cycles.
+_ALIASES = [
+    "abs", "acos", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
+    "ceil", "clip", "cos", "cosh", "cumprod", "cumsum", "digamma",
+    "divide", "equal", "erf", "exp", "expm1", "fill_diagonal", "flatten",
+    "floor", "floor_divide", "floor_mod", "frac", "gcd", "greater_equal",
+    "greater_than", "hypot", "i0", "index_add", "index_fill", "index_put",
+    "lcm", "ldexp", "lerp", "less_equal", "less_than", "lgamma", "log",
+    "log10", "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "multiply", "nan_to_num", "neg", "polygamma", "pow",
+    "put_along_axis", "reciprocal", "remainder", "renorm", "reshape",
+    "round", "rsqrt", "scale", "scatter", "sigmoid", "sin", "sinh",
+    "sqrt", "square", "squeeze", "subtract", "t", "tan", "tanh",
+    "transpose", "tril", "triu", "trunc", "uniform", "unsqueeze", "where",
+]
+
+__all__ = []
+
+
+def _make(base_name):
+    def fn(*args, **kwargs):
+        import paddle_tpu.tensor as _t
+        return getattr(_t, base_name)(*args, **kwargs)
+    fn.__name__ = base_name + "_"
+    fn.__qualname__ = base_name + "_"
+    fn.__doc__ = (f"Value-semantics alias of ``{base_name}`` (paddle's "
+                  f"inplace spelling; see module docstring).")
+    return fn
+
+
+_mod = sys.modules[__name__]
+for _name in _ALIASES:
+    setattr(_mod, _name + "_", _make(_name))
+    __all__.append(_name + "_")

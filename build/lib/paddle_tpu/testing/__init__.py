@@ -1,0 +1,13 @@
+"""Testing utilities — the OpTest harness.
+
+TPU-native analogue of the reference's op-test backbone
+(test/legacy_test/op_test.py:420): every op is checked against a numpy
+reference, gradients are checked numerically (central differences), and the
+same op is additionally run under ``jax.jit`` and under shardings on a
+device mesh to assert path parity — the reference runs each op through every
+registered execution path (static/dygraph/PIR, CPU/GPU) the same way.
+"""
+
+from .op_test import OpTest, numeric_grad, check_output, check_grad, check_sharded
+
+__all__ = ["OpTest", "numeric_grad", "check_output", "check_grad", "check_sharded"]

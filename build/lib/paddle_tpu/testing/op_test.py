@@ -1,0 +1,178 @@
+"""OpTest harness: numpy-reference + numeric-grad + path-parity checks.
+
+Reference model: test/legacy_test/op_test.py:420 (``check_output`` /
+``check_grad`` run each op through every registered path and compare against
+a numpy forward reference and finite-difference gradients). Here the "paths"
+are: eager (op-by-op dispatch), ``jax.jit`` (XLA-compiled), and sharded
+execution over a ``jax.sharding.Mesh`` (GSPMD) — outputs must agree across
+all of them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# per-dtype default tolerances, mirroring the reference's white_list/tolerance
+# tiers (test/white_list/op_accuracy_white_list.py)
+_DEFAULT_TOL = {
+    np.dtype(np.float64): (1e-7, 1e-7),
+    np.dtype(np.float32): (1e-5, 1e-5),
+    np.dtype(np.float16): (1e-3, 1e-3),
+    jnp.bfloat16.dtype: (2e-2, 2e-2),
+}
+
+
+def _tol_for(dtype, rtol, atol):
+    d_rtol, d_atol = _DEFAULT_TOL.get(np.dtype(dtype), (1e-5, 1e-5))
+    return (rtol if rtol is not None else d_rtol,
+            atol if atol is not None else d_atol)
+
+
+def numeric_grad(f: Callable[[np.ndarray], float], x: np.ndarray,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central-difference gradient of scalar ``f`` at ``x`` (fp64)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def _as_arrays(inputs, dtype):
+    out = []
+    for a in inputs:
+        a = np.asarray(a)
+        if np.issubdtype(a.dtype, np.floating):
+            a = a.astype(dtype)
+        out.append(a)
+    return out
+
+
+def check_output(fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 dtypes: Sequence = (np.float32,), rtol: Optional[float] = None,
+                 atol: Optional[float] = None, with_jit: bool = True,
+                 kwargs: Optional[Dict] = None) -> None:
+    """Assert fn(*inputs) == np_ref(*inputs) per dtype, eagerly and under jit.
+
+    Float inputs are cast to each dtype in ``dtypes``; the numpy reference
+    always runs in fp64 for a stable oracle.
+    """
+    kwargs = kwargs or {}
+    ref = np_ref(*_as_arrays(inputs, np.float64), **kwargs)
+    ref_list = ref if isinstance(ref, (tuple, list)) else [ref]
+    for dtype in dtypes:
+        r, a = _tol_for(dtype, rtol, atol)
+        xs = [jnp.asarray(v) for v in _as_arrays(inputs, dtype)]
+        paths = [("eager", fn)]
+        if with_jit:
+            paths.append(("jit", jax.jit(lambda *args: fn(*args, **kwargs))))
+        for name, f in paths:
+            got = f(*xs, **({} if name == "jit" else kwargs))
+            got_list = got if isinstance(got, (tuple, list)) else [got]
+            assert len(got_list) == len(ref_list), (
+                f"{name}: arity {len(got_list)} != ref {len(ref_list)}")
+            for g, e in zip(got_list, ref_list):
+                np.testing.assert_allclose(
+                    np.asarray(g, np.float64), np.asarray(e, np.float64),
+                    rtol=r, atol=a,
+                    err_msg=f"path={name} dtype={np.dtype(dtype).name}")
+
+
+def check_grad(fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+               arg_idx: int = 0, eps: float = 1e-3, rtol: float = 1e-3,
+               atol: float = 1e-3, kwargs: Optional[Dict] = None) -> None:
+    """Check jax.grad of sum(fn) at inputs[arg_idx] vs finite differences of
+    the fp64 numpy reference (the reference's numeric grad check)."""
+    kwargs = kwargs or {}
+    base = _as_arrays(inputs, np.float64)
+
+    def scalar_np(x):
+        args = list(base)
+        args[arg_idx] = x
+        out = np_ref(*args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return float(np.sum(np.asarray(out, np.float64)))
+
+    g_num = numeric_grad(scalar_np, base[arg_idx], eps=eps)
+
+    def scalar_jax(x):
+        args = [jnp.asarray(v, jnp.float32) for v in base]
+        args[arg_idx] = x
+        out = fn(*args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return jnp.sum(out)
+
+    g_jax = jax.grad(scalar_jax)(jnp.asarray(base[arg_idx], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g_jax, np.float64), g_num,
+                               rtol=rtol, atol=atol)
+
+
+def check_sharded(fn: Callable, inputs: Sequence[np.ndarray], mesh,
+                  in_specs: Sequence, rtol: float = 1e-5, atol: float = 1e-5,
+                  kwargs: Optional[Dict] = None) -> None:
+    """Run fn with inputs placed under NamedShardings on ``mesh`` and assert
+    the result matches unsharded execution (GSPMD path parity — the analogue
+    of the reference running ops on every backend)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    kwargs = kwargs or {}
+    xs = [jnp.asarray(v) for v in inputs]
+    ref = fn(*xs, **kwargs)
+    placed = [jax.device_put(x, NamedSharding(mesh, spec if spec is not None else P()))
+              for x, spec in zip(xs, in_specs)]
+    got = jax.jit(lambda *args: fn(*args, **kwargs))(*placed)
+    ref_list = ref if isinstance(ref, (tuple, list)) else [ref]
+    got_list = got if isinstance(got, (tuple, list)) else [got]
+    for g, e in zip(got_list, ref_list):
+        np.testing.assert_allclose(np.asarray(g, np.float64),
+                                   np.asarray(e, np.float64),
+                                   rtol=rtol, atol=atol)
+
+
+class OpTest:
+    """Declarative op test, the shape of the reference's ``OpTest`` subclassing
+    pattern: set ``fn`` / ``np_ref`` / ``inputs`` (and optionally ``kwargs``,
+    ``dtypes``, ``grad_args``) in ``setup`` and call the check methods.
+
+    Example::
+
+        class TestSilu(OpTest):
+            def setup(self):
+                self.fn = F.silu
+                self.np_ref = lambda x: x / (1 + np.exp(-x))
+                self.inputs = [np.random.randn(4, 8)]
+
+        TestSilu().run()    # checks output (fp32+bf16), grads, jit parity
+    """
+
+    fn: Callable = None
+    np_ref: Callable = None
+    inputs: Sequence[np.ndarray] = ()
+    kwargs: Dict = {}
+    dtypes: Sequence = (np.float32,)
+    grad_args: Sequence[int] = (0,)
+    grad_tol: Tuple[float, float] = (1e-3, 1e-3)
+
+    def setup(self):  # override
+        raise NotImplementedError
+
+    def run(self, grad: bool = True):
+        self.setup()
+        check_output(self.fn, self.np_ref, self.inputs, dtypes=self.dtypes,
+                     kwargs=self.kwargs)
+        if grad:
+            rtol, atol = self.grad_tol
+            for i in self.grad_args:
+                if np.issubdtype(np.asarray(self.inputs[i]).dtype, np.floating):
+                    check_grad(self.fn, self.np_ref, self.inputs, arg_idx=i,
+                               rtol=rtol, atol=atol, kwargs=self.kwargs)

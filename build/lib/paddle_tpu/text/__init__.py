@@ -1,0 +1,157 @@
+"""paddle_tpu.text — text-domain ops (reference: python/paddle/text/ plus the
+sequence ops the NLP stack uses: viterbi_decode at
+python/paddle/text/viterbi_decode.py, CRF ops under fluid/operators).
+
+TPU-native: decode loops are lax.scan — fixed-shape, jittable, batched.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "crf_log_likelihood",
+           "edit_distance"]
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Batched Viterbi decode (reference: text/viterbi_decode.py).
+
+    Args:
+        potentials: [B, T, N] unary emission scores.
+        transition: [N, N] (or [N+2, N+2] with bos/eos when
+            include_bos_eos_tag) pairwise scores, trans[i, j] = score(i→j).
+        lengths: [B] int lengths (default: full T).
+    Returns:
+        (scores [B], paths [B, T]) — best-path score and tag sequence.
+    """
+    potentials = jnp.asarray(potentials)
+    transition = jnp.asarray(transition)
+    B, T, N = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, dtype=jnp.int32)
+    lengths = jnp.asarray(lengths, dtype=jnp.int32)
+
+    if include_bos_eos_tag:
+        # reference convention: tags [0..N-1] are real, N = bos, N+1 = eos,
+        # transition is [N+2, N+2]
+        if transition.shape[0] != N + 2:
+            raise ValueError("with bos/eos, transition must be [N+2, N+2]")
+        bos, eos = N, N + 1
+        init = potentials[:, 0, :] + transition[bos, :N][None, :]
+        trans = transition[:N, :N]
+        eos_in = transition[:N, eos]
+    else:
+        if transition.shape[0] != N:
+            raise ValueError("transition must be [N, N]")
+        init = potentials[:, 0, :]
+        trans = transition
+        eos_in = jnp.zeros((N,), potentials.dtype)
+
+    def step(carry, t):
+        alpha = carry  # [B, N] best score ending in tag j at t-1
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)                    # [B, N]
+        best_score = jnp.max(scores, axis=1) + potentials[:, t, :]
+        # masked: positions past each sequence's length keep old alpha
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        return new_alpha, best_prev
+
+    alpha, backptrs = lax.scan(step, init, jnp.arange(1, T))
+    # terminal: add eos transition
+    final = alpha + eos_in[None, :]
+    last_tag = jnp.argmax(final, axis=-1)                          # [B]
+    best = jnp.max(final, axis=-1)
+
+    # backtrack (reverse scan over backpointers)
+    def back(carry, bp_t):
+        tag, t = carry
+        bp, t_idx = bp_t
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        active = (t_idx < lengths)
+        new_tag = jnp.where(active, prev, tag)
+        return (new_tag, t), new_tag
+
+    ts = jnp.arange(1, T)
+    (first_tag, _), rev_tags = lax.scan(back, (last_tag, T),
+                                        (backptrs[::-1], ts[::-1]))
+    paths = jnp.concatenate([rev_tags[::-1].T, last_tag[:, None]], axis=1)
+    return best, paths.astype(jnp.int32)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def crf_log_likelihood(potentials, transition, labels, lengths=None):
+    """log p(labels | potentials) under a linear-chain CRF ([N, N]
+    transitions, no bos/eos). Returns [B] log-likelihoods; differentiable —
+    the training counterpart of viterbi_decode."""
+    potentials = jnp.asarray(potentials)
+    transition = jnp.asarray(transition)
+    labels = jnp.asarray(labels, dtype=jnp.int32)
+    B, T, N = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, dtype=jnp.int32)
+    lengths = jnp.asarray(lengths, dtype=jnp.int32)
+
+    # numerator: score of the labeled path
+    emit = jnp.take_along_axis(potentials, labels[:, :, None], axis=2)[:, :, 0]
+    t_idx = jnp.arange(T)
+    emit_mask = t_idx[None, :] < lengths[:, None]
+    num = jnp.sum(emit * emit_mask, axis=1)
+    pair = transition[labels[:, :-1], labels[:, 1:]]
+    pair_mask = t_idx[None, 1:] < lengths[:, None]
+    num = num + jnp.sum(pair * pair_mask, axis=1)
+
+    # denominator: log-partition by forward algorithm
+    def step(alpha, t):
+        scores = alpha[:, :, None] + transition[None, :, :]
+        new_alpha = jax.nn.logsumexp(scores, axis=1) + potentials[:, t, :]
+        active = (t < lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alpha0 = potentials[:, 0, :]
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    log_z = jax.nn.logsumexp(alpha, axis=-1)
+    return num - log_z
+
+
+def edit_distance(hyps, refs, normalized: bool = True):
+    """Levenshtein distance between int sequences (reference:
+    fluid edit_distance op). Host-side (ragged inputs)."""
+    import numpy as np
+    out = []
+    for h, r in zip(hyps, refs):
+        h = list(h)
+        r = list(r)
+        dp = np.arange(len(r) + 1)
+        for i, ch in enumerate(h, 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j, cr in enumerate(r, 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (ch != cr))
+        d = float(dp[-1])
+        out.append(d / max(len(r), 1) if normalized else d)
+    return jnp.asarray(out, dtype=jnp.float32)
+
+
+# -- datasets (round-3 parity batch) ----------------------------------------
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+
+__all__ += ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+            "WMT14", "WMT16"]

@@ -1,0 +1,268 @@
+"""Training loop with built-in throughput/MFU accounting.
+
+Reference analogue: the hapi Model.fit loop (python/paddle/hapi/model.py:1756)
++ fleet's hybrid training step (SURVEY.md §3.3), redesigned around one jitted
+functional step: params/opt-state are donated pytrees, the loss fn comes from
+the Layer functional bridge, randomness enters as a key argument, and the LR
+is a scalar argument (scheduler stays host-side, never retraces).
+
+MFU = achieved_flops / peak_flops, with model FLOPs from
+``model.flops_per_token`` (PaLM convention) and per-chip peak from a small
+device table — the calculator the reference lacks (BASELINE.md requires it
+from day one).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import rng_tracker
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+
+# bf16 peak TFLOP/s per chip
+PEAK_FLOPS = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,   # v5e
+    "tpu v5e": 197e12,
+    "tpu v5": 459e12,        # v5p
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,   # v6e (trillium)
+    "cpu": 1e12,             # nominal, for smoke runs
+}
+
+
+def device_peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return PEAK_FLOPS.get(d.platform, 1e12)
+
+
+@dataclass
+class TrainMetrics:
+    step: int
+    loss: float
+    step_time_s: float
+    tokens_per_sec: float
+    tokens_per_sec_per_chip: float
+    mfu: float
+    lr: float
+
+    def as_dict(self):
+        return self.__dict__.copy()
+
+
+class Trainer:
+    """Single-program trainer: works 1-chip or over a mesh (pass sharded
+    params/opt-state; the jitted step inherits their shardings via GSPMD).
+
+    ``offload_opt_state=True`` parks the optimizer moments in HOST memory
+    between steps (pinned_host memory space): train_step pulls them to
+    device for the (donated) update and pushes the result back, one
+    batched transfer each way. Device HBM then holds params+grads+acts
+    plus only a transient optimizer copy — the TPU analogue of the
+    reference's GroupSharded CPU offload."""
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_key: Optional[str] = None, donate: bool = True,
+                 accumulate_steps: int = 1,
+                 offload_opt_state: Optional[bool] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self._named = dict(model.named_parameters())
+        self.params = model.raw_parameters()
+        self.opt_state = optimizer.init_state(self.params)
+        # None = inherit from the optimizer flag (group_sharded_parallel /
+        # fleet set it); an explicit True/False always wins, including over
+        # a flag set later
+        self._offload_explicit = offload_opt_state is not None
+        if offload_opt_state is None:
+            offload_opt_state = getattr(optimizer, "_offload_opt_state",
+                                        False)
+        self._offload = bool(offload_opt_state)
+        if self._offload:
+            self.opt_state = self._place_opt_state("pinned_host")
+        self._step_fn = None
+        self._donate = donate
+        self._step = 0
+        self._peak = device_peak_flops()
+        self._watchdog = None
+        self.accumulate_steps = max(1, int(accumulate_steps))
+
+    # -- step function -------------------------------------------------------
+
+    def _build_step(self):
+        model, opt = self.model, self.optimizer
+
+        accum = self.accumulate_steps
+
+        # models with a fused forward+backward schedule (1F1B pipeline)
+        # provide loss_and_grads instead of being differentiated through
+        fused = (getattr(model, "pp_schedule", None) == "1f1b"
+                 and hasattr(model, "loss_and_grads"))
+
+        def loss_of(params, batch, key):
+            if fused:
+                with rng_tracker().scope(key):
+                    return model.loss_and_grads(params, **batch)
+
+            def loss_fn(p):
+                with rng_tracker().scope(key):
+                    out = model.functional_call(p, **batch)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss
+            return jax.value_and_grad(loss_fn)(params)
+
+        def step_fn(params, opt_state, batch, lr, key):
+            if accum == 1:
+                loss, grads = loss_of(params, batch, key)
+            else:
+                # gradient accumulation (reference: GradientMerge pass /
+                # accumulate_steps): batch arrays carry a leading microbatch
+                # dim [A, ...]; one lax.scan accumulates grads in-place —
+                # a single compiled program, activations of only one
+                # microbatch live at a time
+                keys = jax.random.split(key, accum)
+
+                def body(carry, inp):
+                    g_acc, l_acc = carry
+                    mb, k = inp
+                    l, g = loss_of(params, mb, k)
+                    return (jax.tree.map(jnp.add, g_acc, g), l_acc + l), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    body, (zeros, 0.0), (batch, keys))
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                loss = loss_sum / accum
+            new_params, new_opt_state = opt.apply_gradients(params, grads,
+                                                            opt_state, lr=lr)
+            return new_params, new_opt_state, loss
+
+        donate = (0, 1) if self._donate else ()
+        self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+    def _place_opt_state(self, kind: str):
+        from ..optimizer.optimizer import place_opt_state
+        return place_opt_state(self.opt_state, self.params, kind)
+
+    def train_step(self, batch: Dict[str, jax.Array]) -> float:
+        """One optimization step. ``batch`` maps forward kwarg names to
+        arrays (e.g. {"input_ids": ..., "labels": ...})."""
+        if (not self._offload and not self._offload_explicit
+                and getattr(self.optimizer, "_offload_opt_state", False)):
+            # group_sharded_parallel(offload=True) ran AFTER this Trainer
+            # was built — honor the flag from here on (unless the caller
+            # explicitly passed offload_opt_state=False)
+            self._offload = True
+            self.opt_state = self._place_opt_state("pinned_host")
+        if self._step_fn is None:
+            self._build_step()
+        if self._watchdog is not None:
+            self._watchdog.tick()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = jax.random.key(self._step)
+        if self._offload:
+            # pull the state up for the step, push the update back down:
+            # host<->device streams around a device-resident step (the
+            # transient device copy is donated straight into the update).
+            # In-jit memory-space annotation is deliberately not used —
+            # mixed-space operands are rejected by XLA and the CPU test
+            # backend lacks annotate_device_placement entirely.
+            self.opt_state = self._place_opt_state("device")
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, batch, lr, key)
+        if self._offload:
+            self.opt_state = self._place_opt_state("pinned_host")
+        self._step += 1
+        if self._donate:
+            # donation invalidates the previous param buffers, which the
+            # Layer's Parameters still reference — rebind them to the new
+            # arrays so imperative model use never touches deleted buffers
+            self.sync_model()
+        sched = self.optimizer.lr_scheduler
+        if sched is not None:
+            sched.step()
+        return loss
+
+    # -- full loop with metrics ---------------------------------------------
+
+    def fit(self, data: Iterable[Dict[str, jax.Array]], steps: int,
+            log_every: int = 10, on_metrics: Optional[Callable] = None,
+            seq_len: Optional[int] = None):
+        # hung-step watchdog (PT_STEP_TIMEOUT_S): armed only for the
+        # duration of this bounded loop — inter-step gaps here ARE steps
+        # (device sync + next-batch wait), so a stall is a real hang, and
+        # stopping it on exit means eval/checkpoint phases outside fit()
+        # can never trigger a spurious kill (reference:
+        # phi/core/distributed/comm_task_manager.cc per-task timeouts)
+        from ..distributed.watchdog import watchdog_from_env
+        if self._watchdog is None:
+            self._watchdog = watchdog_from_env()
+        it = iter(data)
+        history = []
+        t_last = time.perf_counter()
+        tokens_since = 0
+        loss = None
+        try:
+            return self._fit_loop(it, steps, log_every, on_metrics, seq_len,
+                                  history, t_last, tokens_since, loss)
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+
+    def _fit_loop(self, it, steps, log_every, on_metrics, seq_len,
+                  history, t_last, tokens_since, loss):
+        for _ in range(steps):
+            batch = next(it)
+            ids = batch.get("input_ids")
+            ntok = int(ids.shape[0] * ids.shape[1]) if ids is not None else 0
+            loss = self.train_step(batch)
+            tokens_since += ntok
+            if self._step % log_every == 0:
+                loss_v = float(loss)  # blocks; amortized over log_every
+                now = time.perf_counter()
+                dt = now - t_last
+                tps = tokens_since / dt if dt > 0 else 0.0
+                n_dev = jax.device_count()
+                sl = seq_len or (ids.shape[1] if ids is not None else 1)
+                fpt = (self.model.flops_per_token(sl)
+                       if hasattr(self.model, "flops_per_token") else 0.0)
+                mfu = (tps / n_dev) * fpt / self._peak if fpt else 0.0
+                m = TrainMetrics(step=self._step, loss=loss_v,
+                                 step_time_s=dt / log_every,
+                                 tokens_per_sec=tps,
+                                 tokens_per_sec_per_chip=tps / n_dev,
+                                 mfu=mfu, lr=self.optimizer.get_lr())
+                history.append(m)
+                if on_metrics:
+                    on_metrics(m)
+                t_last = time.perf_counter()
+                tokens_since = 0
+        # write trained params back into the Layer (imperative view);
+        # train_step already does this when donation is on
+        self.sync_model()
+        return history
+
+    def sync_model(self):
+        for k, v in self.params.items():
+            self._named[k].value = v
+
+    def state_dict(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "step": self._step}
+
+    def set_state_dict(self, sd):
+        self.params = sd["params"]
+        self.opt_state = sd["opt_state"]
+        self._step = sd["step"]

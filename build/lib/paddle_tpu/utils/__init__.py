@@ -1,0 +1,17 @@
+"""paddle_tpu.utils (reference: python/paddle/utils/): flops accounting,
+weights fetch/cache, dlpack interop, unique_name, cpp_extension."""
+
+from . import flops as flops_mod
+from .flops import flops, transformer_flops_per_token, model_flops_per_token
+from .download import get_weights_path_from_url, get_path_from_url, DownloadError
+from .misc import (to_dlpack, from_dlpack, generate as unique_name_generate, guard,
+                   deprecated, require_version, try_import, run_check)
+from . import misc as unique_name_mod
+from . import cpp_extension
+from . import unique_name
+from . import dlpack
+from . import install_check
+
+__all__ = ["flops", "transformer_flops_per_token", "model_flops_per_token",
+           "get_weights_path_from_url", "get_path_from_url", "DownloadError",
+           "to_dlpack", "from_dlpack", "cpp_extension"]

@@ -1,0 +1,147 @@
+"""Out-of-tree C++ custom ops, JIT-compiled and called from inside XLA.
+
+Reference surface: ``python/paddle/utils/cpp_extension/`` (`load` compiles
+user C++ sources against installed headers and imports the resulting ops)
+and the C++ registration side ``paddle/fluid/framework/custom_operator.cc``.
+
+TPU-native redesign: user kernels implement the **XLA typed FFI** ABI
+(headers shipped with jaxlib, ``jax.ffi.include_dir()``); :func:`load`
+compiles them with g++, dlopens the result, registers each exported
+``XLA_FFI_DEFINE_HANDLER_SYMBOL`` under its symbol name via
+``jax.ffi.register_ffi_target``, and hands back a module-like object whose
+``call`` builds a jittable ``jax.ffi.ffi_call``. The op then runs inside the
+XLA program like any built-in — the custom-call slot the reference fills
+with its C++ op registry.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+_DEFAULT_BUILD_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+_build_lock = threading.Lock()
+
+
+def _compile(name: str, sources: Sequence[str], build_directory: str,
+             extra_cflags: Sequence[str], verbose: bool) -> str:
+    import jax.ffi
+
+    os.makedirs(build_directory, exist_ok=True)
+    so_path = os.path.join(build_directory, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= newest_src:
+        return so_path
+    import fcntl
+    with open(so_path + ".lock", "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        if (os.path.exists(so_path)
+                and os.path.getmtime(so_path) >= newest_src):
+            return so_path
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+               f"-I{jax.ffi.include_dir()}", *extra_cflags, *srcs, "-o", tmp]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(f"cpp_extension build failed:\n{proc.stderr[-8000:]}")
+        os.replace(tmp, so_path)
+    return so_path
+
+
+class CustomOpModule:
+    """Handle to a loaded extension: registered FFI targets + call builder."""
+
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self.so_path = so_path
+        self._cdll = ctypes.CDLL(so_path)
+        self._targets: dict[str, str] = {}
+
+    def register(self, symbol: str, target_name: str | None = None,
+                 platform: str = "cpu") -> str:
+        """Register an ``XLA_FFI_DEFINE_HANDLER_SYMBOL`` export as an FFI
+        target. Returns the target name to use with :meth:`call`."""
+        import jax.ffi
+        target_name = target_name or f"{self.name}.{symbol}"
+        if target_name in self._targets:
+            return target_name
+        fn = getattr(self._cdll, symbol)
+        jax.ffi.register_ffi_target(
+            target_name, jax.ffi.pycapsule(fn), platform=platform)
+        self._targets[target_name] = symbol
+        return target_name
+
+    def call(self, target_name: str, result_shape_dtypes, *args, **attrs):
+        """Invoke a registered target inside XLA (jittable). ``attrs`` become
+        FFI attributes (must match the handler's Bind().Attr list)."""
+        import jax
+        return jax.ffi.ffi_call(target_name, result_shape_dtypes)(*args, **attrs)
+
+    def targets(self):
+        return dict(self._targets)
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Sequence[str] = (),
+         build_directory: str | None = None, verbose: bool = False,
+         register: Sequence[str] = (), platform: str = "cpu") -> CustomOpModule:
+    """Compile + load a custom C++ op library (reference: cpp_extension.load).
+
+    ``register`` lists handler symbol names to register immediately;
+    others can be registered later via :meth:`CustomOpModule.register`.
+    """
+    with _build_lock:
+        so_path = _compile(name, sources, build_directory or _DEFAULT_BUILD_DIR,
+                           list(extra_cflags), verbose)
+    mod = CustomOpModule(name, so_path)
+    for sym in register:
+        mod.register(sym, platform=platform)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Built-in extension: the ops shipped in csrc/pt_ffi_ops.cc
+# ---------------------------------------------------------------------------
+
+_builtin = None
+_builtin_lock = threading.Lock()
+
+
+def builtin_ops() -> CustomOpModule:
+    """Load + register the framework's own FFI ops (csrc/pt_ffi_ops.cc)."""
+    global _builtin
+    with _builtin_lock:
+        if _builtin is None:
+            here = os.path.dirname(os.path.abspath(__file__))
+            src = os.path.join(here, os.pardir, os.pardir, "csrc", "pt_ffi_ops.cc")
+            _builtin = load("pt_ffi_ops", [src],
+                            register=["pt_ffi_rms_norm", "pt_ffi_swiglu"])
+        return _builtin
+
+
+def ffi_rms_norm(x, weight, eps: float = 1e-6):
+    """fused_rms_norm via the C++ FFI path (CPU). Jittable."""
+    import jax
+    import numpy as np
+    mod = builtin_ops()
+    out_spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+    # attrs are typed: the handler binds Attr<float>, so pass a true f32
+    return mod.call("pt_ffi_ops.pt_ffi_rms_norm", out_spec, x, weight,
+                    eps=np.float32(eps))
+
+
+def ffi_swiglu(gate, up):
+    """silu(gate) * up via the C++ FFI path (CPU). Jittable."""
+    import jax
+    mod = builtin_ops()
+    out_spec = jax.ShapeDtypeStruct(gate.shape, gate.dtype)
+    return mod.call("pt_ffi_ops.pt_ffi_swiglu", out_spec, gate, up)

@@ -1,0 +1,7 @@
+"""paddle.utils.dlpack module-path parity (reference:
+python/paddle/utils/dlpack.py); implementation in utils/misc.py over the
+jax dlpack interop."""
+
+from .misc import to_dlpack, from_dlpack
+
+__all__ = ["to_dlpack", "from_dlpack"]

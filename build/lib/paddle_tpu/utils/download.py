@@ -1,0 +1,69 @@
+"""Model-zoo fetch utilities (reference: python/paddle/utils/download.py
+get_weights_path_from_url + hub.py).
+
+Zero-egress redesign: resolution order is (1) an already-cached file under
+``PADDLE_TPU_HOME`` (default ~/.cache/paddle_tpu), (2) a local mirror
+directory given via ``PADDLE_TPU_MIRROR``; an actual network fetch raises a
+clear error instead of hanging — weights ship to TPU pods via mounted
+storage, not per-process downloads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "cached_path",
+           "DownloadError"]
+
+
+class DownloadError(RuntimeError):
+    pass
+
+
+def _home() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def cached_path(url: str) -> str:
+    fname = url.rstrip("/").rsplit("/", 1)[-1]
+    return os.path.join(_home(), "weights", fname)
+
+
+def get_path_from_url(url: str, root_dir: str = None, md5sum: str = None,
+                      check_exist: bool = True) -> str:
+    """Resolve a weights URL to a local path without network access."""
+    target = cached_path(url) if root_dir is None else os.path.join(
+        root_dir, url.rstrip("/").rsplit("/", 1)[-1])
+    if os.path.exists(target):
+        if md5sum and _md5(target) != md5sum:
+            raise DownloadError(f"{target}: md5 mismatch")
+        return target
+    mirror = os.environ.get("PADDLE_TPU_MIRROR")
+    if mirror:
+        cand = os.path.join(mirror, os.path.basename(target))
+        if os.path.exists(cand):
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            shutil.copy2(cand, target)
+            if md5sum and _md5(target) != md5sum:
+                raise DownloadError(f"{cand}: md5 mismatch")
+            return target
+    raise DownloadError(
+        f"cannot fetch {url!r}: this environment has no network egress. "
+        f"Place the file at {target} or set PADDLE_TPU_MIRROR to a local "
+        f"mirror directory.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    return get_path_from_url(url, md5sum=md5sum)

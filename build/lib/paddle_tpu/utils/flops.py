@@ -1,0 +1,132 @@
+"""Per-op FLOPs accounting (reference: python/paddle/utils/flops.py — the
+table the profiler and auto-parallel cost model share; also the basis of the
+trainer's MFU calculator).
+
+``flops(op_type, input_shapes, attrs)`` mirrors the reference entry point;
+``model_flops_per_token`` gives the transformer closed form used by the MFU
+meter (6*N + attention term), matching trainer/trainer.py accounting.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence
+
+_FLOP_FNS = {}
+
+
+def _register(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _FLOP_FNS[t] = fn
+        return fn
+    return deco
+
+
+def flops(op_type: str, input_shapes: Dict[str, Sequence[int]] = None,
+          attrs: Dict = None) -> int:
+    """FLOPs of one op instance (reference: utils/flops.py:flops). Unknown
+    ops count 0, like the reference."""
+    fn = _FLOP_FNS.get(op_type)
+    if fn is None:
+        return 0
+    return int(fn(input_shapes or {}, attrs or {}))
+
+
+def _numel(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+@_register("matmul", "matmul_v2", "mul")
+def _matmul_flops(shapes, attrs):
+    x = list(shapes.get("X") or shapes.get("x") or [])
+    y = list(shapes.get("Y") or shapes.get("y") or [])
+    if not x or not y:
+        return 0
+    if attrs.get("transpose_x") or attrs.get("trans_x"):
+        x[-1], x[-2] = x[-2], x[-1]
+    if attrs.get("transpose_y") or attrs.get("trans_y"):
+        y[-1], y[-2] = y[-2], y[-1]
+    m, k = x[-2] if len(x) > 1 else 1, x[-1]
+    n = y[-1]
+    batch = _numel(x[:-2]) if len(x) > 2 else 1
+    return 2 * batch * m * n * k
+
+
+@_register("conv2d", "depthwise_conv2d")
+def _conv_flops(shapes, attrs):
+    inp = shapes.get("Input") or shapes.get("x")
+    w = shapes.get("Filter") or shapes.get("weight")
+    if not inp or not w:
+        return 0
+    n, _, h, wdt = inp
+    cout, cin_g, kh, kw = w
+    stride = attrs.get("strides", [1, 1])
+    pad = attrs.get("paddings", [0, 0])
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (wdt + 2 * pad[1] - kw) // stride[1] + 1
+    return 2 * n * cout * oh * ow * cin_g * kh * kw
+
+
+@_register("relu", "gelu", "silu", "sigmoid", "tanh", "softmax",
+           "elementwise_add", "elementwise_mul", "elementwise_sub",
+           "elementwise_div", "dropout", "scale")
+def _elementwise_flops(shapes, attrs):
+    x = shapes.get("X") or shapes.get("x") or []
+    return _numel(x)
+
+
+@_register("layer_norm", "rms_norm")
+def _norm_flops(shapes, attrs):
+    x = shapes.get("X") or shapes.get("x") or []
+    return 5 * _numel(x)
+
+
+@_register("flash_attn", "flash_attention")
+def _attn_flops(shapes, attrs):
+    q = shapes.get("q") or shapes.get("Q") or []
+    k = shapes.get("k") or shapes.get("K") or q
+    if not q:
+        return 0
+    b, sq, h, d = q
+    sk = k[1]
+    causal_factor = 0.5 if attrs.get("causal") else 1.0
+    return int(4 * b * h * sq * sk * d * causal_factor)
+
+
+# ---------------------------------------------------------------------------
+# model-level closed forms (MFU meter)
+# ---------------------------------------------------------------------------
+
+def transformer_flops_per_token(num_params: int, num_layers: int,
+                                hidden_size: int, seq_len: int,
+                                causal: bool = True,
+                                include_backward: bool = True) -> float:
+    """FLOPs/token for decoder training: 6N (fwd+bwd weight FLOPs) plus the
+    attention quadratic term 12*L*h*s (6*L*h*s forward, halved if causal,
+    x3 with backward)."""
+    weight = (6 if include_backward else 2) * num_params
+    attn_fwd = 2 * num_layers * hidden_size * seq_len * (2 if not causal else 1)
+    attn = attn_fwd * (3 if include_backward else 1)
+    return float(weight + attn)
+
+
+def model_flops_per_token(cfg, include_backward: bool = True) -> float:
+    """Convenience over a Llama-style config object with num_hidden_layers,
+    hidden_size, and a parameter count derivable from it."""
+    n_layers = cfg.num_hidden_layers
+    h = cfg.hidden_size
+    inter = getattr(cfg, "intermediate_size", 4 * h)
+    vocab = cfg.vocab_size
+    head_dim = h // cfg.num_attention_heads
+    kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    per_layer = (h * h + 2 * h * kv_heads * head_dim + h * h   # qkv + o
+                 + 3 * h * inter                                # gated mlp
+                 + 2 * h)                                       # norms
+    n_params = n_layers * per_layer + vocab * h * 2 + h
+    return transformer_flops_per_token(
+        n_params, n_layers, h, getattr(cfg, "max_position_embeddings", 2048),
+        include_backward=include_backward)

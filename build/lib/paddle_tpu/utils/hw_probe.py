@@ -1,0 +1,123 @@
+"""Safe TPU-availability probing.
+
+Tunneled TPU PJRT plugins can hang indefinitely inside backend init (not
+just fail), so availability is checked in a killable SUBPROCESS: the child
+runs in its own session and the whole process group is SIGKILLed on
+timeout. Used by bench.py and tools/tune_kernels.py before they commit
+this process to a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+PROBE_CODE = ("import jax; d=jax.devices(); "
+              "from paddle_tpu.ops.registry import device_is_tpu; "
+              "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
+
+
+def _one_probe(timeout: float, cwd: str) -> Tuple[bool, str]:
+    p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, start_new_session=True, cwd=cwd)
+    try:
+        out, err = p.communicate(timeout=timeout)
+        if p.returncode == 0 and "TPU_OK" in out:
+            return True, "TPU_OK"
+        return False, (f"rc={p.returncode} "
+                       f"platform={out.strip()[-40:] or '?'}: "
+                       f"{(err or '').strip()[-300:]}")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            p.communicate(timeout=10)
+        except Exception:
+            pass
+        return False, f"hung >{timeout:.0f}s (TPU tunnel wedged?)"
+
+
+def probe_tpu(attempts: Optional[int] = None, timeout: Optional[float] = None,
+              sleep: Optional[float] = None, window: Optional[float] = None,
+              cwd: Optional[str] = None) -> Tuple[bool, Optional[str]]:
+    """Returns (tpu_available, note). The child must print TPU_OK — a
+    silent CPU fallback in the child does not count as TPU.
+
+    Retry policy (round-3 verdict: two 240s attempts then surrender wasted
+    the round budget): a FAST first probe (60s) catches a healthy tunnel
+    cheaply; on a wedged tunnel, retries back off over a total ``window``
+    (default 900s) with full-length (240s) attempts, optionally running a
+    tunnel-reset hook (env ``PT_TUNNEL_RESET_CMD``) between attempts. All
+    knobs have env overrides (PT_PROBE_ATTEMPTS / PT_PROBE_TIMEOUT /
+    PT_PROBE_SLEEP / PT_PROBE_WINDOW) so the driver can tune the budget
+    without a code change."""
+    if os.environ.get("PT_BENCH_FORCE_CPU"):
+        return False, "PT_BENCH_FORCE_CPU set"
+    env = os.environ
+    if attempts is None:
+        attempts = int(env.get("PT_PROBE_ATTEMPTS", "4"))
+    if timeout is None:
+        timeout = float(env.get("PT_PROBE_TIMEOUT", "240"))
+    if sleep is None:
+        sleep = float(env.get("PT_PROBE_SLEEP", "30"))
+    if window is None:
+        window = float(env.get("PT_PROBE_WINDOW", "900"))
+    if attempts < 1:
+        return False, "PT_PROBE_ATTEMPTS < 1: probing disabled"
+    cwd = cwd or os.getcwd()
+    t0 = time.monotonic()
+    notes = []
+    for i in range(attempts):
+        # fast first probe: a healthy tunnel answers in seconds, so don't
+        # spend the full timeout discovering a healthy chip late
+        tmo = min(60.0, timeout) if i == 0 else timeout
+        remaining = window - (time.monotonic() - t0)
+        if i > 0 and remaining < 30:
+            notes.append(f"window {window:.0f}s exhausted")
+            break
+        ok, msg = _one_probe(min(tmo, max(remaining, 30.0)), cwd)
+        if ok:
+            return True, None
+        notes.append(f"attempt {i + 1}/{attempts}: {msg}")
+        sys.stderr.write(notes[-1] + "\n")
+        if i < attempts - 1:
+            reset_cmd = env.get("PT_TUNNEL_RESET_CMD")
+            if reset_cmd:
+                try:
+                    subprocess.run(reset_cmd, shell=True, timeout=120,
+                                   capture_output=True)
+                    notes.append("ran PT_TUNNEL_RESET_CMD")
+                except Exception as e:
+                    notes.append(f"reset hook failed: {e}")
+            time.sleep(sleep)
+    return False, "; ".join(notes[-4:])
+
+
+def force_cpu():
+    """Pin this process to the CPU backend (wins over the site hook's
+    forced platform selection); call before any backend init."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+__all__ = ["probe_tpu", "force_cpu", "PROBE_CODE"]
+
+
+def force_host_sync(x) -> None:
+    """Force a real device->host readback of one leaf of ``x``.
+
+    Through the tunneled-TPU plugin, jax.block_until_ready alone has been
+    observed returning before the queued work drains, yielding
+    microsecond-scale fantasy timings — a scalar np.asarray round-trip is
+    the reliable fence. Shared by bench.py and tools/tune_kernels.py."""
+    import jax
+    import numpy as np
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(leaf.ravel()[0])

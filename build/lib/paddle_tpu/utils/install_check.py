@@ -1,0 +1,7 @@
+"""paddle.utils.install_check module-path parity (reference:
+python/paddle/utils/install_check.py run_check — a smoke matmul on every
+visible device); implementation in utils/misc.py."""
+
+from .misc import run_check
+
+__all__ = ["run_check"]

@@ -1,0 +1,144 @@
+"""Small utilities: dlpack interop, unique_name (reference:
+python/paddle/utils/{dlpack.py,unique_name.py})."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+
+
+# -- dlpack (reference: utils/dlpack.py to_dlpack/from_dlpack) --------------
+
+def to_dlpack(x):
+    """jax array → dlpack capsule-compatible object (zero copy on device)."""
+    return jax.dlpack.to_dlpack(x) if hasattr(jax.dlpack, "to_dlpack") else x
+
+
+def from_dlpack(capsule):
+    """dlpack → jax array. Accepts any __dlpack__-bearing object (torch,
+    numpy, cupy) per the array-api interchange protocol."""
+    return jax.dlpack.from_dlpack(capsule)
+
+
+# -- unique_name (reference: utils/unique_name.py generate/guard/switch) ----
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, key: str) -> str:
+        with self._lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = _UniqueNameGenerator()
+_gen_stack = [_generator]
+
+
+def generate(key: str) -> str:
+    return _gen_stack[-1](key)
+
+
+class guard:
+    """Scoped fresh namespace (reference unique_name.guard)."""
+
+    def __init__(self, new_generator=None):
+        self._gen = _UniqueNameGenerator()
+
+    def __enter__(self):
+        _gen_stack.append(self._gen)
+        return self._gen
+
+    def __exit__(self, *exc):
+        _gen_stack.pop()
+        return False
+
+
+def switch(new_generator=None):
+    gen = new_generator or _UniqueNameGenerator()
+    old = _gen_stack[-1]
+    _gen_stack[-1] = gen
+    return old
+
+
+# -- round-3 parity batch (reference: python/paddle/utils/{deprecated.py,
+#    lazy_import.py,install_check.py, base/framework require_version}) -----
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Deprecation decorator (reference: utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+                   f"since {since or 'an earlier release'}"
+                   + (f", use '{update_to}' instead" if update_to else "")
+                   + (f". Reason: {reason}" if reason else ""))
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Check the installed framework version (reference:
+    base/framework.py require_version)."""
+    from .. import __version__
+
+    def _tuple(v):
+        return tuple(int(p) for p in v.split(".") if p.isdigit())
+
+    cur = _tuple(__version__)
+    if _tuple(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and _tuple(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import-or-explain (reference: utils/lazy_import.py try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; this "
+            f"environment is offline — gate the feature or vendor the "
+            f"dependency")
+
+
+def run_check():
+    """Smoke-test the install (reference: utils/install_check.py
+    run_check): one matmul on the default device, one on an 8-way mesh if
+    enough devices are visible."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    x = jnp.ones((64, 64), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    jax.block_until_ready(y)
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(jax.devices(), ("x",))
+        xs = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+        jax.block_until_ready(jax.jit(lambda a: a @ a.T)(xs))
+    print(f"PaddleTPU works well on 1 {dev.platform} device.")
+    if n > 1:
+        print(f"PaddleTPU works well on {n} {dev.platform} devices.")
+    print("PaddleTPU is installed successfully!")

@@ -1,0 +1,7 @@
+"""paddle.utils.unique_name module-path parity (reference:
+python/paddle/utils/unique_name.py re-exporting base/unique_name.py);
+implementation in utils/misc.py."""
+
+from .misc import generate, guard, switch
+
+__all__ = ["generate", "guard", "switch"]

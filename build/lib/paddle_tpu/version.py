@@ -1,0 +1,62 @@
+"""paddle.version module-path parity (reference: generated
+python/paddle/version/__init__.py — full_version/major/minor/patch/rc and
+the toolchain probes). TPU build: no CUDA/cuDNN in the build by design
+(the north-star constraint); xla() reports the jaxlib that provides the
+compiler."""
+
+_v = "0.1.0"
+
+full_version = _v
+_parts = (_v.split("+")[0].split(".") + ["0", "0"])[:3]
+major, minor = _parts[0], _parts[1]
+# split any pre-release suffix out of the patch component ("0rc1" -> 0, 1)
+import re as _re
+_m = _re.match(r"(\d+)(?:rc(\d+))?", _parts[2])
+patch = _m.group(1) if _m else _parts[2]
+rc = _m.group(2) or "0" if _m else "0"
+commit = "unknown"
+with_gpu = "OFF"
+istaged = False
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"commit: {commit}\nwith_gpu: {with_gpu}")
+    print(f"xla: {xla()}")
+
+
+def cuda():
+    """No CUDA in the build (BASELINE north star: no CUDA)."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    return False
+
+
+def xpu():
+    return False
+
+
+def xpu_xccl():
+    return False
+
+
+def cinn():
+    """XLA fills the CINN slot (docs/DESIGN_DECISIONS.md)."""
+    return False
+
+
+def xla() -> str:
+    import jaxlib
+    return getattr(jaxlib, "__version__", "unknown")
+
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn", "nccl", "xpu", "xpu_xccl", "cinn",
+           "xla", "with_gpu", "istaged"]

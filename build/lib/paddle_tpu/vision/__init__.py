@@ -1,0 +1,51 @@
+"""paddle_tpu.vision — vision domain library (reference: python/paddle/vision/).
+
+Subpackages: transforms (host-side preprocessing with native C++ normalize
+fast path), datasets (local-file readers + hermetic fake data), models
+(classification backbones; OCR det/rec live in paddle_tpu.models.vision).
+"""
+
+from . import ops
+from . import transforms
+from . import datasets
+from . import models
+from .models import (LeNet, VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1,
+                     MobileNetV2, mobilenet_v1, mobilenet_v2, ResNet,
+                     resnet18, resnet34, resnet50, resnet101, SqueezeNet,
+                     squeezenet1_0)
+from .datasets import (MNIST, FashionMNIST, Cifar10, Cifar100,
+                       FakeImageDataset, DatasetFolder, ImageFolder)
+
+__all__ = ["transforms", "datasets", "models", "ops"]
+
+# -- image backend control (reference: python/paddle/vision/image.py) -------
+_IMAGE_BACKEND = "pil"
+
+
+def get_image_backend() -> str:
+    return _IMAGE_BACKEND
+
+
+def set_image_backend(backend: str) -> None:
+    global _IMAGE_BACKEND
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected 'pil', 'cv2' or 'tensor', got {backend!r}")
+    _IMAGE_BACKEND = backend
+
+
+def image_load(path: str, backend=None):
+    """Load an image via the active backend (reference: vision/image.py
+    image_load). cv2 is not shipped; PIL covers decode."""
+    backend = backend or _IMAGE_BACKEND
+    from PIL import Image
+    img = Image.open(path)
+    if backend in ("cv2", "tensor"):
+        import numpy as np
+        return np.asarray(img)
+    return img
+
+
+__all__ += ["get_image_backend", "set_image_backend", "image_load"]
+
+from . import image  # paddle.vision.image module path

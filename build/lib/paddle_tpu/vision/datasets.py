@@ -1,0 +1,256 @@
+"""paddle_tpu.vision.datasets — dataset readers (reference:
+python/paddle/vision/datasets/: MNIST/FashionMNIST/Cifar10/Cifar100/
+Flowers/VOC2012 + folder datasets; python/paddle/dataset/ legacy fetchers).
+
+Zero-egress environment: the reference auto-downloads; here datasets read
+local files when paths are given (same on-disk formats: IDX for MNIST,
+pickled batches for CIFAR), and every class can synthesize deterministic
+fake data (``backend="fake"``) so tests and pipelines run hermetically —
+the role the reference's fake_cpu_device plays for device tests.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageDataset",
+           "DatasetFolder", "ImageFolder"]
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic synthetic image classification set."""
+
+    def __init__(self, num_samples: int = 256, image_shape=(3, 32, 32),
+                 num_classes: int = 10, transform: Optional[Callable] = None,
+                 seed: int = 0, channels_last: bool = False):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.channels_last = channels_last
+        self._seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(self._seed + idx)
+        shape = self.image_shape
+        if self.channels_last and len(shape) == 3:
+            shape = (shape[1], shape[2], shape[0])
+        img = rs.randint(0, 256, shape, dtype=np.uint8)
+        label = idx % self.num_classes
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+class MNIST(Dataset):
+    """IDX-format reader (reference: vision/datasets/mnist.py). Pass
+    ``image_path``/``label_path`` to local files, or ``backend="fake"``."""
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, backend: str = "auto",
+                 download: bool = False):
+        if download:
+            raise RuntimeError(
+                "this environment has no network egress; place the IDX files "
+                "locally and pass image_path/label_path")
+        self.transform = transform
+        if backend == "fake" or (image_path is None and backend == "auto"):
+            n = 512 if mode == "train" else 128
+            self._fake = FakeImageDataset(n, (1, 28, 28), 10,
+                                          transform=None, seed=42)
+            self.images = None
+            self.labels = None
+        else:
+            self._fake = None
+            self.images = _read_idx_images(image_path)
+            self.labels = _read_idx_labels(label_path)
+
+    def __len__(self):
+        return len(self._fake) if self._fake else len(self.images)
+
+    def __getitem__(self, idx):
+        if self._fake:
+            img, label = self._fake[idx]
+            img = img[0][:, :, None]  # HW1
+        else:
+            img = self.images[idx][:, :, None]
+            label = np.asarray(int(self.labels[idx]), dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    _n_classes = 10
+    _label_key = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, backend: str = "auto",
+                 download: bool = False):
+        if download:
+            raise RuntimeError("no network egress; pass data_file to the local "
+                               "CIFAR python-format tar.gz")
+        self.transform = transform
+        if backend == "fake" or (data_file is None and backend == "auto"):
+            n = 512 if mode == "train" else 128
+            self._fake = FakeImageDataset(n, (3, 32, 32), self._n_classes,
+                                          transform=None, seed=7,
+                                          channels_last=True)
+            self.data = None
+        else:
+            self._fake = None
+            self.data, self.labels = self._load(data_file, mode)
+
+    def _load(self, path: str, mode: str):
+        imgs, labels = [], []
+        with tarfile.open(path, "r:*") as tf:
+            names = [m for m in tf.getmembers()
+                     if (("data_batch" in m.name or "train" in m.name)
+                         if mode == "train"
+                         else ("test" in m.name))]
+            for m in sorted(names, key=lambda m: m.name):
+                if not m.isfile():
+                    continue
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                if b"data" not in d:
+                    continue
+                imgs.append(d[b"data"])
+                labels.extend(d.get(self._label_key, d.get(b"fine_labels")))
+        data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        return data.transpose(0, 2, 3, 1), np.asarray(labels, dtype=np.int64)
+
+    def __len__(self):
+        return len(self._fake) if self._fake else len(self.data)
+
+    def __getitem__(self, idx):
+        if self._fake:
+            img, label = self._fake[idx]
+        else:
+            img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Cifar10(_CifarBase):
+    _n_classes = 10
+    _label_key = b"labels"
+
+
+class Cifar100(_CifarBase):
+    _n_classes = 100
+    _label_key = b"fine_labels"
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp", ".tiff")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference:
+    vision/datasets/folder.py DatasetFolder)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=_IMG_EXTENSIONS, transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise FileNotFoundError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    p = os.path.join(dirpath, fname)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files under {root}")
+
+    @staticmethod
+    def _default_loader(path: str):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, dtype=np.int64)
+
+
+class ImageFolder(Dataset):
+    """Flat image list without labels (reference: folder.py ImageFolder)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=_IMG_EXTENSIONS, transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                p = os.path.join(dirpath, fname)
+                if valid(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

@@ -1,0 +1,6 @@
+"""paddle.vision.image module-path parity (reference:
+python/paddle/vision/image.py); implementation in vision/__init__.py."""
+
+from . import (image_load, set_image_backend, get_image_backend)
+
+__all__ = ["image_load", "set_image_backend", "get_image_backend"]

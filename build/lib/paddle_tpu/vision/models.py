@@ -1,0 +1,279 @@
+"""paddle_tpu.vision.models — classification backbones (reference:
+python/paddle/vision/models/: LeNet, VGG, ResNet, MobileNetV1/V2/V3,
+GoogLeNet, ShuffleNetV2, ...).
+
+ResNet (+OCR det/rec heads) live in paddle_tpu.models.vision; this module
+adds the remaining reference families that the target configs touch. All
+NCHW, bf16-friendly, compiled by XLA (convs tile onto the MXU; no custom
+kernels needed at these sizes).
+"""
+
+from __future__ import annotations
+
+from .. import nn
+from ..models.vision import (ResNet, resnet18, resnet50, BasicBlock,
+                             BottleneckBlock, ConvBNLayer)
+
+__all__ = [
+    "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+    "SqueezeNet", "squeezenet1_0",
+]
+
+
+class LeNet(nn.Layer):
+    """reference: python/paddle/vision/models/lenet.py"""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Flatten(), nn.Linear(400, 120), nn.Linear(120, 84),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(self.features(x))
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Layer):
+    """reference: python/paddle/vision/models/vgg.py"""
+
+    def __init__(self, cfg: str = "D", num_classes: int = 1000,
+                 batch_norm: bool = False, with_pool: bool = True):
+        super().__init__()
+        layers = []
+        in_ch = 3
+        for v in _VGG_CFGS[cfg]:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, 2))
+            else:
+                layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.ReLU())
+                in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        x = x.reshape(x.shape[0], -1)
+        return self.classifier(x)
+
+
+def vgg11(**kw):
+    return VGG("A", **kw)
+
+
+def vgg13(**kw):
+    return VGG("B", **kw)
+
+
+def vgg16(**kw):
+    return VGG("D", **kw)
+
+
+def vgg19(**kw):
+    return VGG("E", **kw)
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.dw = ConvBNLayer(in_ch, in_ch, 3, stride=stride, groups=in_ch)
+        self.pw = ConvBNLayer(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """reference: python/paddle/vision/models/mobilenetv1.py"""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
+               (s(128), s(256), 2), (s(256), s(256), 1), (s(256), s(512), 2),
+               *[(s(512), s(512), 1)] * 5,
+               (s(512), s(1024), 2), (s(1024), s(1024), 1)]
+        self.stem = ConvBNLayer(3, s(32), 3, stride=2)
+        self.blocks = nn.Sequential(
+            *[_DepthwiseSeparable(i, o, st) for i, o, st in cfg])
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.fc(x)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_ch * expand_ratio))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(in_ch, hidden, 1, act="relu6"))
+        layers += [ConvBNLayer(hidden, hidden, 3, stride=stride, groups=hidden,
+                               act="relu6"),
+                   ConvBNLayer(hidden, out_ch, 1, act=None)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """reference: python/paddle/vision/models/mobilenetv2.py"""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_ch = max(int(32 * scale), 8)
+        self.stem = ConvBNLayer(3, in_ch, 3, stride=2, act="relu6")
+        blocks = []
+        for t, c, n, s in cfg:
+            out_ch = max(int(c * scale), 8)
+            for i in range(n):
+                blocks.append(_InvertedResidual(in_ch, out_ch,
+                                                s if i == 0 else 1, t))
+                in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        last = max(int(1280 * scale), 1280)
+        self.head = ConvBNLayer(in_ch, last, 1, act="relu6")
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                        nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.head(self.blocks(self.stem(x)))
+        x = self.pool(x).reshape(x.shape[0], -1)
+        return self.classifier(x)
+
+
+def mobilenet_v1(scale: float = 1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale: float = 1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+def resnet34(**kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet101(**kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(in_ch, squeeze, 1), nn.ReLU())
+        self.expand1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.expand3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1),
+                                     nn.ReLU())
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        s = self.squeeze(x)
+        return jnp.concatenate([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference: python/paddle/vision/models/squeezenet.py (v1.0)"""
+
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+            _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+            _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+            _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+            nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet(**kw)
+
+
+class _SqueezeNet11(nn.Layer):
+    """reference: vision/models/squeezenet.py v1.1 layout (3x3 stem,
+    earlier pools — same accuracy, ~2.4x cheaper)."""
+
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+            nn.MaxPool2D(3, 2),
+            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_1(**kw):
+    return _SqueezeNet11(**kw)
+
+
+def resnet34(**kw):  # noqa: F811 — original kept above; ensure export
+    return ResNet(34, **kw)
+
+
+# -- round-3 parity batch: deep/grouped/wide + classic families -------------
+from .models_extras import (  # noqa: E402
+    AlexNet, alexnet, DenseNet, densenet121, densenet161, densenet169,
+    densenet201, densenet264, GoogLeNet, googlenet, InceptionV3,
+    inception_v3, MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large, ShuffleNetV2, shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0, shufflenet_v2_swish,
+    resnet152, resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d, wide_resnet50_2,
+    wide_resnet101_2)

@@ -1,0 +1,736 @@
+"""vision.models long tail: AlexNet, DenseNet, GoogLeNet, InceptionV3,
+MobileNetV3, ShuffleNetV2, ResNeXt/wide/deep ResNet variants.
+
+Reference: python/paddle/vision/models/{alexnet.py,densenet.py,
+googlenet.py,inceptionv3.py,mobilenetv3.py,shufflenetv2.py,resnet.py}.
+Same construction idiom as vision/models.py: plain Layers over
+paddle_tpu.nn; pretrained weights are a download concern (hub) and not
+bundled (offline image).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (reference: models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = F.adaptive_avg_pool2d(x, (6, 6))
+        x = x.reshape(x.shape[0], -1)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        return x
+
+
+def alexnet(pretrained: bool = False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# grouped/wide/deep ResNet family (reference: models/resnet.py)
+# ---------------------------------------------------------------------------
+
+class _GroupedBottleneck(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_c, out_c, stride=1, groups: int = 1,
+                 base_width: int = 64):
+        super().__init__()
+        width = int(out_c * (base_width / 64.0)) * groups
+        self.conv1 = nn.Sequential(nn.Conv2D(in_c, width, 1, bias_attr=False),
+                                   nn.BatchNorm2D(width), nn.ReLU())
+        self.conv2 = nn.Sequential(
+            nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(width), nn.ReLU())
+        self.conv3 = nn.Sequential(
+            nn.Conv2D(width, out_c * 4, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c * 4))
+        self.short = (None if stride == 1 and in_c == out_c * 4
+                      else nn.Sequential(
+                          nn.Conv2D(in_c, out_c * 4, 1, stride=stride,
+                                    bias_attr=False),
+                          nn.BatchNorm2D(out_c * 4)))
+        if self.short is None:
+            self.add_sublayer("short", None)
+
+    def forward(self, x):
+        s = x if self.short is None else self.short(x)
+        return F.relu(self.conv3(self.conv2(self.conv1(x))) + s)
+
+
+class _ResNetG(nn.Layer):
+    """ResNet skeleton with groups/base_width (ResNeXt/wide variants)."""
+
+    def __init__(self, layers: List[int], num_classes: int = 1000,
+                 groups: int = 1, base_width: int = 64,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(64), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        in_c, widths = 64, [64, 128, 256, 512]
+        stages = []
+        for i, (w, n) in enumerate(zip(widths, layers)):
+            blocks = []
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                blocks.append(_GroupedBottleneck(in_c, w, stride,
+                                                 groups, base_width))
+                in_c = w * 4
+            stages.append(nn.Sequential(*blocks))
+        self.layer1, self.layer2, self.layer3, self.layer4 = stages
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(in_c, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.stem(x))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def resnet152(pretrained: bool = False, **kwargs):
+    return _ResNetG([3, 8, 36, 3], **kwargs)
+
+
+def wide_resnet50_2(pretrained: bool = False, **kwargs):
+    return _ResNetG([3, 4, 6, 3], base_width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained: bool = False, **kwargs):
+    return _ResNetG([3, 4, 23, 3], base_width=128, **kwargs)
+
+
+def _resnext(layers, groups, width, **kwargs):
+    return _ResNetG(layers, groups=groups, base_width=width, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 6, 3], 32, 4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 6, 3], 64, 4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 23, 3], 32, 4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 23, 3], 64, 4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return _resnext([3, 8, 36, 3], 32, 4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return _resnext([3, 8, 36, 3], 64, 4, **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (reference: models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = dropout
+
+    def forward(self, x):
+        y = self.conv1(F.relu(self.norm1(x)))
+        y = self.conv2(F.relu(self.norm2(y)))
+        if self.dropout:
+            y = F.dropout(y, self.dropout, training=self.training)
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+
+    def forward(self, x):
+        x = self.conv(F.relu(self.norm(x)))
+        return F.avg_pool2d(x, 2, stride=2)
+
+
+class DenseNet(nn.Layer):
+    CONFIGS = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+               264: (6, 12, 64, 48)}
+
+    def __init__(self, layers: int = 121, growth_rate: int = 32,
+                 bn_size: int = 4, dropout: float = 0.0,
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        if layers not in self.CONFIGS:
+            raise ValueError(f"layers must be one of {sorted(self.CONFIGS)}")
+        if layers == 161:
+            growth_rate, init_c = 48, 96
+        else:
+            init_c = 64
+        block_cfg = self.CONFIGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        c = init_c
+        blocks = []
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(c, growth_rate, bn_size, dropout))
+                c += growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c = c // 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm = nn.BatchNorm2D(c)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.norm(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (reference: models/googlenet.py)
+# ---------------------------------------------------------------------------
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(in_c, proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b2(x), self.b3(x),
+                                self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.reshape(x.shape[0], -1)))
+        # reference returns (out, aux1, aux2); aux heads are train-time
+        # classifiers — mirrored as the main logits here
+        return x, x, x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (reference: models/inceptionv3.py — standard tower layout)
+# ---------------------------------------------------------------------------
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b2 = nn.Sequential(_ConvBN(in_c, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.b4 = _ConvBN(in_c, pool_c, 1)
+
+    def forward(self, x):
+        p = F.avg_pool2d(x, 3, stride=1, padding=1)
+        return jnp.concatenate([self.b1(x), self.b2(x), self.b3(x),
+                                self.b4(p)], axis=1)
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b2 = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, stride=2))
+
+    def forward(self, x):
+        p = F.max_pool2d(x, 3, stride=2)
+        return jnp.concatenate([self.b1(x), self.b2(x), p], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c, mid):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b2 = nn.Sequential(_ConvBN(in_c, mid, 1),
+                                _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+                                _ConvBN(mid, 192, (7, 1), padding=(3, 0)))
+        self.b3 = nn.Sequential(_ConvBN(in_c, mid, 1),
+                                _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+                                _ConvBN(mid, mid, (1, 7), padding=(0, 3)),
+                                _ConvBN(mid, mid, (7, 1), padding=(3, 0)),
+                                _ConvBN(mid, 192, (1, 7), padding=(0, 3)))
+        self.b4 = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        p = F.avg_pool2d(x, 3, stride=1, padding=1)
+        return jnp.concatenate([self.b1(x), self.b2(x), self.b3(x),
+                                self.b4(p)], axis=1)
+
+
+class _ReductionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = nn.Sequential(_ConvBN(in_c, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b2 = nn.Sequential(_ConvBN(in_c, 192, 1),
+                                _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+                                _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+                                _ConvBN(192, 192, 3, stride=2))
+
+    def forward(self, x):
+        p = F.max_pool2d(x, 3, stride=2)
+        return jnp.concatenate([self.b1(x), self.b2(x), p], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b2_stem = _ConvBN(in_c, 384, 1)
+        self.b2_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b2_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3_stem = nn.Sequential(_ConvBN(in_c, 448, 1),
+                                     _ConvBN(448, 384, 3, padding=1))
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b4 = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        b2 = self.b2_stem(x)
+        b3 = self.b3_stem(x)
+        p = F.avg_pool2d(x, 3, stride=1, padding=1)
+        return jnp.concatenate(
+            [self.b1(x), self.b2_a(b2), self.b2_b(b2),
+             self.b3_a(b3), self.b3_b(b3), self.b4(p)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _ReductionA(288),
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192),
+            _ReductionB(768),
+            _InceptionC(1280), _InceptionC(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.reshape(x.shape[0], -1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (reference: models/mobilenetv3.py)
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SE(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.fc1 = nn.Conv2D(c, _make_divisible(c // r), 1)
+        self.fc2 = nn.Conv2D(_make_divisible(c // r), c, 1)
+
+    def forward(self, x):
+        s = F.adaptive_avg_pool2d(x, 1)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act_fn = F.hardswish if act == "hardswish" else F.relu
+        self._act = act_fn
+        self.expand = (None if exp == in_c else nn.Sequential(
+            nn.Conv2D(in_c, exp, 1, bias_attr=False), nn.BatchNorm2D(exp)))
+        if self.expand is None:
+            self.add_sublayer("expand", None)
+        self.dw = nn.Sequential(
+            nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2, groups=exp,
+                      bias_attr=False),
+            nn.BatchNorm2D(exp))
+        self.se = _SE(exp) if use_se else None
+        if self.se is None:
+            self.add_sublayer("se", None)
+        self.project = nn.Sequential(
+            nn.Conv2D(exp, out_c, 1, bias_attr=False), nn.BatchNorm2D(out_c))
+
+    def forward(self, x):
+        y = x
+        if self.expand is not None:
+            y = self._act(self.expand(y))
+        y = self._act(self.dw(y))
+        if self.se is not None:
+            y = self.se(y)
+        y = self.project(y)
+        return x + y if self.use_res else y
+
+
+_MBV3_SMALL = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+_MBV3_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, scale: float = 1.0,
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(in_c), nn.Hardswish())
+        blocks = []
+        for k, exp, out_c, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            oc = _make_divisible(out_c * scale)
+            blocks.append(_MBV3Block(in_c, exp_c, oc, k, s, se, act))
+            in_c = oc
+        self.blocks = nn.Sequential(*blocks)
+        last_c = _make_divisible(last_exp * scale)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(in_c, last_c, 1, bias_attr=False),
+            nn.BatchNorm2D(last_c), nn.Hardswish())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_c, 1280), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape(x.shape[0], -1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__(_MBV3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__(_MBV3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (reference: models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        self._act = F.silu if act == "swish" else F.relu
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = self._branch(in_c // 2, branch_c)
+            self.add_sublayer("branch1", None)
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c))
+            self.branch2 = self._branch(in_c, branch_c)
+
+    def _branch(self, in_c, out_c):
+        return nn.Sequential(
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU(),
+            nn.Conv2D(out_c, out_c, 3, stride=self.stride, padding=1,
+                      groups=out_c, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.Conv2D(out_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = jnp.concatenate([x1, self.branch2(x2)], axis=1)
+        else:
+            out = jnp.concatenate([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    CONFIGS = {0.25: (24, 48, 96, 192, 1024),
+               0.33: (24, 32, 64, 128, 512),
+               0.5: (24, 48, 96, 192, 1024),
+               1.0: (24, 116, 232, 464, 1024),
+               1.5: (24, 176, 352, 704, 1024),
+               2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        cfg = self.CONFIGS[scale]
+        repeats = (4, 8, 4)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, cfg[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(cfg[0]), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        in_c = cfg[0]
+        stages = []
+        for i, n in enumerate(repeats):
+            out_c = cfg[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            for _ in range(n - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.head = nn.Sequential(
+            nn.Conv2D(in_c, cfg[4], 1, bias_attr=False),
+            nn.BatchNorm2D(cfg[4]), nn.ReLU())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(cfg[4], num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)

@@ -1,0 +1,782 @@
+"""paddle.vision.ops — detection / vision operators.
+
+Reference: python/paddle/vision/ops.py (yolo_loss, yolo_box, prior_box,
+box_coder, deform_conv2d, distribute_fpn_proposals, generate_proposals,
+roi_pool/align, psroi_pool, nms, matrix_nms, read_file, decode_jpeg).
+
+TPU design notes: the pooled/aligned ROI ops are gather + bilinear-tap
+compositions (batched einsum-friendly, static output shapes, jit-safe);
+NMS-family ops have data-dependent output sizes, so like the reference's
+CPU kernels they run host-side numpy and return index tensors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg", "roi_pool",
+           "RoIPool", "psroi_pool", "PSRoIPool", "roi_align", "RoIAlign",
+           "nms", "matrix_nms"]
+
+
+# ---------------------------------------------------------------------------
+# file / image decode
+# ---------------------------------------------------------------------------
+
+def read_file(filename: str, name=None):
+    """Raw bytes as a uint8 tensor (reference: ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """JPEG decode to [C, H, W] uint8 (reference: ops.py decode_jpeg over
+    nvjpeg; PIL is the host decoder here)."""
+    import io
+    from PIL import Image
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode in ("gray", "L"):
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+
+def _box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) \
+        * jnp.maximum(b[..., 3] - b[..., 1], 0)
+
+
+def _iou_matrix(a, b):
+    """IoU of [n, 4] vs [m, 4] xyxy boxes -> [n, m]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Greedy hard NMS returning kept indices (reference: ops.py nms;
+    kernel nms_kernel.cu). Host-side: output length is data-dependent."""
+    b = np.asarray(boxes, np.float32)
+    n = b.shape[0]
+    if scores is None:
+        order = np.arange(n)
+    else:
+        order = np.argsort(-np.asarray(scores, np.float32), kind="stable")
+    if categories is not None and category_idxs is not None:
+        cats = np.asarray(category_idxs)
+        keep_all = []
+        for c in categories:
+            idx = order[cats[order] == c]
+            kept = _nms_single(b[idx], iou_threshold)
+            keep_all.append(idx[kept])
+        keep = np.concatenate(keep_all) if keep_all else np.asarray([], int)
+        if scores is not None:
+            keep = keep[np.argsort(-np.asarray(scores)[keep], kind="stable")]
+    else:
+        kept = _nms_single(b[order], iou_threshold)
+        keep = order[kept]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return jnp.asarray(keep, jnp.int64)
+
+
+def _nms_single(boxes_sorted, thr):
+    n = boxes_sorted.shape[0]
+    if n == 0:
+        return np.asarray([], int)
+    iou = np.asarray(_iou_matrix(jnp.asarray(boxes_sorted),
+                                 jnp.asarray(boxes_sorted)))
+    keep = []
+    alive = np.ones(n, bool)
+    for i in range(n):
+        if not alive[i]:
+            continue
+        keep.append(i)
+        alive &= iou[i] <= thr
+        alive[i] = False
+    return np.asarray(keep, int)
+
+
+def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
+               nms_top_k: int, keep_top_k: int, use_gaussian: bool = False,
+               gaussian_sigma: float = 2.0, background_label: int = 0,
+               normalized: bool = True, return_index: bool = False,
+               return_rois_num: bool = True, name=None):
+    """Matrix (parallel soft) NMS (reference: ops.py matrix_nms; used by
+    SOLOv2/PP-YOLO): per class, decay each score by the best-overlap decay
+    factor — one IoU matrix instead of a sequential loop (TPU-friendly
+    math, host-side assembled ragged output like the reference kernel)."""
+    bb = np.asarray(bboxes, np.float32)     # [n, m, 4]
+    sc = np.asarray(scores, np.float32)     # [n, c, m]
+    outs, indices, rois_num = [], [], []
+    n, c, m = sc.shape
+    for b in range(n):
+        per_img = []
+        per_idx = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel], kind="stable")][:nms_top_k]
+            boxes_c = bb[b, order]
+            s_c = s[order]
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes_c),
+                                         jnp.asarray(boxes_c)))
+            iou = np.triu(iou, k=1)
+            iou_cmax = iou.max(axis=0)                      # [k]
+            pair = iou                                       # [k, k] (i<j)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[None, :] ** 2 - pair ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1.0 - pair) / np.maximum(1.0 - iou_cmax[None, :],
+                                                  1e-10)
+            decay = np.where(np.triu(np.ones_like(pair), k=1) > 0, decay,
+                             np.inf).min(axis=0)
+            decay[0] = 1.0
+            s_dec = s_c * decay
+            keep = s_dec > post_threshold
+            for j in np.nonzero(keep)[0]:
+                per_img.append([cls, s_dec[j], *boxes_c[j]])
+                per_idx.append(b * m + order[j])
+        if per_img:
+            arr = np.asarray(per_img, np.float32)
+            srt = np.argsort(-arr[:, 1], kind="stable")[:keep_top_k]
+            arr = arr[srt]
+            idx = np.asarray(per_idx)[srt]
+        else:
+            arr = np.zeros((0, 6), np.float32)
+            idx = np.asarray([], np.int64)
+        outs.append(arr)
+        indices.append(idx)
+        rois_num.append(arr.shape[0])
+    out = jnp.asarray(np.concatenate(outs, axis=0)) if outs else \
+        jnp.zeros((0, 6))
+    ret = [out]
+    if return_index:
+        ret.append(jnp.asarray(np.concatenate(indices), jnp.int64))
+    if return_rois_num:
+        ret.append(jnp.asarray(rois_num, jnp.int32))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None):
+    """Encode/decode boxes against priors (reference: ops.py box_coder;
+    kernel box_coder_kernel)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        # [n_t, n_p]
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if var.ndim == 2:
+            out = out / var[None, :, :]
+        else:
+            out = out / var.reshape(1, 1, 4)
+        return out
+    # decode_center_size: target [n, n_p, 4] deltas against priors
+    if axis == 0:
+        pxx, pyy, pww, phh = (px[None, :], py[None, :], pw[None, :],
+                              ph[None, :])
+    else:
+        pxx, pyy, pww, phh = (px[:, None], py[:, None], pw[:, None],
+                              ph[:, None])
+    if var.ndim == 2:
+        v = var[None, :, :] if axis == 0 else var[:, None, :]
+    else:
+        v = var.reshape(1, 1, 4)
+    dx, dy, dw, dh = (tb[..., 0] * v[..., 0], tb[..., 1] * v[..., 1],
+                      tb[..., 2] * v[..., 2], tb[..., 3] * v[..., 3])
+    cx = dx * pww + pxx
+    cy = dy * phh + pyy
+    w = jnp.exp(dw) * pww
+    h = jnp.exp(dh) * phh
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip: bool = False,
+              clip: bool = False, steps=(0.0, 0.0), offset: float = 0.5,
+              min_max_aspect_ratios_order: bool = False, name=None):
+    """SSD prior boxes (reference: ops.py prior_box)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    variances = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for k, ms in enumerate(np.atleast_1d(min_sizes)):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes is not None:
+                        big = math.sqrt(ms * np.atleast_1d(max_sizes)[k])
+                        cell.append((cx, cy, big, big))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                    if max_sizes is not None:
+                        big = math.sqrt(ms * np.atleast_1d(max_sizes)[k])
+                        cell.append((cx, cy, big, big))
+            for (ccx, ccy, w, h) in cell:
+                boxes.append(((ccx - w * 0.5) / iw, (ccy - h * 0.5) / ih,
+                              (ccx + w * 0.5) / iw, (ccy + h * 0.5) / ih))
+                variances.append(variance)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    var = np.asarray(variances, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops — bilinear-tap compositions, jit-safe static shapes
+# ---------------------------------------------------------------------------
+
+def _bilinear_tap(feat, ys, xs):
+    """Sample feat [C, H, W] at float coords ys/xs [...] -> [C, ...]."""
+    h, w = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        return jnp.where(inside, feat[:, yc, xc], 0.0)
+
+    return (tap(y0, x0) * ((1 - wy) * (1 - wx))
+            + tap(y0, x0 + 1) * ((1 - wy) * wx)
+            + tap(y0 + 1, x0) * (wy * (1 - wx))
+            + tap(y0 + 1, x0 + 1) * (wy * wx))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """ROI Align (reference: ops.py roi_align; kernel
+    roi_align_kernel.cu): average of bilinear taps per output bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    arr = jnp.asarray(x)
+    rois = jnp.asarray(boxes, jnp.float32)
+    rois_host = None  # fetched lazily; only the adaptive path needs it
+    nums = np.asarray(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(feat, roi, ry, rx):
+        x1, y1, x2, y2 = roi * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw = jnp.maximum(x2 - x1, 1e-4 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-4 if aligned else 1.0)
+        bw = rw / pw
+        bh = rh / ph
+        gy = (y1 + bh * (jnp.arange(ph)[:, None, None, None] +
+                         (jnp.arange(ry)[None, None, :, None] + 0.5)
+                         / ry))
+        gx = (x1 + bw * (jnp.arange(pw)[None, :, None, None] +
+                         (jnp.arange(rx)[None, None, None, :] + 0.5)
+                         / rx))
+        ys = jnp.broadcast_to(gy, (ph, pw, ry, rx))
+        xs = jnp.broadcast_to(gx, (ph, pw, ry, rx))
+        vals = _bilinear_tap(feat, ys, xs)          # [C, ph, pw, ry, rx]
+        return jnp.mean(vals, axis=(-1, -2))        # [C, ph, pw]
+
+    def grid_for(i):
+        # Reference: sampling_ratio<=0 -> adaptive ceil(roi_size/bin) per
+        # ROI (roi_align_kernel.cu); computed host-side so shapes stay
+        # static per trace. Under jit the boxes are traced (no host values)
+        # so the adaptive path degrades to the fixed 2x2 grid.
+        if sampling_ratio > 0:
+            return sampling_ratio, sampling_ratio
+        nonlocal rois_host
+        if rois_host is None:
+            if isinstance(rois, jax.core.Tracer):
+                return 2, 2
+            rois_host = np.asarray(rois, np.float32)
+        x1, y1, x2, y2 = rois_host[i] * spatial_scale
+        rh = max(float(y2 - y1), 1e-4)
+        rw = max(float(x2 - x1), 1e-4)
+        return (max(int(np.ceil(rh / ph)), 1),
+                max(int(np.ceil(rw / pw)), 1))
+
+    outs = [one_roi(arr[int(b)], rois[i], *grid_for(i))
+            for i, b in enumerate(batch_of_roi)]
+    return (jnp.stack(outs) if outs
+            else jnp.zeros((0, arr.shape[1], ph, pw), arr.dtype))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """Max ROI pooling (reference: ops.py roi_pool)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    arr = jnp.asarray(x)
+    rois = np.asarray(boxes, np.float32)
+    nums = np.asarray(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+    h, w = arr.shape[2], arr.shape[3]
+    outs = []
+    for i, b in enumerate(batch_of_roi):
+        x1, y1, x2, y2 = np.round(rois[i] * spatial_scale).astype(int)
+        x2 = max(x2 + 1, x1 + 1)
+        y2 = max(y2 + 1, y1 + 1)
+        feat = arr[int(b), :, max(y1, 0):min(y2, h), max(x1, 0):min(x2, w)]
+        rh, rw = feat.shape[1], feat.shape[2]
+        bins_y = np.linspace(0, rh, ph + 1).astype(int)
+        bins_x = np.linspace(0, rw, pw + 1).astype(int)
+        pooled = jnp.stack([
+            jnp.stack([
+                jnp.max(feat[:, bins_y[i2]:max(bins_y[i2 + 1],
+                                               bins_y[i2] + 1),
+                             bins_x[j2]:max(bins_x[j2 + 1],
+                                            bins_x[j2] + 1)],
+                        axis=(1, 2))
+                for j2 in range(pw)], axis=-1)
+            for i2 in range(ph)], axis=-2)
+        outs.append(pooled)
+    return (jnp.stack(outs) if outs
+            else jnp.zeros((0, arr.shape[1], ph, pw), arr.dtype))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+               name=None):
+    """Position-sensitive ROI pooling (reference: ops.py psroi_pool):
+    channel block (i,j) feeds output bin (i,j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    arr = jnp.asarray(x)
+    c = arr.shape[1]
+    if c % (ph * pw):
+        raise ValueError(f"channels {c} must be divisible by "
+                         f"{ph}*{pw}")
+    co = c // (ph * pw)
+    rois = np.asarray(boxes, np.float32)
+    nums = np.asarray(boxes_num)
+    batch_of_roi = np.repeat(np.arange(len(nums)), nums)
+    h, w = arr.shape[2], arr.shape[3]
+    outs = []
+    for i, b in enumerate(batch_of_roi):
+        x1, y1, x2, y2 = rois[i] * spatial_scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        grid = jnp.zeros((co, ph, pw), arr.dtype)
+        # Reference kernel: input_channel = (c*ph_ + iy)*pw_ + ix, i.e.
+        # channels are laid out (co, ph, pw) — output channel outermost.
+        feat = arr[int(b)].reshape(co, ph, pw, h, w)
+        for iy in range(ph):
+            for ix in range(pw):
+                ys = int(np.floor(y1 + rh * iy / ph))
+                ye = int(np.ceil(y1 + rh * (iy + 1) / ph))
+                xs_ = int(np.floor(x1 + rw * ix / pw))
+                xe = int(np.ceil(x1 + rw * (ix + 1) / pw))
+                ys, ye = max(ys, 0), min(max(ye, ys + 1), h)
+                xs_, xe = max(xs_, 0), min(max(xe, xs_ + 1), w)
+                region = feat[:, iy, ix, ys:ye, xs_:xe]
+                grid = grid.at[:, iy, ix].set(jnp.mean(region, axis=(1, 2)))
+        outs.append(grid)
+    return (jnp.stack(outs) if outs
+            else jnp.zeros((0, co, ph, pw), arr.dtype))
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._a[0],
+                         spatial_scale=self._a[1])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._a[0],
+                        spatial_scale=self._a[1])
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        super().__init__()
+        self._a = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._a[0],
+                          spatial_scale=self._a[1])
+
+
+# ---------------------------------------------------------------------------
+# deformable conv — offset-guided bilinear gather + matmul (MXU does the
+# contraction; the gather is the only irregular part)
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None, name=None):
+    """Deformable conv v1/v2 (reference: ops.py deform_conv2d; kernels
+    deformable_conv_kernel). mask=None -> v1; with mask -> v2 modulation."""
+    from ..nn.functional import _norm_tuple
+    arr = jnp.asarray(x)
+    off = jnp.asarray(offset)
+    w = jnp.asarray(weight)
+    n, cin, h, ww_ = arr.shape
+    cout, cin_g, kh, kw = w.shape
+    s = _norm_tuple(stride, 2)
+    p = _norm_tuple(padding, 2)
+    d = _norm_tuple(dilation, 2)
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (ww_ + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    # base sampling grid [oh, ow, kh, kw]
+    gy = (jnp.arange(oh)[:, None, None, None] * s[0] - p[0]
+          + jnp.arange(kh)[None, None, :, None] * d[0])
+    gx = (jnp.arange(ow)[None, :, None, None] * s[1] - p[1]
+          + jnp.arange(kw)[None, None, None, :] * d[1])
+    gy = jnp.broadcast_to(gy, (oh, ow, kh, kw)).astype(jnp.float32)
+    gx = jnp.broadcast_to(gx, (oh, ow, kh, kw)).astype(jnp.float32)
+    # offsets laid out [n, dg*kh*kw*2, oh, ow] with (dy, dx) paired per
+    # kernel point (reference layout)
+    off2 = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+    dy = jnp.transpose(off2[:, :, :, 0], (0, 1, 3, 4, 2)) \
+        .reshape(n, deformable_groups, oh, ow, kh, kw)
+    dx = jnp.transpose(off2[:, :, :, 1], (0, 1, 3, 4, 2)) \
+        .reshape(n, deformable_groups, oh, ow, kh, kw)
+    if mask is not None:
+        mk = jnp.asarray(mask).reshape(n, deformable_groups, kh * kw, oh, ow)
+        mk = jnp.transpose(mk, (0, 1, 3, 4, 2)) \
+            .reshape(n, deformable_groups, oh, ow, kh, kw)
+    cg = cin // deformable_groups
+
+    cols = []
+    for b in range(n):
+        per_dg = []
+        for g in range(deformable_groups):
+            ys = gy[None] + dy[b, g][None]          # [1, oh, ow, kh, kw]
+            xs = gx[None] + dx[b, g][None]
+            feat = arr[b, g * cg:(g + 1) * cg]      # [cg, h, w]
+            vals = _bilinear_tap(feat, ys[0], xs[0])  # [cg, oh, ow, kh, kw]
+            if mask is not None:
+                vals = vals * mk[b, g][None]
+            per_dg.append(vals)
+        cols.append(jnp.concatenate(per_dg, axis=0))
+    col = jnp.stack(cols)                           # [n, cin, oh, ow, kh, kw]
+    if groups > 1:
+        col = col.reshape(n, groups, cin // groups, oh, ow, kh, kw)
+        wg = w.reshape(groups, cout // groups, cin_g, kh, kw)
+        out = jnp.einsum("ngcyxhw,gochw->ngoyx", col, wg)
+        out = out.reshape(n, cout, oh, ow)
+    else:
+        out = jnp.einsum("ncyxhw,ochw->noyx", col, w)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1, 1, 1)
+    return out.astype(arr.dtype)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, deformable_groups: int = 1,
+                 groups: int = 1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.functional import _norm_tuple
+        from ..nn import initializer as I
+        k = _norm_tuple(kernel_size, 2)
+        self._a = (stride, padding, dilation, deformable_groups, groups)
+        fan_in = in_channels * k[0] * k[1]
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k],
+            initializer=I.Uniform(-bound, bound))
+        self.bias = (self.create_parameter(
+            [out_channels], initializer=I.Uniform(-bound, bound),
+            is_bias=True) if bias_attr is not False else None)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._a
+        return deform_conv2d(x, offset, self.weight,
+                             self.bias if self.bias is not None else None,
+                             stride=s, padding=p, dilation=d,
+                             deformable_groups=dg, groups=g, mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# FPN / RPN helpers
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: int,
+                             pixel_offset: bool = False, rois_num=None,
+                             name=None):
+    """Assign each ROI to an FPN level by scale (reference: ops.py
+    distribute_fpn_proposals). Host-side ragged output."""
+    rois = np.asarray(fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    n_levels = max_level - min_level + 1
+    multi_rois = []
+    rois_num_per_level = []
+    order = []
+    for i, l in enumerate(range(min_level, max_level + 1)):
+        idx = np.nonzero(lvl == l)[0]
+        multi_rois.append(jnp.asarray(rois[idx]))
+        rois_num_per_level.append(len(idx))
+        order.append(idx)
+    restore = np.argsort(np.concatenate(order)) if order else np.asarray([])
+    out = (multi_rois, jnp.asarray(restore, jnp.int32))
+    if rois_num is not None:
+        out = out + ([jnp.asarray([n], jnp.int32)
+                      for n in rois_num_per_level],)
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, pixel_offset: bool = False,
+                       return_rois_num: bool = False, name=None):
+    """RPN proposal generation (reference: ops.py generate_proposals):
+    decode deltas on anchors, clip, filter small, NMS. Host-side."""
+    n = scores.shape[0]
+    sc = np.asarray(scores, np.float32)     # [n, a, h, w]
+    bd = np.asarray(bbox_deltas, np.float32)  # [n, 4a, h, w]
+    anc = np.asarray(anchors, np.float32).reshape(-1, 4)
+    var = np.asarray(variances, np.float32).reshape(-1, 4)
+    img = np.asarray(img_size, np.float32)
+    all_rois, all_scores, rois_num = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].reshape(-1, 4, bd.shape[2], bd.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=1)
+        ih, iw = img[b, 0], img[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        kept = _nms_single(boxes, nms_thresh)[:post_nms_top_n]
+        all_rois.append(boxes[kept])
+        all_scores.append(s[kept])
+        rois_num.append(len(kept))
+    rois = jnp.asarray(np.concatenate(all_rois, axis=0)) if all_rois else \
+        jnp.zeros((0, 4))
+    scr = jnp.asarray(np.concatenate(all_scores)) if all_scores else \
+        jnp.zeros((0,))
+    if return_rois_num:
+        return rois, scr, jnp.asarray(rois_num, jnp.int32)
+    return rois, scr
+
+
+# ---------------------------------------------------------------------------
+# YOLO ops
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float = 0.01,
+             downsample_ratio: int = 32, clip_bbox: bool = True,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5, name=None):
+    """Decode YOLOv3 head output into boxes+scores (reference: ops.py
+    yolo_box; kernel yolo_box_kernel). x: [n, a*(5+c), h, w]."""
+    arr = jnp.asarray(x, jnp.float32)
+    n, _, h, w = arr.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    feats = arr.reshape(n, na, 5 + class_num + (1 if iou_aware else 0), h, w)
+    if iou_aware:
+        ious = jax.nn.sigmoid(feats[:, :, -1])
+        feats = feats[:, :, :-1]
+    tx, ty, tw, th = feats[:, :, 0], feats[:, :, 1], feats[:, :, 2], \
+        feats[:, :, 3]
+    obj = jax.nn.sigmoid(feats[:, :, 4])
+    if iou_aware:
+        obj = obj ** (1 - iou_aware_factor) * ious ** iou_aware_factor
+    cls = jax.nn.sigmoid(feats[:, :, 5:])           # [n, a, c, h, w]
+    gx = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
+    gy = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
+    alpha = scale_x_y
+    beta = -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(tx) * alpha + beta + gx) / w
+    by = (jax.nn.sigmoid(ty) * alpha + beta + gy) / h
+    img = jnp.asarray(img_size, jnp.float32)        # [n, 2] (h, w)
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    bw = jnp.exp(tw) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(th) * anc[None, :, 1, None, None] / in_h
+    iw = img[:, 1].reshape(n, 1, 1, 1)
+    ih = img[:, 0].reshape(n, 1, 1, 1)
+    x1 = (bx - bw * 0.5) * iw
+    y1 = (by - bh * 0.5) * ih
+    x2 = (bx + bw * 0.5) * iw
+    y2 = (by + bh * 0.5) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, iw - 1)
+        y2 = jnp.minimum(y2, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2) \
+        .reshape(n, -1, class_num)
+    mask = (obj.reshape(n, -1) > conf_thresh)[..., None]
+    return boxes * mask, scores * mask
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num: int,
+              ignore_thresh: float, downsample_ratio: int, gt_score=None,
+              use_label_smooth: bool = True, scale_x_y: float = 1.0,
+              name=None):
+    """YOLOv3 training loss (reference: ops.py yolo_loss; kernel
+    yolo_loss_kernel): coordinate + objectness + class terms with
+    best-anchor target assignment per gt box."""
+    arr = jnp.asarray(x, jnp.float32)
+    n, _, h, w = arr.shape
+    na = len(anchor_mask)
+    anc_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    anc = anc_all[np.asarray(anchor_mask)]
+    feats = arr.reshape(n, na, 5 + class_num, h, w)
+    tx, ty = jax.nn.sigmoid(feats[:, :, 0]), jax.nn.sigmoid(feats[:, :, 1])
+    tw, th = feats[:, :, 2], feats[:, :, 3]
+    obj_logit = feats[:, :, 4]
+    cls_logit = feats[:, :, 5:]
+    gt = np.asarray(gt_box, np.float32)             # [n, g, 4] cx cy w h
+    gl = np.asarray(gt_label)
+    gs = (np.asarray(gt_score, np.float32) if gt_score is not None
+          else np.ones(gl.shape, np.float32))
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+
+    # target assembly (host: gt count is small and static per batch)
+    tobj = np.zeros((n, na, h, w), np.float32)
+    t_xywh = np.zeros((n, na, 4, h, w), np.float32)
+    t_cls = np.zeros((n, na, class_num, h, w), np.float32)
+    t_scale = np.zeros((n, na, h, w), np.float32)
+    for b in range(n):
+        for g in range(gt.shape[1]):
+            gw, gh = gt[b, g, 2] * in_w, gt[b, g, 3] * in_h
+            if gw <= 0 or gh <= 0:
+                continue
+            # best anchor over ALL anchors by shape IoU
+            inter = np.minimum(anc_all[:, 0], gw) \
+                * np.minimum(anc_all[:, 1], gh)
+            union = anc_all[:, 0] * anc_all[:, 1] + gw * gh - inter
+            best = int(np.argmax(inter / union))
+            if best not in list(anchor_mask):
+                continue
+            a = list(anchor_mask).index(best)
+            gi = min(int(gt[b, g, 0] * w), w - 1)
+            gj = min(int(gt[b, g, 1] * h), h - 1)
+            tobj[b, a, gj, gi] = gs[b, g]
+            t_xywh[b, a, 0, gj, gi] = gt[b, g, 0] * w - gi
+            t_xywh[b, a, 1, gj, gi] = gt[b, g, 1] * h - gj
+            t_xywh[b, a, 2, gj, gi] = np.log(gw / anc[a, 0] + 1e-9)
+            t_xywh[b, a, 3, gj, gi] = np.log(gh / anc[a, 1] + 1e-9)
+            t_scale[b, a, gj, gi] = 2.0 - gt[b, g, 2] * gt[b, g, 3]
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            t_cls[b, a, :, gj, gi] = smooth
+            t_cls[b, a, int(gl[b, g]), gj, gi] = 1.0 - smooth \
+                if use_label_smooth else 1.0
+    tobj_j = jnp.asarray(tobj)
+    pos = tobj_j > 0
+    sc = jnp.asarray(t_scale)
+    loss_xy = jnp.sum(jnp.where(
+        pos, sc * (jnp.square(tx - jnp.asarray(t_xywh[:, :, 0]))
+                   + jnp.square(ty - jnp.asarray(t_xywh[:, :, 1]))), 0.0),
+        axis=(1, 2, 3))
+    loss_wh = jnp.sum(jnp.where(
+        pos, sc * (jnp.square(tw - jnp.asarray(t_xywh[:, :, 2]))
+                   + jnp.square(th - jnp.asarray(t_xywh[:, :, 3]))), 0.0),
+        axis=(1, 2, 3))
+    bce_obj = (jnp.maximum(obj_logit, 0) - obj_logit * tobj_j
+               + jnp.log1p(jnp.exp(-jnp.abs(obj_logit))))
+    loss_obj = jnp.sum(jnp.where(pos | (tobj_j == 0), bce_obj, 0.0),
+                       axis=(1, 2, 3))
+    tc = jnp.asarray(t_cls)
+    bce_cls = (jnp.maximum(cls_logit, 0) - cls_logit * tc
+               + jnp.log1p(jnp.exp(-jnp.abs(cls_logit))))
+    loss_cls = jnp.sum(jnp.where(pos[:, :, None], bce_cls, 0.0),
+                       axis=(1, 2, 3, 4))
+    return loss_xy + loss_wh + loss_obj + loss_cls

@@ -1,0 +1,652 @@
+"""paddle_tpu.vision.transforms — host-side image preprocessing.
+
+Reference: python/paddle/vision/transforms/{transforms.py,functional.py}
+(Compose, Resize, RandomCrop, Normalize, ToTensor, ...).
+
+TPU-first design: transforms run on the *host* over numpy/PIL (they feed the
+DataLoader workers; the chip only sees assembled batches), with the native
+C++ normalize fast path (csrc/pt_native.cc pt_normalize_u8_f32) used for the
+u8→f32 conversion that dominates input-pipeline time. Randomness uses
+per-call numpy Generators seeded from the framework seed — reproducible and
+fork-safe, no global PRNG state races between workers.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Resize", "RandomResizedCrop",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "RandomRotation", "Grayscale",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "RandomErasing",
+    # functional
+    "to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
+    "normalize", "pad", "rotate", "to_grayscale", "adjust_brightness",
+    "adjust_contrast", "adjust_hue", "erase",
+]
+
+
+def _is_pil(img):
+    try:
+        from PIL import Image
+        return isinstance(img, Image.Image)
+    except ImportError:
+        return False
+
+
+def _to_numpy(img) -> np.ndarray:
+    """HWC uint8/float numpy view of a PIL image or ndarray."""
+    if _is_pil(img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _to_pil(arr: np.ndarray):
+    from PIL import Image
+    if arr.shape[-1] == 1:
+        arr = arr[:, :, 0]
+    return Image.fromarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+
+def to_tensor(img, data_format: str = "CHW") -> np.ndarray:
+    """u8 HWC → f32 [0,1] CHW (reference: transforms.functional.to_tensor)."""
+    arr = _to_numpy(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    """size: int (short side) or (h, w)."""
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    w, h = pil.size
+    if isinstance(size, int):
+        if w <= h:
+            ow, oh = size, max(int(size * h / w), 1)
+        else:
+            oh, ow = size, max(int(size * w / h), 1)
+    else:
+        oh, ow = size
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}[interpolation]
+    out = pil.resize((ow, oh), resample)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    arr = _to_numpy(img)
+    out = arr[top:top + height, left:left + width]
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    arr = _to_numpy(img)[:, ::-1]
+    return _to_pil(arr) if _is_pil(img) else arr
+
+
+def vflip(img):
+    arr = _to_numpy(img)[::-1]
+    return _to_pil(arr) if _is_pil(img) else arr
+
+
+def normalize(img, mean, std, data_format: str = "CHW",
+              to_rgb: bool = False) -> np.ndarray:
+    """(x - mean) / std. u8 HWC input takes the native C++ fast path."""
+    arr = np.asarray(img)
+    if arr.dtype == np.uint8 and data_format == "HWC":
+        try:
+            from ..native import normalize_images, is_available
+            if is_available():
+                # native op folds /255; reference Normalize does NOT rescale,
+                # so pre-scale mean/std accordingly
+                m = np.asarray(mean, np.float32) / 255.0
+                s = np.asarray(std, np.float32) / 255.0
+                return normalize_images(arr, m, s)
+        except Exception:
+            pass
+    arr = arr.astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt_ = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt_ = padding
+        pr, pb = padding
+    else:
+        pl, pt_, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(arr, ((pt_, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def rotate(img, angle: float, interpolation: str = "nearest", expand=False,
+           center=None, fill=0):
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = pil.rotate(angle, resample=resample, expand=expand, center=center,
+                     fillcolor=fill)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = (0.2989 * arr[..., 0] + 0.5870 * arr[..., 1] + 0.1140 * arr[..., 2])
+    gray = np.clip(gray, 0, 255).astype(np.uint8)[..., None]
+    out = np.repeat(gray, num_output_channels, axis=-1)
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def adjust_brightness(img, factor: float):
+    arr = _to_numpy(img).astype(np.float32) * factor
+    out = np.clip(arr, 0, 255).astype(np.uint8)
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def adjust_contrast(img, factor: float):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = arr.mean()
+    out = np.clip((arr - mean) * factor + mean, 0, 255).astype(np.uint8)
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def adjust_saturation(img, factor: float):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = (0.2989 * arr[..., :1] + 0.5870 * arr[..., 1:2]
+            + 0.1140 * arr[..., 2:3])
+    out = np.clip(gray + (arr - gray) * factor, 0, 255).astype(np.uint8)
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def adjust_hue(img, factor: float):
+    """factor in [-0.5, 0.5] — fraction of the hue circle."""
+    if not -0.5 <= factor <= 0.5:
+        raise ValueError("hue factor must be in [-0.5, 0.5]")
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    hsv = np.asarray(pil.convert("HSV")).copy()
+    hsv[..., 0] = (hsv[..., 0].astype(np.int16)
+                   + int(factor * 255)) % 256
+    out = Image.fromarray(hsv.astype(np.uint8), "HSV").convert("RGB")
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+def erase(img, i: int, j: int, h: int, w: int, v, inplace: bool = False):
+    arr = _to_numpy(img)
+    arr = arr if inplace else arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return _to_pil(arr) if _is_pil(img) else arr
+
+
+# ---------------------------------------------------------------------------
+# transform classes
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """Reference: transforms.BaseTransform — keys select which elements of a
+    (img, label, ...) tuple get transformed."""
+
+    def __init__(self, keys: Optional[Sequence[str]] = None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, tuple):
+            return self._apply_image(inputs)
+        keys = self.keys or ("image",) * len(inputs)
+        out = []
+        for key, item in zip(keys, inputs):
+            out.append(self._apply_image(item) if key == "image" else item)
+        return tuple(out)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed: bool = False,
+                 fill=0, padding_mode: str = "constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, 0, max(tw - w, 0), max(th - h, 0)), self.fill,
+                      self.padding_mode)
+            arr = _to_numpy(img)
+            h, w = arr.shape[:2]
+        top = _pyrandom.randint(0, max(h - th, 0))
+        left = _pyrandom.randint(0, max(w - tw, 0))
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation: str = "bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * _pyrandom.uniform(*self.scale)
+            aspect = np.exp(_pyrandom.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                top = _pyrandom.randint(0, h - ch)
+                left = _pyrandom.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if _pyrandom.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if _pyrandom.random() < self.prob else img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW",
+                 to_rgb: bool = False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format, self.to_rgb)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_to_numpy(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode: str = "constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation: str = "nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = _pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand, self.center,
+                      self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels: int = 1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_brightness(img, _pyrandom.uniform(
+            max(0, 1 - self.value), 1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img, _pyrandom.uniform(
+            max(0, 1 - self.value), 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(img, _pyrandom.uniform(
+            max(0, 1 - self.value), 1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, _pyrandom.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        _pyrandom.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob: float = 0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace: bool = False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if _pyrandom.random() >= self.prob:
+            return img
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _pyrandom.uniform(*self.scale)
+            aspect = np.exp(_pyrandom.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                i = _pyrandom.randint(0, h - eh)
+                j = _pyrandom.randint(0, w - ew)
+                return erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img
+
+
+# -- round-3 parity batch: affine/perspective (reference:
+#    python/paddle/vision/transforms/{functional.py,transforms.py}) --------
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    # paddle/torchvision convention: M = T(center) R(angle) Sh(shear)
+    # Scale T(-center) + translate
+    rot = np.array([[np.cos(a + sy) / np.cos(sy),
+                     -np.cos(a + sy) * np.tan(sx) / np.cos(sy)
+                     - np.sin(a), 0],
+                    [np.sin(a + sy) / np.cos(sy),
+                     -np.sin(a + sy) * np.tan(sx) / np.cos(sy)
+                     + np.cos(a), 0],
+                    [0, 0, 1]])
+    rot[:2, :2] *= scale
+    t_pre = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                      [0, 0, 1]])
+    t_post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]])
+    return t_pre @ rot @ t_post
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference: vision/transforms/functional.py affine)."""
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    w, h = pil.size
+    if center is None:
+        center = (w * 0.5, h * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    inv = np.linalg.inv(m)
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = pil.transform((w, h), Image.AFFINE, data=inv[:2].reshape(-1),
+                        resample=resample, fillcolor=fill)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping startpoints->endpoints (reference:
+    vision/transforms/functional.py perspective)."""
+    from PIL import Image
+    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
+    # solve the 8-dof homography endpoints -> startpoints (PIL convention)
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b.append(sx)
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.append(sy)
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                "bicubic": Image.BICUBIC}[interpolation]
+    out = pil.transform(pil.size, Image.PERSPECTIVE, data=coeffs,
+                        resample=resample, fillcolor=fill)
+    return out if _is_pil(img) else _to_numpy(out)
+
+
+class RandomAffine(BaseTransform):
+    """reference: vision/transforms/transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, numbers.Number) else degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        angle = _pyrandom.uniform(*self.degrees)
+        w, h = (_to_numpy(img).shape[1], _to_numpy(img).shape[0])
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = _pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        scale = (_pyrandom.uniform(*self.scale) if self.scale is not None
+                 else 1.0)
+        if self.shear is None:
+            shear = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            shear = (_pyrandom.uniform(-self.shear, self.shear), 0.0)
+        else:
+            shear = (_pyrandom.uniform(-self.shear[0], self.shear[0]),
+                     _pyrandom.uniform(-self.shear[1], self.shear[1])
+                     if len(self.shear) > 1 else 0.0)
+        return affine(img, angle, (tx, ty), scale, shear,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: vision/transforms/transforms.py RandomPerspective."""
+
+    def __init__(self, prob: float = 0.5, distortion_scale: float = 0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _points(self, w, h):
+        d = self.distortion_scale
+        half_w, half_h = w // 2, h // 2
+        tl = (_pyrandom.randint(0, int(d * half_w)),
+              _pyrandom.randint(0, int(d * half_h)))
+        tr = (w - 1 - _pyrandom.randint(0, int(d * half_w)),
+              _pyrandom.randint(0, int(d * half_h)))
+        br = (w - 1 - _pyrandom.randint(0, int(d * half_w)),
+              h - 1 - _pyrandom.randint(0, int(d * half_h)))
+        bl = (_pyrandom.randint(0, int(d * half_w)),
+              h - 1 - _pyrandom.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return start, [tl, tr, br, bl]
+
+    def __call__(self, img):
+        if _pyrandom.random() >= self.prob:
+            return img
+        arr = _to_numpy(img)
+        h, w = arr.shape[0], arr.shape[1]
+        start, end = self._points(w, h)
+        return perspective(img, start, end,
+                           interpolation=self.interpolation, fill=self.fill)

@@ -1,0 +1,428 @@
+"""Minimal dy2static: AST conversion of Python control flow to lax ops.
+
+Reference analogue: python/paddle/jit/dy2static/ — the AST transformer
+stack (transformers/ifelse_transformer.py, loop_transformer.py) that
+rewrites ``if``/``while``/``for`` over tensors into ``cond``/``while_loop``
+ops, with convert-call runtime dispatch (convert_operators.py
+convert_ifelse/convert_while_loop). The SOT bytecode path is out of scope
+(documented in docs/DESIGN_DECISIONS.md); this is the AST fallback the
+reference uses when SOT is disabled.
+
+TPU design: the rewrite targets jax.lax.cond / lax.while_loop — traced
+once, compiled control flow, no Python in the hot path. Dispatch is at
+RUNTIME: a concrete (non-traced) condition runs plain Python, a traced
+condition lowers to the lax op — the same dual behavior as the reference's
+convert_ifelse checking for Variable.
+
+Supported rewrites (everything else raises Dy2StaticError with the source
+line — the "clear graph-break error" contract):
+- ``if``/``elif``/``else`` — branch-assigned variables become the cond
+  outputs; both branches must produce matching shapes/dtypes.
+- ``while`` — loop-carried variables = names assigned in the body that
+  are already defined before the loop.
+- ``for i in range(...)`` — desugared to the while form.
+
+Not supported inside a converted construct (graph breaks): ``return``/
+``break``/``continue``, attribute/subscript assignment, ``for`` over
+arbitrary iterables with a traced condition. Python-level loops over
+concrete values still work untransformed (they trace-unroll as before).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_RUNTIME_NAME = "__pt_jst__"
+
+
+class Dy2StaticError(Exception):
+    """Unconvertible Python construct under to_static(full_graph=False)."""
+
+
+# ---------------------------------------------------------------------------
+# runtime dispatch (reference: dy2static/convert_operators.py)
+# ---------------------------------------------------------------------------
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def run_ifelse(pred, true_fn, false_fn, args: tuple):
+    """convert_ifelse: Python if on concrete pred, lax.cond on traced."""
+    if not _is_traced(pred):
+        # concrete predicate: plain Python — user errors propagate raw
+        return true_fn(*args) if pred else false_fn(*args)
+    try:
+        pred = jnp.asarray(pred)
+        if pred.shape != ():
+            raise Dy2StaticError(
+                "if-condition is a traced tensor with shape "
+                f"{pred.shape}; reduce it to a scalar (e.g. .any()/.all()) "
+                "for lax.cond")
+        # UNDEF placeholders are not arrays — route them around the cond
+        # as static closure; a branch that assigns them returns real values
+        idx = [i for i, a in enumerate(args) if a is not UNDEF]
+        ops = tuple(args[i] for i in idx)
+
+        def wrap(branch):
+            def h(ops_in):
+                full = list(args)
+                for j, i in enumerate(idx):
+                    full[i] = ops_in[j]
+                return branch(*full)
+            return h
+
+        return jax.lax.cond(pred, wrap(true_fn), wrap(false_fn), ops)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "if/else branches returned mismatched structures or dtypes "
+            f"under tracing (lax.cond requires identical outputs): {e}"
+        ) from e
+    except NameError as e:
+        raise Dy2StaticError(
+            f"variable assigned in only one if/else branch and undefined "
+            f"before it ({e}); define it before the if") from e
+
+
+def run_while(test_fn, body_fn, carry: tuple):
+    """convert_while_loop: Python while on concrete test, lax.while_loop
+    on traced."""
+    first = test_fn(*carry)
+    if not _is_traced(first):
+        while test_fn(*carry):
+            carry = body_fn(*carry)
+        return carry
+    if any(c is UNDEF for c in carry):
+        raise Dy2StaticError(
+            "a loop-body temporary is undefined before a while/for loop "
+            "with a TRACED condition (lax.while_loop needs concrete "
+            "initial values for every carried variable) — initialize it "
+            "before the loop")
+    try:
+        return jax.lax.while_loop(lambda c: jnp.asarray(test_fn(*c)),
+                                  lambda c: body_fn(*c), carry)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "while-loop carried variables changed structure/shape/dtype "
+            f"across an iteration (lax.while_loop invariant): {e}") from e
+
+
+class _Undef:
+    """Placeholder for a name not yet bound before a converted if/while.
+    Any USE of it raises a clear error; merely passing it through a branch
+    that doesn't touch it is fine (Python-path semantics)."""
+
+    def _die(self, *a, **k):
+        raise Dy2StaticError(
+            "use of a variable that was only assigned in the untaken "
+            "branch of a converted if/else — define it before the if")
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _die
+    __truediv__ = __rtruediv__ = __matmul__ = __call__ = __getattr__ = _die
+    __getitem__ = __iter__ = __bool__ = __float__ = __int__ = _die
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+
+_RUNTIME = {"run_ifelse": staticmethod(run_ifelse),
+            "run_while": staticmethod(run_while), "UNDEF": UNDEF}
+
+
+# ---------------------------------------------------------------------------
+# scope analysis
+# ---------------------------------------------------------------------------
+
+def _assigned_names(nodes: Sequence[ast.stmt]) -> List[str]:
+    out: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Store) and n.id not in out \
+                    and not n.id.startswith("__pt_"):
+                out.append(n.id)
+
+        def visit_FunctionDef(self, n):  # don't descend into nested defs
+            # generated __pt_* helpers are not data and never carried
+            if n.name not in out and not n.name.startswith("__pt_"):
+                out.append(n.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    for s in nodes:
+        V().visit(s)
+    return out
+
+
+def _walk_same_scope(node):
+    """ast.walk that does NOT descend into nested function defs/lambdas —
+    a return inside a nested def (including our generated __pt_* helpers)
+    belongs to that def, not to the construct being converted."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child,
+                      (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield child          # the def node itself, not its body
+            continue
+        yield from _walk_same_scope(child)
+
+
+def _forbid(nodes: Sequence[ast.stmt], where: str):
+    for s in nodes:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue             # nested defs keep their own returns
+        for n in _walk_same_scope(s):
+            if isinstance(n, (ast.Return, ast.Break, ast.Continue)):
+                kind = type(n).__name__.lower()
+                raise Dy2StaticError(
+                    f"graph break at line {getattr(n, 'lineno', '?')}: "
+                    f"'{kind}' inside a converted {where} is not "
+                    f"supported — restructure to assign a variable and "
+                    f"{kind == 'return' and 'return after the block' or 'use a loop condition'}")
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, (ast.Attribute, ast.Subscript)) \
+                                and isinstance(leaf.ctx, ast.Store):
+                            raise Dy2StaticError(
+                                f"graph break at line "
+                                f"{getattr(n, 'lineno', '?')}: assignment "
+                                f"to an attribute/subscript inside a "
+                                f"converted {where} is not supported — "
+                                f"use functional updates (x = x.at[i].set(v))")
+
+
+def _names(ids: Sequence[str], ctx) -> List[ast.Name]:
+    return [ast.Name(id=i, ctx=ctx) for i in ids]
+
+
+def _tuple_of(ids: Sequence[str], ctx) -> ast.expr:
+    return ast.Tuple(elts=_names(ids, ctx), ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while/for into runtime-dispatch calls. Fresh helper
+    names are namespaced per construct to avoid collisions."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__pt_{kind}_{self._n}"
+
+    # -- if/else ----------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        _forbid(node.body, "if")
+        _forbid(node.orelse, "if")
+        outs = sorted(set(_assigned_names(node.body))
+                      | set(_assigned_names(node.orelse)))
+        if not outs:
+            # pure side-effect-free branch (e.g. raise): leave as-is; a
+            # traced pred will fail loudly inside jax anyway
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+
+        # branch-assigned names become helper PARAMETERS (shadowing the
+        # enclosing scope) so `x = x + 1` patterns read the passed-in value
+        # instead of tripping UnboundLocalError; purely-read names still
+        # close over the enclosing scope
+        def mk(name, body):
+            ret = ast.Return(value=_tuple_of(outs, ast.Load()))
+            return ast.FunctionDef(
+                name=name, args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=o) for o in outs],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=(list(body) or [ast.Pass()]) + [ret],
+                decorator_list=[])
+
+        # pre-bind outs that don't exist yet to the UNDEF sentinel so the
+        # call-site tuple can always be built; using an untaken-branch-only
+        # variable later raises a clear error (see _Undef)
+        guards = [self._undef_guard(o) for o in outs]
+        call = ast.Assign(
+            targets=[_tuple_of(outs, ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_RUNTIME_NAME, ctx=ast.Load()),
+                    attr="run_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      _tuple_of(outs, ast.Load())],
+                keywords=[]))
+        return guards + [mk(tname, node.body), mk(fname, node.orelse), call]
+
+    def _undef_guard(self, name: str) -> ast.stmt:
+        """try: name \nexcept (NameError, UnboundLocalError): name = UNDEF"""
+        return ast.Try(
+            body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(
+                    elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                          ast.Name(id="UnboundLocalError", ctx=ast.Load())],
+                    ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=name, ctx=ast.Store())],
+                    value=ast.Attribute(
+                        value=ast.Name(id=_RUNTIME_NAME, ctx=ast.Load()),
+                        attr="UNDEF", ctx=ast.Load()))])],
+            orelse=[], finalbody=[])
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            raise Dy2StaticError(
+                f"graph break at line {node.lineno}: while/else is not "
+                "supported under to_static(full_graph=False)")
+        _forbid(node.body, "while")
+        return self._lower_while(node)
+
+    def _lower_while(self, node: ast.While):
+        carried = sorted(set(_assigned_names(node.body)))
+        if not carried:
+            raise Dy2StaticError(
+                f"graph break at line {getattr(node, 'lineno', '?')}: "
+                "while body assigns no variables — nothing to carry")
+        tname, bname = self._fresh("test"), self._fresh("body")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=c) for c in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        test_fn = ast.FunctionDef(
+            name=tname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [
+                ast.Return(value=_tuple_of(carried, ast.Load()))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[_tuple_of(carried, ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_RUNTIME_NAME, ctx=ast.Load()),
+                    attr="run_while", ctx=ast.Load()),
+                args=[ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      _tuple_of(carried, ast.Load())],
+                keywords=[]))
+        # loop-body temporaries undefined before the loop enter the carry
+        # as UNDEF (fine on the Python path; clear error on the traced one)
+        guards = [self._undef_guard(c) for c in carried]
+        return guards + [test_fn, body_fn, call]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.orelse
+                    and isinstance(node.target, ast.Name))
+        if not is_range:
+            # non-range for-loops stay Python (trace-unrolled over concrete
+            # iterables — the common, supported case)
+            return node
+        _forbid(node.body, "for")
+        a = node.iter.args
+        if len(a) == 1:
+            start, stop = ast.Constant(value=0), a[0]
+        elif len(a) == 2:
+            start, stop = a
+        else:
+            raise Dy2StaticError(
+                f"graph break at line {node.lineno}: range() with a step "
+                "is not supported under to_static(full_graph=False); use a "
+                "while loop")
+        ivar = node.target.id
+        # desugar:  i = start; while i < stop: body; i = i + 1
+        init = ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                          value=start)
+        incr = ast.Assign(
+            targets=[ast.Name(id=ivar, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=ivar, ctx=ast.Load()),
+                            op=ast.Add(), right=ast.Constant(value=1)))
+        wh = ast.copy_location(ast.While(
+            test=ast.Compare(left=ast.Name(id=ivar, ctx=ast.Load()),
+                             ops=[ast.Lt()], comparators=[stop]),
+            body=list(node.body) + [incr], orelse=[]), node)
+        # body already visited + checked above — lower directly, no re-walk
+        return [init] + self._lower_while(wh)
+
+
+def convert(fn: Callable) -> Callable:
+    """AST-convert ``fn``'s control flow; returns the rewritten function.
+
+    The original closure/globals are preserved; free variables are bound by
+    VALUE at conversion time (document: rebind by reconverting)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Dy2StaticError(
+            f"cannot read source of {fn!r} for AST conversion (lambdas, "
+            f"REPL or C functions are not convertible): {e}") from e
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise Dy2StaticError(f"expected a function def, got {type(fdef)}")
+    fdef.decorator_list = []   # decorators already applied to the original
+    new = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+
+    glb = dict(fn.__globals__)
+    glb[_RUNTIME_NAME] = type("rt", (), _RUNTIME)
+    cls_cell = None
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                if name == "__class__":
+                    cls_cell = cell.cell_contents
+                else:
+                    glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    if cls_cell is not None:
+        # zero-arg super() needs a real __class__ CLOSURE CELL, not a
+        # global: rebuild the def inside an outer fn providing it
+        outer = ast.FunctionDef(
+            name="__pt_outer__",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg="__class__")],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fdef,
+                  ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+            decorator_list=[])
+        new = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(new)
+    code = compile(new, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    exec(code, glb)
+    out = (glb["__pt_outer__"](cls_cell) if cls_cell is not None
+           else glb[fdef.name])
+    functools.update_wrapper(out, fn)
+    out.__dy2static__ = True
+    return out
+
+
+__all__ = ["convert", "run_ifelse", "run_while", "Dy2StaticError"]

@@ -1,0 +1,277 @@
+"""dy2static AST conversion (round-3 verdict item 7).
+
+Reference analogue: test/dygraph_to_static/ — dygraph code with Python
+control flow over tensors must run under to_static with output parity.
+Here the AST transformer (jit/dy2static.py) rewrites if/while/for into
+lax.cond / lax.while_loop with runtime concrete-vs-traced dispatch.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import dy2static
+from paddle_tpu.jit.dy2static import Dy2StaticError
+
+
+def _branchy(x):
+    y = x * 0
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def _loopy(x):
+    i = 0
+    while i < 5:
+        x = x + 1
+        i = i + 1
+    return x
+
+
+def _fory(x):
+    s = x * 0
+    for k in range(4):
+        s = s + x + k
+    return s
+
+
+def _nested(x, n):
+    acc = x * 0
+    i = 0
+    while i < n:
+        if (acc.sum() > 10):
+            acc = acc - 1
+        else:
+            acc = acc + x
+        i = i + 1
+    return acc
+
+
+def _data_dep_while(x):
+    # data-dependent trip count: impossible under plain jax tracing
+    while x.sum() < 100:
+        x = x * 2
+    return x
+
+
+class TestConvertParity:
+    def test_if_parity_and_cond_lowering(self):
+        g = dy2static.convert(_branchy)
+        for arr in ([1.0, 2.0], [-5.0, 1.0]):
+            x = jnp.asarray(arr)
+            np.testing.assert_allclose(g(x), _branchy(x))
+        prims = {e.primitive.name
+                 for e in jax.make_jaxpr(g)(jnp.asarray([1.0, 2.0])).eqns}
+        assert "cond" in prims
+        np.testing.assert_allclose(jax.jit(g)(jnp.asarray([-5.0, 1.0])),
+                                   _branchy(jnp.asarray([-5.0, 1.0])))
+
+    def test_while_parity(self):
+        g = dy2static.convert(_loopy)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(g(x), _loopy(x))
+        np.testing.assert_allclose(jax.jit(g)(x), _loopy(x))
+
+    def test_for_range_parity(self):
+        g = dy2static.convert(_fory)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(g(x), _fory(x))
+        # concrete range bounds dispatch to the Python path and unroll at
+        # trace time — no while primitive, same as plain jax tracing
+        prims = {e.primitive.name for e in jax.make_jaxpr(g)(x).eqns}
+        assert "while" not in prims
+        np.testing.assert_allclose(jax.jit(g)(x), _fory(x))
+
+    def test_nested_if_in_while(self):
+        g = dy2static.convert(_nested)
+        x = jnp.asarray([3.0, 4.0])
+        np.testing.assert_allclose(g(x, 5), _nested(x, 5))
+        np.testing.assert_allclose(jax.jit(g, static_argnums=1)(x, 5),
+                                   _nested(x, 5))
+
+    def test_data_dependent_trip_count_under_jit(self):
+        # the case plain tracing CANNOT do: while-condition on a traced value
+        g = jax.jit(dy2static.convert(_data_dep_while))
+        x = jnp.asarray([1.0, 1.0])
+        np.testing.assert_allclose(g(x), _data_dep_while(np.asarray([1., 1.])))
+        prims = {e.primitive.name
+                 for e in jax.make_jaxpr(dy2static.convert(_data_dep_while))(x).eqns}
+        assert "while" in prims
+
+
+def _with_return_in_branch(x):
+    if x.sum() > 0:
+        return x * 2
+    return x
+
+
+def _with_subscript_store(x):
+    y = np.zeros(3)
+    if x.sum() > 0:
+        y[0] = 1.0
+    else:
+        y[0] = 2.0
+    return y
+
+
+def _range_step(x):
+    s = x * 0
+    for k in range(0, 8, 2):
+        s = s + k
+    return s
+
+
+class TestGraphBreakErrors:
+    def test_return_in_branch_is_clear_error(self):
+        with pytest.raises(Dy2StaticError, match="return"):
+            dy2static.convert(_with_return_in_branch)
+
+    def test_subscript_store_is_clear_error(self):
+        with pytest.raises(Dy2StaticError, match="subscript"):
+            dy2static.convert(_with_subscript_store)
+
+    def test_range_step_is_clear_error(self):
+        with pytest.raises(Dy2StaticError, match="step"):
+            dy2static.convert(_range_step)
+
+    def test_nonscalar_pred_is_clear_error(self):
+        def many(x):
+            y = x
+            if x > 0:          # vector predicate
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+        # function defined in a test body: source IS available via the file
+        g = dy2static.convert(many)
+        with pytest.raises(Dy2StaticError, match="scalar"):
+            jax.jit(g)(jnp.asarray([1.0, -1.0]))
+
+
+class TestToStaticIntegration:
+    def test_full_graph_false_on_model(self):
+        """A dygraph-style Layer with data-dependent branching in forward
+        runs under to_static(full_graph=False) with output parity — the
+        verdict's Done criterion."""
+        from paddle_tpu import nn
+
+        class Gated(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(8, 8)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.sum() > 0:
+                    out = h * 2.0
+                else:
+                    out = h - 1.0
+                i = 0
+                while i < 3:
+                    out = out + 0.5
+                    i = i + 1
+                return out
+
+        pt.seed(0)
+        m = Gated()
+        x = jnp.asarray(np.random.RandomState(0).normal(size=(2, 8)),
+                        jnp.float32)
+        eager = m(x)
+        st = pt.jit.to_static(m, full_graph=False)
+        out = st(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(eager),
+                                   rtol=1e-6)
+
+    def test_full_graph_false_on_function(self):
+        @pt.jit.to_static(full_graph=False)
+        def f(x):
+            if x.sum() > 0:
+                y = x + 10.0
+            else:
+                y = x - 10.0
+            return y
+        np.testing.assert_allclose(f(jnp.asarray([1.0])), [11.0])
+        np.testing.assert_allclose(f(jnp.asarray([-1.0])), [-11.0])
+
+
+def _loop_temp(x, n):
+    s = x
+    for i in range(n):
+        tmp = s * 2
+        s = tmp + 1
+    return s
+
+
+class TestReviewRegressions:
+    def test_loop_body_temporary_concrete_path(self):
+        # temporaries defined only inside the loop body must work on the
+        # concrete path (UNDEF carry, assigned before use each iteration)
+        g = dy2static.convert(_loop_temp)
+        x = jnp.asarray([1.0])
+        np.testing.assert_allclose(g(x, 3), _loop_temp(x, 3))
+
+    def test_loop_body_temporary_traced_cond_clear_error(self):
+        def f(x):
+            while x.sum() < 10:
+                tmp = x * 2
+                x = tmp
+            return x
+        g = dy2static.convert(f)
+        with pytest.raises(Dy2StaticError, match="initialize it"):
+            jax.jit(g)(jnp.asarray([1.0]))
+
+    def test_super_in_converted_forward(self):
+        from paddle_tpu import nn
+
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x + 1.0
+
+        class Child(Base):
+            def forward(self, x):
+                h = super().forward(x)
+                if h.sum() > 0:
+                    h = h * 2
+                else:
+                    h = h - 2
+                return h
+
+        m = Child()
+        x = jnp.asarray([1.0, 2.0])
+        eager = np.asarray(m(x))
+        st = pt.jit.to_static(m, full_graph=False)
+        np.testing.assert_allclose(np.asarray(st(x)), eager)
+        # original layer is NOT mutated: eager call still plain Python
+        assert "forward" not in m.__dict__
+        np.testing.assert_allclose(np.asarray(m(x)), eager)
+
+    def test_concrete_branch_errors_propagate_raw(self):
+        def f(x, flag):
+            y = x
+            if flag:
+                y = x + "oops"
+            else:
+                y = x
+            return y
+        g = dy2static.convert(f)
+        with pytest.raises(TypeError):
+            g(jnp.asarray([1.0]), True)
+
+    def test_overlap_flag_substring_not_shadowed(self, monkeypatch):
+        from paddle_tpu.distributed import overlap as ov
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_tpu_enable_async_collective_fusion_multiple_steps=false")
+        cur = os.environ["XLA_FLAGS"]
+        names = {t.split("=")[0] for t in cur.split()}
+        missing = [f for f in ov.OVERLAP_XLA_FLAGS.split()
+                   if f.split("=")[0] not in names]
+        assert any("--xla_tpu_enable_async_collective_fusion=true" == f
+                   for f in missing)
