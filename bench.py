@@ -226,8 +226,49 @@ def _decode_bench(cfg, on_tpu):
         out["serving_requests"] = n_req
         out["serving_slots"] = slots
         out["serving_preemptions"] = eng.preemptions
+        lat = eng.latency_stats()
+        if lat:
+            out["serving_ttft_p50_s"] = round(lat["ttft_p50_s"], 4)
+            out["serving_ttft_p99_s"] = round(lat["ttft_p99_s"], 4)
+            out["serving_latency_p50_s"] = round(lat["latency_p50_s"], 4)
+            out["serving_latency_p99_s"] = round(lat["latency_p99_s"], 4)
     except Exception as e:
         out["serving_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
+    try:
+        # weight-only int8 linear: fused Pallas kernel vs XLA dequant
+        # (reference: cutlass weight-only GEMM). TPU-only — interpret-mode
+        # timing on CPU is meaningless, so CPU runs record no row.
+        if on_tpu:
+            from paddle_tpu.nn.quantized_linear import (weight_quantize,
+                                                        weight_only_linear)
+            from paddle_tpu.ops.registry import pallas_disabled_scope
+            m_, k_, n_ = 512, 4096, 4096
+            rs2 = np.random.RandomState(2)
+            xw = jnp.asarray(rs2.normal(0, 1, (m_, k_)), jnp.bfloat16)
+            w = jnp.asarray(rs2.normal(0, 0.05, (k_, n_)), jnp.float32)
+            qw, sc = weight_quantize(w, algo="weight_only_int8")
+            f_fused = jax.jit(lambda a: weight_only_linear(
+                a, qw, weight_scale=sc, weight_dtype="int8"))
+            r = f_fused(xw); _sync(r)
+            t0 = time.perf_counter()
+            for _ in range(30):
+                r = f_fused(xw)
+            _sync(r)
+            fused_us = (time.perf_counter() - t0) / 30 * 1e6
+            with pallas_disabled_scope():
+                f_xla = jax.jit(lambda a: weight_only_linear(
+                    a, qw, weight_scale=sc, weight_dtype="int8"))
+                r = f_xla(xw); _sync(r)
+                t0 = time.perf_counter()
+                for _ in range(30):
+                    r = f_xla(xw)
+                _sync(r)
+                xla_us = (time.perf_counter() - t0) / 30 * 1e6
+            out["int8_matmul_pallas_us"] = round(fused_us, 1)
+            out["int8_matmul_xla_us"] = round(xla_us, 1)
+    except Exception as e:
+        out["int8_matmul_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
     if on_tpu:
         try:

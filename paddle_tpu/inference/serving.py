@@ -28,6 +28,7 @@ regardless of batching/preemption interleaving (tests/test_serving.py).
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -46,6 +47,9 @@ class _Request:
     generated: List[int] = field(default_factory=list)
     done: bool = False
     slot: int = -1                      # active slot, -1 = queued/finished
+    submit_t: float = 0.0               # perf_counter at submit
+    first_tok_t: float = 0.0            # TTFT timestamp (0 = none yet)
+    done_t: float = 0.0                 # completion timestamp
 
 
 class ContinuousBatchingEngine:
@@ -84,6 +88,10 @@ class ContinuousBatchingEngine:
         self._decode_fn = None
         self._logits = None                # device [max_batch, vocab]
         self.preemptions = 0
+        # bounded window (run() releases _Request objects for the same
+        # reason — a long-lived engine must not grow per-request state)
+        from collections import deque
+        self._latencies = deque(maxlen=10_000)  # (ttft_s, total_s, n_tok)
 
     # -- public API ---------------------------------------------------------
 
@@ -103,6 +111,7 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prompt needs more pages than the pool holds "
                              f"({self._total_pages}); raise num_pages")
         req = _Request(next(self._rid), ids, new)
+        req.submit_t = time.perf_counter()
         self._requests[req.rid] = req
         self._queue.append(req)
         return req.rid
@@ -283,18 +292,44 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.tables), jnp.asarray(active), sub)
         tok_host = np.asarray(tok)
         emitted = []
+        now = time.perf_counter()
         for slot in active_slots:
             req = self._slots[slot]
             t = int(tok_host[slot])
             req.generated.append(t)
+            if req.first_tok_t == 0.0:
+                req.first_tok_t = now
             emitted.append((req.rid, t))
             self.pos[slot] += 1
             eos = self.cfg.eos_token_id
             if (len(req.generated) >= req.max_new_tokens
                     or (eos is not None and t == eos)):
                 req.done = True
+                req.done_t = now
+                self._latencies.append(
+                    (req.first_tok_t - req.submit_t,
+                     req.done_t - req.submit_t,
+                     len(req.generated)))
                 self._free_slot(slot)
         return emitted
+
+    def latency_stats(self) -> Dict[str, float]:
+        """TTFT / end-to-end latency percentiles over every request retired
+        by this engine (survives run()'s request release) — the serving
+        SLO numbers (reference: PaddleNLP llm serving benchmarks report
+        the same trio: throughput, TTFT, p99)."""
+        if not self._latencies:
+            return {}
+        arr = np.asarray(self._latencies, np.float64)
+        ttft, total = arr[:, 0], arr[:, 1]
+        return {
+            "requests": int(arr.shape[0]),
+            "tokens": int(arr[:, 2].sum()),
+            "ttft_p50_s": float(np.percentile(ttft, 50)),
+            "ttft_p99_s": float(np.percentile(ttft, 99)),
+            "latency_p50_s": float(np.percentile(total, 50)),
+            "latency_p99_s": float(np.percentile(total, 99)),
+        }
 
 
 class _null:

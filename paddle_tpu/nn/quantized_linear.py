@@ -147,14 +147,48 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     algo = "weight_only_int8" if weight_dtype == "int8" else \
         "weight_only_int4"
     _check(algo, group_size)
-    w = _dequant(jnp.asarray(weight), weight_scale, algo, group_size,
-                 x.dtype)                               # [n, k]
-    out = jax.lax.dot_general(
-        x, w, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(x.dtype)
+    wq = jnp.asarray(weight)
+    out = None
+    if weight_dtype == "int8" and group_size == -1 and weight_scale is not None:
+        # fused Pallas path: int8 weight crosses HBM quantized, dequant
+        # happens in VMEM inside the matmul (ops/pallas/int8_matmul.py);
+        # shape-gated, TPU-only, kill-switch honored
+        out = _try_pallas_weight_only(x, wq, weight_scale)
+    if out is None:
+        w = _dequant(wq, weight_scale, algo, group_size, x.dtype)  # [n, k]
+        out = jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
     if bias is not None:
         out = out + jnp.asarray(bias, x.dtype)
     return out
+
+
+def _try_pallas_weight_only(x, wq, weight_scale):
+    """Run the fused kernel when eligible, else None (XLA fallback)."""
+    from ..ops import registry
+    from ..core.flags import flag
+    if (registry.pallas_disabled() or not flag("use_pallas_kernels")
+            or registry.backend_kind() != "tpu"):
+        return None
+    scale = jnp.asarray(weight_scale, jnp.float32)
+    if scale.ndim != 1:
+        return None
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    from ..ops.pallas import int8_matmul as im
+    bm, bn, bk = im.tuned_blocks(m, wq.shape[0], x.shape[-1], x.dtype)
+    if not im.shapes_supported((m, x.shape[-1]), tuple(wq.shape),
+                               block_m=bm, block_n=bn, block_k=bk):
+        return None
+    try:
+        y = im.int8_matmul_pallas(x.reshape(m, x.shape[-1]), wq, scale,
+                                  block_m=bm, block_n=bn, block_k=bk)
+    except Exception:
+        return None
+    return y.reshape(lead + (wq.shape[0],))
 
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,

@@ -1,0 +1,135 @@
+"""Pallas TPU fused weight-only int8 matmul.
+
+Reference analogue: the cutlass weight-only GEMMs behind
+python/paddle/nn/quant/quantized_linear.py weight_only_linear:152
+(paddle/phi/kernels/fusion/cutlass/...), where dequantization happens in
+the GEMM epilogue instead of a separate pass.
+
+TPU-first design: the win at decode time is HBM bandwidth — the weight
+crosses HBM as int8 ([n, k], the reference's transposed layout) and is
+widened to the activation dtype IN VMEM, right before the MXU dot; the
+per-channel scale multiplies the f32 accumulator once per output tile.
+XLA's fallback composition (convert + scale folded into dot_general) is
+kept for non-TPU backends, group-wise scales, int4, and shapes that do
+not tile; dispatch happens in nn/quantized_linear.py via ops.registry.
+
+Block sizes come from the tune DB (`tune_db.json`, op "int8_matmul") with
+MXU-shaped defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_M = 256
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_K = 512
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xb = x_ref[...]                                   # [bm, bk] activation
+    wb = w_ref[...].astype(xb.dtype)                  # [bn, bk] int8 -> act
+    acc_ref[...] += jax.lax.dot_general(
+        xb, wb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [bm, bn] f32
+
+    @pl.when(pl.program_id(2) == nk - 1)
+    def _epilogue():
+        scale = s_ref[...].astype(jnp.float32)        # [1, bn]
+        o_ref[...] = (acc_ref[...] * scale).astype(o_ref.dtype)
+
+
+def int8_matmul_pallas(x, wq, scale, *, block_m: int = DEFAULT_BLOCK_M,
+                       block_n: int = DEFAULT_BLOCK_N,
+                       block_k: int = DEFAULT_BLOCK_K,
+                       interpret: bool = False):
+    """y[m, n] = x[m, k] @ wq[n, k].T * scale[n], dequant fused in VMEM.
+
+    x: float (bf16/f32) [m, k]; wq: int8 [n, k] (transposed reference
+    layout); scale: [n] per-channel. Shapes must divide the block sizes —
+    the caller (weight_only_linear) checks and falls back otherwise."""
+    m, k = x.shape
+    n, k2 = wq.shape
+    assert k == k2 and scale.shape == (n,)
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    if m % block_m or n % block_n or k % block_k:
+        raise ValueError(
+            f"shape ({m},{k})x({n},{k}) does not divide blocks "
+            f"({block_m},{block_n},{block_k}); gate with shapes_supported()")
+    nm, nn, nk = m // block_m, n // block_n, k // block_k
+    scale2 = scale.reshape(1, n)
+
+    grid = (nm, nn, nk)
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_n, block_k), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((1, block_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)]
+        if _HAS_PLTPU else [],
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+            if (_HAS_PLTPU and not interpret) else None),
+        interpret=interpret,
+    )(x, wq, scale2)
+    return out
+
+
+def shapes_supported(x_shape, w_shape, *, block_m=DEFAULT_BLOCK_M,
+                     block_n=DEFAULT_BLOCK_N, block_k=DEFAULT_BLOCK_K):
+    """True when the fused kernel can run these shapes without padding:
+    every dim divides its (clamped) block."""
+    m, k = x_shape
+    n, k2 = w_shape
+    if k != k2:
+        return False
+    # m must be sublane-aligned: Mosaic failures at block_m < 8 surface at
+    # jit COMPILE time, after the dispatch fallback has already committed,
+    # so the gate has to be conservative here (batch-1 decode goes XLA)
+    if m < 8 or m % 8:
+        return False
+    bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    return m % bm == 0 and n % bn == 0 and k % bk == 0 and bn >= 128 \
+        and bk >= 128
+
+
+def tuned_blocks(m, n, k, dtype="bfloat16"):
+    """Tune-DB lookup for (m, n, k); falls back to the MXU defaults."""
+    try:
+        from .autotune import _DB
+        import jax as _jax
+        kind = getattr(_jax.devices()[0], "device_kind", "cpu")
+        cfg = _DB.lookup(_DB.key("int8_matmul", kind, str(dtype),
+                                 sm=m, sn=n, sk=k))
+        if cfg:
+            return (cfg.get("block_m", DEFAULT_BLOCK_M),
+                    cfg.get("block_n", DEFAULT_BLOCK_N),
+                    cfg.get("block_k", DEFAULT_BLOCK_K))
+    except Exception:
+        pass
+    return DEFAULT_BLOCK_M, DEFAULT_BLOCK_N, DEFAULT_BLOCK_K
+
+
+__all__ = ["int8_matmul_pallas", "shapes_supported", "tuned_blocks"]

@@ -168,3 +168,55 @@ def test_validation_errors():
     with pytest.raises(ValueError, match="per-channel"):
         weight_only_linear(jnp.zeros((1, K)), q1, weight_scale=s1,
                            group_size=64)
+
+
+class TestPallasInt8Matmul:
+    """Fused weight-only int8 kernel (ops/pallas/int8_matmul.py) vs the
+    XLA dequant composition — interpret mode on CPU."""
+
+    def test_kernel_matches_xla_path(self):
+        import jax.numpy as jnp
+        from paddle_tpu.nn.quantized_linear import (weight_quantize,
+                                                    weight_only_linear)
+        from paddle_tpu.ops.pallas.int8_matmul import int8_matmul_pallas
+        rs = np.random.RandomState(0)
+        k, n, m = 256, 384, 128
+        w = jnp.asarray(rs.normal(0, 0.05, (k, n)), jnp.float32)
+        x = jnp.asarray(rs.normal(0, 1, (m, k)), jnp.float32)
+        qw, sc = weight_quantize(w, algo="weight_only_int8")
+        ref = weight_only_linear(x, qw, weight_scale=sc,
+                                 weight_dtype="int8")
+        got = int8_matmul_pallas(x, qw, sc, block_n=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_indivisible_blocks_raise(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.int8_matmul import int8_matmul_pallas
+        x = jnp.ones((128, 256), jnp.float32)
+        qw = jnp.ones((384, 256), jnp.int8)
+        sc = jnp.ones((384,), jnp.float32)
+        with pytest.raises(ValueError, match="divide"):
+            int8_matmul_pallas(x, qw, sc, block_n=256, interpret=True)
+
+    def test_shapes_supported_gate(self):
+        from paddle_tpu.ops.pallas.int8_matmul import shapes_supported
+        assert shapes_supported((256, 512), (256, 512))
+        assert not shapes_supported((256, 100), (256, 100))   # k < 128
+        assert not shapes_supported((256, 512), (256, 384))   # k mismatch
+
+    def test_odd_shapes_fall_back_cleanly(self):
+        # weight_only_linear must stay correct for shapes the kernel
+        # rejects (falls back to XLA dequant)
+        import jax.numpy as jnp
+        from paddle_tpu.nn.quantized_linear import (weight_quantize,
+                                                    weight_only_linear)
+        rs = np.random.RandomState(1)
+        k, n = 100, 52
+        w = jnp.asarray(rs.normal(0, 0.05, (k, n)), jnp.float32)
+        x = jnp.asarray(rs.normal(0, 1, (3, k)), jnp.float32)
+        qw, sc = weight_quantize(w, algo="weight_only_int8")
+        out = weight_only_linear(x, qw, weight_scale=sc, weight_dtype="int8")
+        dense = np.asarray(x) @ np.asarray(w)
+        np.testing.assert_allclose(np.asarray(out), dense, rtol=0.06,
+                                   atol=0.05)
