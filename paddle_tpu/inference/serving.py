@@ -314,10 +314,11 @@ class ContinuousBatchingEngine:
         return emitted
 
     def latency_stats(self) -> Dict[str, float]:
-        """TTFT / end-to-end latency percentiles over every request retired
-        by this engine (survives run()'s request release) — the serving
-        SLO numbers (reference: PaddleNLP llm serving benchmarks report
-        the same trio: throughput, TTFT, p99)."""
+        """TTFT / end-to-end latency percentiles over a sliding window of
+        the most recent 10,000 retired requests (survives run()'s request
+        release; ``requests``/``tokens`` count the window, not lifetime) —
+        the serving SLO numbers (reference: PaddleNLP llm serving
+        benchmarks report the same trio: throughput, TTFT, p99)."""
         if not self._latencies:
             return {}
         arr = np.asarray(self._latencies, np.float64)

@@ -181,7 +181,8 @@ def _try_pallas_weight_only(x, wq, weight_scale):
     from ..ops.pallas import int8_matmul as im
     bm, bn, bk = im.tuned_blocks(m, wq.shape[0], x.shape[-1], x.dtype)
     if not im.shapes_supported((m, x.shape[-1]), tuple(wq.shape),
-                               block_m=bm, block_n=bn, block_k=bk):
+                               block_m=bm, block_n=bn, block_k=bk,
+                               dtype=x.dtype):
         return None
     try:
         y = im.int8_matmul_pallas(x.reshape(m, x.shape[-1]), wq, scale,

@@ -63,6 +63,10 @@ def int8_matmul_pallas(x, wq, scale, *, block_m: int = DEFAULT_BLOCK_M,
     x: float (bf16/f32) [m, k]; wq: int8 [n, k] (transposed reference
     layout); scale: [n] per-channel. Shapes must divide the block sizes —
     the caller (weight_only_linear) checks and falls back otherwise."""
+    if not _HAS_PLTPU:
+        raise ImportError(
+            "pallas.tpu is unavailable in this jax build; use the XLA "
+            "weight_only_linear path")
     m, k = x.shape
     n, k2 = wq.shape
     assert k == k2 and scale.shape == (n,)
@@ -87,28 +91,34 @@ def int8_matmul_pallas(x, wq, scale, *, block_m: int = DEFAULT_BLOCK_M,
         ],
         out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
-        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)]
-        if _HAS_PLTPU else [],
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         compiler_params=(pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
-            if (_HAS_PLTPU and not interpret) else None),
+            if not interpret else None),
         interpret=interpret,
     )(x, wq, scale2)
     return out
 
 
 def shapes_supported(x_shape, w_shape, *, block_m=DEFAULT_BLOCK_M,
-                     block_n=DEFAULT_BLOCK_N, block_k=DEFAULT_BLOCK_K):
+                     block_n=DEFAULT_BLOCK_N, block_k=DEFAULT_BLOCK_K,
+                     dtype=None):
     """True when the fused kernel can run these shapes without padding:
     every dim divides its (clamped) block."""
     m, k = x_shape
     n, k2 = w_shape
     if k != k2:
         return False
-    # m must be sublane-aligned: Mosaic failures at block_m < 8 surface at
-    # jit COMPILE time, after the dispatch fallback has already committed,
-    # so the gate has to be conservative here (batch-1 decode goes XLA)
-    if m < 8 or m % 8:
+    # m must be sublane-tile-aligned for the ACTIVATION dtype (f32: 8,
+    # bf16: 16, int8: 32): Mosaic failures at misaligned block_m surface
+    # at jit COMPILE time, after the dispatch fallback has already
+    # committed, so the gate has to be conservative (batch-1 decode and
+    # ragged m go XLA)
+    sublane = 8
+    if dtype is not None:
+        itemsize = jnp.dtype(dtype).itemsize
+        sublane = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    if m < sublane or m % sublane:
         return False
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     return m % bm == 0 and n % bn == 0 and k % bk == 0 and bn >= 128 \

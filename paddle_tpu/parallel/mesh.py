@@ -122,19 +122,76 @@ def current_mesh() -> Optional[HybridMesh]:
     return None
 
 
+def pod_bootstrap_env() -> Optional[dict]:
+    """Map pod/launcher env to jax.distributed.initialize kwargs.
+
+    Sources, in precedence order (first complete set wins):
+    - ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+      ``JAX_PROCESS_ID`` — set by distributed/launch (and GKE JobSet TPU
+      manifests);
+    - ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` + ``MASTER_ADDR`` /
+      ``MASTER_PORT`` — the reference recipe env
+      (python/paddle/distributed/parallel.py:943 init_parallel_env reads
+      the same trio for its TCPStore rendezvous).
+
+    Returns None when the env describes a single-process job (nothing to
+    initialize; on Cloud TPU pods with no env at all,
+    jax.distributed.initialize() self-discovers via the TPU metadata
+    server, which the caller falls back to)."""
+    import os
+    env = os.environ
+    # first COMPLETE set wins — fields are never mixed across sources (a
+    # stale PADDLE_TRAINER_ID must not complete a partial JAX_* trio)
+    sets = [
+        (env.get("JAX_COORDINATOR_ADDRESS"), env.get("JAX_NUM_PROCESSES"),
+         env.get("JAX_PROCESS_ID")),
+    ]
+    if env.get("MASTER_ADDR") and env.get("MASTER_PORT"):
+        sets.append((f"{env['MASTER_ADDR']}:{env['MASTER_PORT']}",
+                     env.get("PADDLE_TRAINERS_NUM"),
+                     env.get("PADDLE_TRAINER_ID")))
+    for coord, nproc, pid in sets:
+        if coord and nproc and pid is not None:
+            if int(nproc) <= 1:
+                return None
+            return {"coordinator_address": coord,
+                    "num_processes": int(nproc), "process_id": int(pid)}
+    return None
+
+
 def init_parallel_env(dp: int = 1, fsdp: int = 1, tp: int = 1, pp: int = 1,
                       sep: int = 1) -> HybridMesh:
     """Multi-host bootstrap + mesh creation.
 
     Reference analogue: paddle.distributed.init_parallel_env
     (python/paddle/distributed/parallel.py:943 — TCPStore rendezvous +
-    default ProcessGroup). On TPU, jax.distributed.initialize's coordination
-    service is the TCPStore equivalent; it is a no-op on single-host.
-    """
+    default ProcessGroup). On TPU, jax.distributed.initialize's
+    coordination service is the TCPStore equivalent; the pod env mapping
+    (pod_bootstrap_env) covers both the launcher's JAX_* trio and the
+    reference's PADDLE_*/MASTER_* recipe env. No-op on single-host."""
     import os
-    if "JAX_COORDINATOR_ADDRESS" in os.environ and jax.process_count() == 1:
-        try:
-            jax.distributed.initialize()
-        except Exception:
-            pass  # already initialized or single-process
+    kwargs = pod_bootstrap_env()
+    # probe initialized-ness WITHOUT touching the backend —
+    # jax.process_count() would initialize it single-process and make the
+    # subsequent distributed.initialize a no-op
+    try:
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    except Exception:
+        already = False
+    if not already:
+        if kwargs is not None:
+            try:
+                jax.distributed.initialize(**kwargs)
+            except RuntimeError as e:
+                if "already" not in str(e).lower():
+                    raise  # real bootstrap failure must surface, not hang
+        elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            # partial env: let jax's own discovery (TPU metadata server /
+            # cluster-env autodetect) fill in the rest
+            try:
+                jax.distributed.initialize()
+            except RuntimeError as e:
+                if "already" not in str(e).lower():
+                    raise
     return HybridMesh.build(dp=dp, fsdp=fsdp, tp=tp, pp=pp, sep=sep)

@@ -1,0 +1,124 @@
+"""Two-process multi-host bootstrap through the launcher (round-3 verdict
+item 8).
+
+Reference analogue: paddle.distributed.launch spawning ranks that each
+call init_parallel_env (parallel.py:943) and join a collective. Here two
+REAL worker processes go through distributed/launch's Pod machinery, each
+maps its pod env to jax.distributed.initialize via
+parallel.mesh.init_parallel_env, builds a GLOBAL 2-device mesh (one CPU
+device per process, Gloo collectives), and runs a psum. The elastic test
+SIGKILLs a real worker and verifies the relaunch policy recovers.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.launch.main import LaunchConfig, build_pod, launch
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    paddle_tpu.__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # one CPU device per process -> global mesh of world_size devices
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel.mesh import init_parallel_env, pod_bootstrap_env
+
+    kw = pod_bootstrap_env()
+    assert kw is not None and kw["num_processes"] == 2, kw
+    hm = init_parallel_env(dp=2)
+    assert jax.process_count() == 2, jax.process_count()
+    mesh = hm.mesh
+
+    @jax.jit
+    def allsum(x):
+        return jax.shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                             in_specs=P("dp"), out_specs=P())(x)
+
+    rank = jax.process_index()
+    x = jax.device_put(jnp.arange(2, dtype=jnp.float32),
+                       NamedSharding(mesh, P("dp")))
+    out = np.asarray(jax.device_get(allsum(x)))
+    assert out[0] == 1.0, out          # 0 + 1
+    print("BOOTSTRAP_OK rank", rank, flush=True)
+""").format(repo=_REPO)
+
+
+def _write_worker(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(body)
+    return str(p)
+
+
+class TestTwoProcessBootstrap:
+    def test_pod_launch_psum(self, tmp_path):
+        script = _write_worker(tmp_path, _WORKER)
+        cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"))
+        pod = build_pod(cfg, script, ())
+        # workers must not inherit the test process's 8-device CPU flag
+        for c in pod.containers:
+            c.env["JAX_PLATFORMS"] = "cpu"
+        pod.start()
+        code = pod.join()
+        logs = "".join(
+            open(c.log_path).read() for c in pod.containers)
+        assert code == 0, logs[-2000:]
+        assert logs.count("BOOTSTRAP_OK") == 2, logs[-2000:]
+
+    def test_pod_env_matches_reference_recipe(self, tmp_path):
+        # the per-rank env carries both the JAX_* trio and the reference's
+        # PADDLE_*/MASTER_* names, so either bootstrap path works
+        cfg = LaunchConfig(nproc_per_node=2)
+        pod = build_pod(cfg, "x.py", ())
+        for rank, c in enumerate(pod.containers):
+            e = c.env
+            assert e["JAX_PROCESS_ID"] == str(rank)
+            assert e["JAX_NUM_PROCESSES"] == "2"
+            assert e["PADDLE_TRAINER_ID"] == str(rank)
+            assert e["PADDLE_TRAINERS_NUM"] == "2"
+            assert e["JAX_COORDINATOR_ADDRESS"] == \
+                f"{e['MASTER_ADDR']}:{e['MASTER_PORT']}"
+
+
+_FLAKY = textwrap.dedent("""
+    import os, signal, sys
+    marker = os.path.join({mark_dir!r}, "died_once")
+    if not os.path.exists(marker):
+        open(marker, "w").write("x")
+        os.kill(os.getpid(), signal.SIGKILL)   # real worker death
+    print("RECOVERED_OK", flush=True)
+""")
+
+
+class TestElasticRealKill:
+    def test_killed_worker_is_relaunched(self, tmp_path):
+        script = _write_worker(
+            tmp_path, _FLAKY.format(mark_dir=str(tmp_path)))
+        cfg = LaunchConfig(nproc_per_node=1, max_restarts=2,
+                           log_dir=str(tmp_path / "log"))
+        code = launch(cfg, script)
+        assert code == 0
+        assert os.path.exists(tmp_path / "died_once")
+        log = open(tmp_path / "log" / "workerlog.0").read()
+        assert "RECOVERED_OK" in log
+
+    def test_restart_budget_exhausted_fails(self, tmp_path):
+        script = _write_worker(tmp_path, "import sys; sys.exit(3)\n")
+        cfg = LaunchConfig(nproc_per_node=1, max_restarts=1,
+                           log_dir=str(tmp_path / "log"))
+        code = launch(cfg, script)
+        assert code != 0
