@@ -270,6 +270,39 @@ def _decode_bench(cfg, on_tpu):
     except Exception as e:
         out["int8_matmul_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
+    try:
+        # fused rope: Pallas q+k single-pass vs XLA elementwise fusion
+        # (keep-only-if-it-wins: the ledger records both numbers)
+        if on_tpu:
+            from paddle_tpu.ops import rope as rope_ops
+            from paddle_tpu.ops.pallas.fused_rope import fused_rope_pallas
+            from paddle_tpu.ops.registry import pallas_disabled_scope
+            b_, s_, h_, hk_, d_ = 8, 2048, 16, 4, 128
+            rs3 = np.random.RandomState(3)
+            q_ = jnp.asarray(rs3.normal(0, 1, (b_, s_, h_, d_)), jnp.bfloat16)
+            k_ = jnp.asarray(rs3.normal(0, 1, (b_, s_, hk_, d_)), jnp.bfloat16)
+            cos_, sin_ = rope_ops.rope_freqs(d_, s_)
+            fp = jax.jit(lambda a, c: fused_rope_pallas(a, c, cos_, sin_))
+            r = fp(q_, k_); _sync(r)
+            t0 = time.perf_counter()
+            for _ in range(50):
+                r = fp(q_, k_)
+            _sync(r)
+            out["rope_pallas_us"] = round(
+                (time.perf_counter() - t0) / 50 * 1e6, 1)
+            with pallas_disabled_scope():
+                fx = jax.jit(lambda a, c: rope_ops.apply_rotary_pos_emb(
+                    a, c, cos_, sin_))
+                r = fx(q_, k_); _sync(r)
+                t0 = time.perf_counter()
+                for _ in range(50):
+                    r = fx(q_, k_)
+                _sync(r)
+                out["rope_xla_us"] = round(
+                    (time.perf_counter() - t0) / 50 * 1e6, 1)
+    except Exception as e:
+        out["rope_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
     if on_tpu:
         try:
             from paddle_tpu.ops.pallas.paged_attention import (

@@ -10,8 +10,10 @@ elementwise rotation into adjacent matmuls.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -36,6 +38,10 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
     Mirrors fused_rotary_position_embedding(use_neox_rotary_style=True).
     """
     s = q.shape[1]
+    if position_ids is None:
+        out = _try_pallas_rope(q, k, cos[:s], sin[:s])
+        if out is not None:
+            return out
     if position_ids is not None:
         cos = cos[position_ids]          # [b, s, d]
         sin = sin[position_ids]
@@ -49,6 +55,60 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
     q_out = q * cos + _rotate_half(q) * sin
     k_out = k * cos + _rotate_half(k) * sin
     return q_out, k_out
+
+
+def _try_pallas_rope(q, k, cos, sin):
+    """Fused q+k rotation in one Pallas kernel (training path, contiguous
+    positions); None -> XLA composition. The dispatch is differentiable:
+    rope is linear in q/k so the custom_vjp applies the transpose rotation
+    (cos, -sin) to the cotangents — no recompute, no saved residuals
+    beyond the tables."""
+    from .registry import backend_kind, pallas_disabled
+    from ..core.flags import flag
+    if (pallas_disabled() or not flag("use_pallas_kernels")
+            or backend_kind() != "tpu" or q.ndim != 4):
+        return None
+    from .pallas.fused_rope import (fused_rope_pallas, rope_supported,
+                                    tuned_block_s)
+    if not rope_supported(tuple(q.shape), tuple(k.shape)):
+        return None
+    bs = tuned_block_s(q.shape[1], q.shape[3], q.dtype)
+    try:
+        return _rope_fwd_bwd(q, k, cos, sin, bs)
+    except Exception:
+        return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _rope_fwd_bwd(q, k, cos, sin, block_s):
+    from .pallas.fused_rope import fused_rope_pallas
+    return fused_rope_pallas(q, k, cos, sin, block_s=block_s)
+
+
+def _rope_fwd(q, k, cos, sin, block_s):
+    out = _rope_fwd_bwd(q, k, cos, sin, block_s)
+    return out, (q, k, cos, sin)
+
+
+def _rope_bwd(block_s, res, g):
+    # rotation matrix transpose: R(theta)^T = R(-theta) -> (cos, -sin)
+    from .pallas.fused_rope import fused_rope_pallas
+    q, k, cos, sin = res
+    gq, gk = g
+    dq, dk = fused_rope_pallas(gq, gk, cos, -sin, block_s=block_s)
+    # table cotangents (exact; XLA DCEs these when the tables are
+    # buffers/stop_gradient'd, the common case): out = x*cos + rot(x)*sin
+    f32 = jnp.float32
+    dcos = (jnp.sum(gq.astype(f32) * q.astype(f32), axis=(0, 2))
+            + jnp.sum(gk.astype(f32) * k.astype(f32), axis=(0, 2)))
+    dsin = (jnp.sum(gq.astype(f32) * _rotate_half(q).astype(f32),
+                    axis=(0, 2))
+            + jnp.sum(gk.astype(f32) * _rotate_half(k).astype(f32),
+                      axis=(0, 2)))
+    return dq, dk, dcos.astype(cos.dtype), dsin.astype(sin.dtype)
+
+
+_rope_fwd_bwd.defvjp(_rope_fwd, _rope_bwd)
 
 
 def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,

@@ -134,10 +134,12 @@ def pod_bootstrap_env() -> Optional[dict]:
       (python/paddle/distributed/parallel.py:943 init_parallel_env reads
       the same trio for its TCPStore rendezvous).
 
-    Returns None when the env describes a single-process job (nothing to
-    initialize; on Cloud TPU pods with no env at all,
-    jax.distributed.initialize() self-discovers via the TPU metadata
-    server, which the caller falls back to)."""
+    Returns None when the env describes a single-process job. With a
+    PARTIAL env (only JAX_COORDINATOR_ADDRESS set), the caller falls back
+    to bare jax.distributed.initialize() so jax's own cluster autodetect
+    fills the rest; on a pod with NO bootstrap env at all, call
+    jax.distributed.initialize() yourself (or use distributed.launch) —
+    single-host runs must not pay an initialize() attempt."""
     import os
     env = os.environ
     # first COMPLETE set wins — fields are never mixed across sources (a
@@ -151,7 +153,9 @@ def pod_bootstrap_env() -> Optional[dict]:
                      env.get("PADDLE_TRAINERS_NUM"),
                      env.get("PADDLE_TRAINER_ID")))
     for coord, nproc, pid in sets:
-        if coord and nproc and pid is not None:
+        # empty strings (unset template vars) count as missing, so an
+        # incomplete set falls through to the next source
+        if coord and nproc and pid not in (None, ""):
             if int(nproc) <= 1:
                 return None
             return {"coordinator_address": coord,

@@ -340,7 +340,13 @@ class TestBasePlumbing:
         assert np.allclose(g, 7.0)
         with pt.LazyGuard():
             p2 = pt.create_parameter([2], "float32", is_bias=True)
-        assert np.allclose(np.asarray(p2.value), 0.0)
+        # LazyGuard defers materialization (reference lazy_init semantics):
+        # inside the guard parameters are abstract shape/dtype structs
+        import jax
+        assert isinstance(p2.value, jax.ShapeDtypeStruct)
+        assert p2.value.shape == (2,)
+        p3 = pt.create_parameter([2], "float32", is_bias=True)
+        assert np.allclose(np.asarray(p3.value), 0.0)
 
     def test_data_parallel_printoptions(self):
         from paddle_tpu.nn import Linear
