@@ -102,8 +102,10 @@ def tuned_block_s(s, d, dtype="bfloat16"):
         from .autotune import _DB
         kind = getattr(jax.devices()[0], "device_kind", "cpu")
         cfg = _DB.lookup(_DB.key("fused_rope", kind, str(dtype), ss=s, d=d))
-        if cfg:
-            return cfg.get("block_s", DEFAULT_BLOCK_S)
+        # the DB key BUCKETS s, so a recorded block may not divide this
+        # exact seq — validate before trusting it
+        if cfg and s % int(cfg.get("block_s", DEFAULT_BLOCK_S)) == 0:
+            return int(cfg.get("block_s", DEFAULT_BLOCK_S))
     except Exception:
         pass
     bs = next((c for c in (512, 256, 128, 64, 32, 16, 8)

@@ -59,10 +59,12 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
 
 def _try_pallas_rope(q, k, cos, sin):
     """Fused q+k rotation in one Pallas kernel (training path, contiguous
-    positions); None -> XLA composition. The dispatch is differentiable:
-    rope is linear in q/k so the custom_vjp applies the transpose rotation
-    (cos, -sin) to the cotangents — no recompute, no saved residuals
-    beyond the tables."""
+    positions); None -> XLA composition. The custom_vjp applies the
+    transpose rotation (cos, -sin) to the q/k cotangents and computes
+    EXACT table cotangents from the saved inputs (q, k, cos, sin are the
+    residuals); when the tables are buffers — every model here — the
+    table-grad computation and its residual use are dead and XLA's DCE
+    removes them under jit."""
     from .registry import backend_kind, pallas_disabled
     from ..core.flags import flag
     if (pallas_disabled() or not flag("use_pallas_kernels")

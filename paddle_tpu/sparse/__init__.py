@@ -338,3 +338,8 @@ __all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "square",
             "log1p", "expm1", "neg", "deg2rad", "rad2deg", "isnan", "cast",
             "is_same_shape", "reshape", "slice", "mv", "addmm",
             "pca_lowrank"]
+
+
+from . import functional  # noqa: E402
+from . import nn  # noqa: E402
+__all__ += ["functional", "nn"]

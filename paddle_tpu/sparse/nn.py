@@ -62,3 +62,118 @@ class BatchNorm(Layer):
                 return jsparse.BCSR((new_vals, x.indices, x.indptr), shape=x.shape)
             return jsparse.BCOO((new_vals, x.indices), shape=x.shape)
         return self._bn(x)
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling / sync-norm layers (reference: sparse/nn/layer/{conv,
+# pooling,norm}.py). Compute documented in sparse/functional.py.
+# ---------------------------------------------------------------------------
+
+from . import functional  # noqa: E402  (module attr: sparse.nn.functional)
+from ..nn import initializer as _I  # noqa: E402
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise NotImplementedError("sparse conv supports zeros padding")
+        if data_format is not None and data_format not in ("NDHWC", "NHWC"):
+            raise ValueError(
+                f"sparse conv supports channel-last layouts only "
+                f"(NDHWC/NHWC), got {data_format!r} — the reference "
+                f"raises likewise")
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = (stride, padding, dilation, groups, nd, subm)
+        init_w = weight_attr if isinstance(weight_attr, _I.Initializer) \
+            else getattr(weight_attr, "initializer", None)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            initializer=init_w,
+            default_initializer=_I.XavierUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels], is_bias=True)
+        else:
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        stride, padding, dilation, groups, nd, subm = self._cfg
+        return functional._sparse_conv(x, self.weight, self.bias, stride,
+                                       padding, dilation, groups, nd, subm)
+
+
+class Conv3D(_SparseConvNd):
+    """Reference: sparse/nn/layer/conv.py Conv3D:239."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, False, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_SparseConvNd):
+    """Reference: sparse/nn/layer/conv.py SubmConv3D:509 — outputs only at
+    the input's active sites."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, True, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, False, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, True, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    """Reference: sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("sparse MaxPool3D: return_mask "
+                                      "unsupported")
+        if data_format != "NDHWC":
+            raise ValueError(f"sparse MaxPool3D supports NDHWC only, got "
+                             f"{data_format!r}")
+        self._a = (kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        ks, st, pd, cm = self._a
+        return functional.max_pool3d(x, ks, st, pd, cm)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Reference: sparse/nn/layer/norm.py SyncBatchNorm — under GSPMD the
+    batch statistics of a dp-sharded batch are already global (XLA inserts
+    the cross-replica reduction), so the sparse BatchNorm IS sync."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer

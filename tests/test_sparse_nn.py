@@ -1,0 +1,175 @@
+"""Sparse nn depth (round-3 verdict Missing #6): CSR softmax, gather-based
+sparse attention, sparse/subm convolutions, pooling.
+
+Reference: python/paddle/sparse/nn/ (functional + layers); oracles are
+dense numpy compositions over the same patterns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.sparse as sp
+from paddle_tpu.sparse import functional as SF
+
+RS = np.random.RandomState(0)
+
+
+def _rand_csr(n=8, density=0.4, seed=0):
+    rs = np.random.RandomState(seed)
+    dense = rs.normal(0, 1, (n, n)) * (rs.uniform(size=(n, n)) < density)
+    # keep at least one entry per row so softmax rows are non-empty
+    for i in range(n):
+        if (dense[i] == 0).all():
+            dense[i, rs.randint(n)] = 1.0
+    return dense.astype(np.float32)
+
+
+class TestCsrSoftmax:
+    def test_matches_dense_softmax_over_nonzeros(self):
+        dense = _rand_csr()
+        x = sp.to_sparse_csr(jnp.asarray(dense))
+        out = SF.softmax(x)
+        got = np.asarray(sp.to_dense(out))
+        want = np.zeros_like(dense)
+        for i in range(dense.shape[0]):
+            nz = dense[i] != 0
+            e = np.exp(dense[i][nz] - dense[i][nz].max())
+            want[i][nz] = e / e.sum()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # stays sparse: same pattern
+        assert int(sp.nnz(out)) == int(sp.nnz(x))
+
+    def test_axis_restriction(self):
+        x = sp.to_sparse_csr(jnp.asarray(_rand_csr()))
+        with pytest.raises(ValueError, match="axis"):
+            SF.softmax(x, axis=0)
+
+
+class TestSparseAttention:
+    def test_matches_dense_masked_attention(self):
+        b, h, s, d = 2, 2, 8, 16
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        k = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        v = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        # causal pattern as the CSR mask (same for every head)
+        pat = np.tril(np.ones((s, s), np.float32))
+        mask = sp.to_sparse_csr(jnp.asarray(pat))
+        out = SF.attention(q, k, v, mask)
+        # dense oracle
+        logits = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k))
+        logits = logits / np.sqrt(d)
+        logits = np.where(pat[None, None] > 0, logits, -np.inf)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", w, np.asarray(v))
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_key_padding_mask(self):
+        b, h, s, d = 1, 1, 8, 8
+        rs = np.random.RandomState(2)
+        q = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        k = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        v = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        pat = np.ones((s, s), np.float32)
+        mask = sp.to_sparse_csr(jnp.asarray(pat))
+        kp = np.zeros((b, s), np.float32)
+        kp[:, -2:] = -np.inf              # last two keys masked out
+        out = SF.attention(q, k, v, mask, key_padding_mask=jnp.asarray(kp))
+        logits = np.einsum("bhqd,bhkd->bhqk", np.asarray(q),
+                           np.asarray(k)) / np.sqrt(d)
+        logits = logits + kp[:, None, None, :]
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", w, np.asarray(v))
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_jit_compiles(self):
+        b, h, s, d = 1, 2, 8, 8
+        rs = np.random.RandomState(3)
+        q = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        pat = np.tril(np.ones((s, s), np.float32))
+        mask = sp.to_sparse_csr(jnp.asarray(pat))
+        f = jax.jit(lambda a: SF.attention(a, a, a, mask))
+        assert np.isfinite(np.asarray(f(q))).all()
+
+
+class TestSparseConv:
+    def test_subm_conv3d_preserves_pattern(self):
+        rs = np.random.RandomState(4)
+        x = np.zeros((1, 4, 6, 6, 3), np.float32)
+        sites = [(0, 1, 2, 3), (0, 2, 4, 1), (0, 3, 0, 0)]
+        for s_ in sites:
+            x[s_[0], s_[1], s_[2], s_[3]] = rs.normal(0, 1, 3)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
+        conv = sp.nn.SubmConv3D(3, 5, kernel_size=3, padding=1)
+        out = conv(xs)
+        dense = np.asarray(sp.to_dense(out))
+        assert dense.shape == (1, 4, 6, 6, 5)
+        active = np.any(np.asarray(x) != 0, axis=-1)
+        inactive_out = dense[~active]
+        assert np.all(inactive_out == 0), "subm conv leaked outside pattern"
+        assert np.any(dense[active] != 0)
+
+    def test_conv3d_matches_dense_conv(self):
+        rs = np.random.RandomState(5)
+        x = (rs.normal(0, 1, (1, 4, 5, 5, 2)) *
+             (rs.uniform(size=(1, 4, 5, 5, 1)) < 0.3)).astype(np.float32)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
+        w = jnp.asarray(rs.normal(0, 0.3, (3, 3, 3, 2, 4)), jnp.float32)
+        out = SF.conv3d(xs, w, stride=1, padding=0)
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(x), w, (1, 1, 1), [(0, 0)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        np.testing.assert_allclose(np.asarray(sp.to_dense(out)),
+                                   np.asarray(want), rtol=1e-4, atol=1e-4)
+
+    def test_subm_conv2d_layer(self):
+        rs = np.random.RandomState(6)
+        x = (rs.normal(0, 1, (2, 8, 8, 3)) *
+             (rs.uniform(size=(2, 8, 8, 1)) < 0.2)).astype(np.float32)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=3)
+        conv = sp.nn.SubmConv2D(3, 4, kernel_size=3, padding=1)
+        out = sp.to_dense(conv(xs))
+        active = np.any(x != 0, axis=-1)
+        assert np.all(np.asarray(out)[~active] == 0)
+
+    def test_max_pool3d(self):
+        rs = np.random.RandomState(7)
+        x = (rs.normal(0, 1, (1, 4, 4, 4, 2)) *
+             (rs.uniform(size=(1, 4, 4, 4, 1)) < 0.5)).astype(np.float32)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
+        out = sp.to_dense(SF.max_pool3d(xs, kernel_size=2))
+        want = jax.lax.reduce_window(
+            jnp.asarray(x), -jnp.inf, jax.lax.max,
+            (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID")
+        want = jnp.where(jnp.isneginf(want), 0, want)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want))
+
+
+class TestSparseGrad:
+    def test_attention_differentiable(self):
+        b, h, s, d = 1, 1, 8, 8
+        rs = np.random.RandomState(8)
+        q = jnp.asarray(rs.normal(0, 1, (b, h, s, d)), jnp.float32)
+        pat = np.tril(np.ones((s, s), np.float32))
+        mask = sp.to_sparse_csr(jnp.asarray(pat))
+        g = jax.grad(lambda a: SF.attention(a, a, a, mask).sum())(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_subm_conv_differentiable(self):
+        rs = np.random.RandomState(9)
+        x = (rs.normal(0, 1, (1, 6, 6, 2)) *
+             (rs.uniform(size=(1, 6, 6, 1)) < 0.4)).astype(np.float32)
+        w = jnp.asarray(rs.normal(0, 0.3, (3, 3, 2, 3)), jnp.float32)
+
+        def loss(ww):
+            out = SF.subm_conv2d(jnp.asarray(x), ww, padding=1)
+            return (sp.to_dense(out) ** 2).sum()
+
+        g = jax.grad(loss)(w)
+        assert np.isfinite(np.asarray(g)).all() and np.any(np.asarray(g))
