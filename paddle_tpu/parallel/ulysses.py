@@ -18,9 +18,11 @@ matters. Both are exact; `models/llama.py` picks via config.sp_mode.
 
 GQA: when h_kv % n == 0 K/V all-to-all the same way and the contiguous
 head slices stay group-aligned (q head j maps to kv head j//(h/h_kv);
-slice i of q maps exactly onto slice i of kv). When h_kv < n (or doesn't
-divide), K/V heads are first repeated up to h — correctness-grade, costs
-group-times K/V bandwidth, documented in docs/DESIGN_DECISIONS.md.
+slice i of q maps exactly onto slice i of kv). When h_kv < n with
+n % h_kv == 0, K/V heads expand only to n (factor n/h_kv — each device's
+q slice sits inside one kv group, so expanded head i IS that group);
+only the ragged remainder falls back to full h expansion. Llama-70B
+(h_kv=8) at sep=16 pays 2x KV bandwidth, not 8x.
 
 The all-to-alls are linear ops with registered transposes, so jax AD
 differentiates straight through them — only the attention core carries a
@@ -80,11 +82,20 @@ def ulysses_attention(q, k, v, causal: bool = True, axis: str = "sep",
             f"h % h_kv == 0); got h={h}, h_kv={h_kv}, {axis}={n} — use "
             f"ring_attention instead")
     if h_kv % n != 0:
-        # repeat KV heads up to h so both sides split evenly (GQA group
-        # expansion; exactness preserved, bandwidth cost documented)
-        group = h // h_kv
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
+        if n % h_kv == 0:
+            # minimal GQA expansion: repeat KV heads only to n (the sep
+            # degree), a factor n/h_kv instead of the full h/h_kv. Exact
+            # because n | h makes each device's q-head slice [i·h/n,
+            # (i+1)·h/n) lie inside ONE original kv group (h/n divides
+            # h/h_kv ⟺ h_kv | n), and expanded kv head i = original
+            # i·h_kv/n is precisely that group. Llama-70B (h=64, h_kv=8)
+            # at sep=16: 2x KV bandwidth, not 8x.
+            r = n // h_kv
+        else:
+            # ragged case: full group expansion (correctness-grade)
+            r = h // h_kv
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
     if interpret is None:
         from ..ops.registry import backend_kind
         interpret = backend_kind() != "tpu"

@@ -63,7 +63,18 @@ def softmax(x, axis: int = -1, name=None):
         new = ex / jnp.maximum(sm[row_of], 1e-30)
         return jsparse.BCSR((new, indices, indptr), shape=x.shape)
     if is_sparse_coo(x):
-        return softmax(to_sparse_csr(to_dense(x)), axis=axis)
+        # COO-native: segment softmax over stored values by row id,
+        # preserving the COO format and pattern (no densification)
+        xc = x if getattr(x, "indices", None) is not None else x
+        data = xc.data
+        rows = xc.indices[..., 0] if xc.indices.ndim == 2 \
+            else xc.indices[0]
+        n_rows = x.shape[-2]
+        mx = jax.ops.segment_max(data, rows, num_segments=n_rows)
+        ex = jnp.exp(data - mx[rows])
+        sm = jax.ops.segment_sum(ex, rows, num_segments=n_rows)
+        new = ex / jnp.maximum(sm[rows], 1e-30)
+        return jsparse.BCOO((new, xc.indices), shape=x.shape)
     return jax.nn.softmax(jnp.asarray(x), axis=axis)
 
 
@@ -130,15 +141,17 @@ def _dense_conv(x_dense, weight, bias, stride, padding, dilation, groups,
                 nd: int):
     """channel-last conv: x [N, *spatial, C_in], weight [*k, C_in, C_out]
     (the reference sparse conv layout)."""
-    import numpy as np
     dn = ("NHWC", "HWIO", "NHWC") if nd == 2 else ("NDHWC", "DHWIO", "NDHWC")
     stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
     dilation = (dilation,) * nd if isinstance(dilation, int) \
         else tuple(dilation)
-    if isinstance(padding, int):
+    if isinstance(padding, str):
+        pad = padding.upper()              # "SAME"/"VALID" pass through
+    elif isinstance(padding, int):
         pad = [(padding, padding)] * nd
     else:
-        pad = [(int(p), int(p)) for p in padding]
+        pad = [tuple(int(q) for q in p) if isinstance(p, (tuple, list))
+               else (int(p), int(p)) for p in padding]
     out = jax.lax.conv_general_dilated(
         x_dense.astype(jnp.float32),
         jnp.asarray(weight, jnp.float32),
@@ -199,17 +212,21 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
         raise NotImplementedError("sparse max_pool3d: ceil_mode=False only "
                                   "(reference raises likewise on CPU)")
     dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
+    # reduce over ACTIVE sites only (reference rulebook semantics):
+    # implicit zeros must not win over negative stored values
+    active = jnp.any(dense != 0, axis=-1, keepdims=True)
+    masked = jnp.where(active, dense, -jnp.inf)
     ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
         else tuple(kernel_size)
     st = ks if stride is None else (
         (stride,) * 3 if isinstance(stride, int) else tuple(stride))
     pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
     out = jax.lax.reduce_window(
-        dense, -jnp.inf, jax.lax.max,
+        masked, -jnp.inf, jax.lax.max,
         window_dimensions=(1,) + ks + (1,),
         window_strides=(1,) + st + (1,),
         padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
-    out = jnp.where(jnp.isneginf(out), 0, out)
+    out = jnp.where(jnp.isneginf(out), 0, out)  # windows with no active site
     return to_sparse_coo(out, sparse_dim=out.ndim - 1)
 
 

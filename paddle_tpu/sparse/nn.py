@@ -96,7 +96,10 @@ class _SparseConvNd(Layer):
             initializer=init_w,
             default_initializer=_I.XavierUniform())
         if bias_attr is not False:
-            self.bias = self.create_parameter([out_channels], is_bias=True)
+            init_b = bias_attr if isinstance(bias_attr, _I.Initializer) \
+                else getattr(bias_attr, "initializer", None)
+            self.bias = self.create_parameter([out_channels], is_bias=True,
+                                              initializer=init_b)
         else:
             self.add_parameter("bias", None)
 

