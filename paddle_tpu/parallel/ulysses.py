@@ -52,6 +52,21 @@ def _local_attn(q, k, v, causal, scale, interpret):
                                   interpret=interpret)
 
 
+def gqa_expand_factor(h: int, h_kv: int, n: int) -> int:
+    """KV head repeat factor before the all-to-all. 1 when h_kv already
+    splits over the axis. MINIMAL expansion n/h_kv when h_kv | n: n | h
+    makes each device's q-head slice [i·h/n, (i+1)·h/n) lie inside ONE
+    original kv group (h/n divides h/h_kv ⟺ h_kv | n), and expanded kv
+    head i = original i·h_kv/n is precisely that group — Llama-70B
+    (h=64, h_kv=8) at sep=16 pays 2x KV bandwidth, not 8x. Ragged
+    remainders expand fully to h (correctness-grade)."""
+    if h_kv % n == 0:
+        return 1
+    if n % h_kv == 0:
+        return n // h_kv
+    return h // h_kv
+
+
 def ulysses_supported(h: int, h_kv: int, n: int) -> bool:
     """Query heads must split evenly over the sep axis, and KV heads must
     either split too or expand to h exactly (GQA group expansion)."""
@@ -81,19 +96,8 @@ def ulysses_attention(q, k, v, causal: bool = True, axis: str = "sep",
             f"ulysses_attention: need h % n == 0 and (h_kv % n == 0 or "
             f"h % h_kv == 0); got h={h}, h_kv={h_kv}, {axis}={n} — use "
             f"ring_attention instead")
-    if h_kv % n != 0:
-        if n % h_kv == 0:
-            # minimal GQA expansion: repeat KV heads only to n (the sep
-            # degree), a factor n/h_kv instead of the full h/h_kv. Exact
-            # because n | h makes each device's q-head slice [i·h/n,
-            # (i+1)·h/n) lie inside ONE original kv group (h/n divides
-            # h/h_kv ⟺ h_kv | n), and expanded kv head i = original
-            # i·h_kv/n is precisely that group. Llama-70B (h=64, h_kv=8)
-            # at sep=16: 2x KV bandwidth, not 8x.
-            r = n // h_kv
-        else:
-            # ragged case: full group expansion (correctness-grade)
-            r = h // h_kv
+    r = gqa_expand_factor(h, h_kv, n)
+    if r > 1:
         k = jnp.repeat(k, r, axis=2)
         v = jnp.repeat(v, r, axis=2)
     if interpret is None:

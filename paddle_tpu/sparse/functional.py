@@ -63,18 +63,28 @@ def softmax(x, axis: int = -1, name=None):
         new = ex / jnp.maximum(sm[row_of], 1e-30)
         return jsparse.BCSR((new, indices, indptr), shape=x.shape)
     if is_sparse_coo(x):
-        # COO-native: segment softmax over stored values by row id,
-        # preserving the COO format and pattern (no densification)
-        xc = x if getattr(x, "indices", None) is not None else x
-        data = xc.data
-        rows = xc.indices[..., 0] if xc.indices.ndim == 2 \
-            else xc.indices[0]
+        # COO-native: segment softmax over stored values per ROW,
+        # preserving the COO format and pattern (no densification).
+        # indices: [nnz, n_sparse]; 2D = (row, col), 3D = (batch, row, col)
+        data = x.data
+        idx = x.indices
+        n_sparse = idx.shape[-1]
         n_rows = x.shape[-2]
-        mx = jax.ops.segment_max(data, rows, num_segments=n_rows)
+        if n_sparse == 2:
+            rows = idx[:, 0]
+            n_seg = n_rows
+        elif n_sparse == 3:
+            rows = idx[:, 0] * n_rows + idx[:, 1]   # (batch, row) key
+            n_seg = x.shape[0] * n_rows
+        else:
+            raise ValueError(
+                f"sparse softmax supports 2D/3D COO, got {n_sparse} "
+                f"sparse dims")
+        mx = jax.ops.segment_max(data, rows, num_segments=n_seg)
         ex = jnp.exp(data - mx[rows])
-        sm = jax.ops.segment_sum(ex, rows, num_segments=n_rows)
+        sm = jax.ops.segment_sum(ex, rows, num_segments=n_seg)
         new = ex / jnp.maximum(sm[rows], 1e-30)
-        return jsparse.BCOO((new, xc.indices), shape=x.shape)
+        return jsparse.BCOO((new, idx), shape=x.shape)
     return jax.nn.softmax(jnp.asarray(x), axis=axis)
 
 

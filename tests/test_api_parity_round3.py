@@ -15,6 +15,8 @@ import torch
 
 import paddle_tpu as pt
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 RS = np.random.RandomState(11)
 
 

@@ -10,6 +10,8 @@ from paddle_tpu.models.diffusion import (
     DDPMScheduler, DDIMScheduler, FlowMatchEulerScheduler,
     ddim_sample, flow_sample, diffusion_train_loss, classifier_free_guidance)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def test_ddpm_forward_noising_snr():
     s = DDPMScheduler(num_train_timesteps=1000)

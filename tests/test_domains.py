@@ -9,6 +9,8 @@ import paddle_tpu as pt
 from paddle_tpu import fft, sparse, distribution as dist, text
 from paddle_tpu import vision
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 # ---------------------------------------------------------------------------
 # fft

@@ -13,6 +13,8 @@ from paddle_tpu.ops.attention import _sdpa_xla
 from paddle_tpu.ops.pallas.flash_attention import (flash_attention_pallas,
                                                    pallas_supported)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def make_qkv(b=1, sq=128, sk=128, h=2, h_kv=2, d=64, dtype=jnp.float32, seed=0):
     rs = np.random.RandomState(seed)

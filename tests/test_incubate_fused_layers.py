@@ -15,6 +15,8 @@ from paddle_tpu.incubate.nn import (FusedBiasDropoutResidualLayerNorm,
                                     FusedMultiTransformer,
                                     FusedTransformerEncoderLayer)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 D, H, FF = 32, 4, 64
 
 

@@ -12,6 +12,8 @@ from paddle_tpu.inference import (Config, Predictor, create_predictor,
                                   GenerationConfig, generate)
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def _tiny():
     pt.seed(0)

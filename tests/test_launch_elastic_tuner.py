@@ -14,6 +14,8 @@ from paddle_tpu.distributed.auto_tuner import (
     TunerConfig, AutoTuner, default_candidates, prune_by_memory,
     estimate_memory_gb, Recorder)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 # ---------------------------------------------------------------------------
 # launch

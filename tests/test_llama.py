@@ -13,6 +13,8 @@ from paddle_tpu.optimizer import AdamW, ClipGradByGlobalNorm
 from paddle_tpu.optimizer.lr import LinearWarmup
 from paddle_tpu.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def tiny_model():
     pt.seed(0)

@@ -14,6 +14,8 @@ from paddle_tpu.models import (MoEConfig, MoEForCausalLM, ErnieConfig,
                                OCRDetModel)
 from paddle_tpu.parallel import HybridMesh, shard_layer, shard_tensor
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def _lm_batch(vocab, b=2, s=17, seed=0):
     rs = np.random.RandomState(seed)

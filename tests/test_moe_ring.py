@@ -12,6 +12,8 @@ from paddle_tpu.parallel import HybridMesh, shard_layer, shard_tensor
 from paddle_tpu.parallel.moe import MoELayer, top_k_gating
 from paddle_tpu.parallel.ring_attention import ring_attention
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 # -- gating -----------------------------------------------------------------
 

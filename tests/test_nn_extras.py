@@ -14,6 +14,8 @@ import paddle_tpu as pt
 import paddle_tpu.nn.functional as F
 from paddle_tpu import nn
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 RS = np.random.RandomState(3)
 
 

@@ -8,6 +8,9 @@ import paddle_tpu as pt
 from paddle_tpu import nn
 from paddle_tpu.optimizer import AdamW
 from paddle_tpu.trainer import Trainer
+import pytest
+
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
 
 
 def _model():

@@ -17,6 +17,8 @@ import paddle_tpu as pt
 from paddle_tpu.nn import functional as F
 from paddle_tpu.testing import check_grad, check_output, check_sharded
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 rs = np.random.RandomState(1234)
 X24 = rs.randn(2, 4)
 X48 = rs.randn(4, 8)

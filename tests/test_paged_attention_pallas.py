@@ -12,6 +12,8 @@ import pytest
 from paddle_tpu.ops.pallas.paged_attention import (paged_decode_attention,
                                                    paged_decode_supported)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def _setup(B=2, H=4, H_kv=2, D=32, page_size=16, pages_per_seq=4,
            num_pages=16, seed=0):

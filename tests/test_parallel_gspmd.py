@@ -18,6 +18,8 @@ from paddle_tpu.parallel import (HybridMesh, shard_tensor, shard_layer, reshard,
                                  Shard, Replicate)
 from paddle_tpu.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def fake_batch(cfg, b=4, s=32, seed=0):
     rs = np.random.RandomState(seed)

@@ -20,6 +20,8 @@ from paddle_tpu.parallel import (HybridMesh, LayerDesc, SegmentLayers,
 from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                LlamaForCausalLMPipe)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 class Block(nn.Layer):
     def __init__(self, d):

@@ -15,6 +15,8 @@ import pytest
 from paddle_tpu.parallel.schedules import (interleaved_ticks, pipeline_1f1b,
                                            pipeline_interleaved)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def _mlp_stage(p, h):
     return jnp.tanh(h @ p["w"] + p["b"])

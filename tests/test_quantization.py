@@ -14,6 +14,8 @@ from paddle_tpu.quantization import (
     QuantConfig, QAT, PTQ, QuantedLinear, Int8Linear,
     quantize_linear, dequantize_linear, int8_matmul)
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def test_observers():
     obs = AbsmaxObserver()

@@ -13,6 +13,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import nn
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 def _np(x):
     return np.asarray(x)

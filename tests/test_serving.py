@@ -10,6 +10,8 @@ from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
 from paddle_tpu.inference.generation import generate_scan
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 PAGE = 8
 
 

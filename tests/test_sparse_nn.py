@@ -207,3 +207,24 @@ class TestSparseGrad:
 
         g = jax.grad(loss)(w)
         assert np.isfinite(np.asarray(g)).all() and np.any(np.asarray(g))
+
+
+class TestBatchedCooSoftmax:
+    def test_3d_coo_softmax_per_row(self):
+        # one nonzero per row: every softmaxed value must be exactly 1.0
+        # (regression: batch-index grouping normalized rows together)
+        x = np.zeros((2, 3, 3), np.float32)
+        for b in range(2):
+            for r in range(3):
+                x[b, r, (b + r) % 3] = float(b + r + 1)
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=3)
+        out = np.asarray(sp.to_dense(SF.softmax(xs)))
+        nz = x != 0
+        np.testing.assert_allclose(out[nz], 1.0, rtol=1e-6)
+
+    def test_too_many_sparse_dims_raise(self):
+        x = np.zeros((2, 2, 3, 3), np.float32)
+        x[0, 0, 0, 0] = 1.0
+        xs = sp.to_sparse_coo(jnp.asarray(x), sparse_dim=4)
+        with pytest.raises(ValueError, match="sparse dims"):
+            SF.softmax(xs)

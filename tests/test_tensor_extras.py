@@ -9,6 +9,8 @@ import pytest
 
 import paddle_tpu as pt
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 
 RS = np.random.RandomState(7)
 
