@@ -1,0 +1,6 @@
+"""fleet.meta_optimizers package path (reference:
+fleet/meta_optimizers/ — the dygraph wrappers recipes import)."""
+from .dygraph_optimizer import (DygraphShardingOptimizer,
+                                HybridParallelOptimizer)
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]

@@ -9,8 +9,12 @@ from ....parallel.mp_layers import (ColumnParallelLinear,
                                     VocabParallelEmbedding)
 from ....parallel.pipeline import (LayerDesc, PipelineLayer, SegmentLayers,
                                    SharedLayerDesc)
+from .wrappers import (MetaParallelBase, PipelineParallel, ShardingParallel,
+                       TensorParallel)
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy",
            "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
-           "PipelineLayer", "LayerDesc", "SharedLayerDesc", "SegmentLayers"]
+           "PipelineLayer", "LayerDesc", "SharedLayerDesc", "SegmentLayers",
+           "MetaParallelBase", "PipelineParallel", "TensorParallel",
+           "ShardingParallel"]

