@@ -93,13 +93,28 @@ class DygraphShardingOptimizer(_DelegatingOptimizer):
         hm = current_mesh()
         if hm is None:
             return
-        try:
-            from paddle_tpu.parallel.api import _clean_spec, shard_optimizer_state
-            specs = {k: _clean_spec(p.sharding, hm.mesh)
-                     for k, p in opt._bound_params.items()}
-            opt._state = shard_optimizer_state(state, specs)
-        except Exception:
-            pass  # unsharded state remains correct, only less memory-even
+        from paddle_tpu.parallel.api import (_clean_spec,
+                                             shard_optimizer_state)
+        from jax.sharding import PartitionSpec as P
+        fsdp = hm.mesh.shape.get("fsdp", 1) if "fsdp" in \
+            hm.mesh.axis_names else 1
+        specs = {}
+        for k, p in opt._bound_params.items():
+            spec = _clean_spec(p.sharding, hm.mesh)
+            if fsdp > 1 and all(e is None for e in spec):
+                # ZeRO-1 proper: even a REPLICATED param's optimizer state
+                # is partitioned across the sharding group — split the
+                # first fsdp-divisible dim (reference shards by rank
+                # ownership; this is the mesh-native equivalent)
+                shape = tuple(p.value.shape)
+                for dim, size in enumerate(shape):
+                    if size % fsdp == 0 and size >= fsdp:
+                        entries = [None] * len(shape)
+                        entries[dim] = "fsdp"
+                        spec = P(*entries)
+                        break
+            specs[k] = spec
+        opt._state = shard_optimizer_state(state, specs)
 
     def reduce_gradients(self, parameter_list=None, hcg=None):
         """No-op by design: gradient reduction is emitted by GSPMD at the
