@@ -82,7 +82,26 @@ class DygraphShardingOptimizer(_DelegatingOptimizer):
     def step(self, grads=None):
         out = self._inner_opt.step(grads)
         self._shard_state()
+        self._restore_param_placement()
         return out
+
+    def _restore_param_placement(self):
+        """The broadcast-after-step equivalent: the sharded-state update
+        arithmetic leaves new param VALUES fsdp-sharded; re-place them per
+        their own annotations (replicated when unannotated) so forwards
+        keep the ZeRO-1 profile — sharded state, gathered params
+        (reference: dygraph_sharding_optimizer's post-step broadcast)."""
+        from paddle_tpu.parallel.mesh import current_mesh
+        hm = current_mesh()
+        if hm is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        from paddle_tpu.parallel.api import _clean_spec
+        for k, p in self._inner_opt._bound_params.items():
+            spec = _clean_spec(p.sharding, hm.mesh)
+            p.value = jax.device_put(p.value,
+                                     NamedSharding(hm.mesh, spec))
 
     def _shard_state(self):
         opt = self._inner_opt
@@ -96,8 +115,7 @@ class DygraphShardingOptimizer(_DelegatingOptimizer):
         from paddle_tpu.parallel.api import (_clean_spec,
                                              shard_optimizer_state)
         from jax.sharding import PartitionSpec as P
-        fsdp = hm.mesh.shape.get("fsdp", 1) if "fsdp" in \
-            hm.mesh.axis_names else 1
+        fsdp = hm.mesh.shape.get("fsdp", 1)
         specs = {}
         for k, p in opt._bound_params.items():
             spec = _clean_spec(p.sharding, hm.mesh)

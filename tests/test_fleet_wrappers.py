@@ -144,3 +144,34 @@ class TestOptimizerWrappers:
                     e is not None and "fsdp" in str(e) for e in spec), (
                     f"slot not fsdp-sharded: {v.sharding}")
         assert opt.reduce_gradients() is None
+
+
+def test_sharding_optimizer_params_stay_replicated():
+    """ZeRO-1 contract: state sharded, params re-gathered after each step
+    (regression: sharded-state arithmetic leaked fsdp sharding into the
+    param values from step 2 on)."""
+    from paddle_tpu.parallel import HybridMesh
+    from jax.sharding import PartitionSpec as P
+    pt.seed(0)
+    m = _Block()
+    with HybridMesh.build(fsdp=8):
+        from paddle_tpu.parallel.api import shard_layer
+        shard_layer(m)   # _Block params unannotated -> replicated
+        opt = DygraphShardingOptimizer(
+            AdamW(learning_rate=0.05, parameters=m))
+        x = jnp.ones((2, 16))
+        y = jnp.zeros((2, 16))
+        for _ in range(3):
+            _, g = jax.value_and_grad(
+                lambda p: _mse(m.functional_call(p, x), y))(
+                dict(m.raw_parameters()))
+            opt.step(dict(g))
+        for name, p in m.named_parameters():
+            spec = getattr(p.value.sharding, "spec", None)
+            assert spec is not None and all(e is None for e in spec), (
+                f"param {name} lost replication: {p.value.sharding}")
+        # while the STATE stays sharded
+        slots = opt.inner_opt._state["slots"]["lin.weight"]
+        for v in slots.values():
+            assert any("fsdp" in str(e)
+                       for e in v.sharding.spec if e is not None)
