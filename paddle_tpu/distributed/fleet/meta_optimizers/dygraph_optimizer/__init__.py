@@ -54,8 +54,11 @@ class _DelegatingOptimizer:
             raise ValueError(
                 "TPU optimizers take explicit grads: wrapper.minimize("
                 "grads=...) or wrapper.step(grads)")
-        self._inner_opt.step(grads)
+        self.step(grads)   # through the subclass hooks (ZeRO-1 etc.)
         return None, None
+
+    def step(self, grads=None):
+        return self._inner_opt.step(grads)
 
 
 class HybridParallelOptimizer(_DelegatingOptimizer):
@@ -93,8 +96,8 @@ class DygraphShardingOptimizer(_DelegatingOptimizer):
         (reference: dygraph_sharding_optimizer's post-step broadcast)."""
         from paddle_tpu.parallel.mesh import current_mesh
         hm = current_mesh()
-        if hm is None:
-            return
+        if hm is None or hm.mesh.shape.get("fsdp", 1) == 1:
+            return   # no ZeRO axis -> placement cannot drift; skip the loop
         import jax
         from jax.sharding import NamedSharding
         from paddle_tpu.parallel.api import _clean_spec

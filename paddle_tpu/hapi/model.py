@@ -42,6 +42,12 @@ class Model:
 
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
+        # the reference accepts a single InputSpec or a list of them
+        # (hapi/model.py Model.__init__ wraps with to_list)
+        if inputs is not None and not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if labels is not None and not isinstance(labels, (list, tuple)):
+            labels = [labels]
         self._input_specs = inputs
         self._label_specs = labels
         self._n_labels = len(labels) if labels is not None else 1

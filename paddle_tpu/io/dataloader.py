@@ -338,6 +338,12 @@ class DataLoader:
                     p.terminate()
             ring.destroy()
 
+    def __call__(self):
+        """Legacy idiom parity: ``for batch in loader():`` — the reference
+        DataLoader is callable and returns its iterator
+        (python/paddle/io/reader.py doctest usage)."""
+        return iter(self)
+
     def __iter__(self):
         host = self._iter_batches_host()
         if not self.prefetch_to_device:

@@ -132,3 +132,46 @@ def test_standalone_summary(capsys):
     assert "Linear" in out and "Total params" in out
     assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
     assert info["trainable_params"] == info["total_params"]
+
+
+def test_model_accepts_single_input_spec():
+    """Reference hapi Model wraps a bare InputSpec with to_list — the
+    canonical Model.fit doctest passes single specs (model.py:1093)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Flatten(1), nn.Linear(16, 4))
+    model = pt.Model(net, InputSpec([None, 16], "float32", "x"),
+                     InputSpec([None, 1], "int64", "label"))
+    opt = pt.optimizer.SGD(learning_rate=1e-2, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), pt.metric.Accuracy())
+
+    class Synth(pt.io.Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            rs = np.random.RandomState(i)
+            return (rs.normal(0, 1, (16,)).astype("float32"),
+                    np.array([i % 4], "int64"))
+
+    model.fit(Synth(), epochs=1, batch_size=8, verbose=0)
+
+
+def test_dataloader_callable_legacy_idiom():
+    import numpy as np
+    import paddle_tpu as pt
+
+    class DS(pt.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    loader = pt.io.DataLoader(DS(), batch_size=4)
+    seen = [np.asarray(b) for b in loader()]   # for b in loader(): ...
+    assert len(seen) == 2
