@@ -26,6 +26,11 @@ from . import tensor
 
 # paddle-style: every tensor function is also a top-level symbol
 from .tensor import *  # noqa: F401,F403
+
+# paddle-style Tensor METHODS on the runtime array type (x.numpy(),
+# x.cast(...), x.unsqueeze(...), clear backward() migration error, ...)
+from .tensor import methods as _tensor_methods
+_tensor_methods.install()
 from .tensor import Tensor
 
 from .nn.layer import set_default_dtype, get_default_dtype

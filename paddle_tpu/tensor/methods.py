@@ -1,0 +1,184 @@
+"""Paddle Tensor METHOD surface on jax arrays.
+
+Reference: python/paddle/tensor/__init__.py installs several hundred
+methods onto the Tensor class (monkey_patch_tensor / tensor_method_func).
+Here the runtime array type is jax's ArrayImpl; this module installs the
+paddle method spellings DIRECTLY on that type at import, so reference
+code written against Tensor methods (``x.numpy()``, ``x.cast('float32')``,
+``x.unsqueeze(0)``, ``x.add(y)``, doctest idioms throughout the reference)
+runs verbatim.
+
+Rules, in order of importance:
+- NEVER shadow an attribute jax already defines (numpy-style .reshape,
+  .astype, .sum, ... keep jax semantics); install only missing names.
+- methods delegate to the SAME functions the namespace exposes
+  (paddle_tpu.tensor / jnp), so method and function forms cannot diverge.
+- tape-era mutators raise the documented migration error
+  (``backward``; see autograd/__init__.py) instead of silently no-opping;
+  ``stop_gradient`` is an accepted-but-inert property (functional
+  autograd takes grads explicitly, there is no tape to stop).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _migration_error(self, *a, **k):
+    raise RuntimeError(
+        "Tensor.backward() needs an eager autograd tape, which this "
+        "framework does not keep (functional autograd). Migrate:\n"
+        "    loss, grads = paddle.autograd.layer_grad(layer, loss_fn, x)\n"
+        "or  grads = jax.grad(loss_fn)(params)\n"
+        "then optimizer.step(grads). See autograd/__init__.py.")
+
+
+def _methods():
+    import paddle_tpu.tensor as T          # fully loaded before install()
+    from ..core.dtype import convert_dtype
+
+    def cast(self, dtype):
+        return self.astype(convert_dtype(dtype))
+
+    def numpy(self):
+        return np.asarray(self)
+
+    def detach(self):
+        return jax.lax.stop_gradient(self)
+
+    def unsqueeze(self, axis):
+        return T.unsqueeze(self, axis)
+
+    def t(self):
+        if self.ndim > 2:
+            raise ValueError(f"t() expects <=2 dims, got {self.ndim}")
+        return self if self.ndim < 2 else jnp.swapaxes(self, 0, 1)
+
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return jnp.asarray(self.size)
+
+    def add(self, y):                 # paddle method spellings of binary
+        return jnp.add(self, y)       # ops (x.add(y) etc.)
+
+    def subtract(self, y):
+        return jnp.subtract(self, y)
+
+    def multiply(self, y):
+        return jnp.multiply(self, y)
+
+    def divide(self, y):
+        return jnp.divide(self, y)
+
+    def matmul(self, y, transpose_x=False, transpose_y=False):
+        from ..linalg import matmul as _mm
+        return _mm(self, y, transpose_x, transpose_y)
+
+    def pow(self, y):
+        return jnp.power(self, y)
+
+    def exp(self):
+        return jnp.exp(self)
+
+    def log(self):
+        return jnp.log(self)
+
+    def sqrt(self):
+        return jnp.sqrt(self)
+
+    def rsqrt(self):
+        return jax.lax.rsqrt(self)
+
+    def tanh(self):
+        return jnp.tanh(self)
+
+    def sigmoid(self):
+        return jax.nn.sigmoid(self)
+
+    def abs(self):
+        return jnp.abs(self)
+
+    def floor(self):
+        return jnp.floor(self)
+
+    def ceil(self):
+        return jnp.ceil(self)
+
+    def cpu(self):
+        return jax.device_put(self, jax.devices("cpu")[0]) \
+            if jax.default_backend() != "cpu" else self
+
+    def cuda(self, *a, **k):          # "to accelerator": already there
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def clone(self):
+        return jnp.array(self, copy=True)
+
+    def norm(self, p=2, axis=None, keepdim=False):
+        return T.norm(self, p=p, axis=axis, keepdim=keepdim)
+
+    def scale(self, scale=1.0, bias=0.0, bias_after_scale=True):
+        return T.scale(self, scale=scale, bias=bias,
+                       bias_after_scale=bias_after_scale)
+
+    def equal_all(self, y):
+        return T.equal_all(self, y)
+
+    def allclose(self, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+        return T.allclose(self, y, rtol=rtol, atol=atol,
+                          equal_nan=equal_nan)
+
+    out = dict(locals())
+    out.pop("convert_dtype")
+    out.pop("T")
+    out["backward"] = _migration_error
+    return out
+
+
+def install():
+    """Install missing method names on the runtime array type AND the
+    tracer base (so methods work inside jit/grad traces too). Idempotent;
+    existing jax attributes are never overridden.
+
+    MUST NOT trigger backend init (no computations!): multi-host workers
+    import paddle_tpu BEFORE jax.distributed.initialize, and any array
+    creation here would pin a single-process backend."""
+    try:
+        from jax._src.array import ArrayImpl as _ArrayImpl
+    except ImportError:  # pragma: no cover - jax layout change
+        import jaxlib
+        _ArrayImpl = jaxlib._jax.ArrayImpl
+    targets = [_ArrayImpl, jax.core.Tracer]
+    installed = []
+    for t in targets:
+        for name, fn in _methods().items():
+            if hasattr(t, name):
+                continue             # never shadow jax semantics
+            try:
+                setattr(t, name, fn)
+                installed.append(f"{t.__name__}.{name}")
+            except (AttributeError, TypeError):
+                break                # immutable type: degrade silently
+        if not hasattr(t, "stop_gradient"):
+            def _get(self):
+                return True          # no tape: nothing flows implicitly
+
+            def _set(self, value):
+                pass                 # accepted and inert (functional AD)
+            try:
+                t.stop_gradient = property(_get, _set)
+                installed.append(f"{t.__name__}.stop_gradient")
+            except (AttributeError, TypeError):
+                pass
+    return installed
+
+
+__all__ = ["install"]

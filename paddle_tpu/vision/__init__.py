@@ -7,6 +7,14 @@ fast path), datasets (local-file readers + hermetic fake data), models
 
 from . import ops
 from . import transforms
+
+# reference layout is a PACKAGE (vision/transforms/{transforms,functional});
+# ours is one module carrying both the classes and the functional surface.
+# Register the functional submodule path so the reference import idiom
+# `import paddle.vision.transforms.functional as F` works verbatim.
+import sys as _sys
+transforms.functional = transforms
+_sys.modules[__name__ + ".transforms.functional"] = transforms
 from . import datasets
 from . import models
 from .models import (LeNet, VGG, vgg11, vgg13, vgg16, vgg19, MobileNetV1,

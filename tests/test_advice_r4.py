@@ -1,6 +1,7 @@
 """Regression tests for the round-3 advisor findings (ADVICE.md)."""
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 RS = np.random.RandomState(0)
 
@@ -85,3 +86,59 @@ class TestStrategyNestedConfig:
         s2 = Strategy(config={"pipeline": {"accumulate_steps": 4}})
         assert s2.pipeline.accumulate_steps == 4
         assert s2.pipeline.schedule_mode == "1F1B"
+
+
+class TestReferenceImportIdioms:
+    def test_vision_transforms_functional_path(self):
+        # reference doctests do `import paddle.vision.transforms.functional`
+        import importlib
+        import paddle_tpu
+        m = importlib.import_module("paddle_tpu.vision.transforms.functional")
+        assert hasattr(m, "to_tensor") and hasattr(m, "normalize")
+        from paddle_tpu.vision import transforms as T
+        assert T.functional is m
+
+
+class TestTensorMethods:
+    def test_paddle_method_surface(self):
+        import jax.numpy as jnp
+        x = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
+        assert x.numpy().shape == (2, 2)
+        assert str(x.cast("int32").dtype) == "int32"
+        assert x.unsqueeze(0).shape == (1, 2, 2)
+        assert x.t().shape == (2, 2)
+        assert float(x.add(1.0)[0, 0]) == 2.0
+        assert x.stop_gradient is True
+        x.stop_gradient = False        # accepted, inert
+
+    def test_backward_raises_migration_error(self):
+        import jax.numpy as jnp
+        with pytest.raises(RuntimeError, match="layer_grad"):
+            jnp.asarray([1.0]).backward()
+
+    def test_jax_semantics_not_shadowed(self):
+        import jax.numpy as jnp
+        x = jnp.arange(4.0)
+        assert x.reshape(2, 2).shape == (2, 2)   # numpy-style kept
+        assert float(x.sum()) == 6.0
+
+    def test_methods_on_tracers(self):
+        import jax, jax.numpy as jnp
+        out = jax.jit(lambda a: a.unsqueeze(0).sigmoid())(jnp.zeros((3,)))
+        assert out.shape == (1, 3)
+
+    def test_import_does_not_initialize_backend(self):
+        # multi-host workers import paddle_tpu BEFORE
+        # jax.distributed.initialize — the import must not touch XLA
+        import subprocess, sys
+        code = (
+            "import os; os.environ['JAX_PLATFORMS']='cpu';"
+            "import paddle_tpu;"
+            "from jax._src import xla_bridge;"
+            "assert not xla_bridge._backends, xla_bridge._backends;"
+            "print('CLEAN')")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           env={k: v for k, v in __import__('os').environ.items()
+                                if k != "PALLAS_AXON_POOL_IPS"})
+        assert "CLEAN" in r.stdout, r.stderr[-500:]

@@ -13,6 +13,8 @@ import pytest
 import paddle_tpu.sparse as sp
 from paddle_tpu.sparse import functional as SF
 
+pytestmark = pytest.mark.slow  # full-matrix tier; default run stays <5min
+
 RS = np.random.RandomState(0)
 
 
