@@ -136,11 +136,59 @@ def _methods():
         return T.allclose(self, y, rtol=rtol, atol=atol,
                           equal_nan=equal_nan)
 
-    out = dict(locals())
-    out.pop("convert_dtype")
-    out.pop("T")
+    # second batch: structural/selection methods, thin delegations to the
+    # namespace functions (paddle code uses the method spellings heavily)
+    def topk(self, k, axis=-1, largest=True, sorted=True):
+        return T.topk(self, k, axis=axis, largest=largest, sorted=sorted)
+
+    def tile(self, repeat_times):
+        return T.tile(self, repeat_times)
+
+    def expand(self, shape):
+        return T.expand(self, shape)
+
+    def gather(self, index, axis=0):
+        return T.gather(self, index, axis=axis)
+
+    def index_select(self, index, axis=0):
+        return T.index_select(self, index, axis=axis)
+
+    def masked_fill(self, mask, value):
+        return T.masked_fill(self, mask, value)
+
+    def flip(self, axis):
+        return T.flip(self, axis)
+
+    def roll(self, shifts, axis=None):
+        return T.roll(self, shifts, axis=axis)
+
+    def split(self, num_or_sections, axis=0):
+        return T.split(self, num_or_sections, axis=axis)
+
+    def chunk(self, chunks, axis=0):
+        return T.chunk(self, chunks, axis=axis)
+
+    def bmm(self, y):
+        return T.bmm(self, y)
+
+    def unbind(self, axis=0):
+        return T.unbind(self, axis=axis)
+
+    def softmax(self, axis=-1):
+        return jax.nn.softmax(self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return jax.nn.log_softmax(self, axis=axis)
+
+    # harvest ONLY the methods defined in this scope — imported helpers
+    # and future locals must never leak onto the array types
+    out = {k: v for k, v in locals().items()
+           if getattr(v, "__qualname__", "").startswith("_methods.")}
     out["backward"] = _migration_error
     return out
+
+
+_WARNED: dict = {}
 
 
 def install():
@@ -158,8 +206,9 @@ def install():
         _ArrayImpl = jaxlib._jax.ArrayImpl
     targets = [_ArrayImpl, jax.core.Tracer]
     installed = []
+    methods = _methods()
     for t in targets:
-        for name, fn in _methods().items():
+        for name, fn in methods.items():
             if hasattr(t, name):
                 continue             # never shadow jax semantics
             try:
@@ -172,7 +221,19 @@ def install():
                 return True          # no tape: nothing flows implicitly
 
             def _set(self, value):
-                pass                 # accepted and inert (functional AD)
+                # =True is the harmless common case (matches reality);
+                # =False signals the user expects implicit tracking —
+                # warn ONCE with the migration pointer (the loud error
+                # comes from paddle.grad/backward themselves)
+                if value is False and not _WARNED.get("sg"):
+                    _WARNED["sg"] = True
+                    import warnings
+                    warnings.warn(
+                        "x.stop_gradient = False has no effect: this "
+                        "framework uses functional autograd (jax.grad / "
+                        "paddle.autograd.layer_grad take grads "
+                        "explicitly); there is no tape to enable.",
+                        stacklevel=2)
             try:
                 t.stop_gradient = property(_get, _set)
                 installed.append(f"{t.__name__}.stop_gradient")

@@ -142,3 +142,18 @@ class TestTensorMethods:
                            env={k: v for k, v in __import__('os').environ.items()
                                 if k != "PALLAS_AXON_POOL_IPS"})
         assert "CLEAN" in r.stdout, r.stderr[-500:]
+
+    def test_method_batch2_selection_structural(self):
+        import jax, jax.numpy as jnp
+        x = jnp.asarray([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]])
+        v, i = x.topk(2)
+        np.testing.assert_array_equal(np.asarray(i), [[0, 2], [0, 1]])
+        assert x.tile([2, 1]).shape == (4, 3)
+        assert x.expand([2, 2, 3]).shape == (2, 2, 3)
+        assert x.gather(jnp.asarray([1]), axis=0).shape == (1, 3)
+        assert float(x.masked_fill(x > 4, 0.0).max()) <= 4.0
+        assert len(x.unbind(0)) == 2
+        np.testing.assert_allclose(np.asarray(x.softmax(-1).sum(-1)), 1.0,
+                                   rtol=1e-6)
+        out = jax.jit(lambda a: a.index_select(jnp.asarray([0]), 1))(x)
+        assert out.shape == (2, 1)
