@@ -298,3 +298,7 @@ def check_shape(shape):
             if not isinstance(s, (int, np.integer)) and s is not None:
                 raise TypeError(f"shape entries must be int, got {type(s)}")
     return True
+
+
+# doctests use paddle.base.set_flags/get_flags (reference: base/framework.py)
+from .core.flags import get_flags, set_flags  # noqa: E402,F401

@@ -84,6 +84,9 @@ def flag(name: str) -> Any:
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf during training "
             "(reference: FLAGS_check_nan_inf, paddle/phi/core/flags.cc:74).")
 define_flag("check_nan_inf_level", 0, "0: fail on NaN/Inf; higher levels only log.")
+define_flag("use_stride_kernel", False, "Accepted for reference parity and "
+            "inert: XLA owns layout/views on TPU, there are no stride "
+            "kernels to toggle (reference: as_strided/view doctests).")
 define_flag("benchmark", False, "Block-until-ready around steps for timing.")
 define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for hot ops when "
             "on TPU; fall back to XLA compositions otherwise.")

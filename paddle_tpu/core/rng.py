@@ -48,14 +48,41 @@ class RNGStatesTracker:
     def has(self, name: str) -> bool:
         return name in self._keys
 
+    @staticmethod
+    def _tracing() -> bool:
+        try:
+            from jax._src import core as _jcore   # jax 0.9: private only
+            return not _jcore.trace_state_clean()
+        except (ImportError, AttributeError):  # pragma: no cover
+            # unknown jax layout: assume tracing, which keeps the SAFE
+            # behavior (loud unseeded error instead of a tracer leak)
+            return True
+
     def next_key(self, name: str = GLOBAL_STREAM) -> jax.Array:
         """Draw the next sub-key from stream ``name`` (deterministic sequence)."""
-        if name not in self._keys:
-            raise RuntimeError(
-                f"RNG stream '{name}' not seeded. Call paddle_tpu.seed(...) or "
-                f"rng_tracker().add('{name}', seed) first, or run inside "
-                f"rng_tracker().scope(key).")
         with self._lock:
+            if name not in self._keys:
+                if name == GLOBAL_STREAM and not self._tracing():
+                    # reference parity: paddle's global generator works
+                    # without an explicit paddle.seed() (random seed).
+                    # Auto-seed from entropy with a ONE-TIME warning —
+                    # EAGER only: inside a trace, key creation would store
+                    # a tracer (frozen randomness + leaked-tracer crashes),
+                    # so traced unseeded use keeps the loud error.
+                    import time
+                    import warnings
+                    warnings.warn(
+                        "global RNG stream auto-seeded from entropy; call "
+                        "paddle.seed(<int>) for reproducible randomness",
+                        stacklevel=3)
+                    self._keys[name] = jax.random.key(
+                        int(time.time_ns()) & 0x7FFFFFFF)
+                    self._counters[name] = 0
+                else:
+                    raise RuntimeError(
+                        f"RNG stream '{name}' not seeded. Call "
+                        f"paddle_tpu.seed(...) or rng_tracker().add('{name}', "
+                        f"seed) first, or run inside rng_tracker().scope(key).")
             c = self._counters[name]
             self._counters[name] = c + 1
         return jax.random.fold_in(self._keys[name], c)

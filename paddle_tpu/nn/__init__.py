@@ -52,3 +52,5 @@ from . import utils
 from . import clip
 from . import decode
 from . import quant
+
+from . import loss  # noqa: E402  (doctest path paddle.nn.loss)

@@ -85,7 +85,10 @@ def softmax(x, axis: int = -1):
     return jax.nn.softmax(x, axis=axis)
 
 
-def log_softmax(x, axis: int = -1):
+def log_softmax(x, axis: int = -1, dtype=None, name=None):
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        x = jnp.asarray(x).astype(convert_dtype(dtype))
     return jax.nn.log_softmax(x, axis=axis)
 
 

@@ -375,8 +375,11 @@ class SpectralNorm(Layer):
     phi/kernels/impl/spectral_norm_kernel_impl.h). Returns W / sigma(W).
     u/v vectors are persistent buffers updated on each forward."""
 
-    def __init__(self, weight_shape, axis: int = 0, power_iters: int = 1,
-                 epsilon: float = 1e-12, dtype="float32"):
+    def __init__(self, weight_shape, dim: int = None, power_iters: int = 1,
+                 epsilon: float = 1e-12, dtype="float32", axis: int = 0):
+        # reference spells the axis arg ``dim`` (nn/layer/norm.py:1900)
+        if dim is not None:
+            axis = dim
         super().__init__()
         self._axis = axis
         self._power_iters = power_iters

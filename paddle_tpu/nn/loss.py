@@ -1,0 +1,19 @@
+"""paddle.nn.loss module path (reference: nn/layer/loss.py is also
+importable as paddle.nn.loss in doctests) — re-export the loss layers."""
+
+from .layers_extras import *  # noqa: F401,F403
+from . import layers_extras as _le
+
+# pull every *Loss class exposed anywhere on paddle_tpu.nn
+def _collect():
+    import paddle_tpu.nn as _nn
+    out = {}
+    for name in dir(_nn):
+        if name.endswith("Loss") or name in ("CrossEntropyLoss", "MSELoss",
+                                             "L1Loss", "NLLLoss", "BCELoss",
+                                             "KLDivLoss", "SmoothL1Loss"):
+            out[name] = getattr(_nn, name)
+    return out
+
+globals().update(_collect())
+del _collect

@@ -134,6 +134,9 @@ def data(name: str, shape: Sequence[Optional[int]], dtype="float32") -> _LazyVar
     prog._feed_specs[name] = InputSpec(shape, dtype, name)
     var = _LazyVar(prog, lambda env: env[name], name)
     var._feed_name = name  # autodiff needs the raw feed key, not the
+    # reference Variables expose declared shape/dtype; None dims stay None
+    var.shape = tuple(shape)
+    var.dtype = dtype
     return var             # uniquified display name
 
 

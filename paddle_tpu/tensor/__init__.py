@@ -35,15 +35,15 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
     return arr
 
 
-def zeros(shape, dtype="float32"):
+def zeros(shape, dtype="float32", name=None):
     return jnp.zeros(shape, _dt.convert_dtype(dtype))
 
 
-def ones(shape, dtype="float32"):
+def ones(shape, dtype="float32", name=None):
     return jnp.ones(shape, _dt.convert_dtype(dtype))
 
 
-def full(shape, fill_value, dtype="float32"):
+def full(shape, fill_value, dtype="float32", name=None):
     return jnp.full(shape, fill_value, _dt.convert_dtype(dtype))
 
 
@@ -108,10 +108,10 @@ def assign(x, output=None):
 # -- random (reference: tensor/random.py; draws from the global RNG tracker) -
 
 def _key():
-    tr = rng_tracker()
-    if not tr.has(GLOBAL_STREAM):
-        tr.add(GLOBAL_STREAM, 0)
-    return tr.next_key()
+    # unseeded handling lives in next_key: eager auto-seed (entropy, warn
+    # once) / loud error under tracing — seeding HERE with a constant
+    # would store a tracer when first touched inside jit (leak)
+    return rng_tracker().next_key()
 
 
 def rand(shape, dtype="float32"):
@@ -620,6 +620,21 @@ def slice(x, axes, starts, ends):
 
 
 def strided_slice(x, axes, starts, ends, strides):
+    def _int_list(v):
+        """starts/ends/strides arrive as lists of ints OR (0-d/1-elem)
+        tensors (the reference passes Tensors); coerce concretes to ints."""
+        items = v if isinstance(v, (list, tuple)) else np.asarray(v).tolist()
+        if not isinstance(items, (list, tuple)):
+            items = [items]
+        out = []
+        for e in items:
+            try:
+                out.append(int(np.asarray(e).reshape(())))
+            except Exception:
+                out.append(e)
+        return out
+    starts, ends, strides = (_int_list(starts), _int_list(ends),
+                             _int_list(strides))
     idx = [builtins.slice(None)] * x.ndim
     for ax, s, e, st in zip(axes, starts, ends, strides):
         idx[ax] = builtins.slice(s, e, st)
@@ -739,7 +754,13 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
-    h, edges = jnp.histogramdd(jnp.asarray(x), bins=bins, range=ranges,
+    arr = jnp.asarray(x)
+    if ranges is not None and len(ranges) and not isinstance(
+            ranges[0], (list, tuple)):
+        # reference passes a FLAT [lo0, hi0, lo1, hi1, ...] list
+        ranges = [(ranges[2 * i], ranges[2 * i + 1])
+                  for i in range(len(ranges) // 2)]
+    h, edges = jnp.histogramdd(arr, bins=bins, range=ranges,
                                density=density, weights=weights)
     return h, edges
 
@@ -1010,3 +1031,37 @@ def fmin(x, y, name=None):
 # -- long-tail surface (extras) + inplace-spelled aliases --------------------
 from .extras import *          # noqa: F401,F403,E402
 from .inplace import *         # noqa: F401,F403,E402
+
+
+# -- legacy tensor-array + var factory (reference: tensor/array.py,
+#    tensor/creation.py create_tensor) --------------------------------------
+from . import array  # noqa: E402
+from . import random  # noqa: E402
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Reference: creation.py create_tensor — an empty typed variable."""
+    return jnp.zeros((0,), _dt.convert_dtype(dtype))
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Reference: manipulation.py tensor_array_to_tensor — fuse a
+    TensorArray into one tensor (+ per-element sizes)."""
+    elems = list(input)
+    if not elems:
+        raise ValueError("empty tensor array")
+    if use_stack:
+        out = jnp.stack(elems, axis=axis)
+        sizes = jnp.asarray([1] * len(elems), jnp.int32)
+    else:
+        out = jnp.concatenate(elems, axis=axis)
+        sizes = jnp.asarray([e.shape[axis] for e in elems], jnp.int32)
+    return out, sizes
+
+
+from . import manipulation  # noqa: E402  (after tensor_array_to_tensor)
+
+for _n in ("array", "random", "manipulation", "create_tensor",
+           "tensor_array_to_tensor"):
+    if "__all__" in globals() and _n not in __all__:
+        __all__.append(_n)

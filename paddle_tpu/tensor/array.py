@@ -1,0 +1,46 @@
+"""LoD TensorArray surface (reference: python/paddle/tensor/array.py —
+create_array/array_write/array_read/array_length over the legacy
+LOD_TENSOR_ARRAY variable).
+
+TPU design: a TensorArray is host-side program STRUCTURE, not device
+data — a Python list of arrays fills the contract exactly (the reference
+dygraph mode does the same: array_write appends to a Python list).
+Static-graph LoD semantics (per-level lengths) are a PS-era non-goal.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference: tensor/array.py create_array."""
+    out = list(initialized_list) if initialized_list is not None else []
+    return out
+
+
+def array_write(x, i, array=None):
+    """Write x at index i (reference array_write; appends when i == len)."""
+    idx = int(i) if not hasattr(i, "shape") else int(jnp.reshape(i, ()))
+    if array is None:
+        array = []
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {idx} beyond array length {len(array)}")
+    return array
+
+
+def array_read(array, i):
+    idx = int(i) if not hasattr(i, "shape") else int(jnp.reshape(i, ()))
+    return array[idx]
+
+
+def array_length(array):
+    return len(array)
+
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]

@@ -199,9 +199,13 @@ def scatter_nd(index, updates, shape, name=None):
     return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)
 
 
-def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+def shard_index(input=None, index_num=None, nshards=None, shard_id=None,
+                ignore_value=-1, x=None):
     """Relabel global ids into a shard-local range (reference:
-    tensor/manipulation.py shard_index; used by dist embedding)."""
+    tensor/manipulation.py shard_index; used by dist embedding).
+    First arg is named ``input`` like the reference; ``x`` kept for
+    callers of the old spelling."""
+    x = input if input is not None else x
     arr = jnp.asarray(x)
     shard_size = (index_num + nshards - 1) // nshards
     lo = shard_id * shard_size
@@ -377,7 +381,9 @@ def polar(abs, angle, name=None):
 
 def complex(real, imag, name=None):
     r = jnp.asarray(real)
-    return jax.lax.complex(r, jnp.asarray(imag, r.dtype))
+    i = jnp.asarray(imag, r.dtype)
+    r, i = jnp.broadcast_arrays(r, i)   # reference broadcasts rank too
+    return jax.lax.complex(r, i)
 
 
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):

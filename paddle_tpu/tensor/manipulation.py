@@ -1,0 +1,10 @@
+"""paddle.tensor.manipulation module path (reference tensor/manipulation.py)
+— re-exports the manipulation surface living on the tensor namespace."""
+
+from . import (concat, stack, split, squeeze, unsqueeze, reshape, flatten,
+               transpose, roll, flip, tile, expand, gather, scatter,
+               strided_slice, tensor_array_to_tensor)
+
+__all__ = ["concat", "stack", "split", "squeeze", "unsqueeze", "reshape",
+           "flatten", "transpose", "roll", "flip", "tile", "expand",
+           "gather", "scatter", "strided_slice", "tensor_array_to_tensor"]

@@ -174,6 +174,15 @@ def _methods():
     def unbind(self, axis=0):
         return T.unbind(self, axis=axis)
 
+    def diagonal_scatter(self, y, offset=0, axis1=0, axis2=1):
+        return T.diagonal_scatter(self, y, offset=offset, axis1=axis1,
+                                  axis2=axis2)
+
+    def fill_diagonal_(self, value, offset=0, wrap=False):
+        # value-semantics alias of the inplace spelling (tensor/inplace.py
+        # convention: compute and return)
+        return T.fill_diagonal(self, value, offset=offset, wrap=wrap)
+
     def softmax(self, axis=-1):
         return jax.nn.softmax(self, axis=axis)
 

@@ -1,0 +1,48 @@
+"""paddle.tensor.random module path (reference: tensor/random.py) — the
+random ops live on the tensor namespace; this module re-exports them so
+`from paddle.tensor import random` / `paddle.tensor.random.xxx` work."""
+
+from . import (bernoulli, multinomial, normal, poisson, rand, randint,
+               randint_like, randn, randperm, standard_normal, uniform)
+
+try:  # optional long-tail names
+    from . import exponential_, uniform_, normal_  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+__all__ = ["bernoulli", "multinomial", "normal", "poisson", "rand",
+           "randint", "randint_like", "randn", "randperm",
+           "standard_normal", "uniform"]
+
+
+def gaussian_(x, mean=0.0, std=1.0, seed=0, name=None):
+    """Value-semantics alias of the inplace gaussian fill (reference
+    tensor/random.py:469): returns a fresh normal draw shaped like x."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.rng import rng_tracker
+    key = (jax.random.key(seed) if seed else rng_tracker().next_key())
+    return mean + std * jax.random.normal(key, jnp.shape(x),
+                                          jnp.asarray(x).dtype)
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype="float32", name=None):
+    """Reference tensor/random.py:297 — shape[output_dim_idx] follows
+    input.shape[input_dim_idx]."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    from ..core.rng import rng_tracker
+    shape = list(shape)
+    in_shape = getattr(input, "shape", None)
+    if in_shape is None:
+        in_shape = jnp.shape(input)
+    shape[output_dim_idx] = in_shape[input_dim_idx]
+    key = (jax.random.key(seed) if seed else rng_tracker().next_key())
+    return jax.random.uniform(key, tuple(int(s) for s in shape),
+                              convert_dtype(dtype), min, max)
+
+
+__all__ += ["gaussian_", "uniform_random_batch_size_like"]

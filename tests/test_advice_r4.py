@@ -157,3 +157,24 @@ class TestTensorMethods:
                                    rtol=1e-6)
         out = jax.jit(lambda a: a.index_select(jnp.asarray([0]), 1))(x)
         assert out.shape == (2, 1)
+
+
+def test_reference_doctests_subset(tmp_path):
+    """Fast regression: a 3-module slice of the reference-doctest sweep
+    must stay green (full matrix: tools/run_reference_doctests.py,
+    docs/DOCTEST_PARITY.md)."""
+    import subprocess, sys, os, json
+    out = str(tmp_path / "doctest_subset.json")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "tools/run_reference_doctests.py",
+         "--modules", "tensor/logic.py", "tensor/attribute.py",
+         "metric/metrics.py", "--json", out],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-500:]
+    d = json.load(open(out))
+    assert d["totals"]["fail"] == 0 and d["totals"]["timeout"] == 0, d["totals"]
+    assert d["totals"]["pass"] >= 30, d["totals"]
