@@ -431,6 +431,17 @@ class Laplace(Distribution):
     def entropy(self):
         return jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape)
 
+    def cdf(self, value):
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+    def icdf(self, q):
+        # reference distribution/laplace.py icdf:
+        # loc - scale * sign(q - 0.5) * log1p(-2|q - 0.5|)
+        a = q - 0.5
+        return self.loc - self.scale * jnp.sign(a) * jnp.log1p(
+            -2 * jnp.abs(a))
+
 
 class LogNormal(Distribution):
     def __init__(self, loc, scale, name=None):
@@ -950,3 +961,12 @@ __all__ += ["ExponentialFamily", "Binomial", "Cauchy",
             "ContinuousBernoulli", "Independent", "MultivariateNormal",
             "TransformedDistribution", "Transform", "AffineTransform",
             "ExpTransform", "SigmoidTransform"]
+
+from ..utils import register_submodule_aliases as _rsa
+import sys as _sys
+_self = _sys.modules[__name__]
+_rsa(__name__, {n: _self for n in (
+    "normal", "uniform", "beta", "bernoulli", "categorical", "cauchy",
+    "dirichlet", "exponential", "gamma", "geometric", "gumbel", "laplace",
+    "lognormal", "multinomial", "poisson", "binomial", "transform", "kl",
+    "distribution")})

@@ -83,3 +83,6 @@ def load(path: str, return_numpy: bool = False) -> Any:
 
 
 # jit lives in paddle_tpu/jit/ (to_static + StableHLO export save/load)
+
+# doctest path: paddle.framework.ParamAttr (reference re-export)
+from .base import ParamAttr  # noqa: E402,F401

@@ -54,3 +54,22 @@ from . import decode
 from . import quant
 
 from . import loss  # noqa: E402  (doctest path paddle.nn.loss)
+
+# reference layout: nn/layer/{common,conv,norm,...}.py + nn/functional/*.py
+# are separate files; register those import paths onto this consolidated
+# namespace (doctest/recipe idiom: `from paddle.nn.layer.transformer import ...`)
+from ..utils import register_submodule_aliases as _rsa
+import sys as _sys
+from . import transformer as _transformer, rnn as _rnn, loss as _loss
+_self = _sys.modules[__name__]
+_rsa(__name__ + ".layer", {
+    "common": _self, "conv": _self, "norm": _self, "pooling": _self,
+    "activation": _self, "distance": _self, "vision": _self,
+    "transformer": _transformer, "rnn": _rnn, "loss": _loss,
+})
+_rsa(__name__ + ".functional", {
+    "activation": functional, "common": functional, "conv": functional,
+    "loss": functional, "norm": functional, "pooling": functional,
+    "vision": functional, "input": functional, "distance": functional,
+    "extension": functional,
+})

@@ -13,11 +13,21 @@ import math
 from typing import List, Optional, Sequence
 
 
+import weakref
+_SCHED_REGISTRY = weakref.WeakValueDictionary()  # name -> scheduler
+_SCHED_SERIAL = [0]   # names must stay unique after collection
+
+
 class LRScheduler:
     def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1, verbose=False):
         self.base_lr = learning_rate
         self.last_epoch = last_epoch
         self.last_lr = learning_rate
+        # reference schedulers expose a fetchable name in static mode
+        # (Executor.run(fetch_list=[sched.name]) reads the current lr)
+        _SCHED_SERIAL[0] += 1
+        self.name = f"learning_rate_{_SCHED_SERIAL[0]}"
+        _SCHED_REGISTRY[self.name] = self
         self.step()  # paddle initializes by stepping to epoch 0
 
     def get_lr(self) -> float:
@@ -314,3 +324,116 @@ class CosineAnnealingWarmRestarts(LRScheduler):
             T_i *= self.T_mult
         return self.eta_min + (self.base_lr - self.eta_min) * (
             1 + math.cos(math.pi * t / T_i)) / 2
+
+
+# ---------------------------------------------------------------------------
+# fluid-era decay FUNCTIONS (reference: optimizer/lr.py:2552-3100 keeps them
+# importable; dygraph mode returns the scheduler object — the behavior kept
+# here; static lr-variable weaving is subsumed by the scheduler's get_lr()
+# read at each Executor train step)
+# ---------------------------------------------------------------------------
+
+class _FluidDecay(LRScheduler):
+    """Closed-form fluid decay (reference: the static lr ops in
+    lr.py:2600+): lr(step) given by ``fn``; advanced automatically per
+    Executor train step (_auto_step), like the reference's appended ops."""
+
+    _auto_step = True
+
+    def __init__(self, fn, learning_rate):
+        self._fn = fn
+        super().__init__(learning_rate)
+
+    def get_lr(self):
+        return self._fn(max(self.last_epoch, 0))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    s = NoamDecay(d_model=d_model, warmup_steps=warmup_steps,
+                  learning_rate=learning_rate)
+    s._auto_step = True
+    return s
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    import math as _m
+
+    def fn(step):
+        t = step / float(decay_steps)
+        if staircase:
+            t = _m.floor(t)
+        return learning_rate * (decay_rate ** t)
+    return _FluidDecay(fn, learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    import math as _m
+
+    def fn(step):
+        t = step / float(decay_steps)
+        if staircase:
+            t = _m.floor(t)
+        return learning_rate * _m.exp(-decay_rate * t)
+    return _FluidDecay(fn, learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    import math as _m
+
+    def fn(step):
+        t = step / float(decay_steps)
+        if staircase:
+            t = _m.floor(t)
+        return learning_rate / (1.0 + decay_rate * t)
+    return _FluidDecay(fn, learning_rate)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    return PolynomialDecay(learning_rate=learning_rate,
+                           decay_steps=decay_steps,
+                           end_lr=end_learning_rate, power=power,
+                           cycle=cycle)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return CosineAnnealingDecay(learning_rate=learning_rate, T_max=epochs)
+
+
+def piecewise_decay(boundaries, values):
+    return PiecewiseDecay(boundaries=boundaries, values=values)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    return LinearWarmup(learning_rate=learning_rate,
+                        warmup_steps=warmup_steps, start_lr=start_lr,
+                        end_lr=end_lr)
+
+
+class LinearLR(LRScheduler):
+    """Linear factor ramp start_factor -> end_factor over total_steps
+    (reference: optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        frac = t / float(self.total_steps)
+        factor = self.start_factor + (self.end_factor
+                                      - self.start_factor) * frac
+        return self.base_lr * factor
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Legacy global step counter variable (reference lr.py:2500). Returns
+    a host counter object; the schedulers above own real step state."""
+    import numpy as np
+    return np.asarray([begin], np.int64)

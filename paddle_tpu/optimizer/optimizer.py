@@ -211,6 +211,31 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None, grads=None):
+        """Reference: Optimizer.minimize(optimizer.py). Two modes:
+
+        - STATIC: ``loss`` is a program var (static.data/static.nn chain):
+          register this optimizer on the loss's program — the Executor
+          then runs forward+backward+update per ``exe.run`` (the classic
+          static training loop; see static/__init__.py Executor.run).
+        - dynamic: explicit ``grads`` (functional autograd), same as
+          ``step(grads)``.
+        """
+        if loss is not None and hasattr(loss, "_build") \
+                and hasattr(loss, "_program"):
+            hooks = loss._program.__dict__.setdefault("_opt_hooks", [])
+            if not any(h[0] is self for h in hooks):
+                hooks.append((self, loss))
+            return None, None
+        if grads is None:
+            raise ValueError(
+                "minimize needs a static-program loss var, or explicit "
+                "grads (functional autograd): opt.minimize(grads=...) — "
+                "compute them with jax.grad / paddle_tpu.autograd.")
+        self.step(grads)
+        return None, None
+
     def state_dict(self) -> Dict:
         out = {"state": self._state}
         if isinstance(self._lr, LRScheduler):

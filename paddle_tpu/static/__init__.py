@@ -79,12 +79,22 @@ class _LazyVar:
         # two distinct expressions must never share one
         _LazyVar._serial += 1
         self.name = f"{name}#{_LazyVar._serial}"
+        # name registry: Executor.run accepts fetches BY NAME (reference
+        # fetch_list takes Variable or str)
+        program.__dict__.setdefault("_vars", {})[self.name] = self
 
     @staticmethod
     def _lift(v):
         if isinstance(v, _LazyVar):
             return v._build
         return lambda env: v
+
+    def _map(self, op, name):
+        """New lazy var applying ``op`` to this var's built value (used by
+        lazy-aware tensor functions like paddle.mean on program vars)."""
+        sb = self._build
+        return _LazyVar(self._program, lambda env: op(sb(env)),
+                        f"{name}({self.name})")
 
     def _binop(self, other, op, name):
         ob = self._lift(other)
@@ -128,7 +138,8 @@ class program_guard:
         return False
 
 
-def data(name: str, shape: Sequence[Optional[int]], dtype="float32") -> _LazyVar:
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level: int = 0) -> _LazyVar:
     """Declare a feed slot in the current program (reference: static.data)."""
     prog = default_main_program()
     prog._feed_specs[name] = InputSpec(shape, dtype, name)
@@ -171,6 +182,49 @@ class Executor:
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        from ..optimizer.lr import LRScheduler as _LRS
+        from ..optimizer.lr import _SCHED_REGISTRY
+
+        def _resolve(v):
+            if isinstance(v, str):
+                hit = program.__dict__.get("_vars", {}).get(v)
+                if hit is not None:
+                    return hit
+                if v in _SCHED_REGISTRY:
+                    return _SCHED_REGISTRY[v]
+                if v in program._feed_specs:      # fetch a feed by name
+                    var = _LazyVar(program, (lambda env, n=v: env[n]), v)
+                    return var
+                known = (list(program.__dict__.get("_vars", {}))[:5]
+                         + list(program._feed_specs))
+                raise ValueError(
+                    f"unknown fetch name {v!r}; known vars include "
+                    f"{known} and scheduler names")
+            return v
+        fetch_list = [_resolve(v) for v in fetch_list]
+        # schedulers fetch host-side (their lr must track step state, not
+        # freeze into a compiled constant); program vars go through the
+        # traced path, results merged back in order
+        sched_pos = {i: v for i, v in enumerate(fetch_list)
+                     if isinstance(v, _LRS)}
+        if sched_pos:
+            import numpy as np
+            var_items = [v for v in fetch_list
+                         if not isinstance(v, _LRS)]
+            var_outs = self.run(program, feed=feed, fetch_list=var_items,
+                                return_numpy=return_numpy) \
+                if var_items else []
+            outs, vi = [], 0
+            for i in range(len(fetch_list)):
+                if i in sched_pos:
+                    outs.append(np.asarray(
+                        [sched_pos[i].get_last_lr()], np.float32))
+                else:
+                    outs.append(var_outs[vi])
+                    vi += 1
+            return outs
 
         if program._fn is not None:
             args = [jnp.asarray(feed[n]) for n in program.feed_names]
@@ -182,16 +236,77 @@ class Executor:
         else:
             builders = [(getattr(v, "name", f"fetch{i}"), v._build)
                         for i, v in enumerate(fetch_list)]
-            key = (id(program), tuple(n for n, _ in builders))
-            if key not in self._cache:
-                run_all = program._trace(builders)
-                self._cache[key] = jax.jit(
-                    lambda env: run_all(env))
             env = {k: jnp.asarray(v) for k, v in feed.items()}
-            outs = self._cache[key](env)
+            hooks = program.__dict__.get("_opt_hooks")
+            if hooks:
+                outs = self._run_train_step(program, builders, env, hooks)
+            else:
+                key = (id(program), tuple(n for n, _ in builders))
+                if key not in self._cache:
+                    run_all = program._trace(builders)
+                    self._cache[key] = jax.jit(
+                        lambda env: run_all(env))
+                outs = self._cache[key](env)
 
         if return_numpy:
             outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def _run_train_step(self, program, builders, env, hooks):
+        """minimize() support: one compiled forward+backward+update per
+        ``run`` (reference: the program's appended grad+optimizer ops
+        executed by StandaloneExecutor; here one jitted step closing over
+        the program builders, params exposed as traced inputs via
+        prog._param_env — see static/nn.py _param)."""
+        import numpy as np
+        opt, loss = hooks[0]
+        if len(hooks) > 1:
+            raise NotImplementedError(
+                "one optimizer per static program (reference allows one "
+                "minimize per program too)")
+        # params materialize on the FIRST (untrained) trace of the loss
+        if "_nn_params" not in program.__dict__:
+            program.__dict__["_nn_params"] = {}
+        if not program.__dict__["_nn_params"]:
+            loss._build(dict(env))        # eager warmup trace fills store
+        store = program.__dict__["_nn_params"]
+        params = {k: jnp.asarray(v) for k, v in store.items()}
+        state = program.__dict__.get("_opt_state")
+        if state is None:
+            state = opt.init_state(params)
+        key = (id(program), "train", tuple(n for n, _ in builders))
+        if key not in self._cache:
+            def step(params, state, env, lr):
+                program.__dict__["_param_env"] = params
+                try:
+                    def loss_of(p):
+                        program.__dict__["_param_env"] = p
+                        return jnp.sum(loss._build(dict(env)))
+                    loss_v, grads = jax.value_and_grad(loss_of)(params)
+                    new_p, new_s = opt.apply_gradients(params, grads,
+                                                       state, lr=lr)
+                    # fetches evaluate under the PRE-update params, like
+                    # the reference (fetch ops run in the same pass)
+                    program.__dict__["_param_env"] = params
+                    fetches = [b(dict(env)) for _, b in builders]
+                    return new_p, new_s, fetches
+                finally:
+                    program.__dict__.pop("_param_env", None)
+            self._cache[key] = jax.jit(step)
+        new_p, new_s, outs = self._cache[key](params, state, env,
+                                              jnp.float32(opt.get_lr()))
+        for k, v in new_p.items():
+            store[k] = np.asarray(v)
+        program.__dict__["_opt_state"] = new_s
+        # fluid-era decay schedules advance per executor step (the
+        # reference appends the decay ops to the program); modern
+        # schedulers advance via the user's scheduler.step()
+        sched = getattr(opt, "_learning_rate", None)
+        if sched is None:
+            sched = getattr(opt, "lr_scheduler", None)
+        if callable(getattr(sched, "step", None)) and \
+                getattr(sched, "_auto_step", False):
+            sched.step()
         return outs
 
     def close(self):

@@ -31,7 +31,7 @@ from . import _LazyVar, default_main_program
 __all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
            "conv3d_transpose", "batch_norm", "layer_norm", "instance_norm",
            "group_norm", "prelu", "spectral_norm", "bilinear_tensor_product",
-           "deform_conv2d", "cond", "case", "switch_case", "while_loop",
+           "deform_conv2d", "deformable_conv", "cond", "case", "switch_case", "while_loop",
            "py_func", "static_pylayer", "sequence_conv", "sequence_softmax",
            "sequence_pool", "sequence_concat", "sequence_first_step",
            "sequence_last_step", "sequence_slice", "sequence_expand",
@@ -57,6 +57,12 @@ def _param(prog, name: str, shape, init: str = "xavier",
     process-stable CRC over (name, shape): python hash() is salted per
     process, which would diverge data-parallel replicas."""
     import zlib
+    # trainable path: when the Executor traces a train step it exposes the
+    # param set as traced INPUTS via prog._param_env (minimize support) —
+    # otherwise values bake in as constants (inference replay)
+    env = prog.__dict__.get("_param_env")
+    if env is not None and name in env:
+        return env[name]
     store = prog.__dict__.setdefault("_nn_params", {})
     if name not in store:
         seed = zlib.crc32(repr((name,) + tuple(int(s) for s in shape))
@@ -108,7 +114,11 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
             out = getattr(F, activation)(out)
         return out
 
-    return x.apply(build, pname)
+    out = x.apply(build, pname)
+    in_shape = getattr(x, "shape", None)
+    if in_shape is not None and len(in_shape) >= nfd:
+        out.shape = tuple(in_shape[:nfd]) + (size,)
+    return out
 
 
 def embedding(input, size, is_sparse: bool = False, padding_idx=None,
@@ -260,7 +270,10 @@ def layer_norm(input, scale: bool = True, shift: bool = True,
             out = getattr(F, act)(out)
         return out
 
-    return input.apply(build, pname)
+    out = input.apply(build, pname)
+    if getattr(input, "shape", None) is not None:
+        out.shape = tuple(input.shape)     # shape-preserving op
+    return out
 
 
 def instance_norm(input, epsilon: float = 1e-5, param_attr=None,
@@ -526,3 +539,22 @@ sparse_embedding = _ps_era("sparse_embedding")
 nce = _ps_era("nce")
 row_conv = _ps_era("row_conv")
 data_norm = _ps_era("data_norm")
+
+
+# reference path static/nn/common.py (doctests use static.nn.common.fc)
+from ..utils import register_submodule_aliases as _rsa
+import sys as _sys
+_rsa(__name__, {"common": _sys.modules[__name__]})
+common = _sys.modules[__name__]   # attribute access: static.nn.common.fc
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """reference: static/nn/common.py deformable_conv (v1: mask=None,
+    v2/modulated: mask given) — alias over deform_conv2d."""
+    return deform_conv2d(input, offset, mask, num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, deformable_groups=deformable_groups,
+                         name=name)

@@ -221,20 +221,37 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
     return (x + bias) * scale
 
 
+def _is_lazy(x):
+    return hasattr(x, "_build") and hasattr(x, "_program")
+
+
 def sum(x, axis=None, dtype=None, keepdim=False):
+    if _is_lazy(x):
+        return x._map(lambda v: jnp.sum(
+            v, axis=axis, dtype=_dt.convert_dtype(dtype) if dtype else None,
+            keepdims=keepdim), "sum")
     return jnp.sum(x, axis=axis, dtype=_dt.convert_dtype(dtype) if dtype else None,
                    keepdims=keepdim)
 
 
 def mean(x, axis=None, keepdim=False):
+    if _is_lazy(x):   # program var (static mode): record, don't eval
+        return x._map(lambda v: jnp.mean(v, axis=axis, keepdims=keepdim),
+                      "mean")
     return jnp.mean(x, axis=axis, keepdims=keepdim)
 
 
 def max(x, axis=None, keepdim=False):
+    if _is_lazy(x):
+        return x._map(lambda v: jnp.max(v, axis=axis, keepdims=keepdim),
+                      "max")
     return jnp.max(x, axis=axis, keepdims=keepdim)
 
 
 def min(x, axis=None, keepdim=False):
+    if _is_lazy(x):
+        return x._map(lambda v: jnp.min(v, axis=axis, keepdims=keepdim),
+                      "min")
     return jnp.min(x, axis=axis, keepdims=keepdim)
 
 

@@ -15,3 +15,23 @@ from . import install_check
 __all__ = ["flops", "transformer_flops_per_token", "model_flops_per_token",
            "get_weights_path_from_url", "get_path_from_url", "DownloadError",
            "to_dlpack", "from_dlpack", "cpp_extension"]
+
+
+def register_submodule_aliases(parent: str, mapping: dict) -> None:
+    """Register reference-layout submodule import paths onto existing
+    modules (e.g. ``paddle.nn.layer.transformer`` -> our nn.transformer).
+    The reference splits surfaces across many files; ours consolidates —
+    sys.modules entries make the reference's import idioms work verbatim
+    (Python consults sys.modules before requiring the parent to be a
+    package)."""
+    import sys
+    parent_mod = sys.modules.get(parent)
+    for name, target in mapping.items():
+        full = f"{parent}.{name}"
+        if full not in sys.modules:
+            sys.modules[full] = target
+        # dotted ATTRIBUTE access (paddle.distribution.normal.Normal after
+        # a plain `import paddle`) needs the attr on the parent module too
+        # — the import machinery skips setattr for preregistered entries
+        if parent_mod is not None and not hasattr(parent_mod, name):
+            setattr(parent_mod, name, target)

@@ -57,3 +57,12 @@ def image_load(path: str, backend=None):
 __all__ += ["get_image_backend", "set_image_backend", "image_load"]
 
 from . import image  # paddle.vision.image module path
+
+from ..utils import register_submodule_aliases as _rsa
+from . import models as _models, datasets as _datasets
+_rsa(__name__ + ".models", {n: _models for n in (
+    "resnet", "vgg", "mobilenetv1", "mobilenetv2", "mobilenetv3",
+    "densenet", "alexnet", "squeezenet", "googlenet", "inceptionv3",
+    "shufflenetv2", "lenet")})
+_rsa(__name__ + ".datasets", {n: _datasets for n in (
+    "mnist", "cifar", "flowers", "voc2012")})

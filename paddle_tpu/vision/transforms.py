@@ -537,23 +537,63 @@ def _affine_matrix(angle, translate, scale, shear, center):
     return t_pre @ rot @ t_post
 
 
+
+
+def _float_chw(arr):
+    """True for paddle-Tensor-style images: float CHW with a small leading
+    channel dim AND genuinely-image-sized spatial dims (a thin float HWC
+    strip like (3, W, 3) must NOT be misread as CHW)."""
+    return (isinstance(arr, np.ndarray) and arr.ndim == 3
+            and arr.dtype.kind == "f" and arr.shape[0] in (1, 3, 4)
+            and arr.shape[1] > 4 and arr.shape[2] > 4)
+
+
+def _warp_via_pil(img, pil_fn, fill=0):
+    """Apply a PIL-image warp to any input form: PIL stays PIL; uint8 HWC
+    round-trips as before; float CHW tensors warp per channel in PIL mode
+    F (32-bit float — no quantization) and come back float CHW.
+    ``pil_fn(pil, fill_scalar)`` receives a per-channel scalar fill when
+    the caller passed a sequence."""
+    from PIL import Image
+
+    def fill_for(c):
+        if isinstance(fill, (list, tuple)):
+            return fill[c] if c < len(fill) else fill[-1]
+        return fill
+
+    if _is_pil(img):
+        return pil_fn(img, fill)
+    arr = _to_numpy(img)
+    if _float_chw(arr):
+        outs = [np.asarray(pil_fn(Image.fromarray(
+            np.ascontiguousarray(arr[c]).astype(np.float32), mode="F"),
+            float(fill_for(c))))
+            for c in range(arr.shape[0])]
+        return np.stack(outs, axis=0).astype(arr.dtype)
+    return _to_numpy(pil_fn(_to_pil(arr.astype(np.uint8)), fill))
+
+
 def affine(img, angle, translate, scale, shear, interpolation="nearest",
            fill=0, center=None):
-    """Affine warp (reference: vision/transforms/functional.py affine)."""
+    """Affine warp (reference: vision/transforms/functional.py affine).
+    Accepts PIL, uint8 HWC arrays, and float CHW tensors (warped in PIL
+    mode F, no quantization)."""
     from PIL import Image
-    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
-    w, h = pil.size
-    if center is None:
-        center = (w * 0.5, h * 0.5)
     if isinstance(shear, numbers.Number):
         shear = (shear, 0.0)
-    m = _affine_matrix(angle, translate, scale, shear, center)
-    inv = np.linalg.inv(m)
     resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
                 "bicubic": Image.BICUBIC}[interpolation]
-    out = pil.transform((w, h), Image.AFFINE, data=inv[:2].reshape(-1),
-                        resample=resample, fillcolor=fill)
-    return out if _is_pil(img) else _to_numpy(out)
+
+    def warp(pil, fill_v):
+        w, h = pil.size
+        c = (w * 0.5, h * 0.5) if center is None else center
+        m = _affine_matrix(angle, translate, scale, shear, c)
+        inv = np.linalg.inv(m)
+        return pil.transform((w, h), Image.AFFINE,
+                             data=inv[:2].reshape(-1), resample=resample,
+                             fillcolor=fill_v)
+
+    return _warp_via_pil(img, warp, fill)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest",
@@ -561,7 +601,6 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
     """Perspective warp mapping startpoints->endpoints (reference:
     vision/transforms/functional.py perspective)."""
     from PIL import Image
-    pil = img if _is_pil(img) else _to_pil(_to_numpy(img).astype(np.uint8))
     # solve the 8-dof homography endpoints -> startpoints (PIL convention)
     a = []
     b = []
@@ -574,9 +613,12 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
                              np.asarray(b, np.float64))
     resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
                 "bicubic": Image.BICUBIC}[interpolation]
-    out = pil.transform(pil.size, Image.PERSPECTIVE, data=coeffs,
-                        resample=resample, fillcolor=fill)
-    return out if _is_pil(img) else _to_numpy(out)
+
+    def warp(pil, fill_v):
+        return pil.transform(pil.size, Image.PERSPECTIVE, data=coeffs,
+                             resample=resample, fillcolor=fill_v)
+
+    return _warp_via_pil(img, warp, fill)
 
 
 class RandomAffine(BaseTransform):
