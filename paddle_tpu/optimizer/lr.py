@@ -393,24 +393,35 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate,
 
 def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
-    return PolynomialDecay(learning_rate=learning_rate,
-                           decay_steps=decay_steps,
-                           end_lr=end_learning_rate, power=power,
-                           cycle=cycle)
+    s = PolynomialDecay(learning_rate=learning_rate,
+                        decay_steps=decay_steps,
+                        end_lr=end_learning_rate, power=power,
+                        cycle=cycle)
+    s._auto_step = True   # fluid decays advance per executor step
+    return s
 
 
 def cosine_decay(learning_rate, step_each_epoch, epochs):
-    return CosineAnnealingDecay(learning_rate=learning_rate, T_max=epochs)
+    import math as _m
+
+    def fn(step):
+        epoch = step // step_each_epoch      # fluid: floor to epochs
+        return 0.5 * learning_rate * (_m.cos(epoch * _m.pi / epochs) + 1)
+    return _FluidDecay(fn, learning_rate)
 
 
 def piecewise_decay(boundaries, values):
-    return PiecewiseDecay(boundaries=boundaries, values=values)
+    s = PiecewiseDecay(boundaries=boundaries, values=values)
+    s._auto_step = True
+    return s
 
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
-    return LinearWarmup(learning_rate=learning_rate,
-                        warmup_steps=warmup_steps, start_lr=start_lr,
-                        end_lr=end_lr)
+    s = LinearWarmup(learning_rate=learning_rate,
+                     warmup_steps=warmup_steps, start_lr=start_lr,
+                     end_lr=end_lr)
+    s._auto_step = True
+    return s
 
 
 class LinearLR(LRScheduler):

@@ -196,6 +196,9 @@ class Executor:
                     return _SCHED_REGISTRY[v]
                 if v in program._feed_specs:      # fetch a feed by name
                     var = _LazyVar(program, (lambda env, n=v: env[n]), v)
+                    # register under the RAW name too: the next run must
+                    # hit the cache key, not mint a fresh serial
+                    program.__dict__["_vars"][v] = var
                     return var
                 known = (list(program.__dict__.get("_vars", {}))[:5]
                          + list(program._feed_specs))
@@ -264,17 +267,20 @@ class Executor:
             raise NotImplementedError(
                 "one optimizer per static program (reference allows one "
                 "minimize per program too)")
-        # params materialize on the FIRST (untrained) trace of the loss
         if "_nn_params" not in program.__dict__:
             program.__dict__["_nn_params"] = {}
-        if not program.__dict__["_nn_params"]:
-            loss._build(dict(env))        # eager warmup trace fills store
         store = program.__dict__["_nn_params"]
+        key = (id(program), "train", tuple(n for n, _ in builders))
+        if key not in self._cache:
+            # warm up EVERY time a step is (re)compiled: a partially
+            # populated store (e.g. an earlier inference fetch touched
+            # only some layers) would bake the missing params in as
+            # untrained constants
+            loss._build(dict(env))
         params = {k: jnp.asarray(v) for k, v in store.items()}
         state = program.__dict__.get("_opt_state")
         if state is None:
             state = opt.init_state(params)
-        key = (id(program), "train", tuple(n for n, _ in builders))
         if key not in self._cache:
             def step(params, state, env, lr):
                 program.__dict__["_param_env"] = params
@@ -296,7 +302,10 @@ class Executor:
         new_p, new_s, outs = self._cache[key](params, state, env,
                                               jnp.float32(opt.get_lr()))
         for k, v in new_p.items():
-            store[k] = np.asarray(v)
+            store[k] = v   # jit OUTPUTS are concrete device arrays — no
+                           # per-step host round trip (the numpy-only rule
+                           # in static/nn.py covers values created INSIDE
+                           # a trace, which these are not)
         program.__dict__["_opt_state"] = new_s
         # fluid-era decay schedules advance per executor step (the
         # reference appends the decay ops to the program); modern
