@@ -23,6 +23,7 @@ from . import nn
 from . import optimizer
 from . import ops
 from . import tensor
+from .linalg import eigvalsh, eigvals, eig  # top-level parity
 
 # paddle-style: every tensor function is also a top-level symbol
 from .tensor import *  # noqa: F401,F403

@@ -186,6 +186,12 @@ class Bernoulli(Distribution):
                  (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12)))
 
 
+    def cdf(self, value):
+        v = jnp.asarray(value)
+        return jnp.where(v < 0, 0.0,
+                         jnp.where(v < 1, 1.0 - self.probs, 1.0))
+
+
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
         if (probs is None) == (logits is None):
@@ -374,6 +380,18 @@ class Geometric(Distribution):
         q = 1 - p
         return -(q * jnp.log(jnp.clip(q, 1e-12)) +
                  p * jnp.log(jnp.clip(p, 1e-12))) / p
+
+
+    def pmf(self, k):
+        return jnp.exp(self.log_pmf(k))
+
+    def log_pmf(self, k):
+        k = jnp.asarray(k)
+        return k * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def cdf(self, k):
+        k = jnp.asarray(k)
+        return 1.0 - jnp.power(1.0 - self.probs, k + 1.0)
 
 
 class Gumbel(Distribution):
@@ -955,7 +973,165 @@ class SigmoidTransform(Transform):
         return -jax.nn.softplus(-x) - jax.nn.softplus(x)
 
 
+class AbsTransform(Transform):
+    """y = |x| (reference transform.py AbsTransform — not bijective; the
+    inverse returns the positive branch like the reference)."""
+
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(jnp.asarray(x, jnp.result_type(float)))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Sums the log-det over the trailing reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        j = self.base.forward_log_det_jacobian(x)
+        return jnp.sum(j, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        lead = jnp.shape(x)[:len(jnp.shape(x)) - len(self.in_event_shape)]
+        return jnp.reshape(x, lead + self.out_event_shape)
+
+    def inverse(self, y):
+        lead = jnp.shape(y)[:len(jnp.shape(y)) - len(self.out_event_shape)]
+        return jnp.reshape(y, lead + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        lead = jnp.shape(x)[:len(jnp.shape(x)) - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x) (reference: not bijective; inverse is log)."""
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] along slices of ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fns, v):
+        parts = [fns[i](jnp.take(v, i, axis=self.axis))
+                 for i in range(len(self.transforms))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def forward(self, x):
+        return self._map([t.forward for t in self.transforms], x)
+
+    def inverse(self, y):
+        return self._map([t.inverse for t in self.transforms], y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map([t.forward_log_det_jacobian
+                          for t in self.transforms], x)
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> K+1 simplex (reference transform.py StickBreakingTransform)."""
+
+    def forward(self, x):
+        offset = jnp.arange(x.shape[-1], 0, -1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               axis=-1)
+        onepad = jnp.concatenate([jnp.ones(x.shape[:-1] + (1,), x.dtype),
+                                  jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * onepad
+
+    def inverse(self, y):
+        y_crop = y[..., :-1]
+        rest = 1 - jnp.cumsum(y_crop, axis=-1)
+        offset = jnp.arange(y_crop.shape[-1], 0, -1)
+        z = y_crop / jnp.concatenate(
+            [jnp.ones(y_crop.shape[:-1] + (1,), y.dtype), rest[..., :-1]],
+            axis=-1)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(
+            offset.astype(y.dtype))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
 import numpy as np  # noqa: E402 (Binomial.entropy host-side support bound)
+
+__all__ += ["AbsTransform", "PowerTransform", "ChainTransform",
+            "IndependentTransform", "ReshapeTransform", "SoftmaxTransform",
+            "StackTransform", "StickBreakingTransform", "TanhTransform"]
 
 __all__ += ["ExponentialFamily", "Binomial", "Cauchy",
             "ContinuousBernoulli", "Independent", "MultivariateNormal",
@@ -969,4 +1145,14 @@ _rsa(__name__, {n: _self for n in (
     "normal", "uniform", "beta", "bernoulli", "categorical", "cauchy",
     "dirichlet", "exponential", "gamma", "geometric", "gumbel", "laplace",
     "lognormal", "multinomial", "poisson", "binomial", "transform", "kl",
-    "distribution")})
+    "distribution", "transformed_distribution", "independent",
+    "variable", "constraint")})
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    # the REFERENCE formula (distribution/kl.py _kl_geometric_geometric):
+    # p*log(p/q) + (1-p)*log((1-p)/(1-q)) — matched for doctest parity
+    return (p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
+            + (1 - p.probs) * (jnp.log1p(-p.probs)
+                               - jnp.log1p(-q.probs)))

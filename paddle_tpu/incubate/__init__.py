@@ -28,3 +28,10 @@ __all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min",
             "graph_send_recv", "identity_loss", "graph_khop_sampler",
             "graph_reindex", "graph_sample_neighbors", "LookAhead",
             "ModelAverage"]
+
+# reference path incubate/autograd/{functional,primapi}.py — ours is one
+# module; register the subpaths for verbatim reference imports
+from ..utils import register_submodule_aliases as _rsa
+from . import autograd as _ag
+_rsa(__name__ + ".autograd", {"functional": _ag, "primapi": _ag,
+                              "utils": _ag})

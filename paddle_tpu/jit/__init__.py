@@ -249,3 +249,40 @@ def set_verbosity(level: int = 0, also_to_stdout: bool = False):
     import logging
     logging.getLogger("paddle_tpu.jit").setLevel(
         logging.DEBUG if level > 0 else logging.WARNING)
+
+
+# reference path jit/api.py (doctests use paddle.jit.api.to_static)
+from ..utils import register_submodule_aliases as _rsa
+import sys as _sys
+_rsa(__name__, {"api": _sys.modules[__name__]})
+
+
+class TracedLayer:
+    """Legacy dygraph tracer (reference: jit/api.py TracedLayer — wraps a
+    traced program + exposes save_inference_model). TPU: the trace IS a
+    jitted function; save_inference_model delegates to jit.save."""
+
+    def __init__(self, layer, jitted, example_inputs):
+        self._layer = layer
+        self._jitted = jitted
+        self._inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs)
+        pure, params = _layer_pure(layer)
+        jitted = jax.jit(lambda *a: pure(layer.raw_state(), *a))
+        out = jitted(*inputs)
+        return out, TracedLayer(layer, jitted, inputs)
+
+    def __call__(self, *args):
+        return self._jitted(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        specs = [InputSpec(tuple(x.shape), str(x.dtype)) for x in self._inputs]
+        save(self._layer, path if isinstance(path, str) else path[0],
+             input_spec=specs)
+
+
+if "TracedLayer" not in __all__:
+    __all__.append("TracedLayer")

@@ -83,19 +83,44 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    """Reference: python/paddle/vision/models/resnet.py (resnet18/34/50...)."""
+    """Reference: python/paddle/vision/models/resnet.py — the reference
+    signature is ``ResNet(block, depth, ...)``; a bare ``ResNet(depth)``
+    and the internal ``ResNet(block, layer_list)`` forms are accepted
+    too."""
 
     CONFIGS = {18: (BasicBlock, [2, 2, 2, 2]),
                34: (BasicBlock, [3, 4, 6, 3]),
                50: (BottleneckBlock, [3, 4, 6, 3]),
-               101: (BottleneckBlock, [3, 4, 23, 3])}
+               101: (BottleneckBlock, [3, 4, 23, 3]),
+               152: (BottleneckBlock, [3, 8, 36, 3])}
 
-    def __init__(self, depth: int = 50, num_classes: int = 1000,
-                 with_pool: bool = True, in_channels: int = 3):
+    def __init__(self, block=None, depth=50, width: int = 64,
+                 num_classes: int = 1000, with_pool: bool = True,
+                 groups: int = 1, in_channels: int = 3):
         super().__init__()
-        if depth not in self.CONFIGS:
-            raise ValueError(f"depth must be one of {sorted(self.CONFIGS)}")
-        block, layers = self.CONFIGS[depth]
+        if isinstance(block, int):          # legacy ResNet(depth) form
+            if isinstance(depth, int) and depth != 50:
+                raise TypeError(
+                    "ResNet signature is now the reference's "
+                    "ResNet(block, depth, ...); for the legacy form pass "
+                    "keyword args: ResNet(%d, num_classes=%d)"
+                    % (block, depth))
+            block, depth = None, block
+        if width != 64 or groups != 1:
+            raise NotImplementedError(
+                "wide/ResNeXt variants (width/groups) are not built into "
+                "this block set; use the torchvision-style recipes in "
+                "vision/models_extras.py")
+        if isinstance(depth, (list, tuple)):
+            layers = list(depth)
+            if block is None:
+                raise ValueError("explicit layer list needs a block class")
+        else:
+            if depth not in self.CONFIGS:
+                raise ValueError(
+                    f"depth must be one of {sorted(self.CONFIGS)}")
+            cfg_block, layers = self.CONFIGS[depth]
+            block = block or cfg_block
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.stem = ConvBNLayer(in_channels, 64, 7, 2)

@@ -6,3 +6,39 @@ from ..optimizer.clip import (ClipGradBase, ClipGradByGlobalNorm,
                               ClipGradByNorm, ClipGradByValue)
 
 __all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """reference: nn/clip.py clip_by_norm:39 — scale x so its l2 norm is
+    at most max_norm."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(arr * arr))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return arr * scale
+
+
+def merge_selected_rows(x, name=None):
+    raise NotImplementedError(
+        "SelectedRows is LoD/PS-era storage; dense grads only on TPU "
+        "(docs/DESIGN_DECISIONS.md)")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    raise NotImplementedError(
+        "SelectedRows is LoD/PS-era storage; dense grads only on TPU "
+        "(docs/DESIGN_DECISIONS.md)")
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Deprecated static-mode global clip setter (reference nn/clip.py:1087
+    warns to pass grad_clip to the optimizer instead — same guidance
+    here); stores the clip on the default program for parity."""
+    import warnings
+    warnings.warn(
+        "set_gradient_clip is deprecated: pass grad_clip=... to the "
+        "optimizer constructor instead (reference issues the same "
+        "warning)", stacklevel=2)
+    from ..static import default_main_program
+    default_main_program().__dict__["_gradient_clip"] = (clip, param_list)

@@ -227,14 +227,20 @@ class Conv2DTranspose(Layer):
 
 
 class MaxPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask: bool = False, ceil_mode: bool = False,
+                 data_format="NCHW", name=None):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.return_mask = return_mask
+        self.ceil_mode = ceil_mode
         self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.data_format)
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):

@@ -81,7 +81,10 @@ def tanh(x):
     return jnp.tanh(x)
 
 
-def softmax(x, axis: int = -1):
+def softmax(x, axis: int = -1, dtype=None, name=None):
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        x = jnp.asarray(x).astype(convert_dtype(dtype))
     return jax.nn.softmax(x, axis=axis)
 
 
@@ -327,7 +330,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW"):
+def max_pool2d(x, kernel_size, stride=None, padding=0,
+               return_mask: bool = False, ceil_mode: bool = False,
+               data_format: str = "NCHW", name=None):
     k = _norm_tuple(kernel_size, 2)
     s = _norm_tuple(stride if stride is not None else kernel_size, 2)
     p = _norm_tuple(padding, 2)
@@ -339,8 +344,50 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW"
         window = (1, k[0], k[1], 1)
         strides = (1, s[0], s[1], 1)
         pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    if ceil_mode and data_format == "NCHW":
+        # extend the high-side padding so output dims use ceil division
+        # (reduce_window pads with init, which max ignores)
+        h_in, w_in = x.shape[2], x.shape[3]
+        def hi_extra(n_, k_, s_, p_):
+            out_c = -(-(n_ + 2 * p_ - k_) // s_) + 1
+            return max((out_c - 1) * s_ + k_ - n_ - 2 * p_, 0)
+        pads = ((0, 0), (0, 0),
+                (p[0], p[0] + hi_extra(h_in, k[0], s[0], p[0])),
+                (p[1], p[1] + hi_extra(w_in, k[1], s[1], p[1])))
+    elif ceil_mode:
+        raise NotImplementedError("ceil_mode supports NCHW")
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if not return_mask:
+        return out
+    # argmax indices into the flattened H*W plane (reference mask
+    # contract, max_pool2d_with_index kernel): extract windows, take the
+    # in-window argmax, map back to global coordinates. Indices are
+    # computed in float32 precision (ties beyond 2^24 in integer inputs
+    # may pick an equal-valued-in-f32 neighbor).
+    if data_format != "NCHW":
+        raise NotImplementedError("return_mask supports NCHW")
+    n, c, h, w = x.shape
+    # pad with -inf OURSELVES: the patches op pads with zeros, which (a)
+    # diverges from reduce_window's -inf when a window is all-negative
+    # and (b) lets argmax select a padding cell (out-of-range index)
+    # large FINITE sentinel: the patches op is a one-hot convolution and
+    # -inf * 0 would poison whole windows with NaN
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0), pads[2], pads[3]),
+                 constant_values=-3.0e38)
+    patches = lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ho, wo = patches.shape[-2:]
+    patches = patches.reshape(n, c, k[0] * k[1], ho, wo)
+    local = jnp.argmax(patches, axis=2)          # [n, c, ho, wo]
+    oy = jnp.arange(ho)[:, None]
+    ox = jnp.arange(wo)[None, :]
+    gy = oy * s[0] + local // k[1] - p[0]        # padded -> input frame
+    gx = ox * s[1] + local % k[1] - p[1]
+    mask = (gy * w + gx).astype(jnp.int32)
+    return out, mask
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW",
@@ -455,17 +502,28 @@ def _reduce(loss, reduction: str):
     return loss
 
 
-def cross_entropy(logits, labels, weight=None, ignore_index: int = -100,
-                  reduction: str = "mean", soft_label: bool = False,
-                  label_smoothing: float = 0.0, axis: int = -1):
+def cross_entropy(input=None, label=None, weight=None,
+                  ignore_index: int = -100, reduction: str = "mean",
+                  soft_label: bool = False, label_smoothing: float = 0.0,
+                  axis: int = -1, use_softmax: bool = True,
+                  logits=None, labels=None):
     """Softmax cross entropy, computed in fp32 with the max-subtraction trick
     (reference: c_softmax_with_cross_entropy / softmax_with_cross_entropy
-    kernels, paddle/phi/kernels/funcs/cross_entropy.cu)."""
+    kernels, paddle/phi/kernels/funcs/cross_entropy.cu).
+    ``use_softmax=False`` treats ``input`` as PROBABILITIES (the reference
+    contract): loss is -log(p[label]) with no extra softmax."""
+    # reference kwarg names are input/label; logits/labels kept for the
+    # existing in-repo callers
+    logits = input if input is not None else logits
+    labels = label if label is not None else labels
     logits = logits.astype(jnp.float32)
     if axis != -1 and axis != logits.ndim - 1:
         logits = jnp.moveaxis(logits, axis, -1)
     n_classes = logits.shape[-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-30, None))
     if soft_label:
         target = labels.astype(jnp.float32)
         loss = -jnp.sum(target * logp, axis=-1)
@@ -497,6 +555,8 @@ softmax_with_cross_entropy = cross_entropy
 def nll_loss(log_probs, labels, weight=None, ignore_index: int = -100,
              reduction: str = "mean"):
     labels = labels.astype(jnp.int32)
+    if labels.ndim == log_probs.ndim and labels.shape[-1] == 1:
+        labels = labels.squeeze(-1)     # reference accepts [N, 1] labels
     valid = labels != ignore_index
     safe = jnp.where(valid, labels, 0)
     nll = -jnp.take_along_axis(log_probs, safe[..., None], axis=-1).squeeze(-1)

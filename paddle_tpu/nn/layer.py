@@ -78,6 +78,30 @@ class Parameter:
     def numpy(self):
         return np.asarray(self.value)
 
+    # array-likeness: jnp/np ops consume Parameters directly (reference
+    # Parameters ARE tensors; e.g. `x * self.params[i]` in containers)
+    def __jax_array__(self):
+        return jnp.asarray(self.value)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self.value, dtype=dtype)
+
+    def __mul__(self, o):
+        return jnp.asarray(self.value) * o
+
+    __rmul__ = __mul__
+
+    def __add__(self, o):
+        return jnp.asarray(self.value) + o
+
+    __radd__ = __add__
+
+    def __matmul__(self, o):
+        return jnp.asarray(self.value) @ o
+
+    def __rmatmul__(self, o):
+        return o @ jnp.asarray(self.value)
+
     def __repr__(self):
         return (f"Parameter(name={self.name!r}, shape={self.shape}, "
                 f"dtype={self.dtype}, trainable={self.trainable}, "

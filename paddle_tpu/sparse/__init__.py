@@ -343,3 +343,16 @@ __all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "square",
 from . import functional  # noqa: E402
 from . import nn  # noqa: E402
 __all__ += ["functional", "nn"]
+
+
+# paddle Tensor method spellings on the jax sparse classes (doctests call
+# sp_x.to_dense() / sp_x.to_sparse_coo() on the objects themselves)
+if not hasattr(jsparse.BCSR, "to_dense"):
+    jsparse.BCSR.to_dense = lambda self: self.todense()
+    jsparse.BCOO.to_dense = lambda self: self.todense()
+    jsparse.BCOO.to_sparse_csr = lambda self: to_sparse_csr(self.todense())
+    jsparse.BCSR.to_sparse_coo = (
+        lambda self, sparse_dim=None: to_sparse_coo(self.todense(),
+                                                    sparse_dim=sparse_dim))
+    jsparse.BCOO.values = lambda self: self.data
+    jsparse.BCSR.values = lambda self: self.data

@@ -116,6 +116,30 @@ class _LazyVar:
         return _LazyVar(self._program, lambda env: fn(sb(env)),
                         f"{self.name}.{name}")
 
+    # common Tensor-method spellings recorded lazily (doctests call them
+    # on program vars)
+    def astype(self, dtype):
+        from ..core.dtype import convert_dtype
+        return self._map(lambda v: v.astype(convert_dtype(dtype)), "astype")
+
+    cast = astype
+
+    def mean(self, axis=None, keepdim=False):
+        return self._map(lambda v: jnp.mean(v, axis=axis,
+                                            keepdims=keepdim), "mean")
+
+    def sum(self, axis=None, keepdim=False):
+        return self._map(lambda v: jnp.sum(v, axis=axis,
+                                           keepdims=keepdim), "sum")
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self._map(lambda v: jnp.reshape(v, shape), "reshape")
+
+    def unsqueeze(self, axis):
+        return self._map(lambda v: jnp.expand_dims(v, axis), "unsqueeze")
+
 
 _default_program = Program()
 _program_stack = []
@@ -271,12 +295,15 @@ class Executor:
             program.__dict__["_nn_params"] = {}
         store = program.__dict__["_nn_params"]
         key = (id(program), "train", tuple(n for n, _ in builders))
-        if key not in self._cache:
-            # warm up EVERY time a step is (re)compiled: a partially
-            # populated store (e.g. an earlier inference fetch touched
-            # only some layers) would bake the missing params in as
-            # untrained constants
+        if key not in self._cache and not program.__dict__.get(
+                "_warm_built"):
+            # warm up ONCE per program: a partially populated store (an
+            # earlier inference fetch touched only some layers) would
+            # bake the missing params in as untrained constants. The
+            # invariant is program state, so later executors/fetch sets
+            # skip the eager forward
             loss._build(dict(env))
+            program.__dict__["_warm_built"] = True
         params = {k: jnp.asarray(v) for k, v in store.items()}
         state = program.__dict__.get("_opt_state")
         if state is None:

@@ -176,10 +176,34 @@ def _conv_nd(x, num_filters, filter_size, stride, padding, dilation, groups,
     return x.apply(build, pname)
 
 
+def _conv_out_shape(in_shape, num_filters, ks, st, pd, dl, nd):
+    """NC* output shape for a plain conv with int padding; None dims and
+    string paddings propagate as None."""
+    if in_shape is None or isinstance(pd, str):
+        return None
+    out = [in_shape[0], num_filters]
+    for i in range(nd):
+        d_in = in_shape[2 + i]
+        if d_in is None:
+            out.append(None)
+            continue
+        p_i = pd if isinstance(pd, int) else pd[i]
+        out.append((d_in + 2 * p_i - dl[i] * (ks[i] - 1) - 1) // st[i] + 1)
+    return tuple(out)
+
+
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None, name=None):
     out = _conv_nd(input, num_filters, filter_size, stride, padding,
                    dilation, groups, bias_attr, nd=2, name=name)
+    in_shape = getattr(input, "shape", None)
+    ks = (filter_size,) * 2 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    st = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    dl = (dilation,) * 2 if isinstance(dilation, int) else tuple(dilation)
+    shp = _conv_out_shape(in_shape, num_filters, ks, st, padding, dl, 2)
+    if shp is not None:
+        out.shape = shp
     if act:
         from ..nn import functional as F
         out = out.apply(getattr(F, act), act)

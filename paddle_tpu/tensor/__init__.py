@@ -66,7 +66,9 @@ def arange(start=0, end=None, step=1, dtype=None):
 
 
 def linspace(start, stop, num, dtype=None):
-    return jnp.linspace(start, stop, num, dtype=_dt.convert_dtype(dtype) if dtype else None)
+    # reference accepts a float num (e.g. sr/2 arithmetic) and truncates
+    return jnp.linspace(start, stop, int(num),
+                        dtype=_dt.convert_dtype(dtype) if dtype else None)
 
 
 def eye(num_rows, num_columns=None, dtype="float32"):
@@ -122,7 +124,7 @@ def randn(shape, dtype="float32"):
     return jax.random.normal(_key(), tuple(shape), _dt.convert_dtype(dtype))
 
 
-def randint(low, high=None, shape=(1,), dtype="int64"):
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
     if high is None:
         low, high = 0, low
     return jax.random.randint(_key(), tuple(shape), low, high,
@@ -353,11 +355,11 @@ bitwise_xor = jnp.bitwise_xor
 bitwise_not = jnp.bitwise_not
 
 
-def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
     return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
-def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
     return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
@@ -667,6 +669,8 @@ def numel(x):
 
 
 def shape(x):
+    if _is_lazy(x):    # static program var: record, don't eval
+        return x._map(lambda v: jnp.asarray(v.shape, jnp.int32), "shape")
     return jnp.asarray(x.shape, dtype=jnp.int32)
 
 
@@ -1077,6 +1081,9 @@ def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
 
 
 from . import manipulation  # noqa: E402  (after tensor_array_to_tensor)
+import sys as _sys
+_sys.modules[__name__ + ".math"] = _sys.modules[__name__]
+math = _sys.modules[__name__]      # paddle.tensor.math doctest path
 
 for _n in ("array", "random", "manipulation", "create_tensor",
            "tensor_array_to_tensor"):

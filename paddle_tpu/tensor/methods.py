@@ -174,6 +174,37 @@ def _methods():
     def unbind(self, axis=0):
         return T.unbind(self, axis=axis)
 
+    def to_sparse_coo(self, sparse_dim=None):
+        import paddle_tpu.sparse as _sp
+        return _sp.to_sparse_coo(self, sparse_dim=sparse_dim)
+
+    def to_sparse_csr(self):
+        import paddle_tpu.sparse as _sp
+        return _sp.to_sparse_csr(self)
+
+    def to_dense(self):
+        return self                      # already dense
+
+    def fill_(self, value):
+        # value-semantics alias of the inplace fill (tensor/inplace.py
+        # convention: compute and return)
+        return jnp.full_like(self, value)
+
+    def zero_(self):
+        return jnp.zeros_like(self)
+
+    def set_value(self, value):
+        return jnp.asarray(value, self.dtype).reshape(self.shape)
+
+    def fill_diagonal_tensor(self, y, offset=0, dim1=0, dim2=1):
+        return T.diagonal_scatter(self, y, offset=offset, axis1=dim1,
+                                  axis2=dim2)
+
+    fill_diagonal_tensor_ = fill_diagonal_tensor
+
+    def nanmedian(self, axis=None, keepdim=False):
+        return jnp.nanmedian(self, axis=axis, keepdims=keepdim)
+
     def diagonal_scatter(self, y, offset=0, axis1=0, axis2=1):
         return T.diagonal_scatter(self, y, offset=offset, axis1=axis1,
                                   axis2=axis2)

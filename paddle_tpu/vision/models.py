@@ -50,24 +50,35 @@ _VGG_CFGS = {
 }
 
 
-class VGG(nn.Layer):
-    """reference: python/paddle/vision/models/vgg.py"""
+def make_layers(cfg, batch_norm: bool = False):
+    """Build the VGG feature extractor from a config list (reference:
+    vision/models/vgg.py make_layers — ints are conv widths, 'M' pools)."""
+    layers = []
+    in_ch = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            in_ch = v
+    return nn.Sequential(*layers)
 
-    def __init__(self, cfg: str = "D", num_classes: int = 1000,
+
+class VGG(nn.Layer):
+    """reference: python/paddle/vision/models/vgg.py — takes a FEATURES
+    layer (make_layers result) like the reference; a config-letter string
+    is also accepted and built internally."""
+
+    def __init__(self, features="D", num_classes: int = 1000,
                  batch_norm: bool = False, with_pool: bool = True):
         super().__init__()
-        layers = []
-        in_ch = 3
-        for v in _VGG_CFGS[cfg]:
-            if v == "M":
-                layers.append(nn.MaxPool2D(2, 2))
-            else:
-                layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
-                if batch_norm:
-                    layers.append(nn.BatchNorm2D(v))
-                layers.append(nn.ReLU())
-                in_ch = v
-        self.features = nn.Sequential(*layers)
+        if isinstance(features, str):
+            features = make_layers(_VGG_CFGS[features],
+                                   batch_norm=batch_norm)
+        self.features = features
         self.with_pool = with_pool
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
