@@ -518,7 +518,8 @@ def main():
         # async-collective + latency-hiding scheduler flags (overlap.py);
         # A/B lever: PT_NO_OVERLAP=1
         from paddle_tpu.distributed.overlap import apply_overlap_flags
-        apply_overlap_flags(True, target="tpu")
+        apply_overlap_flags(True, target="tpu", validate=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__)))
     else:
         error_note = f"TPU unavailable, CPU fallback: {note}"
         # config.update beats the site hook's forced jax_platforms=axon,cpu;

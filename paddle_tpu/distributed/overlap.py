@@ -50,13 +50,136 @@ OVERLAP_XLA_FLAGS = (
 )
 
 
-def apply_overlap_flags(enable: bool = True, *, target: str = "tpu") -> str:
+def validate_xla_flags(candidates: List[str], *, cwd: Optional[str] = None,
+                       timeout: Optional[float] = None) -> List[str]:
+    """Return the subset of ``candidates`` this XLA build accepts.
+
+    XLA FATALLY ABORTS the whole process on any unrecognized flag in
+    XLA_FLAGS (parse_flags_from_env.cc) — observed live on the axon/libtpu
+    build, which rejects the whole async-collective set. So candidates are
+    vetted in a killable probe subprocess first: the child's abort message
+    names the offending flags, those are dropped, and the remainder is
+    re-vetted (the build may reject several in sequence). A hang or any
+    non-flag failure vets conservatively to [] — no flag is worth wedging
+    the bench — but such transient outcomes are NOT cached; only a
+    definitive verdict (probe succeeded, or the unknown-flag refinement
+    converged) is persisted per jax/plugin-build under build/ so repeat
+    runs skip the extra backend inits."""
+    import json as _json
+
+    if not candidates:
+        return []
+    if timeout is None:
+        timeout = float(os.environ.get("PT_FLAG_VET_TIMEOUT", "240"))
+    fp = _xla_build_fingerprint()
+    cacheable = "plugin-meta-unavailable" not in fp
+    key = fp + "|" + " ".join(sorted(candidates))
+    # repo root, shared by the cache file and the probe child's PYTHONPATH
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cache_path = os.path.join(pkg_root, "build", "xla_flag_cache.json")
+    cache = {}
+    if cacheable:
+        try:
+            with open(cache_path) as f:
+                cache = _json.load(f)
+            if key in cache:
+                return [c for c in candidates if c in cache[key]]
+        except Exception:
+            cache = {}
+
+    from paddle_tpu.utils.hw_probe import _one_probe
+    base = os.environ.get("XLA_FLAGS", "")
+    # pkg_root also goes on the probe child's PYTHONPATH: the child must
+    # find paddle_tpu regardless of the caller's cwd (library users run
+    # from anywhere; only bench.py happens to sit next to the package)
+    live = list(candidates)
+    definitive = True
+    for _ in range(len(candidates)):
+        if not live:
+            break
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (base + " " + " ".join(live)).strip()
+        env["PYTHONPATH"] = (pkg_root + os.pathsep
+                             + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+        ok, msg = _one_probe(timeout, cwd or pkg_root, env=env)
+        if ok:
+            break
+        if msg.startswith("UNKNOWN_XLA_FLAGS"):
+            bad = set(msg.split()[1:])
+            nxt = [c for c in live if c.split("=")[0] not in bad]
+            if len(nxt) == len(live):
+                # abort names only flags outside our set — the user's own
+                # XLA_FLAGS are bad; nothing we drop can fix that
+                sys.stderr.write(
+                    f"paddle_tpu.overlap: XLA rejects flags not from the "
+                    f"overlap set ({sorted(bad)}) — fix XLA_FLAGS; applying "
+                    f"no overlap flags\n")
+                live = []
+                definitive = False
+                break
+            live = nxt
+            continue
+        sys.stderr.write(f"paddle_tpu.overlap: flag vetting probe failed "
+                         f"({msg[:200]}); applying no overlap flags\n")
+        live = []
+        definitive = False  # hang/TPU-busy/import error: retry next run
+        break
+    if definitive and cacheable:
+        try:
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            cache[key] = live
+            with open(cache_path, "w") as f:
+                _json.dump(cache, f, indent=1)
+        except Exception:
+            pass
+    return live
+
+
+def _xla_build_fingerprint() -> str:
+    """Cache key for flag-support vetting: the flag parser lives in the
+    PJRT plugin (libtpu/axon), not in jax — include every installed
+    dist that looks like a TPU/PJRT plugin so a plugin upgrade without a
+    jax version bump invalidates the cache."""
+    import jax as _jax
+    parts = [f"jax{_jax.__version__}",
+             os.environ.get("JAX_PLATFORMS", "")]
+    try:
+        import importlib.metadata as _md
+        plug = []
+        for d in _md.distributions():
+            try:
+                # d.metadata can be None for orphaned/partial dist-info
+                # dirs (interrupted pip uninstall) — skip those, don't
+                # abandon the whole fingerprint
+                name = d.metadata["Name"] if d.metadata else None
+            except Exception:
+                continue
+            if name and any(t in name.lower()
+                            for t in ("libtpu", "axon", "pjrt", "jaxlib")):
+                plug.append(f"{name}{d.version}")
+        parts.extend(sorted(plug))
+    except Exception:
+        # plugin versions unknowable -> the key cannot prove build
+        # identity, so mark it uncacheable rather than risk serving a
+        # stale "accepted" verdict to a different plugin build (which
+        # would reintroduce the fatal abort this machinery prevents)
+        parts.append("plugin-meta-unavailable")
+    return "|".join(parts)
+
+
+def apply_overlap_flags(enable: bool = True, *, target: str = "tpu",
+                        validate: bool = False, cwd: Optional[str] = None,
+                        validate_timeout: Optional[float] = None) -> str:
     """Install the overlap scheduler flags into XLA_FLAGS (idempotent).
 
     Must run BEFORE jax backend initialization — flags set after the
     backend is live are ignored, in which case this warns and returns the
     current value unchanged. ``PT_NO_OVERLAP=1`` forces them off (the A/B
-    lever for measuring the overlap win on hardware)."""
+    lever for measuring the overlap win on hardware). ``validate=True``
+    vets each flag against the installed XLA in a subprocess first
+    (required on real hardware: unknown flags are a process-fatal error,
+    see :func:`validate_xla_flags`)."""
     if os.environ.get("PT_NO_OVERLAP"):
         enable = False
     cur = os.environ.get("XLA_FLAGS", "")
@@ -74,10 +197,17 @@ def apply_overlap_flags(enable: bool = True, *, target: str = "tpu") -> str:
     except AttributeError:
         initialized = {}
     if initialized:
+        # checked BEFORE validate: vetting spawns multi-minute backend-init
+        # subprocesses, pointless when flags can no longer be applied
         sys.stderr.write(
             "paddle_tpu.overlap: backend already initialized; XLA overlap "
             "flags NOT applied (set strategy before first jax use)\n")
         return cur
+    if validate:
+        missing = validate_xla_flags(missing, cwd=cwd,
+                                     timeout=validate_timeout)
+        if not missing:
+            return cur
     new = (cur + " " + " ".join(missing)).strip()
     os.environ["XLA_FLAGS"] = new
     return new
@@ -229,7 +359,14 @@ def apply_strategy_overlap(strategy, *, target: Optional[str] = None) -> str:
     if target is None:
         target = _detect_target()
     if any(summary.values()):
-        return apply_overlap_flags(True, target=target)
+        # vet on real hardware: unknown flags abort the process at init.
+        # Short default timeout on this path — fleet.init must not stall
+        # minutes behind a wedged tunnel; a vet timeout just means no
+        # overlap flags this run (bench.py keeps the long default)
+        return apply_overlap_flags(
+            True, target=target, validate=(target == "tpu"),
+            validate_timeout=float(
+                os.environ.get("PT_FLAG_VET_TIMEOUT", "60")))
     return os.environ.get("XLA_FLAGS", "")
 
 
@@ -251,6 +388,6 @@ def _detect_target() -> str:
     return "tpu" if ("tpu" in jp or "axon" in jp) else "cpu"
 
 
-__all__ = ["OVERLAP_XLA_FLAGS", "apply_overlap_flags",
+__all__ = ["OVERLAP_XLA_FLAGS", "apply_overlap_flags", "validate_xla_flags",
            "backward_overlap_independent", "collectives_in_loop",
            "strategy_overlap_summary", "apply_strategy_overlap"]

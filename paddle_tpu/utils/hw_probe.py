@@ -21,14 +21,25 @@ PROBE_CODE = ("import jax; d=jax.devices(); "
               "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
 
 
-def _one_probe(timeout: float, cwd: str) -> Tuple[bool, str]:
+def _one_probe(timeout: float, cwd: str,
+               env: Optional[dict] = None) -> Tuple[bool, str]:
     p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True, start_new_session=True, cwd=cwd)
+                         text=True, start_new_session=True, cwd=cwd, env=env)
     try:
         out, err = p.communicate(timeout=timeout)
         if p.returncode == 0 and "TPU_OK" in out:
             return True, "TPU_OK"
+        # XLA aborts the process on unrecognized XLA_FLAGS
+        # (parse_flags_from_env.cc FATAL); surface the flag names intact so
+        # callers can drop exactly those and retry — the generic 300-char
+        # stderr tail would truncate the list
+        import re as _re
+        m = _re.search(r"Unknown flags? in XLA_FLAGS:\s*(.+)", err or "")
+        if m:
+            names = " ".join(tok.split("=")[0]
+                             for tok in m.group(1).split())
+            return False, f"UNKNOWN_XLA_FLAGS {names}"
         return False, (f"rc={p.returncode} "
                        f"platform={out.strip()[-40:] or '?'}: "
                        f"{(err or '').strip()[-300:]}")

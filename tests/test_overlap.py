@@ -184,3 +184,110 @@ class TestHloAnalysisSoundness:
         assert overlap._detect_target() == "cpu"
         monkeypatch.setattr(overlap, "_config_platforms", lambda: "tpu,cpu")
         assert overlap._detect_target() == "tpu"
+
+
+class TestFlagVetting:
+    """validate_xla_flags: unknown flags are a process-FATAL error at XLA
+    backend init (parse_flags_from_env.cc), observed live on the axon
+    build — the vetting subprocess plus refinement loop is the only thing
+    standing between the overlap flags and a zeroed bench."""
+
+    def _patch_probe(self, monkeypatch, responses, calls):
+        def fake_probe(timeout, cwd, env=None):
+            calls.append(env.get("XLA_FLAGS", ""))
+            return responses[min(len(calls) - 1, len(responses) - 1)]
+        monkeypatch.setattr(
+            "paddle_tpu.utils.hw_probe._one_probe", fake_probe)
+
+    def _no_cache(self, monkeypatch, tmp_path):
+        # point the cache at a throwaway dir: tests must not poison (or
+        # read) the real build/xla_flag_cache.json
+        import paddle_tpu.distributed.overlap as ov
+        real = os.path.abspath
+        monkeypatch.setattr(
+            ov.os.path, "abspath",
+            lambda p: str(tmp_path / "x" / "y" / "z.py")
+            if p.endswith("overlap.py") else real(p))
+
+    def test_all_accepted(self, monkeypatch, tmp_path):
+        self._no_cache(monkeypatch, tmp_path)
+        calls = []
+        self._patch_probe(monkeypatch, [(True, "TPU_OK")], calls)
+        got = overlap.validate_xla_flags(["--a=true", "--b=true"])
+        assert got == ["--a=true", "--b=true"]
+        assert len(calls) == 1
+
+    def test_refinement_drops_only_named_flags(self, monkeypatch, tmp_path):
+        self._no_cache(monkeypatch, tmp_path)
+        calls = []
+        self._patch_probe(monkeypatch, [
+            (False, "UNKNOWN_XLA_FLAGS --a"),
+            (True, "TPU_OK"),
+        ], calls)
+        got = overlap.validate_xla_flags(["--a=true", "--b=true"])
+        assert got == ["--b=true"]
+        assert len(calls) == 2
+        assert "--a=true" not in calls[1]
+
+    def test_all_rejected_in_sequence(self, monkeypatch, tmp_path):
+        self._no_cache(monkeypatch, tmp_path)
+        calls = []
+        self._patch_probe(monkeypatch, [
+            (False, "UNKNOWN_XLA_FLAGS --a --b"),
+        ], calls)
+        assert overlap.validate_xla_flags(["--a=1", "--b=1"]) == []
+
+    def test_foreign_bad_flag_drops_all_without_loop(self, monkeypatch,
+                                                    tmp_path, capsys):
+        # abort names a flag NOT in our candidate set (user typo in their
+        # own XLA_FLAGS): vet to [] with a diagnostic, don't spin
+        self._no_cache(monkeypatch, tmp_path)
+        calls = []
+        self._patch_probe(monkeypatch, [
+            (False, "UNKNOWN_XLA_FLAGS --users_own_typo"),
+        ], calls)
+        assert overlap.validate_xla_flags(["--a=1"]) == []
+        assert len(calls) == 1
+        assert "not from the overlap set" in capsys.readouterr().err
+
+    def test_transient_failure_not_cached(self, monkeypatch, tmp_path):
+        import json
+        import paddle_tpu.distributed.overlap as ov
+        self._no_cache(monkeypatch, tmp_path)
+        cache_file = tmp_path / "build" / "xla_flag_cache.json"
+        calls = []
+        self._patch_probe(monkeypatch,
+                          [(False, "hung >240s (TPU tunnel wedged?)")],
+                          calls)
+        assert overlap.validate_xla_flags(["--a=1"]) == []
+        assert not cache_file.exists(), \
+            "transient probe failure must not be cached as a verdict"
+        # definitive success IS cached and replayed without re-probing
+        self._patch_probe(monkeypatch, [(True, "TPU_OK")], calls)
+        calls.clear()
+        assert overlap.validate_xla_flags(["--a=1"]) == ["--a=1"]
+        assert len(calls) == 1
+        if "plugin-meta-unavailable" not in ov._xla_build_fingerprint():
+            assert cache_file.exists()
+            calls.clear()
+            assert overlap.validate_xla_flags(["--a=1"]) == ["--a=1"]
+            assert calls == [], "cached verdict should skip the probe"
+
+
+class TestUnknownFlagParsing:
+    def test_one_probe_extracts_flag_names(self, monkeypatch):
+        import subprocess as sp
+        from paddle_tpu.utils import hw_probe
+
+        class FakeProc:
+            returncode = -6
+            pid = 0
+            def communicate(self, timeout=None):
+                return ("", "F0731 03:48:10 parse_flags_from_env.cc:234] "
+                        "Unknown flags in XLA_FLAGS: --xla_foo=true "
+                        "--xla_bar=false\n")
+        monkeypatch.setattr(hw_probe.subprocess, "Popen",
+                            lambda *a, **k: FakeProc())
+        ok, msg = hw_probe._one_probe(1.0, "/tmp")
+        assert not ok
+        assert msg == "UNKNOWN_XLA_FLAGS --xla_foo --xla_bar"
