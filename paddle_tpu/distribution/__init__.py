@@ -935,6 +935,17 @@ class Transform:
     def forward_log_det_jacobian(self, x):
         raise NotImplementedError
 
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    # shape maps are identity for elementwise transforms; shape-changing
+    # transforms (Reshape) override (reference transform.py forward_shape)
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
 
 class AffineTransform(Transform):
     def __init__(self, loc, scale):
@@ -1059,6 +1070,22 @@ class ReshapeTransform(Transform):
         lead = jnp.shape(x)[:len(jnp.shape(x)) - len(self.in_event_shape)]
         return jnp.zeros(lead)
 
+    def forward_shape(self, shape):
+        shape = tuple(shape)
+        n = len(self.in_event_shape)
+        if shape[len(shape) - n:] != self.in_event_shape:
+            raise ValueError(f"trailing dims of {shape} do not match "
+                             f"in_event_shape {self.in_event_shape}")
+        return shape[:len(shape) - n] + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        shape = tuple(shape)
+        n = len(self.out_event_shape)
+        if shape[len(shape) - n:] != self.out_event_shape:
+            raise ValueError(f"trailing dims of {shape} do not match "
+                             f"out_event_shape {self.out_event_shape}")
+        return shape[:len(shape) - n] + self.in_event_shape
+
 
 class SoftmaxTransform(Transform):
     """x -> softmax(x) (reference: not bijective; inverse is log)."""
@@ -1156,3 +1183,24 @@ def _kl_geometric(p, q):
     return (p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
             + (1 - p.probs) * (jnp.log1p(-p.probs)
                                - jnp.log1p(-q.probs)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    # standard Dirichlet-family closed form (reference kl.py
+    # _kl_beta_beta): lnB(a2,b2) - lnB(a1,b1) + (a1-a2)ψ(a1) +
+    # (b1-b2)ψ(b1) + (a2-a1+b2-b1)ψ(a1+b1)
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return (betaln(a2, b2) - betaln(a1, b1)
+            + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+            + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019; the reference cites the same in
+    # distribution/cauchy.py kl_divergence):
+    # log[ ((γp+γq)² + (xp−xq)²) / (4 γp γq) ]
+    return jnp.log(((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+                   / (4.0 * p.scale * q.scale))

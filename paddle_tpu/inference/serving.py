@@ -59,7 +59,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, max_batch: int = 8, page_size: int = 128,
                  max_len: int = 2048, num_pages: Optional[int] = None,
-                 generation_config: Optional[GenerationConfig] = None):
+                 generation_config: Optional[GenerationConfig] = None,
+                 decode_block: int = 1):
         self.model = model
         self.core = getattr(model, "model", model)
         self.cfg = generation_config or GenerationConfig()
@@ -85,7 +86,16 @@ class ContinuousBatchingEngine:
                         if hasattr(model, "raw_parameters") else {})
         self._key = jax.random.PRNGKey(self.cfg.seed)
         self._prefill_cache: Dict[int, object] = {}
-        self._decode_fn = None
+        # decode_block = tokens generated per compiled scheduler tick. One
+        # tick costs ONE dispatch + ONE host readback regardless of K, so
+        # over a high-latency link (tunneled TPU; real pods to a lesser
+        # degree) throughput scales ~K until compute dominates. Tokens a
+        # slot generates past its own EOS/max_new inside a block are
+        # discarded on the host (their garbage KV sits beyond the slot's
+        # position and is overwritten by later writes), so outputs are
+        # EXACT for any K under greedy decoding.
+        self.decode_block = max(1, int(decode_block))
+        self._decode_fns: Dict[int, object] = {}  # K -> compiled block
         self._logits = None                # device [max_batch, vocab]
         self.preemptions = 0
         # bounded window (run() releases _Request objects for the same
@@ -229,89 +239,130 @@ class ContinuousBatchingEngine:
 
     # -- decode -------------------------------------------------------------
 
-    def _build_decode(self):
+    def _build_decode(self, K: int):
+        """K sample+decode steps chained in one compiled lax.scan: one
+        dispatch + one [K, B] token readback per scheduler tick."""
         core, model, cfg = self.core, self.model, self.cfg
         head = model.logits if hasattr(model, "logits") else (lambda h: h)
 
         def run(params, logits, pos, pools, tables, active, key):
             ctx = model._bind(params) if hasattr(model, "_bind") else None
             with ctx if ctx is not None else _null():
-                tok = _sample_logits(logits.astype(jnp.float32), cfg, key)
-                tok = jnp.where(active, tok, 0)
-                h, pools = core.decode_step_paged(tok, pos, pools, tables)
-                new_logits = head(h[:, 0, :])
-            return tok, new_logits, pools
+                def body(carry, _):
+                    logits, pos, pools, key = carry
+                    key, sub = jax.random.split(key)
+                    tok = _sample_logits(logits.astype(jnp.float32), cfg,
+                                         sub)
+                    tok = jnp.where(active, tok, 0)
+                    h, pools = core.decode_step_paged(tok, pos, pools,
+                                                      tables)
+                    new_logits = head(h[:, 0, :])
+                    pos = jnp.where(active, pos + 1, pos)
+                    return (new_logits, pos, pools, key), tok
+
+                (logits, pos, pools, key), toks = jax.lax.scan(
+                    body, (logits, pos, pools, key), None, length=K)
+            return toks, logits, pools
 
         return jax.jit(run, donate_argnums=(3,))
 
-    def _ensure_decode_pages(self):
-        """Claim next pages for slots about to cross a page boundary;
-        preempt (recompute policy) when the pool is dry."""
+    def _ensure_decode_pages(self, K: int = 1):
+        """Claim every page any active slot will KEEP writes in within the
+        next K decode steps; preempt (recompute policy) when the pool is
+        dry. A slot's claim span is capped by its remaining max_new
+        budget — in-block steps past that produce discarded tokens whose
+        KV lands in the garbage page (tables entry 0), so claiming for
+        them would evict victims for pages never legitimately written."""
         for slot in range(self.max_batch):
-            if self._slots[slot] is None:
+            req = self._slots[slot]
+            if req is None:
                 continue
             pos = int(self.pos[slot])
-            if pos % self.page_size != 0:
-                continue                      # not at a boundary
-            pidx = pos // self.page_size
-            if pidx >= self.pages_per_seq:
-                raise RuntimeError("sequence exceeded engine max_len")
-            if self.tables[slot, pidx] != 0:
-                continue                      # already holds this page
-            page = self._alloc_pages(1)
-            while page is None:
-                victim = max((i for i in range(self.max_batch)
-                              if self._slots[i] is not None and i != slot),
-                             key=lambda i: self._slots[i].rid,
-                             default=None)
-                if victim is None:
-                    raise RuntimeError("page pool too small for one request")
-                self.preemptions += 1
-                vreq = self._slots[victim]
-                self._free_slot(victim)
-                self._queue.insert(0, vreq)
+            span = min(K, req.max_new_tokens - len(req.generated))
+            first = pos // self.page_size    # ceil == floor at a boundary;
+            # a mid-page pos's current page is already held (tables check)
+            last = (pos + span - 1) // self.page_size
+            for pidx in range(first, last + 1):
+                if pidx >= self.pages_per_seq:
+                    raise RuntimeError("sequence exceeded engine max_len")
+                if self.tables[slot, pidx] != 0:
+                    continue                  # already holds this page
                 page = self._alloc_pages(1)
-            self.tables[slot, pidx] = page[0]
+                while page is None:
+                    victim = max((i for i in range(self.max_batch)
+                                  if self._slots[i] is not None
+                                  and i != slot),
+                                 key=lambda i: self._slots[i].rid,
+                                 default=None)
+                    if victim is None:
+                        raise RuntimeError(
+                            "page pool too small for one request")
+                    self.preemptions += 1
+                    vreq = self._slots[victim]
+                    self._free_slot(victim)
+                    self._queue.insert(0, vreq)
+                    page = self._alloc_pages(1)
+                self.tables[slot, pidx] = page[0]
 
     def _decode(self) -> List[tuple]:
         active_slots = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_slots:
             return []
-        self._ensure_decode_pages()
+        # block length this tick: the configured K, capped so no slot's
+        # in-block writes can run past its page-table capacity
+        cap = self.pages_per_seq * self.page_size
+        K = min(self.decode_block,
+                min(cap - int(self.pos[i]) for i in active_slots))
+        K = max(K, 1)
+        self._ensure_decode_pages(K)
         # a preemption may have emptied every slot
         active_slots = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_slots:
             return []
-        if self._decode_fn is None:
-            self._decode_fn = self._build_decode()
+        fn = self._decode_fns.get(K)
+        if fn is None:
+            fn = self._decode_fns[K] = self._build_decode(K)
         active = np.zeros((self.max_batch,), bool)
         active[active_slots] = True
         self._key, sub = jax.random.split(self._key)
-        tok, self._logits, self.pools = self._decode_fn(
+        toks, self._logits, self.pools = fn(
             self._params, self._logits, jnp.asarray(self.pos), self.pools,
             jnp.asarray(self.tables), jnp.asarray(active), sub)
-        tok_host = np.asarray(tok)
+        toks_host = np.asarray(toks)          # [K, max_batch]
         emitted = []
         now = time.perf_counter()
+        eos = self.cfg.eos_token_id
         for slot in active_slots:
             req = self._slots[slot]
-            t = int(tok_host[slot])
-            req.generated.append(t)
-            if req.first_tok_t == 0.0:
-                req.first_tok_t = now
-            emitted.append((req.rid, t))
-            self.pos[slot] += 1
-            eos = self.cfg.eos_token_id
-            if (len(req.generated) >= req.max_new_tokens
-                    or (eos is not None and t == eos)):
-                req.done = True
+            kept = 0
+            for j in range(K):
+                t = int(toks_host[j, slot])
+                req.generated.append(t)
+                kept += 1
+                if req.first_tok_t == 0.0:
+                    req.first_tok_t = now
+                emitted.append((req.rid, t))
+                if (len(req.generated) >= req.max_new_tokens
+                        or (eos is not None and t == eos)):
+                    req.done = True
+                    break
+            if req.done:
                 req.done_t = now
                 self._latencies.append(
                     (req.first_tok_t - req.submit_t,
                      req.done_t - req.submit_t,
                      len(req.generated)))
+                # tokens past the stop point (and their KV) are dropped;
+                # _free_slot resets pos/tables so the garbage is unreachable
                 self._free_slot(slot)
+            else:
+                self.pos[slot] += kept        # kept == K here
         return emitted
+
+    def reset_latency_stats(self) -> None:
+        """Drop the retired-request latency window (e.g. after a warmup
+        phase whose TTFTs include one-time jit compiles)."""
+        self._latencies.clear()
 
     def latency_stats(self) -> Dict[str, float]:
         """TTFT / end-to-end latency percentiles over a sliding window of

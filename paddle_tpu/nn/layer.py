@@ -472,6 +472,10 @@ class Sequential(Layer):
         return x
 
     def __getitem__(self, idx):
+        # reference Sequential supports both positional and named access
+        # (container.py Sequential example: model1[0], model2['l1'])
+        if isinstance(idx, str):
+            return self._sub_layers[idx]
         return list(self._sub_layers.values())[idx]
 
     def __len__(self):
@@ -491,6 +495,29 @@ class LayerList(Layer):
     def append(self, layer: Layer) -> "LayerList":
         self.add_sublayer(str(len(self._sub_layers)), layer)
         return self
+
+    def insert(self, index: int, sublayer: Layer) -> None:
+        """Insert ``sublayer`` before ``index`` (reference:
+        nn/layer/container.py LayerList.insert — same bounds contract)."""
+        n = len(self._sub_layers)
+        if not (isinstance(index, int) and -n <= index < max(n, 1)):
+            raise AssertionError(
+                f"index should be an integer in range [{-n}, {n})")
+        if index < 0:
+            index += n
+        for i in range(n, index, -1):
+            self._sub_layers[str(i)] = self._sub_layers[str(i - 1)]
+        self._sub_layers[str(index)] = sublayer
+
+    def extend(self, sublayers) -> "LayerList":
+        offset = len(self)
+        for i, sublayer in enumerate(sublayers):
+            self.add_sublayer(str(offset + i), sublayer)
+        return self
+
+    def __setitem__(self, idx: int, layer: Layer):
+        idx = idx if idx >= 0 else len(self) + idx
+        self._sub_layers[str(idx)] = layer
 
     def __getitem__(self, idx):
         if isinstance(idx, slice):
@@ -516,6 +543,38 @@ class LayerDict(Layer):
 
     def __setitem__(self, key, layer):
         self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def __iter__(self):
+        # dict-like: iterate KEYS (reference container.py LayerDict
+        # example: `for k in layers_dict: layers_dict[k]`)
+        return iter(self._sub_layers)
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def update(self, sublayers):
+        """Merge key/layer pairs, overwriting existing keys (reference:
+        container.py LayerDict.update)."""
+        assert isinstance(sublayers, (dict, LayerDict)) or hasattr(
+            sublayers, "__iter__"), \
+            "sublayers should be a dict/LayerDict or iterable of pairs"
+        if isinstance(sublayers, (dict, LayerDict)):
+            for k, v in sublayers.items():
+                self.add_sublayer(k, v)
+        else:
+            for k, v in sublayers:
+                self.add_sublayer(k, v)
 
     def keys(self):
         return self._sub_layers.keys()

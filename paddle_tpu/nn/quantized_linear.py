@@ -179,6 +179,8 @@ def _try_pallas_weight_only(x, wq, weight_scale):
     for d in lead:
         m *= d
     from ..ops.pallas import int8_matmul as im
+    if im.db_winner(m, wq.shape[0], x.shape[-1], x.dtype) == "xla":
+        return None  # measured on hardware: XLA path >= fused kernel here
     bm, bn, bk = im.tuned_blocks(m, wq.shape[0], x.shape[-1], x.dtype)
     if not im.shapes_supported((m, x.shape[-1]), tuple(wq.shape),
                                block_m=bm, block_n=bn, block_k=bk,

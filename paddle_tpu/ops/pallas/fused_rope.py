@@ -35,17 +35,20 @@ DEFAULT_BLOCK_S = 512
 
 
 def _rope_kernel(q_ref, k_ref, cos_ref, sin_ref, qo_ref, ko_ref):
-    cos = cos_ref[...]                       # [bs, d]
-    sin = sin_ref[...]
-    half = cos.shape[-1] // 2
+    # half-sliced form: out1 = x1*c1 - x2*s1, out2 = x2*c2 + x1*s2
+    # (identical to concat([-x2, x1]) rotate-half, but never materializes
+    # the full-width rot/product temporaries — the concat form blew the
+    # 16M scoped-vmem stack limit at block_s=512, h=16, d=128 on v5e)
+    half = cos_ref.shape[-1] // 2
+    c1 = cos_ref[:, :half][None, :, None, :]     # [1, bs, 1, d/2]
+    c2 = cos_ref[:, half:][None, :, None, :]
+    s1 = sin_ref[:, :half][None, :, None, :]
+    s2 = sin_ref[:, half:][None, :, None, :]
     for ref, out in ((q_ref, qo_ref), (k_ref, ko_ref)):
-        x = ref[...].astype(jnp.float32)     # [1, bs, h, d]
-        x1 = x[..., :half]
-        x2 = x[..., half:]
-        rot = jnp.concatenate([-x2, x1], axis=-1)
-        c = cos[None, :, None, :]
-        s = sin[None, :, None, :]
-        out[...] = (x * c + rot * s).astype(out.dtype)
+        x1 = ref[..., :half].astype(jnp.float32)  # [1, bs, h, d/2]
+        x2 = ref[..., half:].astype(jnp.float32)
+        out[..., :half] = (x1 * c1 - x2 * s1).astype(out.dtype)
+        out[..., half:] = (x2 * c2 + x1 * s2).astype(out.dtype)
 
 
 def fused_rope_pallas(q, k, cos, sin, *, block_s: int = DEFAULT_BLOCK_S,
@@ -56,10 +59,10 @@ def fused_rope_pallas(q, k, cos, sin, *, block_s: int = DEFAULT_BLOCK_S,
     b, s, h, d = q.shape
     assert k.shape[0] == b and k.shape[1] == s and k.shape[3] == d
     assert cos.shape == (s, d) and sin.shape == (s, d)
-    block_s = min(block_s, s)
+    hk = k.shape[2]
+    block_s = _fit_block_s(min(block_s, s), h, hk, d)
     if s % block_s:
         raise ValueError(f"seq {s} does not divide block_s {block_s}")
-    hk = k.shape[2]
     grid = (b, s // block_s)
     cf = jnp.float32
 
@@ -84,6 +87,22 @@ def fused_rope_pallas(q, k, cos, sin, *, block_s: int = DEFAULT_BLOCK_S,
         interpret=interpret,
     )(q, k, cos.astype(cf), sin.astype(cf))
     return qo, ko
+
+
+_VMEM_BUDGET = 12 * 2**20  # leave headroom under the 16M scoped-vmem limit
+
+
+def _fit_block_s(block_s: int, h: int, hk: int, d: int) -> int:
+    """Largest power-of-two block_s whose VMEM working set fits.
+
+    Per sequence position: q+k blocks in and out (bf16, double-buffered by
+    Mosaic) plus the f32 half-width temporaries the kernel body creates
+    (~3 live full-width-f32-equivalents per tensor) plus cos/sin (f32).
+    Estimate ~= block_s * [(h+hk)*d*(2B*2*2 + 4B*3) + 2*d*4B]."""
+    per_s = (h + hk) * d * (2 * 2 * 2 + 4 * 3) + 2 * d * 4
+    while block_s > 8 and block_s * per_s > _VMEM_BUDGET:
+        block_s //= 2
+    return block_s
 
 
 def rope_supported(q_shape, k_shape, d_lane: int = 128) -> bool:

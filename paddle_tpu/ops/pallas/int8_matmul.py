@@ -125,14 +125,18 @@ def shapes_supported(x_shape, w_shape, *, block_m=DEFAULT_BLOCK_M,
         and bk >= 128
 
 
+def _db_cfg(m, n, k, dtype):
+    from .autotune import _DB
+    import jax as _jax
+    kind = getattr(_jax.devices()[0], "device_kind", "cpu")
+    return _DB.lookup(_DB.key("int8_matmul", kind, str(dtype),
+                              sm=m, sn=n, sk=k))
+
+
 def tuned_blocks(m, n, k, dtype="bfloat16"):
     """Tune-DB lookup for (m, n, k); falls back to the MXU defaults."""
     try:
-        from .autotune import _DB
-        import jax as _jax
-        kind = getattr(_jax.devices()[0], "device_kind", "cpu")
-        cfg = _DB.lookup(_DB.key("int8_matmul", kind, str(dtype),
-                                 sm=m, sn=n, sk=k))
+        cfg = _db_cfg(m, n, k, dtype)
         if cfg:
             return (cfg.get("block_m", DEFAULT_BLOCK_M),
                     cfg.get("block_n", DEFAULT_BLOCK_N),
@@ -142,4 +146,20 @@ def tuned_blocks(m, n, k, dtype="bfloat16"):
     return DEFAULT_BLOCK_M, DEFAULT_BLOCK_N, DEFAULT_BLOCK_K
 
 
-__all__ = ["int8_matmul_pallas", "shapes_supported", "tuned_blocks"]
+def db_winner(m, n, k, dtype="bfloat16"):
+    """Measured dispatch preference for this shape bucket.
+
+    'xla' = on-hardware A/B showed the XLA dequant-matmul at least ties
+    the fused kernel (v5e: the op is weight-streaming/overhead bound at
+    serving shapes, so fusing the dequant buys nothing measurable —
+    amortized scan-loop timings recorded in the DB entry). None = no
+    measurement, caller keeps its default."""
+    try:
+        cfg = _db_cfg(m, n, k, dtype)
+        return cfg.get("winner") if cfg else None
+    except Exception:
+        return None
+
+
+__all__ = ["int8_matmul_pallas", "shapes_supported", "tuned_blocks",
+           "db_winner"]

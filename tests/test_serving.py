@@ -211,3 +211,99 @@ def test_rejects_prompt_larger_than_pool(model):
         generation_config=GenerationConfig(max_new_tokens=4))
     with pytest.raises(ValueError, match="pool"):
         eng.submit(np.zeros((PAGE * 3,), np.int32))
+
+
+class TestDecodeBlocks:
+    """decode_block=K: K sample+decode steps per compiled tick (one host
+    round trip per K tokens). Outputs must be EXACT vs the step-wise
+    engine for any K — post-EOS/max_new tokens inside a block are
+    host-discarded and their garbage KV is unreachable."""
+
+    def test_block_matches_generate_scan_mixed_lengths(self, model):
+        rs = np.random.RandomState(7)
+        vocab = model.cfg.vocab_size
+        prompts = [_mk_prompt(rs, n, vocab) for n in (3, 9, 12, 5, 6)]
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, page_size=PAGE, max_len=64,
+            generation_config=GenerationConfig(max_new_tokens=10,
+                                               do_sample=False),
+            decode_block=4)
+        rids = [eng.submit(p) for p in prompts]
+        out = eng.run()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(out[rid],
+                                          _ref_greedy(model, p, 10))
+
+    def test_block_mid_block_retirement_and_uneven_max_new(self, model):
+        # per-request max_new NOT a multiple of K: every retirement
+        # happens mid-block and the trailing tokens must be dropped
+        rs = np.random.RandomState(8)
+        vocab = model.cfg.vocab_size
+        prompts = [_mk_prompt(rs, n, vocab) for n in (4, 11, 7)]
+        news = [5, 3, 9]
+        eng = ContinuousBatchingEngine(
+            model, max_batch=3, page_size=PAGE, max_len=64,
+            generation_config=GenerationConfig(max_new_tokens=9,
+                                               do_sample=False),
+            decode_block=4)
+        rids = [eng.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        out = eng.run()
+        for rid, p, n in zip(rids, prompts, news):
+            got = out[rid]
+            assert len(got) == n
+            np.testing.assert_array_equal(got, _ref_greedy(model, p, n))
+
+    def test_block_with_preemption_parity(self, model):
+        # tiny pool forces preemption while blocks pre-claim K ahead
+        rs = np.random.RandomState(9)
+        vocab = model.cfg.vocab_size
+        prompts = [_mk_prompt(rs, n, vocab) for n in (8, 8, 8)]
+        eng = ContinuousBatchingEngine(
+            model, max_batch=3, page_size=PAGE, max_len=32,
+            num_pages=7,   # < 3 slots * 4 pages: someone must be evicted
+            generation_config=GenerationConfig(max_new_tokens=12,
+                                               do_sample=False),
+            decode_block=4)
+        rids = [eng.submit(p) for p in prompts]
+        out = eng.run()
+        assert eng.preemptions >= 1
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(out[rid],
+                                          _ref_greedy(model, p, 12))
+
+    def test_block_eos_truncation(self, model):
+        # find the greedy EOS-free stream, then declare one of its tokens
+        # EOS: the engine must stop there even mid-block
+        rs = np.random.RandomState(10)
+        prompt = _mk_prompt(rs, 5, model.cfg.vocab_size)
+        ref = _ref_greedy(model, prompt, 8)
+        eos = int(ref[4])   # stops after the 5th generated token
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, page_size=PAGE, max_len=64,
+            generation_config=GenerationConfig(max_new_tokens=8,
+                                               do_sample=False,
+                                               eos_token_id=eos),
+            decode_block=4)
+        rid = eng.submit(prompt)
+        out = eng.run()
+        stop = int(np.where(ref == eos)[0][0])
+        np.testing.assert_array_equal(out[rid], ref[:stop + 1])
+
+    def test_block_claims_capped_by_remaining_budget(self, model):
+        # a request 4 tokens from done must NOT demand decode_block worth
+        # of pages: pool sized so over-claiming K=16 ahead would raise
+        # "page pool too small" / preempt spuriously
+        rs = np.random.RandomState(11)
+        prompt = _mk_prompt(rs, 16, model.cfg.vocab_size)   # 2 pages
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, page_size=PAGE, max_len=64,
+            num_pages=3,
+            generation_config=GenerationConfig(max_new_tokens=4,
+                                               do_sample=False),
+            decode_block=16)
+        rid = eng.submit(prompt)
+        out = eng.run()
+        assert eng.preemptions == 0
+        np.testing.assert_array_equal(out[rid],
+                                      _ref_greedy(model, prompt, 4))
