@@ -36,12 +36,13 @@ class MelSpectrogram(Layer):
     def __init__(self, sr: int = 22050, n_fft: int = 512,
                  hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
-                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
-                 f_max: Optional[float] = None, htk: bool = False,
-                 norm: str = "slaney"):
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney"):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
-                                       power)
+                                       power, center, pad_mode)
         fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
         self.register_buffer("fbank", fb)
 
@@ -54,12 +55,16 @@ class LogMelSpectrogram(Layer):
     def __init__(self, sr: int = 22050, n_fft: int = 512,
                  hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
-                 power: float = 2.0, n_mels: int = 64, f_min: float = 50.0,
-                 f_max: Optional[float] = None, ref_value: float = 1.0,
-                 amin: float = 1e-10, top_db: Optional[float] = None):
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None):
         super().__init__()
         self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
-                                  power, n_mels, f_min, f_max)
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
         self.ref_value = ref_value
         self.amin = amin
         self.top_db = top_db
@@ -71,11 +76,26 @@ class LogMelSpectrogram(Layer):
 
 class MFCC(Layer):
     def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
-                 n_mels: int = 64, f_min: float = 50.0,
-                 f_max: Optional[float] = None, top_db: Optional[float] = None):
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        # full reference signature (features/layers.py MFCC:352); the
+        # stft/window/mel knobs flow through LogMelSpectrogram
         super().__init__()
-        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels,
+        self.log_mel = LogMelSpectrogram(sr=sr, n_fft=n_fft,
+                                         hop_length=hop_length,
+                                         win_length=win_length,
+                                         window=window, power=power,
+                                         center=center, pad_mode=pad_mode,
+                                         n_mels=n_mels,
                                          f_min=f_min, f_max=f_max,
+                                         htk=htk, norm=norm,
+                                         ref_value=ref_value, amin=amin,
                                          top_db=top_db)
         self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels))
 

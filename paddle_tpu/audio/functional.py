@@ -12,24 +12,101 @@ __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
            "compute_fbank_matrix", "stft", "power_to_db", "create_dct"]
 
 
-def get_window(window: str, win_length: int, fftbins: bool = True):
-    """reference: functional/window.py get_window (hann/hamming/blackman/
-    rect/triang). Periodic (fftbins) windows by default, like the reference."""
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """reference: functional/window.py get_window — the full registered
+    set (hamming/hann/gaussian/general_gaussian/exponential/triang/
+    bohman/blackman/cosine/tukey/taylor). Parameterized kinds take the
+    reference's tuple form, e.g. ('gaussian', std). Periodic (fftbins)
+    windows are the symmetric (M+1)-point window truncated by one —
+    scipy's construction, which the reference wraps."""
+    args = ()
+    if isinstance(window, (tuple, list)):
+        window, *args = window
+    if window in ("gaussian", "exponential") and not args:
+        raise ValueError(f"The {window!r} window needs one or more "
+                         f"parameters — pass a tuple")
     n = win_length
-    denom = n if fftbins else n - 1
-    k = jnp.arange(n)
-    if window in ("hann", "hanning"):
-        return 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
-    if window == "hamming":
-        return 0.54 - 0.46 * jnp.cos(2 * math.pi * k / denom)
-    if window == "blackman":
-        return (0.42 - 0.5 * jnp.cos(2 * math.pi * k / denom)
-                + 0.08 * jnp.cos(4 * math.pi * k / denom))
-    if window in ("rect", "boxcar", "ones"):
-        return jnp.ones((n,))
-    if window == "triang":
-        return 1.0 - jnp.abs((k - (n - 1) / 2) / ((n if fftbins else n - 1) / 2))
-    raise ValueError(f"unknown window {window!r}")
+    M = n + 1 if fftbins else n          # build symmetric, then truncate
+    k = jnp.arange(M)
+
+    def _sym():
+        if window in ("hann", "hanning"):
+            return 0.5 - 0.5 * jnp.cos(2 * math.pi * k / (M - 1))
+        if window == "hamming":
+            return 0.54 - 0.46 * jnp.cos(2 * math.pi * k / (M - 1))
+        if window == "blackman":
+            return (0.42 - 0.5 * jnp.cos(2 * math.pi * k / (M - 1))
+                    + 0.08 * jnp.cos(4 * math.pi * k / (M - 1)))
+        if window in ("rect", "boxcar", "ones"):
+            return jnp.ones((M,))
+        if window == "triang":
+            nn = jnp.arange(1, (M + 1) // 2 + 1)
+            if M % 2 == 0:
+                half = (2 * nn - 1) / M
+                return jnp.concatenate([half, half[::-1]])
+            half = 2 * nn / (M + 1)
+            return jnp.concatenate([half, half[-2::-1]])
+        if window == "cosine":
+            return jnp.sin(math.pi / M * (k + 0.5))
+        if window == "gaussian":
+            std = float(args[0])
+            return jnp.exp(-0.5 * ((k - (M - 1) / 2) / std) ** 2)
+        if window == "general_gaussian":
+            p, sig = float(args[0]), float(args[1])
+            return jnp.exp(-0.5 * jnp.abs((k - (M - 1) / 2) / sig)
+                           ** (2 * p))
+        if window == "exponential":
+            center = (args[0] if len(args) > 1 and args[0] is not None
+                      else (M - 1) / 2)
+            tau = float(args[-1])
+            return jnp.exp(-jnp.abs(k - center) / tau)
+        if window == "bohman":
+            x = jnp.abs(2 * k / (M - 1) - 1)
+            w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+            return w.at[0].set(0.0).at[-1].set(0.0)
+        if window == "tukey":
+            alpha = float(args[0]) if args else 0.5
+            if alpha <= 0:
+                return jnp.ones((M,))
+            if alpha >= 1:
+                return 0.5 - 0.5 * jnp.cos(2 * math.pi * k / (M - 1))
+            width = int(alpha * (M - 1) / 2.0)
+            edge = 0.5 * (1 + jnp.cos(math.pi * (-1 + 2.0 * k / alpha
+                                                 / (M - 1))))
+            tail = 0.5 * (1 + jnp.cos(math.pi * (-2.0 / alpha + 1
+                                                 + 2.0 * k / alpha
+                                                 / (M - 1))))
+            w = jnp.ones((M,))
+            w = jnp.where(k < width + 1, edge, w)
+            return jnp.where(k >= M - width - 1, tail, w)
+        if window == "taylor":
+            nbar = int(args[0]) if args else 4
+            sll = float(args[1]) if len(args) > 1 else 30.0
+            B = 10 ** (sll / 20)
+            A = math.log(B + math.sqrt(B ** 2 - 1)) / math.pi
+            s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+            ma = jnp.arange(1, nbar)
+
+            def coef(mi):
+                num = jnp.prod(1 - mi ** 2 / s2
+                               / (A ** 2 + (ma - 0.5) ** 2))
+                den = jnp.prod(jnp.where(ma != mi, 1 - mi ** 2 / ma ** 2,
+                                         1.0))
+                return ((-1) ** (mi + 1)) * num / (2 * den)
+            Fm = jnp.stack([coef(float(mi)) for mi in range(1, nbar)])
+            xi = (k - (M - 1) / 2) / M
+            w = jnp.sum(Fm[:, None]
+                        * jnp.cos(2 * math.pi * ma[:, None] * xi[None, :]),
+                        axis=0)
+            w = 1 + 2 * w
+            # normalize by the CENTER value W(xi=0)=1+2*sum(Fm), not the
+            # sample max (even M has no sample at the center)
+            return w / (1 + 2 * jnp.sum(Fm))
+        raise ValueError(f"unknown window {window!r}")
+
+    w = _sym()
+    return (w[:-1] if fftbins else w).astype(dtype)
 
 
 def hz_to_mel(freq, htk: bool = False):
@@ -92,7 +169,9 @@ def stft(x, n_fft: int = 512, hop_length: Optional[int] = None,
     from .. import fft as pfft
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    w = get_window(window, win_length)
+    x = jnp.asarray(x)
+    wdt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else "float32"
+    w = get_window(window, win_length, dtype=wdt)
     if win_length < n_fft:
         pad = (n_fft - win_length) // 2
         w = jnp.pad(w, (pad, n_fft - win_length - pad))

@@ -71,6 +71,11 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
            "div": jnp.divide}
     if message_op not in ops:
         raise ValueError(f"message_op must be one of {sorted(ops)}")
+    y = jnp.asarray(y)
+    # reference broadcast rule: y's leading dim is the EDGE axis; a
+    # lower-rank y gains trailing dims ([E] edge scalars vs [E, F] msgs)
+    while y.ndim < msgs.ndim:
+        y = y[..., None]
     msgs = ops[message_op](msgs, y)
     fn = _REDUCERS.get(reduce_op)
     if fn is None:

@@ -67,6 +67,34 @@ class InputSpec:
             dims = [next(it) if d is None else d for d in dims]
         return jax.ShapeDtypeStruct(tuple(dims), convert_dtype(self.dtype))
 
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        """Spec from a live array (reference: static/input.py
+        InputSpec.from_tensor:238)."""
+        if not hasattr(tensor, "shape") or not hasattr(tensor, "dtype"):
+            raise ValueError(
+                f"Input `tensor` should be a Tensor, but received "
+                f"{type(tensor).__name__}.")
+        return cls(tuple(tensor.shape), str(tensor.dtype),
+                   name or getattr(tensor, "name", None))
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(tuple(ndarray.shape), str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        """Prepend a batch dim (reference contract)."""
+        if isinstance(batch_size, (list, tuple)):
+            batch_size = batch_size[0]
+        self.shape = (int(batch_size),) + tuple(self.shape)
+        return self
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("unbatch on a 0-d InputSpec")
+        self.shape = tuple(self.shape)[1:]
+        return self
+
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
@@ -229,7 +257,11 @@ def load(path: str) -> TranslatedLayer:
     with open(path + ".pdparams", "rb") as f:
         state = pickle.load(f)
     params = jax.tree.map(jnp.asarray, state.get("params", {}))
-    return TranslatedLayer(exported, params, meta["with_params"])
+    tl = TranslatedLayer(exported, params, meta["with_params"])
+    # surface the artifact's input arity (static.load_inference_model
+    # sizes its feed list from this)
+    tl.n_inputs = int(meta.get("n_inputs", 1))
+    return tl
 
 
 _SOT_CODE_LEVEL = 0
@@ -276,7 +308,18 @@ class TracedLayer:
         return out, TracedLayer(layer, jitted, inputs)
 
     def __call__(self, *args):
+        # reference convention: static_layer([in_var]) — one LIST of
+        # inputs (jit/api.py TracedLayer.__call__); bare arrays also taken
+        if len(args) == 1 and isinstance(args[0], (list, tuple)):
+            args = tuple(args[0])
         return self._jitted(*args)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """Reference: TracedLayer.set_strategy(BuildStrategy,
+        ExecutionStrategy) tunes the legacy executor. XLA owns both
+        concerns here; accepted and recorded for API parity."""
+        self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
 
     def save_inference_model(self, path, feed=None, fetch=None, **kw):
         specs = [InputSpec(tuple(x.shape), str(x.dtype)) for x in self._inputs]

@@ -67,11 +67,15 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, in_c, out_c, stride=1):
+    def __init__(self, in_c, out_c, stride=1, groups=1, base_width=64):
         super().__init__()
-        self.conv1 = ConvBNLayer(in_c, out_c, 1, 1)
-        self.conv2 = ConvBNLayer(out_c, out_c, 3, stride)
-        self.conv3 = ConvBNLayer(out_c, out_c * 4, 1, 1, act=None)
+        # reference resnet.py BottleneckBlock: the 3x3 runs at
+        # width = planes * base_width/64 * groups (wide-resnet widens,
+        # resnext groups), the 1x1 out stays planes*4
+        width = int(out_c * (base_width / 64.0)) * groups
+        self.conv1 = ConvBNLayer(in_c, width, 1, 1)
+        self.conv2 = ConvBNLayer(width, width, 3, stride, groups=groups)
+        self.conv3 = ConvBNLayer(width, out_c * 4, 1, 1, act=None)
         self.short = (None if stride == 1 and in_c == out_c * 4
                       else ConvBNLayer(in_c, out_c * 4, 1, stride, act=None))
         if self.short is None:
@@ -106,11 +110,6 @@ class ResNet(nn.Layer):
                     "keyword args: ResNet(%d, num_classes=%d)"
                     % (block, depth))
             block, depth = None, block
-        if width != 64 or groups != 1:
-            raise NotImplementedError(
-                "wide/ResNeXt variants (width/groups) are not built into "
-                "this block set; use the torchvision-style recipes in "
-                "vision/models_extras.py")
         if isinstance(depth, (list, tuple)):
             layers = list(depth)
             if block is None:
@@ -121,17 +120,28 @@ class ResNet(nn.Layer):
                     f"depth must be one of {sorted(self.CONFIGS)}")
             cfg_block, layers = self.CONFIGS[depth]
             block = block or cfg_block
+        # checked AFTER block resolution: ResNet(18, width=...) must
+        # raise, not silently build a plain resnet18
+        is_bottleneck = isinstance(block, type) and \
+            issubclass(block, BottleneckBlock)
+        if (width != 64 or groups != 1) and not is_bottleneck:
+            raise ValueError(
+                "width/groups only apply to BottleneckBlock (the "
+                "reference's wide-resnet/resnext recipes are all "
+                "bottleneck-based)")
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.stem = ConvBNLayer(in_channels, 64, 7, 2)
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_c, widths = 64, [64, 128, 256, 512]
+        wide = {"groups": groups, "base_width": width} \
+            if is_bottleneck else {}
         for i, (w, n) in enumerate(zip(widths, layers)):
             blocks = []
             for j in range(n):
                 stride = 2 if (i > 0 and j == 0) else 1
-                blocks.append(block(in_c, w, stride))
+                blocks.append(block(in_c, w, stride, **wide))
                 in_c = w * block.expansion
             stages.append(nn.Sequential(*blocks))
         self.layer1, self.layer2, self.layer3, self.layer4 = stages

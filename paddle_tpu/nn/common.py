@@ -451,12 +451,13 @@ class SyncBatchNorm(BatchNorm2D):
         if isinstance(layer, BatchNorm2D) and not isinstance(layer, SyncBatchNorm):
             new = cls(layer.num_features, momentum=layer.momentum,
                       epsilon=layer.epsilon, data_format=layer.data_format)
-            if layer.weight is not None:
-                new.weight.value = layer.weight.value
-            if layer.bias is not None:
-                new.bias.value = layer.bias.value
-            new._buffers["_mean"].value = layer._buffers["_mean"].value
-            new._buffers["_variance"].value = layer._buffers["_variance"].value
+            # copy through the Parameter/Buffer objects — attribute access
+            # (layer.weight) unwraps to the raw array, which has no .value
+            for k, p in layer._parameters.items():
+                if p is not None and k in new._parameters:
+                    new._parameters[k].value = p.value
+            for k in ("_mean", "_variance"):
+                new._buffers[k].value = layer._buffers[k].value
             return new
         for name, sub in list(layer._sub_layers.items()):
             layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)

@@ -512,6 +512,15 @@ def cross_entropy(input=None, label=None, weight=None,
     kernels, paddle/phi/kernels/funcs/cross_entropy.cu).
     ``use_softmax=False`` treats ``input`` as PROBABILITIES (the reference
     contract): loss is -log(p[label]) with no extra softmax."""
+    # static-mode program vars record the op instead of evaluating
+    # (reference: the static softmax_with_cross_entropy layer)
+    from ..static import _LazyVar, lazy_apply
+    if isinstance(input, _LazyVar) or isinstance(label, _LazyVar):
+        return lazy_apply(
+            cross_entropy, input, label, weight=weight,
+            ignore_index=ignore_index, reduction=reduction,
+            soft_label=soft_label, label_smoothing=label_smoothing,
+            axis=axis, use_softmax=use_softmax, name="cross_entropy")
     # reference kwarg names are input/label; logits/labels kept for the
     # existing in-repo callers
     logits = input if input is not None else logits

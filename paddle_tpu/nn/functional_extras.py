@@ -1115,6 +1115,50 @@ def class_center_sample(label, num_classes: int, num_samples: int,
     return remap, sampled
 
 
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batched Levenshtein distance (reference: nn/functional/loss.py
+    edit_distance — GPU kernel there; host-side numpy here like the other
+    data-dependent ops, NMS precedent in DESIGN_DECISIONS.md). Returns
+    (distance [B, 1] float32, sequence_num [1] int64 — the sequence
+    COUNT, per the kernel contract edit_distance_kernel.cc:66);
+    ``normalized`` divides by the label length."""
+    import numpy as _np
+    a = _np.asarray(input)
+    b = _np.asarray(label)
+    if a.ndim == 1:
+        a, b = a[None, :], b[None, :]
+    B = a.shape[0]
+    in_len = (_np.asarray(input_length).reshape(-1).astype(_np.int64)
+              if input_length is not None
+              else _np.full((B,), a.shape[1], _np.int64))
+    lb_len = (_np.asarray(label_length).reshape(-1).astype(_np.int64)
+              if label_length is not None
+              else _np.full((B,), b.shape[1], _np.int64))
+    ignored = set(_np.asarray(ignored_tokens).reshape(-1).tolist()) \
+        if ignored_tokens is not None else set()
+
+    def _lev(x, y):
+        if ignored:
+            x = [t for t in x if t not in ignored]
+            y = [t for t in y if t not in ignored]
+        m, n = len(x), len(y)
+        prev = list(range(n + 1))
+        for i in range(1, m + 1):
+            cur = [i] + [0] * n
+            for j in range(1, n + 1):
+                cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                             prev[j - 1] + (x[i - 1] != y[j - 1]))
+            prev = cur
+        return prev[n], n
+
+    dist = _np.zeros((B, 1), _np.float32)
+    for i in range(B):
+        d, n = _lev(a[i, :in_len[i]].tolist(), b[i, :lb_len[i]].tolist())
+        dist[i, 0] = d / n if (normalized and n) else d
+    return jnp.asarray(dist), jnp.asarray([B], jnp.int64)
+
+
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and getattr(_v, "__module__", None) == __name__]

@@ -201,11 +201,51 @@ class _MultiLayerRNN(Layer):
         self.layers_f = LayerList(layers_f)
         self.layers_b = LayerList(layers_b) if self.bidirectional else None
 
+    def _is_lstm(self):
+        return isinstance(self.layers_f[0].cell, LSTMCell)
+
+    def _per_layer_states(self, initial_states):
+        """Accept the REFERENCE format — stacked tensors
+        [num_layers*D, B, H] ((h, c) pair for LSTM, single h otherwise;
+        nn/layer/rnn.py LSTM doc) — or a legacy per-layer list; return
+        per-(layer, direction) cell states."""
+        L, D = self.num_layers, 2 if self.bidirectional else 1
+
+        def _stacked(a):
+            return (hasattr(a, "ndim") and a.ndim == 3
+                    and a.shape[0] == L * D)
+        if self._is_lstm():
+            h0c0 = tuple(initial_states)
+            if len(h0c0) == 2 and all(_stacked(a) for a in h0c0):
+                h0, c0 = h0c0
+                return [tuple((h0[li * D + d], c0[li * D + d])
+                              for d in range(D)) if D == 2
+                        else (h0[li], c0[li]) for li in range(L)]
+        elif _stacked(initial_states):
+            h0 = initial_states
+            return [tuple(h0[li * D + d] for d in range(D)) if D == 2
+                    else h0[li] for li in range(L)]
+        return list(initial_states)       # legacy per-layer list
+
+    def _stack_finals(self, finals):
+        """Per-(layer, direction) cell states -> the reference's stacked
+        [num_layers*D, B, H] tensors ((h, c) for LSTM, h otherwise)."""
+        D = 2 if self.bidirectional else 1
+        flat = []
+        for st in finals:
+            flat.extend(st if D == 2 else (st,))
+        if self._is_lstm():
+            return (jnp.stack([s[0] for s in flat]),
+                    jnp.stack([s[1] for s in flat]))
+        return jnp.stack(flat)
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        per_layer = (self._per_layer_states(initial_states)
+                     if initial_states is not None else None)
         finals = []
         for li in range(self.num_layers):
-            init = initial_states[li] if initial_states is not None else None
+            init = per_layer[li] if per_layer is not None else None
             if self.bidirectional:
                 init_f, init_b = init if init is not None else (None, None)
                 out_f, st_f = self.layers_f[li](
@@ -224,7 +264,7 @@ class _MultiLayerRNN(Layer):
                 from . import functional as F
                 x = F.dropout(x, p=self.dropout, training=True)
         outs = x if self.time_major else jnp.swapaxes(x, 0, 1)
-        return outs, finals
+        return outs, self._stack_finals(finals)
 
 
 class SimpleRNN(_MultiLayerRNN):

@@ -111,6 +111,31 @@ class Optimizer:
             raise RuntimeError("cannot set_lr when using an LRScheduler")
         self._lr = lr
 
+    def set_lr_scheduler(self, scheduler: LRScheduler) -> None:
+        """Swap in an LRScheduler (reference: optimizer.py
+        set_lr_scheduler:598 — same contract, subsequent get_lr() reads
+        the scheduler's current value)."""
+        if not isinstance(scheduler, LRScheduler):
+            raise TypeError(
+                f"scheduler must be an LRScheduler, got "
+                f"{type(scheduler).__name__}")
+        self._lr = scheduler
+
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        """Tape-style grads-from-a-loss-value (reference optimizer.py
+        backward:1380). This framework keeps no eager tape; differentiate
+        the function instead and feed the grads to step()/apply_gradients:
+
+            loss, grads = autograd.layer_grad(model, loss_fn, *inputs)
+            opt.step(grads)
+        """
+        raise NotImplementedError(
+            "optimizer.backward(loss) differentiates an eager tape, which "
+            "this framework does not keep. Use autograd.layer_grad(model, "
+            "loss_fn, *inputs) -> (loss, grads), then opt.step(grads) "
+            "(docs/DESIGN_DECISIONS.md eager-tape entry)")
+
     @property
     def lr_scheduler(self):
         return self._lr if isinstance(self._lr, LRScheduler) else None

@@ -81,16 +81,23 @@ def to_sparse_csr(x):
         return x
     if is_sparse_coo(x):
         return jsparse.BCSR.from_bcoo(x)
-    return jsparse.BCSR.fromdense(jnp.asarray(x))
+    x = jnp.asarray(x)
+    # paddle's N-d CSR (N>2) is batched CSR over the leading dims
+    return jsparse.BCSR.fromdense(x, n_batch=max(x.ndim - 2, 0))
 
 
 def nnz(x) -> int:
     return int(x.nse)
 
 
-def coalesce(x):
-    """Merge duplicate indices (reference: Tensor.coalesce for COO)."""
-    return x.sum_duplicates() if is_sparse_coo(x) else x
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference: sparse/unary.py coalesce)."""
+    if is_sparse_coo(x):
+        # BCOO.sum_duplicates is a METHOD on new jax, a property-like
+        # bound attr historically; call defensively
+        out = x.sum_duplicates
+        return out() if callable(out) else out
+    return x
 
 
 # -- math -------------------------------------------------------------------
@@ -171,7 +178,15 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 def transpose(x, perm, name=None):
     if is_sparse_coo(x):
-        return x.transpose(tuple(perm))
+        try:
+            return x.transpose(tuple(perm))
+        except NotImplementedError:
+            # permutations mixing sparse and dense axes (partial-sparsity
+            # tensors, e.g. to_sparse_coo(1) then [1, 0]): dense
+            # round-trip, keeping the original sparse-dim count
+            sd = x.ndim - x.n_dense
+            out = jnp.transpose(x.todense(), tuple(perm))
+            return to_sparse_coo(out, sparse_dim=min(sd, out.ndim))
     return jnp.transpose(to_dense(x), perm)
 
 
@@ -290,9 +305,12 @@ def is_same_shape(x, y) -> bool:
 
 def reshape(x, shape, name=None):
     """COO reshape via dense round-trip (reference sparse/unary.py reshape
-    supports re-distributing sparse dims; nnz is preserved)."""
+    supports re-distributing sparse dims; nnz is preserved). Paddle shape
+    semantics: 0 copies the input dim, -1 infers."""
     dense = to_dense(x) if is_sparse(x) else jnp.asarray(x)
-    out = dense.reshape(tuple(int(s) for s in shape))
+    dims = [dense.shape[i] if int(s) == 0 else int(s)
+            for i, s in enumerate(shape)]
+    out = dense.reshape(tuple(dims))
     if is_sparse_csr(x):
         return to_sparse_csr(out)
     if is_sparse_coo(x):

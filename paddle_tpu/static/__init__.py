@@ -45,6 +45,35 @@ class Program:
     def global_block(self):
         return self
 
+    def current_block(self):
+        # single-block programs: the reference's block stack collapses to
+        # the global block under trace-based capture
+        return self
+
+    def block(self, idx: int = 0):
+        return self
+
+    def var(self, name: str):
+        """Look up a recorded program var by its display name (reference
+        Block.var). Feed slots resolve too."""
+        vars_ = self.__dict__.get("_vars", {})
+        if name in vars_:
+            return vars_[name]
+        for v in vars_.values():
+            if getattr(v, "name", "").split("#")[0] == name:
+                return v
+        if name in self._feed_specs:
+            return _LazyVar(self, lambda env, n=name: env[n], name)
+        raise ValueError(f"program has no var named {name!r}")
+
+    def create_var(self, name=None, dtype="float32", shape=None,
+                   persistable=False, type=None, **kw):
+        """Declare an output slot (reference Block.create_var) — used as
+        the ``out=`` declaration of ``py_func``; carries name/shape/dtype
+        only, the value is produced by the op that binds it."""
+        return _DeclaredVar(name or f"tmp_{len(self.__dict__.get('_vars', {}))}",
+                            dtype, shape)
+
     def clone(self, for_test: bool = False):
         import copy
         return copy.copy(self)
@@ -63,6 +92,15 @@ class Program:
                 outs.append(env[name])
             return outs
         return run_all
+
+
+class _DeclaredVar:
+    """Shape/dtype-only output declaration (Block.create_var result)."""
+
+    def __init__(self, name, dtype, shape):
+        self.name = name
+        self.dtype = dtype
+        self.shape = tuple(shape) if shape is not None else None
 
 
 class _LazyVar:
@@ -140,6 +178,95 @@ class _LazyVar:
     def unsqueeze(self, axis):
         return self._map(lambda v: jnp.expand_dims(v, axis), "unsqueeze")
 
+    # -- shape/dtype inspection (reference Variable.shape/.dtype): infer
+    # by abstract evaluation over the program's declared feed specs —
+    # the static-graph InferShape pass, done with jax.eval_shape
+    def _abstract(self):
+        from ..core.dtype import convert_dtype
+
+        def _specs(sub):
+            out, dynamic = {}, False
+            for name, spec in self._program._feed_specs.items():
+                dims = []
+                for d in spec.shape:
+                    if d is None or (isinstance(d, int) and d < 0):
+                        dims.append(sub)
+                        dynamic = True
+                    else:
+                        dims.append(d)
+                out[name] = jax.ShapeDtypeStruct(tuple(dims),
+                                                 convert_dtype(spec.dtype))
+            return out, dynamic
+        try:
+            s2, dynamic = _specs(2)
+            r2 = jax.eval_shape(self._build, s2)
+            if not dynamic:
+                return r2, r2.shape
+            # dims that track a dynamic feed dim change with the
+            # substitute — report those as -1 (the reference's marker)
+            r3 = jax.eval_shape(self._build, _specs(3)[0])
+            shape = tuple(-1 if a != b else a
+                          for a, b in zip(r2.shape, r3.shape))
+            return r2, shape
+        except Exception as e:
+            # AttributeError keeps hasattr(var, "shape") duck-typing safe
+            raise AttributeError(
+                f"cannot infer shape/dtype of program var {self.name!r}: "
+                f"{type(e).__name__}: {e}") from e
+
+    @property
+    def shape(self):
+        # declared shape (static.data sets it) wins; derived vars infer
+        if getattr(self, "_shape", None) is not None:
+            return self._shape
+        return list(self._abstract()[1])
+
+    @shape.setter
+    def shape(self, v):
+        self._shape = tuple(v) if v is not None else None
+
+    @property
+    def dtype(self):
+        if getattr(self, "_dtype", None) is not None:
+            return self._dtype
+        return self._abstract()[0].dtype
+
+    @dtype.setter
+    def dtype(self, v):
+        self._dtype = v
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def _set_error_clip(self, clip):
+        raise NotImplementedError(
+            "per-var error clip rewrote the legacy block IR's backward; "
+            "under trace-based capture use gradient clipping on the "
+            "OPTIMIZER instead: optimizer(..., grad_clip="
+            "nn.ClipGradByValue(...)) (docs/DESIGN_DECISIONS.md)")
+
+
+def lazy_apply(fn, *args, name="apply", **kwargs):
+    """Lift ``fn`` over any mix of program vars and concrete values: the
+    result is a new lazy var whose build evaluates every lazy input then
+    applies ``fn``. This is the generic static-op recorder behind the
+    lazy-aware spellings of dynamic functions (e.g. F.cross_entropy on
+    static.data vars)."""
+    lazies = [a for a in args if isinstance(a, _LazyVar)]
+    lazies += [v for v in kwargs.values() if isinstance(v, _LazyVar)]
+    if not lazies:
+        return fn(*args, **kwargs)
+    prog = lazies[0]._program
+
+    def build(env):
+        a = [x._build(env) if isinstance(x, _LazyVar) else x for x in args]
+        kw = {k: (v._build(env) if isinstance(v, _LazyVar) else v)
+              for k, v in kwargs.items()}
+        return fn(*a, **kw)
+    label = ",".join(v.name for v in lazies)
+    return _LazyVar(prog, build, f"{name}({label})")
+
 
 _default_program = Program()
 _program_stack = []
@@ -212,6 +339,11 @@ class Executor:
         from ..optimizer.lr import _SCHED_REGISTRY
 
         def _resolve(v):
+            if program._fn is not None:
+                # function-backed programs (from_function / loaded
+                # inference artifacts) fetch POSITIONALLY — names like
+                # "fetch_0" are labels, not recorded vars
+                return v
             if isinstance(v, str):
                 hit = program.__dict__.get("_vars", {}).get(v)
                 if hit is not None:
@@ -621,16 +753,112 @@ def create_global_var(shape, value, dtype, persistable=False,
     return _cgv(shape, value, dtype, persistable=persistable, name=name)
 
 
+def _pyfunc_spec(o):
+    from ..core.dtype import convert_dtype
+    if getattr(o, "shape", None) is None or any(
+            d is None or int(d) < 0 for d in o.shape):
+        raise ValueError(
+            f"py_func out var {getattr(o, 'name', o)!r} needs an explicit "
+            f"concrete shape (pure_callback requires the result shape "
+            f"up front): create_var(name=..., dtype=..., shape=[...])")
+    shape = tuple(int(d) for d in o.shape)
+    return jax.ShapeDtypeStruct(shape, convert_dtype(str(o.dtype)))
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """Host-callback op (reference: static/nn/common.py py_func). Maps to
-    jax.pure_callback with the declared output shape."""
-    xs = [jnp.asarray(v) for v in (x if isinstance(x, (list, tuple))
-                                   else [x])]
-    specs = [jax.ShapeDtypeStruct(o.shape, o.dtype)
-             for o in (out if isinstance(out, (list, tuple)) else [out])]
-    result = jax.pure_callback(
-        func, specs if len(specs) > 1 else specs[0], *xs)
-    return result
+    """Host-callback op (reference: static/nn/common.py py_func:3100).
+
+    Maps to jax.pure_callback with the declared ``out`` shape; when
+    ``backward_func`` is given the op carries a custom_vjp whose backward
+    is a second host callback receiving, per the reference contract, the
+    non-skipped inputs, the outputs, and the output gradients (in that
+    order) and returning one gradient per input. ``out=None`` (debug
+    hook) runs the callback for effect via jax.debug.callback.
+
+    Works in BOTH modes: on arrays directly, and on program vars (the op
+    is recorded and replayed at Executor.run trace time). Platform note:
+    host callbacks need PJRT send/recv support — available on CPU and
+    standard Cloud TPU runtimes, NOT over the tunneled axon plugin (it
+    reports host callbacks unimplemented); py_func graphs are a
+    host-interop feature, not a TPU hot path."""
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = (list(out) if isinstance(out, (list, tuple))
+            else ([] if out is None else [out]))
+    skips = (list(skip_vars_in_backward_input)
+             if isinstance(skip_vars_in_backward_input, (list, tuple))
+             else ([] if skip_vars_in_backward_input is None
+                   else [skip_vars_in_backward_input]))
+    skip_idx = {i for i, v in enumerate(xs)
+                if any(v is s for s in skips)}
+
+    if not outs:
+        def effect_op(*vals):
+            jax.debug.callback(lambda *a: func(*a), *vals)
+            return None
+        if any(isinstance(v, _LazyVar) for v in xs):
+            # debug hooks on program vars: not wired to any fetch, so a
+            # lazy recording would be dead code — run on the abstract
+            # values' concrete replay only if fetched; recorded as no-op
+            return None
+        return effect_op(*[jnp.asarray(v) for v in xs])
+
+    specs = [_pyfunc_spec(o) for o in outs]
+    single = len(specs) == 1
+
+    def fwd_raw(*vals):
+        return jax.pure_callback(func, specs[0] if single else specs, *vals)
+
+    if backward_func is None:
+        op = fwd_raw
+    else:
+        @jax.custom_vjp
+        def op(*vals):
+            return fwd_raw(*vals)
+
+        def _fwd(*vals):
+            y = fwd_raw(*vals)
+            keep = tuple(v for i, v in enumerate(vals)
+                         if i not in skip_idx)
+            return y, (keep, y, tuple(
+                jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals))
+
+        def _bwd(res, dy):
+            keep, y, xspecs = res
+            # pure_callback yields a LIST for multi-output ops
+            ys = tuple(y) if isinstance(y, (list, tuple)) else (y,)
+            dys = tuple(dy) if isinstance(dy, (list, tuple)) else (dy,)
+            grads = jax.pure_callback(
+                backward_func, list(xspecs) if len(xspecs) > 1
+                else xspecs[0], *keep, *ys, *dys)
+            return (tuple(grads) if isinstance(grads, (list, tuple))
+                    else (grads,))
+
+        op.defvjp(_fwd, _bwd)
+
+    if any(isinstance(v, _LazyVar) for v in xs):
+        lv = lazy_apply(op, *xs, name="py_func")
+        prog = lv._program
+        # bind the result to the DECLARED out var names so
+        # fetch_list=[output.name] resolves (reference: py_func writes
+        # into the pre-created block vars)
+        reg = prog.__dict__.setdefault("_vars", {})
+        if single:
+            reg[outs[0].name] = lv
+            return lv
+
+        def _once(env, _k="__pyfunc_" + lv.name):
+            # memoized per trace env: each component indexes ONE host
+            # call, not one call per fetched output
+            if _k not in env:
+                env[_k] = lv._build(env)
+            return env[_k]
+        comps = []
+        for i, o in enumerate(outs):
+            c = _LazyVar(prog, (lambda env, i=i: _once(env)[i]), o.name)
+            reg[o.name] = c
+            comps.append(c)
+        return comps
+    return op(*[jnp.asarray(v) for v in xs])
 
 
 def Print(input, first_n: int = -1, message: Optional[str] = None,
@@ -728,7 +956,23 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
 
 def load_inference_model(path_prefix: str, executor=None, **kwargs):
     """Load the pair written by save_inference_model; returns
-    [program_meta, feed_names, fetch_count] like the reference triplet."""
+    [program_meta, feed_names, fetch_count] like the reference triplet.
+    Also accepts a jit.save/TracedLayer.save_inference_model artifact
+    (.pdexport StableHLO) — the reference's TracedLayer example saves with
+    one API and loads with this one, so both formats resolve here."""
+    import os as _os
+    if (not _os.path.exists(path_prefix + ".pdmodel")
+            and _os.path.exists(path_prefix + ".pdexport")):
+        from ..jit import load as _jit_load
+        tl = _jit_load(path_prefix)
+        n = int(getattr(tl, "n_inputs", 1) or 1)
+        names = [f"feed_{i}" for i in range(n)]
+        prog = Program()
+        prog._fn = lambda *a: tl(*a)
+        for nm in names:
+            prog._feed_specs[nm] = InputSpec((None,), "float32", nm)
+        prog.__dict__["_translated_layer"] = tl
+        return [prog, names, ["fetch_0"]]
     meta = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
     deserialize_persistables(None, load_from_file(path_prefix
                                                   + ".pdiparams"))

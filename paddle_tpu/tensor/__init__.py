@@ -161,52 +161,69 @@ def bernoulli(x):
 
 # -- math (reference: tensor/math.py) ----------------------------------------
 
-add = jnp.add
-subtract = jnp.subtract
-multiply = jnp.multiply
-divide = jnp.divide
-floor_divide = jnp.floor_divide
-mod = remainder = jnp.remainder
-pow = jnp.power
-maximum = jnp.maximum
-minimum = jnp.minimum
-exp = jnp.exp
-expm1 = jnp.expm1
-log = jnp.log
-log2 = jnp.log2
-log10 = jnp.log10
-log1p = jnp.log1p
-sqrt = jnp.sqrt
-square = jnp.square
-abs = jnp.abs
-sign = jnp.sign
-floor = jnp.floor
-ceil = jnp.ceil
-round = jnp.round
-trunc = jnp.trunc
-sin = jnp.sin
-cos = jnp.cos
-tan = jnp.tan
-asin = jnp.arcsin
-acos = jnp.arccos
-atan = jnp.arctan
-atan2 = jnp.arctan2
-sinh = jnp.sinh
-cosh = jnp.cosh
-tanh = jnp.tanh
-asinh = jnp.arcsinh
-acosh = jnp.arccosh
-atanh = jnp.arctanh
-erf = jax.scipy.special.erf
-reciprocal = jnp.reciprocal
-isnan = jnp.isnan
-isinf = jnp.isinf
-isfinite = jnp.isfinite
-conj = jnp.conj
-real = jnp.real
-imag = jnp.imag
-angle = jnp.angle
-lerp = lambda x, y, w: x + w * (y - x)
+def _pd_sig(f):
+    """Paddle call-convention shim over a jnp ufunc: jnp parameters are
+    POSITIONAL-ONLY, but the reference's examples call by keyword
+    (paddle.sign(x=x), paddle.pow(x=a, y=2)) and pass name=."""
+    import functools as _ft
+
+    @_ft.wraps(f)
+    def g(*args, x=None, y=None, name=None, **kw):
+        pos = list(args)
+        if x is not None:
+            pos.insert(0, x)
+        if y is not None:
+            pos.insert(1 if pos else 0, y)
+        return f(*pos, **kw)
+    return g
+
+
+add = _pd_sig(jnp.add)
+subtract = _pd_sig(jnp.subtract)
+multiply = _pd_sig(jnp.multiply)
+divide = _pd_sig(jnp.divide)
+floor_divide = _pd_sig(jnp.floor_divide)
+mod = remainder = _pd_sig(jnp.remainder)
+pow = _pd_sig(jnp.power)
+maximum = _pd_sig(jnp.maximum)
+minimum = _pd_sig(jnp.minimum)
+exp = _pd_sig(jnp.exp)
+expm1 = _pd_sig(jnp.expm1)
+log = _pd_sig(jnp.log)
+log2 = _pd_sig(jnp.log2)
+log10 = _pd_sig(jnp.log10)
+log1p = _pd_sig(jnp.log1p)
+sqrt = _pd_sig(jnp.sqrt)
+square = _pd_sig(jnp.square)
+abs = _pd_sig(jnp.abs)
+sign = _pd_sig(jnp.sign)
+floor = _pd_sig(jnp.floor)
+ceil = _pd_sig(jnp.ceil)
+round = _pd_sig(jnp.round)
+trunc = _pd_sig(jnp.trunc)
+sin = _pd_sig(jnp.sin)
+cos = _pd_sig(jnp.cos)
+tan = _pd_sig(jnp.tan)
+asin = _pd_sig(jnp.arcsin)
+acos = _pd_sig(jnp.arccos)
+atan = _pd_sig(jnp.arctan)
+atan2 = _pd_sig(jnp.arctan2)
+sinh = _pd_sig(jnp.sinh)
+cosh = _pd_sig(jnp.cosh)
+tanh = _pd_sig(jnp.tanh)
+asinh = _pd_sig(jnp.arcsinh)
+acosh = _pd_sig(jnp.arccosh)
+atanh = _pd_sig(jnp.arctanh)
+erf = _pd_sig(jax.scipy.special.erf)
+reciprocal = _pd_sig(jnp.reciprocal)
+isnan = _pd_sig(jnp.isnan)
+isinf = _pd_sig(jnp.isinf)
+isfinite = _pd_sig(jnp.isfinite)
+conj = _pd_sig(jnp.conj)
+real = _pd_sig(jnp.real)
+imag = _pd_sig(jnp.imag)
+angle = _pd_sig(jnp.angle)
+lerp = lambda x, y, w, name=None: x + w * (y - x)
 
 
 def rsqrt(x):
@@ -482,11 +499,21 @@ def bincount(x, weights=None, minlength=0):
 
 # -- manipulation (reference: tensor/manipulation.py) ------------------------
 
-def reshape(x, shape):
-    return jnp.reshape(x, shape)
+def reshape(x, shape, name=None):
+    # reference semantics (manipulation.py reshape): shape may be a
+    # Tensor or contain Tensors, 0 copies the input dim, -1 infers
+    if not isinstance(shape, (list, tuple)):
+        shape = np.asarray(shape).tolist()
+    dims = []
+    for i, d in enumerate(shape):
+        d = int(np.asarray(d).reshape(())) if not isinstance(d, int) else d
+        dims.append(x.shape[i] if d == 0 else d)
+    return jnp.reshape(x, dims)
 
 
-def concat(x, axis=0):
+def concat(x, axis=0, name=None):
+    if not isinstance(axis, int):
+        axis = int(np.asarray(axis).reshape(-1)[0])
     return jnp.concatenate(x, axis=axis)
 
 
@@ -632,9 +659,12 @@ def unbind(x, axis=0):
 
 
 def slice(x, axes, starts, ends):
+    def _as_int(v):
+        # the reference accepts Tensors (0-d or [1]) inside starts/ends
+        return v if isinstance(v, int) else int(np.asarray(v).reshape(-1)[0])
     idx = [builtins.slice(None)] * x.ndim
     for ax, s, e in zip(axes, starts, ends):
-        idx[ax] = builtins.slice(s, e)
+        idx[ax] = builtins.slice(_as_int(s), _as_int(e))
     return x[tuple(idx)]
 
 
@@ -664,8 +694,10 @@ def cast(x, dtype):
     return x.astype(_dt.convert_dtype(dtype))
 
 
-def numel(x):
-    return int(np.prod(x.shape)) if x.shape else 1
+def numel(x, name=None):
+    # returns a 0-d int64 Tensor like the reference (stat.py numel
+    # example calls .numpy() on it), not a python int
+    return jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64)
 
 
 def shape(x):
@@ -742,8 +774,21 @@ def kthvalue(x, k, axis=-1, keepdim=False):
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,
                  name=None):
     side = "right" if right else "left"
-    out = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
-                           side=side)
+    seq = jnp.asarray(sorted_sequence)
+    vals = jnp.asarray(values)
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals, side=side)
+    else:
+        # reference semantics: N-d sorted_sequence searches row-wise
+        # against matching leading dims of values
+        if seq.shape[:-1] != vals.shape[:-1]:
+            raise ValueError(
+                f"searchsorted: leading dims of sorted_sequence "
+                f"{seq.shape} and values {vals.shape} must match")
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_vals = vals.reshape(-1, vals.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_vals).reshape(vals.shape)
     return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
 
 

@@ -14,7 +14,71 @@ from . import install_check
 
 __all__ = ["flops", "transformer_flops_per_token", "model_flops_per_token",
            "get_weights_path_from_url", "get_path_from_url", "DownloadError",
-           "to_dlpack", "from_dlpack", "cpp_extension"]
+           "to_dlpack", "from_dlpack", "cpp_extension",
+           "install_paddle_import_alias"]
+
+
+def install_paddle_import_alias() -> None:
+    """Make ``import paddle`` (and every ``import paddle.x.y`` form)
+    resolve to this package, module-identity-safe.
+
+    ``sys.modules['paddle'] = paddle_tpu`` alone is a trap: a later
+    ``import paddle.static`` misses the 'paddle.static' sys.modules key,
+    so the import machinery executes static/__init__.py a SECOND time
+    under the new name — duplicating every class, after which isinstance
+    checks (e.g. the static _LazyVar dispatch in functional APIs) silently
+    fail. This installs a meta-path finder that redirects any paddle[.sub]
+    import to the corresponding paddle_tpu module object, reusing the
+    already-loaded instance."""
+    import importlib
+    import importlib.machinery
+    import sys
+
+    if any(getattr(f, "_pt_paddle_alias", False) for f in sys.meta_path):
+        return
+
+    def _alias_descendants(real: str, alias: str) -> None:
+        # the import machinery checks sys.modules BEFORE requiring the
+        # parent to be a package, so eagerly aliasing known descendants
+        # makes `import paddle.nn.layer.transformer` work even though
+        # paddle.nn.layer is a consolidated plain module (its pseudo-
+        # children live only in sys.modules via
+        # register_submodule_aliases)
+        for k in list(sys.modules):
+            if k == real or k.startswith(real + "."):
+                sys.modules.setdefault(alias + k[len(real):],
+                                       sys.modules[k])
+
+    class _Loader(importlib.machinery.SourceFileLoader):
+        def __init__(self, mod):
+            self._mod = mod
+
+        def create_module(self, spec):
+            return self._mod
+
+        def exec_module(self, module):
+            pass
+
+    class _Finder:
+        _pt_paddle_alias = True
+
+        def find_spec(self, fullname, path=None, target=None):
+            if fullname != "paddle" and not fullname.startswith("paddle."):
+                return None
+            real = "paddle_tpu" + fullname[len("paddle"):]
+            mod = sys.modules.get(real)
+            if mod is None:
+                try:
+                    mod = importlib.import_module(real)
+                except ImportError:
+                    return None      # genuinely absent submodule
+            _alias_descendants(real, fullname)
+            return importlib.machinery.ModuleSpec(fullname, _Loader(mod))
+
+    sys.meta_path.insert(0, _Finder())
+    import paddle_tpu
+    sys.modules["paddle"] = paddle_tpu
+    _alias_descendants("paddle_tpu", "paddle")
 
 
 def register_submodule_aliases(parent: str, mapping: dict) -> None:

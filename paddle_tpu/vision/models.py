@@ -222,55 +222,56 @@ class _Fire(nn.Layer):
 
 
 class SqueezeNet(nn.Layer):
-    """reference: python/paddle/vision/models/squeezenet.py (v1.0)"""
+    """reference: python/paddle/vision/models/squeezenet.py — takes
+    version ('1.0' 7x7 stem / '1.1' 3x3 stem, earlier pools),
+    num_classes, with_pool like the reference signature."""
 
-    def __init__(self, num_classes: int = 1000):
+    def __init__(self, version: str = "1.0", num_classes: int = 1000,
+                 with_pool: bool = True):
         super().__init__()
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
-            _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
-            _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
-            _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
-            _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
-            nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
-        self.classifier = nn.Sequential(
-            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
-            nn.AdaptiveAvgPool2D(1))
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"supported versions are '1.0' and '1.1', "
+                             f"but input version is {version!r}")
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        head = [nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU()]
+        if with_pool:
+            head.append(nn.AdaptiveAvgPool2D(1))
+        self.classifier = nn.Sequential(*head)
 
     def forward(self, x):
         x = self.classifier(self.features(x))
-        return x.reshape(x.shape[0], -1)
+        if self.with_pool:
+            x = x.reshape(x.shape[0], -1)
+        return x
 
 
 def squeezenet1_0(**kw):
-    return SqueezeNet(**kw)
-
-
-class _SqueezeNet11(nn.Layer):
-    """reference: vision/models/squeezenet.py v1.1 layout (3x3 stem,
-    earlier pools — same accuracy, ~2.4x cheaper)."""
-
-    def __init__(self, num_classes: int = 1000):
-        super().__init__()
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
-            _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
-            nn.MaxPool2D(3, 2),
-            _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
-            nn.MaxPool2D(3, 2),
-            _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
-            _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
-        self.classifier = nn.Sequential(
-            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
-            nn.AdaptiveAvgPool2D(1))
-
-    def forward(self, x):
-        x = self.classifier(self.features(x))
-        return x.reshape(x.shape[0], -1)
+    return SqueezeNet("1.0", **kw)
 
 
 def squeezenet1_1(**kw):
-    return _SqueezeNet11(**kw)
+    return SqueezeNet("1.1", **kw)
 
 
 def resnet34(**kw):  # noqa: F811 — original kept above; ensure export

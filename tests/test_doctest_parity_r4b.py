@@ -1,0 +1,256 @@
+"""Round-4 doctest-parity batch: APIs surfaced by the reference's own
+docstring examples (tools/run_reference_doctests.py) — containers,
+distributions, RNN state contract, py_func, TracedLayer round trip,
+windows, sparse edge cases, wide/resnext ResNet."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_pd_sig_keyword_calls():
+    x = paddle.to_tensor([3.0, 0.0, -2.0, 1.7])
+    np.testing.assert_allclose(np.asarray(paddle.sign(x=x)),
+                               [1., 0., -1., 1.])
+    np.testing.assert_allclose(np.asarray(paddle.pow(x=x, y=2.0)),
+                               np.asarray(x) ** 2, rtol=1e-6)
+
+
+def test_reshape_zero_dim_and_tensor_shape():
+    x = paddle.rand([2, 4, 6])
+    assert paddle.reshape(x, [-1, 0, 3, 2]).shape == (2, 4, 3, 2)
+    four = paddle.full([1], 4, "int32")
+    assert paddle.reshape(x, shape=[four, 12]).shape == (4, 12)
+    st = paddle.to_tensor([8, 6], dtype="int32")
+    assert paddle.reshape(x, shape=st).shape == (8, 6)
+
+
+def test_concat_axis_tensor_and_slice_tensor_starts():
+    x1 = paddle.to_tensor([[1, 2], [3, 4]])
+    zero = paddle.full([1], 0, "int32")
+    out = paddle.concat([x1, x1], axis=zero)
+    assert out.shape == (4, 2)
+    inp = paddle.rand([4, 5, 6])
+    m3 = paddle.full([1], -3, "int32")
+    s = paddle.slice(inp, axes=[0, 1, 2], starts=[m3, 0, 2],
+                     ends=[3, 2, 4])
+    assert s.shape == (2, 2, 2)
+
+
+def test_numel_returns_tensor():
+    n = paddle.numel(paddle.zeros([4, 5, 7]))
+    assert int(np.asarray(n)) == 140
+    assert hasattr(n, "dtype")          # tensor, not python int
+
+
+def test_searchsorted_2d_rowwise():
+    seq = paddle.to_tensor([[1, 3, 5, 7, 9, 11], [2, 4, 6, 8, 10, 12]],
+                           dtype="int32")
+    vals = paddle.to_tensor([[3, 6, 9, 10], [3, 6, 9, 10]], dtype="int32")
+    out = paddle.searchsorted(seq, vals)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  [[1, 3, 4, 5], [1, 2, 4, 4]])
+    out_r = paddle.searchsorted(seq, vals, right=True)
+    np.testing.assert_array_equal(np.asarray(out_r),
+                                  [[2, 3, 5, 5], [1, 3, 4, 5]])
+
+
+def test_lstm_reference_state_contract():
+    paddle.seed(0)
+    rnn = nn.LSTM(16, 32, 2)
+    x = paddle.randn((4, 23, 16))
+    prev_h = paddle.randn((2, 4, 32))
+    prev_c = paddle.randn((2, 4, 32))
+    y, (h, c) = rnn(x, (prev_h, prev_c))
+    assert y.shape == (4, 23, 32) and h.shape == (2, 4, 32) \
+        and c.shape == (2, 4, 32)
+    # stacked states round-trip as initial states
+    y2, (h2, c2) = rnn(x, (h, c))
+    assert h2.shape == (2, 4, 32)
+
+
+def test_edit_distance():
+    import paddle_tpu.nn.functional as F
+    inp = paddle.to_tensor([[1, 2, 3], [4, 5, 6], [4, 4, 4], [1, 1, 1]],
+                           dtype="int64")
+    lab = paddle.to_tensor([[1, 3, 4, 1], [4, 5, 8, 1], [7, 7, 7, 1],
+                            [1, 1, 1, 1]], dtype="int64")
+    il = paddle.to_tensor([3, 3, 3, 3], dtype="int64")
+    ll = paddle.to_tensor([4, 4, 4, 4], dtype="int64")
+    d, _ = F.edit_distance(input=inp, label=lab, input_length=il,
+                           label_length=ll, normalized=False)
+    np.testing.assert_allclose(np.asarray(d).ravel(), [3., 2., 4., 1.])
+    dn, _ = F.edit_distance(input=inp, label=lab, input_length=il,
+                            label_length=ll, normalized=True)
+    np.testing.assert_allclose(np.asarray(dn).ravel(),
+                               [0.75, 0.5, 1.0, 0.25])
+
+
+def test_window_parity_vs_scipy():
+    from scipy.signal import get_window as sp
+    from paddle_tpu.audio.functional import get_window as pd
+    for spec in ["cosine", "triang", ("gaussian", 7), ("tukey", 0.5),
+                 ("taylor", 4, 30), ("exponential", None, 3.0)]:
+        for fftbins in (True, False):
+            a = np.asarray(pd(spec, 48, fftbins=fftbins), np.float64)
+            b = sp(spec if isinstance(spec, str) else tuple(spec), 48,
+                   fftbins=fftbins)
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_mfcc_full_signature():
+    from paddle_tpu.audio.features import MFCC
+    m = MFCC(sr=16000, n_mfcc=20, n_fft=512, window="hamming",
+             hop_length=160, n_mels=40)
+    wav = paddle.randn((1, 8000))
+    out = m(wav)
+    assert out.shape[-2] == 20
+
+
+def test_send_ue_recv_edge_scalar_broadcast():
+    x = paddle.to_tensor([[0, 2, 3], [1, 4, 5], [2, 6, 7]], dtype="float32")
+    y = paddle.to_tensor([1, 1, 1, 1], dtype="float32")
+    src = paddle.to_tensor([0, 1, 2, 0], dtype="int32")
+    dst = paddle.to_tensor([1, 2, 1, 0], dtype="int32")
+    out = paddle.geometric.send_ue_recv(x, y, src, dst, message_op="add",
+                                        reduce_op="sum")
+    np.testing.assert_allclose(np.asarray(out),
+                               [[1., 3., 4.], [4., 10., 12.],
+                                [2., 5., 6.]])
+
+
+def test_sparse_partial_and_batched():
+    import paddle_tpu.sparse as sparse
+    dense = paddle.to_tensor([[-2., 0.], [1., 2.]])
+    sp1 = sparse.to_sparse_coo(dense, sparse_dim=1)
+    out = sparse.transpose(sp1, [1, 0])
+    np.testing.assert_allclose(np.asarray(sparse.to_dense(out)),
+                               np.asarray(dense).T)
+    y = paddle.rand([2, 3, 8])
+    csr = sparse.to_sparse_csr(y)           # batched CSR (3-d)
+    assert sparse.is_same_shape(y, csr)
+    r = sparse.reshape(sp1, [1, 0, -1])
+    assert tuple(r.shape) == (1, 2, 2)
+
+
+def test_resnet_wide_and_resnext():
+    from paddle_tpu.vision.models import ResNet
+    from paddle_tpu.models.vision import BottleneckBlock
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 64, 64)
+                    .astype(np.float32))
+    assert ResNet(BottleneckBlock, 50, width=128)(x).shape == (1, 1000)
+    assert ResNet(BottleneckBlock, 50, groups=32, width=4)(x).shape \
+        == (1, 1000)
+
+
+def test_traced_layer_save_load_roundtrip(tmp_path):
+    class L(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(3, 5)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    in_np = np.random.RandomState(0).rand(2, 3).astype("float32")
+    out, tl = paddle.jit.api.TracedLayer.trace(L(), [paddle.to_tensor(in_np)])
+    assert np.allclose(np.asarray(tl([paddle.to_tensor(in_np)])),
+                       np.asarray(out))
+    tl.set_strategy(build_strategy=None, exec_strategy=None)
+    prefix = str(tmp_path / "m")
+    tl.save_inference_model(prefix, feed=[0], fetch=[0])
+    paddle.enable_static()
+    try:
+        exe = paddle.static.Executor(paddle.CPUPlace())
+        prog, feeds, fetches = paddle.static.load_inference_model(prefix, exe)
+        got, = exe.run(prog, feed={feeds[0]: in_np}, fetch_list=fetches)
+        np.testing.assert_allclose(got, np.asarray(out), atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_py_func_static_and_custom_vjp():
+    def tanh_np(x):
+        return np.tanh(x)
+
+    def tanh_grad(y, dy):
+        return np.array(dy) * (1 - np.square(np.array(y)))
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name="x", shape=[1, 4], dtype="float32")
+            h = paddle.static.nn.fc(x, size=8)
+            nv = prog.current_block().create_var(
+                name="h2", dtype=h.dtype, shape=h.shape)
+            h = paddle.static.py_func(func=tanh_np, x=h, out=nv,
+                                      backward_func=tanh_grad,
+                                      skip_vars_in_backward_input=h)
+            paddle.static.py_func(func=lambda v: None, x=h, out=None)
+            loss = h.mean()
+        exe = paddle.static.Executor()
+        out, = exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                       fetch_list=[loss])
+        assert np.isfinite(out).all()
+    finally:
+        paddle.disable_static()
+
+    # dynamic custom-vjp path: gradient equals tanh'
+    class O:
+        shape, dtype = (3,), "float32"
+    xv = jnp.asarray(np.random.RandomState(0).randn(3).astype("float32"))
+    f = lambda a: paddle.static.py_func(
+        tanh_np, a, O, backward_func=tanh_grad,
+        skip_vars_in_backward_input=a).sum()
+    g = jax.grad(f)(xv)
+    np.testing.assert_allclose(np.asarray(g),
+                               1 - np.tanh(np.asarray(xv)) ** 2, rtol=1e-5)
+
+
+def test_lazy_cross_entropy_and_var_lookup():
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            img = paddle.static.data(name="im", shape=[4, 8],
+                                     dtype="float32")
+            lab = paddle.static.data(name="lb", shape=[4], dtype="int64")
+            pred = paddle.static.nn.fc(img, size=3, activation="softmax")
+            loss = paddle.nn.functional.cross_entropy(input=pred, label=lab,
+                                                      use_softmax=False)
+            assert loss.shape == []          # inferred via eval_shape
+        exe = paddle.static.Executor()
+        rs = np.random.RandomState(0)
+        out, = exe.run(prog,
+                       feed={"im": rs.rand(4, 8).astype("float32"),
+                             "lb": rs.randint(0, 3, (4,)).astype("int64")},
+                       fetch_list=[loss])
+        assert np.isfinite(out)
+        assert prog.block(0) is prog.global_block()
+    finally:
+        paddle.disable_static()
+
+
+def test_paddle_import_alias_identity():
+    """install_paddle_import_alias: `import paddle.x.y` must REUSE the
+    loaded paddle_tpu module — a bare sys.modules['paddle'] assignment
+    re-executes submodules, duplicating classes and silently breaking
+    isinstance dispatch (observed live: _LazyVar lazy dispatch)."""
+    import sys
+    import importlib
+    paddle._ensure_alias_for_test = True
+    paddle.utils.install_paddle_import_alias()
+    mod = importlib.import_module("paddle.static")
+    assert mod is sys.modules["paddle_tpu.static"]
+    mod2 = importlib.import_module("paddle.nn.functional")
+    import paddle_tpu.nn.functional as F
+    assert mod2 is F
+    # idempotent
+    paddle.utils.install_paddle_import_alias()
+    assert sum(getattr(f, "_pt_paddle_alias", False)
+               for f in sys.meta_path) == 1

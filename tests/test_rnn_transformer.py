@@ -89,7 +89,8 @@ def test_bidirectional_gru_shapes_and_grad():
     x = jnp.asarray(rs.randn(3, 7, 4).astype(np.float32))
     out, finals = gru(x)
     assert out.shape == (3, 7, 12)
-    assert len(finals) == 2  # per layer (fwd, bwd) states
+    # reference contract: stacked [num_layers * num_directions, B, H]
+    assert finals.shape == (4, 3, 6)
     from paddle_tpu.autograd import layer_grad
     loss, grads = layer_grad(gru, lambda o: (o[0] ** 2).mean(), x)
     assert all(np.isfinite(_np(g)).all() for g in jax.tree.leaves(grads))
@@ -247,9 +248,10 @@ def test_rnn_initial_states_and_sequence_length():
     # sequence_length freezes state at each row's true end
     lens = jnp.asarray([3, 6])
     out_m, finals = lstm(x, sequence_length=lens)
-    h_final = finals[0][0]
+    # finals = (h, c) stacked [num_layers, B, H] (reference contract)
+    h_final = finals[0][0]            # layer-0 h, [B, H]
     out_short, st_short = lstm(x[:1, :3])
-    np.testing.assert_allclose(_np(h_final[0]), _np(st_short[0][0][0]),
+    np.testing.assert_allclose(_np(h_final[0]), _np(st_short[0][0, 0]),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(_np(out_m[0, 3:]), 0.0)  # padded outputs zero
 

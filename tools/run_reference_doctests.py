@@ -93,6 +93,8 @@ DEFAULT_MODULES = [
 # not as failures): eager-tape autograd and device pinning.
 _SKIP_PATTERNS = [
     r"\.backward\(\)", r"set_device\(['\"]gpu", r"\.register_hook\(",
+    r"optimizer\.backward\(",   # tape-style grads-from-loss (raises with
+    # the layer_grad migration recipe; see Optimizer.backward)
     r"paddle\.grad\(", r"device\.cuda\.", r"\bParamAttr\(.*gradient",
     r"base\.dygraph", r"to_variable\(",
     # jax arrays are immutable: in-place subscript stores are the
@@ -102,7 +104,24 @@ _SKIP_PATTERNS = [
     r"ignore_module\(",
     # PS/LoD-era builders: documented non-goals (docs/DESIGN_DECISIONS.md)
     r"row_conv\(|sparse_embedding\(|\bnce\(|data_norm\(",
+    r"continuous_value_model\(",
+    # deprecated per-var error-clip on the legacy block IR (the clip
+    # would need to rewrite already-captured downstream closures; raises
+    # with the ClipGradBy* migration pointer)
+    r"_set_error_clip\(",
+    # jax sparse convention: BCOO indices/data are ATTRIBUTES — the
+    # reference's .indices()/.values() method spelling cannot be
+    # shadowed onto the registered pytree dataclass (ledger entry)
+    r"\.indices\(\)",
     r"get_selected_rows\(|core\.Scope\(",
+    # SelectedRows storage: ledgered PS-era non-goal (nn/clip.py raises
+    # with the pointer); `base.Program(` = reference doc bug (base used
+    # without an import in the block)
+    r"SELECTED_ROWS|merge_selected_rows\(",
+    r"\bbase\.Program\(",
+    # static-Value prim transforms: documented migration errors pointing
+    # at the (func, inputs) forms (incubate/autograd.py)
+    r"incubate\.autograd\.(forward_grad|grad)\(",
 ]
 _DIRECTIVE_SKIP = re.compile(
     r"doctest:\s*\+(SKIP|REQUIRES\(env:\s*(GPU|XPU|DISTRIBUTED))",
@@ -179,7 +198,10 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     import paddle_tpu
-    sys.modules["paddle"] = paddle_tpu
+    # identity-safe alias: `import paddle.static` must reuse the loaded
+    # paddle_tpu.static module, not execute it a second time (duplicate
+    # classes break isinstance-based dispatch)
+    paddle_tpu.utils.install_paddle_import_alias()
 
     report = {}
     totals = {"pass": 0, "fail": 0, "timeout": 0, "directive-skip": 0,
