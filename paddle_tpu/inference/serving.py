@@ -50,6 +50,8 @@ class _Request:
     submit_t: float = 0.0               # perf_counter at submit
     first_tok_t: float = 0.0            # TTFT timestamp (0 = none yet)
     done_t: float = 0.0                 # completion timestamp
+    prefilled: int = 0                  # KV tokens written (chunked mode)
+    prefill_target: int = 0             # prompt+replay length to prefill
 
 
 class ContinuousBatchingEngine:
@@ -60,7 +62,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_batch: int = 8, page_size: int = 128,
                  max_len: int = 2048, num_pages: Optional[int] = None,
                  generation_config: Optional[GenerationConfig] = None,
-                 decode_block: int = 1):
+                 decode_block: int = 1, chunked_prefill: bool = False,
+                 prefill_chunk: Optional[int] = None):
         self.model = model
         self.core = getattr(model, "model", model)
         self.cfg = generation_config or GenerationConfig()
@@ -96,6 +99,17 @@ class ContinuousBatchingEngine:
         # EXACT for any K under greedy decoding.
         self.decode_block = max(1, int(decode_block))
         self._decode_fns: Dict[int, object] = {}  # K -> compiled block
+        # chunked prefill (Sarathi/vLLM prefill-extend): admission claims
+        # pages but prefill proceeds one chunk per scheduler tick,
+        # interleaved with decode of running slots — bounds the per-tick
+        # stall a long prompt inflicts on running requests' ITL. The
+        # chunk is page-aligned so every chunk writes whole pages.
+        self.chunked_prefill = bool(chunked_prefill)
+        self.prefill_chunk = int(prefill_chunk or page_size)
+        if self.prefill_chunk % page_size:
+            raise ValueError(f"prefill_chunk ({self.prefill_chunk}) must "
+                             f"be a multiple of page_size ({page_size})")
+        self._chunk_fn = None
         self._logits = None                # device [max_batch, vocab]
         self.preemptions = 0
         # bounded window (run() releases _Request objects for the same
@@ -130,9 +144,12 @@ class ContinuousBatchingEngine:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
     def step(self) -> List[tuple]:
-        """Admit what fits, decode one token for every active slot.
-        Returns [(rid, token), ...] emitted this step."""
+        """Admit what fits, advance at most one prefill chunk (chunked
+        mode), decode a block for every decode-ready slot. Returns
+        [(rid, token), ...] emitted this step."""
         self._admit()
+        if self.chunked_prefill:
+            self._prefill_tick()
         return self._decode()
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -171,6 +188,7 @@ class ContinuousBatchingEngine:
         self._slots[slot] = None
         if req is not None:
             req.slot = -1
+            req.prefilled = 0     # freed pages took the written KV along
 
     # -- admission / prefill ------------------------------------------------
 
@@ -218,17 +236,72 @@ class ContinuousBatchingEngine:
             # replay = prompt + anything generated before a preemption
             toks = np.concatenate([req.prompt,
                                    np.asarray(req.generated, np.int32)])
+            self.tables[slot, :len(pages)] = pages
+            self._slots[slot] = req
+            req.slot = slot
+            if self.chunked_prefill:
+                # pages claimed now; KV written one chunk per tick
+                req.prefilled = 0
+                req.prefill_target = L
+                self.pos[slot] = 0
+                continue
             bucket = self._bucket(L)
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :L] = toks
-            self.tables[slot, :len(pages)] = pages
             self.pos[slot] = L
-            self._slots[slot] = req
-            req.slot = slot
+            req.prefilled = req.prefill_target = L
             logits, self.pools = self._prefill_fn(bucket)(
                 self._params, jnp.asarray(ids), self.pools,
                 jnp.asarray(self.tables[slot:slot + 1]),
                 jnp.int32(L - 1))
+            self._set_slot_logits(slot, logits)
+
+    def _decode_ready(self, req) -> bool:
+        return req is not None and req.prefilled >= req.prefill_target
+
+    def _build_chunk_fn(self):
+        core, model = self.core, self.model
+        head = model.logits if hasattr(model, "logits") else (lambda h: h)
+
+        def run(params, ids, offset, pools, tables1, last_idx):
+            ctx = model._bind(params) if hasattr(model, "_bind") else None
+            with ctx if ctx is not None else _null():
+                hidden, pools = core.prefill_chunk_paged(
+                    ids, offset, pools, tables1)
+                # logits at the prompt's true last index — meaningful on
+                # the FINAL chunk only (a single-row head matmul, cheap
+                # to compute unconditionally)
+                logits = head(hidden[0, last_idx - offset, :])
+            return logits, pools
+
+        return jax.jit(run, donate_argnums=(3,))
+
+    def _prefill_tick(self):
+        """Advance the oldest in-prefill slot by ONE chunk."""
+        cand = [(self._slots[s].rid, s) for s in range(self.max_batch)
+                if self._slots[s] is not None
+                and not self._decode_ready(self._slots[s])]
+        if not cand:
+            return
+        slot = min(cand)[1]
+        req = self._slots[slot]
+        C = self.prefill_chunk
+        off = req.prefilled
+        toks = np.concatenate([req.prompt,
+                               np.asarray(req.generated, np.int32)])
+        ids = np.zeros((1, C), np.int32)
+        chunk = toks[off:off + C]
+        ids[0, :len(chunk)] = chunk
+        if self._chunk_fn is None:
+            self._chunk_fn = self._build_chunk_fn()
+        last_idx = req.prefill_target - 1
+        logits, self.pools = self._chunk_fn(
+            self._params, jnp.asarray(ids), jnp.int32(off), self.pools,
+            jnp.asarray(self.tables[slot:slot + 1]),
+            jnp.int32(min(last_idx, off + C - 1)))
+        req.prefilled = min(off + C, self._bucket(req.prefill_target))
+        if req.prefilled >= req.prefill_target:
+            self.pos[slot] = req.prefill_target
             self._set_slot_logits(slot, logits)
 
     def _set_slot_logits(self, slot: int, logits):
@@ -275,8 +348,8 @@ class ContinuousBatchingEngine:
         them would evict victims for pages never legitimately written."""
         for slot in range(self.max_batch):
             req = self._slots[slot]
-            if req is None:
-                continue
+            if not self._decode_ready(req):
+                continue              # mid-prefill slots claim at admission
             pos = int(self.pos[slot])
             span = min(K, req.max_new_tokens - len(req.generated))
             first = pos // self.page_size    # ceil == floor at a boundary;
@@ -305,7 +378,8 @@ class ContinuousBatchingEngine:
                 self.tables[slot, pidx] = page[0]
 
     def _decode(self) -> List[tuple]:
-        active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+        active_slots = [i for i, s in enumerate(self._slots)
+                        if self._decode_ready(s)]
         if not active_slots:
             return []
         # block length this tick: the configured K, capped so no slot's
@@ -316,7 +390,8 @@ class ContinuousBatchingEngine:
         K = max(K, 1)
         self._ensure_decode_pages(K)
         # a preemption may have emptied every slot
-        active_slots = [i for i, s in enumerate(self._slots) if s is not None]
+        active_slots = [i for i, s in enumerate(self._slots)
+                        if self._decode_ready(s)]
         if not active_slots:
             return []
         fn = self._decode_fns.get(K)
@@ -324,10 +399,14 @@ class ContinuousBatchingEngine:
             fn = self._decode_fns[K] = self._build_decode(K)
         active = np.zeros((self.max_batch,), bool)
         active[active_slots] = True
+        # inactive rows masked to the garbage page: a mid-prefill slot
+        # HOLDS real pages, and the compiled block writes KV for every
+        # slot — without the mask those writes would corrupt its prefix
+        tables_arg = self.tables * active[:, None]
         self._key, sub = jax.random.split(self._key)
         toks, self._logits, self.pools = fn(
             self._params, self._logits, jnp.asarray(self.pos), self.pools,
-            jnp.asarray(self.tables), jnp.asarray(active), sub)
+            jnp.asarray(tables_arg), jnp.asarray(active), sub)
         toks_host = np.asarray(toks)          # [K, max_batch]
         emitted = []
         now = time.perf_counter()

@@ -278,6 +278,72 @@ class LlamaAttention(nn.Layer):
                 tiles.astype(pool.dtype))
         return out, scatter(k_pool, k), scatter(v_pool, v)
 
+    def prefill_chunk_paged(self, x, cos, sin, offset, k_pool, v_pool,
+                            tables):
+        """Chunked-prefill step (Sarathi/vLLM-style prefill-extend): a
+        C-token chunk at positions [offset, offset+C) writes its K/V
+        pages and attends over the FULL paged history plus itself.
+        ``offset`` is traced (no recompile per chunk index) and must be
+        page-aligned with C a page multiple — the engine enforces both.
+        Garbage KV beyond the true prompt (final-chunk padding) is never
+        attended by any REAL query position and is overwritten by later
+        decode writes — the same invariant as the padded full prefill."""
+        cfg = self.cfg
+        b, C, _ = x.shape
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        page = k_pool.shape[2]
+        positions = offset + jnp.arange(C, dtype=jnp.int32)[None, :]
+        q, k, v = self._qkv_rope(x, cos, sin,
+                                 jnp.broadcast_to(positions, (b, C)))
+        npg = C // page
+        max_pages = tables.shape[1]
+        pidx = offset // page + jnp.arange(npg, dtype=jnp.int32)
+        # a final chunk larger than the remaining table (prompt tail with
+        # prefill_chunk > page_size) routes its overflow tiles to page 0
+        # EXPLICITLY — the serving engine reserves page 0 as the garbage
+        # page (chunked prefill is engine-path only), and relying on
+        # jnp.take/scatter OOB-drop semantics instead would break under a
+        # refactor to clamping indexers
+        valid = pidx < max_pages
+        phys = jnp.take(tables, jnp.minimum(pidx, max_pages - 1), axis=1)
+        phys = jnp.where(valid[None, :], phys, 0)    # [b, npg]
+
+        def scatter(pool, new):
+            tiles = jnp.transpose(
+                new.reshape(b, npg, page, n_kv, hd), (3, 0, 1, 2, 4)
+            ).reshape(n_kv, b * npg, page, hd)
+            return pool.at[:, phys.reshape(-1)].set(
+                tiles.astype(pool.dtype))
+        k_pool = scatter(k_pool, k)
+        v_pool = scatter(v_pool, v)
+
+        # gather the whole table (static shape: max_pages * page) and
+        # mask by j_global <= offset + i — O(C * max_len) per chunk, the
+        # same total work order as one full-prompt pass
+        S = max_pages * page
+
+        def gather(pool):
+            ctx = pool[:, tables.reshape(-1)]        # [n_kv, b*mp, pg, hd]
+            ctx = ctx.reshape(n_kv, b, S, hd)
+            return jnp.transpose(ctx, (1, 0, 2, 3))  # [b, n_kv, S, hd]
+        k_ctx = gather(k_pool).astype(jnp.float32)
+        v_ctx = gather(v_pool).astype(jnp.float32)
+        rep = n_h // n_kv
+        k_ctx = jnp.repeat(k_ctx, rep, axis=1)       # [b, n_h, S, hd]
+        v_ctx = jnp.repeat(v_ctx, rep, axis=1)
+        qf = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)
+        scores = jnp.einsum("bhcd,bhsd->bhcs", qf, k_ctx) / (hd ** 0.5)
+        j = jnp.arange(S, dtype=jnp.int32)[None, :]
+        i = positions[0][:, None]
+        scores = jnp.where((j <= i)[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhcs,bhsd->bhcd", probs, v_ctx)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, C, n_h * hd)
+        out = out.astype(x.dtype)
+        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
+                k_pool, v_pool)
+
     def decode_paged(self, x, cos, sin, pos, k_pool, v_pool, tables):
         """One-token step over the page pools: writes the new K/V into the
         page slot for position ``pos`` and attends via the Pallas paged
@@ -457,6 +523,18 @@ class LlamaModel(nn.Layer):
             a, kp, vp = layer.self_attn.prefill_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
                 kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
+
+    def prefill_chunk_paged(self, input_ids, offset, pools, tables):
+        x = jnp.take(self.embed_tokens, input_ids, axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.prefill_chunk_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                offset, kp, vp, tables)
             h = x + a
             x = h + layer.mlp(layer.post_attention_layernorm(h))
             new_pools.append((kp, vp))
