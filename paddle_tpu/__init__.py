@@ -38,6 +38,8 @@ from .nn.layer import set_default_dtype, get_default_dtype
 
 from .framework import save, load, set_device, get_device, is_compiled_with_cuda, \
     is_compiled_with_tpu, device_count, no_grad
+from .device import (is_compiled_with_rocm, is_compiled_with_xpu,  # noqa: E402
+                     is_compiled_with_ipu, is_compiled_with_custom_device)
 from .base import (CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace,
                    IPUPlace, ParamAttr, LazyGuard, DataParallel,
                    in_dynamic_mode, in_dynamic_or_pir_mode, enable_static,

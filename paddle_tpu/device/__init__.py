@@ -23,7 +23,7 @@ __all__ = [
     "set_device", "get_device", "device_count", "synchronize", "get_device_properties",
     "get_available_device", "get_available_custom_device", "get_all_device_type",
     "get_all_custom_device_type", "is_compiled_with_cuda", "is_compiled_with_rocm",
-    "is_compiled_with_xpu", "is_compiled_with_custom_device", "Stream", "Event",
+    "is_compiled_with_xpu", "is_compiled_with_ipu", "is_compiled_with_custom_device", "Stream", "Event",
     "current_stream", "stream_guard", "memory_stats", "XPUPlace", "CPUPlace",
     "TPUPlace", "CUDAPlace",
 ]
@@ -77,6 +77,10 @@ def is_compiled_with_rocm() -> bool:
 
 
 def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
     return False
 
 
@@ -217,47 +221,11 @@ class stream_guard:
 # wrappers resolving to jax devices so ported code can keep constructing them
 # ---------------------------------------------------------------------------
 
-class _Place:
-    platform = "cpu"
-
-    def __init__(self, idx: int = 0):
-        self.idx = idx
-
-    def jax_device(self):
-        return jax.devices(self.platform)[self.idx]
-
-    def __repr__(self):
-        return f"{type(self).__name__}({self.idx})"
-
-    def __eq__(self, other):
-        return type(self) is type(other) and self.idx == other.idx
-
-
-class CPUPlace(_Place):
-    platform = "cpu"
-
-    def __init__(self, idx: int = 0):
-        super().__init__(idx)
-
-
-class TPUPlace(_Place):
-    platform = "tpu"
-
-
-class CUDAPlace(_Place):
-    """Accepted for portability; resolves to the accelerator actually
-    present (TPU) rather than CUDA."""
-    platform = "tpu"
-
-    def jax_device(self):
-        try:
-            return jax.devices("tpu")[self.idx]
-        except RuntimeError:
-            return jax.devices()[self.idx]
-
-
-class XPUPlace(CUDAPlace):
-    pass
+# ONE Place family for the whole package: these are the same classes a
+# plain `import paddle` exposes (base.py) — a second definition here made
+# paddle.CPUPlace() != paddle.device.CPUPlace()
+from ..base import (_Place, CPUPlace, TPUPlace, CUDAPlace,  # noqa: E402
+                    CUDAPinnedPlace, IPUPlace, XPUPlace)
 
 
 def get_cudnn_version():

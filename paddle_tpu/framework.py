@@ -63,19 +63,49 @@ def _to_numpy_tree(obj):
         lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, obj)
 
 
-def save(obj: Any, path: str, protocol: int = 4) -> None:
-    """Pickle-based save of (nested) state dicts; jax Arrays stored as numpy.
-    The orbax-backed sharded checkpoint lives in paddle_tpu.checkpoint."""
+def save(obj: Any, path, protocol: int = 4) -> None:
+    """Pickle-based save of (nested) state dicts; jax Arrays stored as
+    numpy. ``path`` may be a file path or a writable file object
+    (reference: paddle.save supports BytesIO). A static ``Program`` saves
+    as its descriptor (feed specs + parameter values) — the recorded
+    builders are closures and do not pickle; the executable artifact is
+    jit.save. The orbax-backed sharded checkpoint lives in
+    paddle_tpu.checkpoint."""
+    from .static import Program
+    if isinstance(obj, Program):
+        # state_dict() force-materializes parameters first (a built but
+        # never-run program has no _nn_params yet — saving without this
+        # would silently drop every weight)
+        params = {k: np.asarray(v)
+                  for k, v in obj.state_dict("param").items()}
+        obj = {"__pt_program_desc__": True,
+               "feed_specs": {n: (tuple(s.shape), str(s.dtype))
+                              for n, s in obj._feed_specs.items()},
+               "params": params}
+    payload = _to_numpy_tree(obj)
+    if hasattr(path, "write"):                   # file-like (BytesIO)
+        pickle.dump(payload, path, protocol=protocol)
+        return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
-        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+        pickle.dump(payload, f, protocol=protocol)
 
 
-def load(path: str, return_numpy: bool = False) -> Any:
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+def load(path, return_numpy: bool = False) -> Any:
+    if hasattr(path, "read"):                    # file-like (BytesIO)
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    if isinstance(obj, dict) and obj.get("__pt_program_desc__"):
+        from .static import Program, InputSpec
+        prog = Program()
+        for n, (shape, dtype) in obj["feed_specs"].items():
+            prog._feed_specs[n] = InputSpec(shape, dtype, n)
+        prog.__dict__["_nn_params"] = dict(obj["params"])
+        return prog
     if return_numpy:
         return obj
     return jax.tree.map(

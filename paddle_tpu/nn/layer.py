@@ -122,13 +122,23 @@ class Buffer:
 class Layer:
     """Base module. See module docstring for the functional-bridge design."""
 
-    def __init__(self):
+    def __init__(self, name_scope: Optional[str] = None,
+                 dtype: Optional[str] = None):
+        # reference signature Layer.__init__(name_scope=None,
+        # dtype="float32"); name_scope feeds full_name(), dtype is the
+        # layer's default parameter dtype
         object.__setattr__(self, "_parameters", OrderedDict())
         object.__setattr__(self, "_buffers", OrderedDict())
         object.__setattr__(self, "_sub_layers", OrderedDict())
         object.__setattr__(self, "training", True)
         object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
         object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+        object.__setattr__(self, "_name_scope", name_scope)
+        object.__setattr__(self, "_layer_dtype", dtype)
+
+    def full_name(self) -> str:
+        base = self._name_scope or type(self).__name__.lower()
+        return base
 
     # -- registration ------------------------------------------------------
 
@@ -391,6 +401,11 @@ class Layer:
                 if jnp.issubdtype(b.value.dtype, jnp.floating):
                     b.value = b.value.astype(dt)
         if device is not None:
+            if isinstance(device, str) or isinstance(device, int):
+                from ..device import _resolve
+                device = _resolve(device)
+            elif hasattr(device, "jax_device"):     # Place classes
+                device = device.jax_device()
             for _, p in self.named_parameters():
                 p.value = jax.device_put(p.value, device)
             for _, b in self.named_buffers():
@@ -399,6 +414,53 @@ class Layer:
 
     def astype(self, dtype) -> "Layer":
         return self.to(dtype=dtype)
+
+    def _cast_except(self, dtype, excluded_layers) -> "Layer":
+        """Cast all floating leaves except those owned by a layer whose
+        type is in ``excluded_layers`` (reference Layer.float/half
+        contract — e.g. keep norm layers fp32 under a half() sweep)."""
+        if not excluded_layers:
+            return self.to(dtype=dtype)
+        excluded = tuple(excluded_layers) if isinstance(
+            excluded_layers, (list, tuple)) else (excluded_layers,)
+        for layer in self.sublayers(include_self=True):
+            if isinstance(layer, excluded):
+                continue
+            for p in layer._parameters.values():
+                if p is not None and jnp.issubdtype(p.value.dtype,
+                                                    jnp.floating):
+                    p.value = p.value.astype(dtype)
+            for b in layer._buffers.values():
+                if b is not None and jnp.issubdtype(b.value.dtype,
+                                                    jnp.floating):
+                    b.value = b.value.astype(dtype)
+        return self
+
+    def float(self, excluded_layers=None) -> "Layer":
+        return self._cast_except("float32", excluded_layers)
+
+    def half(self, excluded_layers=None) -> "Layer":
+        return self._cast_except("float16", excluded_layers)
+
+    def bfloat16(self, excluded_layers=None) -> "Layer":
+        return self._cast_except("bfloat16", excluded_layers)
+
+    def children(self):
+        """Immediate sublayers (reference: Layer.children)."""
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self):
+        for name, layer in self._sub_layers.items():
+            if layer is not None:
+                yield name, layer
+
+    def to_static_state_dict(self, destination=None, include_sublayers=True,
+                             use_hook=True):
+        """Reference: Layer.to_static_state_dict — the static-graph-shaped
+        state dict. Trace-based capture keeps one state layout, so this is
+        state_dict() (parameters + buffers) under the legacy name."""
+        return self.state_dict()
 
     def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
         for l in self.sublayers(include_self=True):

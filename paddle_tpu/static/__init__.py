@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..jit import InputSpec
 
@@ -66,6 +67,45 @@ class Program:
             return _LazyVar(self, lambda env, n=name: env[n], name)
         raise ValueError(f"program has no var named {name!r}")
 
+    def list_vars(self):
+        """Iterate the program's vars (reference Program.list_vars):
+        materialized parameters (as value-bearing handles) plus the
+        recorded lazy vars. Parameters materialize at first trace; this
+        forces materialization by abstract-evaluating each recorded var
+        so a freshly-built network lists its weights like the
+        reference's startup-initialized program does."""
+        for v in list(self.__dict__.get("_vars", {}).values()):
+            try:
+                v._abstract()        # triggers _param materialization
+            except Exception:
+                pass
+        store = self.__dict__.get("_nn_params", {})
+        for name in store:
+            yield _ParamVar(self, name)
+        for v in self.__dict__.get("_vars", {}).values():
+            yield v
+
+    def state_dict(self, mode: str = "all", scope=None):
+        """Reference Program.state_dict('param'|'opt'|'all'): the
+        program's persistables. Optimizer state lives with the Optimizer
+        here (functional design), so 'opt' is empty."""
+        if mode not in ("param", "opt", "all"):
+            raise ValueError("mode must be 'param', 'opt' or 'all'")
+        for v in list(self.__dict__.get("_vars", {}).values()):
+            try:
+                v._abstract()
+            except Exception:
+                pass
+        if mode == "opt":
+            return {}
+        return {k: jnp.asarray(v)
+                for k, v in self.__dict__.get("_nn_params", {}).items()}
+
+    def set_state_dict(self, state_dict, scope=None):
+        store = self.__dict__.setdefault("_nn_params", {})
+        for k, v in state_dict.items():
+            store[k] = np.asarray(v)
+
     def create_var(self, name=None, dtype="float32", shape=None,
                    persistable=False, type=None, **kw):
         """Declare an output slot (reference Block.create_var) — used as
@@ -83,9 +123,14 @@ class Program:
         return list(self._feed_specs)
 
     def _trace(self, fetch_builders):
-        """Compose the recorded graph body into one callable over feeds."""
+        """Compose the recorded graph body into one callable over feeds.
+        Side-effect vars (Assert) always build, fetched or not."""
+        side = list(self.__dict__.get("_side_effect_vars", []))
+
         def run_all(feeds: Dict[str, jax.Array]):
             env = dict(feeds)
+            for v in side:
+                env[v.name] = v._build(env)
             outs = []
             for name, builder in fetch_builders:
                 env[name] = builder(env)
@@ -101,6 +146,44 @@ class _DeclaredVar:
         self.name = name
         self.dtype = dtype
         self.shape = tuple(shape) if shape is not None else None
+
+
+class _ParamVar:
+    """Value-bearing handle over a program's materialized parameter
+    (what Program.list_vars yields for weights; reference Variable with
+    get_value/set_value)."""
+
+    persistable = True
+
+    def __init__(self, program, name):
+        self._program = program
+        self.name = name
+
+    @property
+    def _store(self):
+        return self._program.__dict__["_nn_params"]
+
+    @property
+    def shape(self):
+        return list(self._store[self.name].shape)
+
+    @property
+    def dtype(self):
+        return self._store[self.name].dtype
+
+    def get_value(self, scope=None):
+        return jnp.asarray(self._store[self.name])
+
+    def set_value(self, value, scope=None):
+        self._store[self.name] = np.asarray(value)
+
+    def __eq__(self, other):
+        return (isinstance(other, _ParamVar)
+                and other._program is self._program
+                and other.name == self.name)
+
+    def __hash__(self):
+        return hash((id(self._program), self.name))
 
 
 class _LazyVar:
@@ -393,14 +476,19 @@ class Executor:
             outs = self._cache[key](*args)
             outs = outs if isinstance(outs, (tuple, list)) else [outs]
         else:
-            builders = [(getattr(v, "name", f"fetch{i}"), v._build)
+            builders = [(getattr(v, "name", f"fetch{i}"),
+                         v._build if hasattr(v, "_build")
+                         else (lambda env, c=v: jnp.asarray(c)))
                         for i, v in enumerate(fetch_list)]
             env = {k: jnp.asarray(v) for k, v in feed.items()}
             hooks = program.__dict__.get("_opt_hooks")
             if hooks:
                 outs = self._run_train_step(program, builders, env, hooks)
             else:
-                key = (id(program), tuple(n for n, _ in builders))
+                # side-effect count in the key: an Assert recorded AFTER a
+                # fetch set compiled must invalidate that cache entry
+                key = (id(program), tuple(n for n, _ in builders),
+                       len(program.__dict__.get("_side_effect_vars", [])))
                 if key not in self._cache:
                     run_all = program._trace(builders)
                     self._cache[key] = jax.jit(
@@ -1035,3 +1123,68 @@ def set_ipu_shard(call_func, index=-1, stage=-1):
 
 from . import nn  # noqa: E402  (paddle.static.nn builders)
 from . import amp  # noqa: E402  (paddle.static.amp facade)
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save a var list's values (reference: static/io.py save_vars).
+    ``vars`` holds value-bearing handles (list_vars output /
+    create_parameter arrays); ``predicate`` filters main_program's vars."""
+    import os as _os
+    prog = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in prog.list_vars()
+                if (predicate is None or predicate(v))
+                and hasattr(v, "get_value")]
+    payload = {}
+    for i, v in enumerate(vars):
+        name = getattr(v, "name", f"var_{i}")
+        if hasattr(v, "get_value"):
+            payload[name] = np.asarray(v.get_value())
+        else:
+            payload[name] = np.asarray(v)
+    from .. import framework as _fw
+    path = (_os.path.join(dirname, filename) if filename
+            else _os.path.join(dirname, "__all_vars__"))
+    _fw.save(payload, path)
+    return path
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Counterpart of save_vars: restores values into the program's
+    parameter store (reference: static/io.py load_vars)."""
+    import os as _os
+    from .. import framework as _fw
+    prog = main_program or default_main_program()
+    path = (_os.path.join(dirname, filename) if filename
+            else _os.path.join(dirname, "__all_vars__"))
+    payload = _fw.load(path, return_numpy=True)
+    if vars is not None:
+        names = {getattr(v, "name", None) for v in vars}
+        payload = {k: v for k, v in payload.items() if k in names}
+    elif predicate is not None:
+        keep = {v.name for v in prog.list_vars()
+                if predicate(v) and hasattr(v, "get_value")}
+        payload = {k: v for k, v in payload.items() if k in keep}
+    prog.set_state_dict(payload)
+
+
+# reference path paddle.static.io.* (save_vars/load_vars/serialize live in
+# static/io.py there; consolidated here)
+from ..utils import register_submodule_aliases as _rsa  # noqa: E402
+import sys as _sys  # noqa: E402
+_rsa(__name__, {"io": _sys.modules[__name__]})
+io = _sys.modules[__name__]
+
+
+def get_program_persistable_vars(program: Program):
+    """Persistable (parameter) vars of a program (reference:
+    static/io.py get_program_persistable_vars)."""
+    return [v for v in program.list_vars() if getattr(v, "persistable",
+                                                      False)]
+
+
+# place classes addressable as paddle.static.CPUPlace etc. (reference
+# re-exports them through the static namespace)
+from ..device import CPUPlace, CUDAPlace, XPUPlace, TPUPlace  # noqa: E402

@@ -431,16 +431,79 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
 
 def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
     """lax.cond over the traced program; branch fns run at trace time on
-    jax values (every paddle_tpu op accepts them)."""
+    jax values (every paddle_tpu op accepts them). A RECORD-TIME-CONSTANT
+    predicate (built from literals, not feeds) dispatches in Python — the
+    reference's block IR runs only the selected branch, so heterogeneous
+    branch outputs (incl. tuples of different shapes/dtypes) are legal
+    in that case."""
+    if not isinstance(pred, _LazyVar) and \
+            not isinstance(pred, jax.core.Tracer):
+        return true_fn() if bool(np.asarray(pred).reshape(())) \
+            else false_fn()
     pb = _LazyVar._lift(pred)
     prog = (pred._program if isinstance(pred, _LazyVar)
             else default_main_program())
 
     def build(env):
-        return jax.lax.cond(jnp.asarray(pb(env)).reshape(()).astype(bool),
+        raw = pb(env)
+        if not isinstance(raw, jax.core.Tracer):
+            return true_fn() if bool(np.asarray(raw).reshape(())) \
+                else false_fn()
+        return jax.lax.cond(jnp.asarray(raw).reshape(()).astype(bool),
                             lambda _: true_fn(), lambda _: false_fn(), 0)
 
     return _LazyVar(prog, build, name or "cond")
+
+
+def Assert(cond, data=None, summarize: int = 20, name=None):
+    """Runtime assertion (reference: control_flow.py Assert). Recorded as
+    a program op: at build, a CONSTANT-false condition raises ValueError
+    printing up to ``summarize`` entries of each ``data`` tensor. Feed-
+    dependent (traced) conditions have no in-graph raise on TPU (no host
+    callbacks through the compiled program) — those raise here with the
+    checkify migration pointer instead of silently passing."""
+    cb = _LazyVar._lift(cond)
+    prog = (cond._program if isinstance(cond, _LazyVar)
+            else default_main_program())
+
+    def build(env):
+        raw = cb(env)
+        if isinstance(raw, jax.core.Tracer):
+            raise NotImplementedError(
+                "Assert on a feed-dependent condition cannot raise from "
+                "inside a compiled TPU program; wrap the step with "
+                "jax.experimental.checkify or assert on fetched host "
+                "values")
+        ok = bool(np.asarray(raw).all())
+        if not ok:
+            parts = []
+            for d in (data or []):
+                v = d._build(env) if isinstance(d, _LazyVar) else d
+                flat = np.asarray(v).ravel()[:summarize]
+                parts.append(f"{getattr(d, 'name', 'var')}: {flat}")
+            raise ValueError(
+                "Assert failed" + (f" ({name})" if name else "") +
+                ("\n" + "\n".join(parts) if parts else ""))
+        return jnp.asarray(True)
+
+    var = _LazyVar(prog, build, name or "assert")
+    # asserts must fire even when nothing fetches them: the Executor
+    # builds every registered side-effect var each run
+    prog.__dict__.setdefault("_side_effect_vars", []).append(var)
+    return var
+
+
+class ConditionalBlock:
+    """Legacy low-level conditional block op (reference:
+    control_flow.py ConditionalBlock — mutates the block IR through
+    ``with cb.block():``). Use static.nn.cond(pred, true_fn, false_fn)."""
+
+    def __init__(self, inputs, is_scalar_condition: bool = False,
+                 name=None):
+        raise NotImplementedError(
+            "ConditionalBlock.block() rewrote the legacy block IR in "
+            "place; use paddle.static.nn.cond(pred, true_fn, false_fn) "
+            "(lax.cond underneath) — docs/DESIGN_DECISIONS.md")
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -454,17 +517,29 @@ def case(pred_fn_pairs, default=None, name=None):
 
     def build(env):
         def rec(i):
-            if i == len(builds) - 1 and default is None:
-                pb, fn = builds[i]
-                return jax.lax.cond(
-                    jnp.asarray(pb(env)).reshape(()).astype(bool),
-                    lambda _: fn(), lambda _: fn(), 0)
             if i == len(builds):
                 return default()
             pb, fn = builds[i]
-            return jax.lax.cond(
-                jnp.asarray(pb(env)).reshape(()).astype(bool),
-                lambda _: fn(), lambda _: rec(i + 1), 0)
+            last_no_default = (i == len(builds) - 1 and default is None)
+            raw = pb(env)
+            # inspect the RAW value BEFORE any jnp op: inside a jit trace
+            # every jnp op stages (even on concrete operands), which would
+            # disguise a trace-time-constant predicate as a tracer
+            if not isinstance(raw, jax.core.Tracer):
+                # constant predicate (not derived from feeds): decide in
+                # Python — the reference's block IR runs only the selected
+                # branch, so heterogeneous branch shapes/dtypes are legal
+                if bool(np.asarray(raw).reshape(())) or last_no_default:
+                    return fn()
+                return rec(i + 1)
+            pv = jnp.asarray(raw).reshape(())
+            if last_no_default:
+                return jax.lax.cond(pv.astype(bool),
+                                    lambda _: fn(), lambda _: fn(), 0)
+            # feed-dependent predicate: lax.cond (branch outputs must
+            # match, the compiled-control-flow contract)
+            return jax.lax.cond(pv.astype(bool),
+                                lambda _: fn(), lambda _: rec(i + 1), 0)
         return rec(0)
 
     return _LazyVar(prog, build, name or "case")
@@ -478,12 +553,27 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     if isinstance(branch_fns, dict):
         keys = sorted(branch_fns)
         fns = [branch_fns[k] for k in keys]
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        # reference also takes [(index, fn), ...] pairs
+        pairs = sorted(branch_fns, key=lambda p: p[0])
+        keys = [int(k) for k, _ in pairs]
+        fns = [f for _, f in pairs]
     else:
         keys = list(range(len(branch_fns)))
         fns = list(branch_fns)
 
     def build(env):
-        idx = jnp.asarray(ib(env)).reshape(()).astype(jnp.int32)
+        raw = ib(env)
+        if not isinstance(raw, jax.core.Tracer):
+            # trace-time-constant index (checked on the RAW value — jnp
+            # ops stage under jit even on constants): Python dispatch,
+            # only the selected branch builds, so heterogeneous outputs
+            # are legal (the reference's block-IR semantics)
+            k = int(np.asarray(raw).reshape(()))
+            if k in dict(zip(keys, fns)):
+                return dict(zip(keys, fns))[k]()
+            return default() if default is not None else fns[-1]()
+        idx = jnp.asarray(raw).reshape(()).astype(jnp.int32)
         # map sparse keys onto dense switch slots; unknown -> default
         table = {k: i for i, k in enumerate(keys)}
         dense = -jnp.ones((max(keys) + 1,), jnp.int32)
@@ -565,11 +655,29 @@ row_conv = _ps_era("row_conv")
 data_norm = _ps_era("data_norm")
 
 
+class While:
+    """Legacy low-level While op (reference: static/nn/control_flow.py
+    While — mutates the block IR through ``with while_op.block():`` and
+    ``assign(..., output=cond)`` side effects). Trace-based capture has
+    no mutable block vars; use the reference's own recommended API:
+
+        out_vars = paddle.static.nn.while_loop(cond_fn, body_fn, loop_vars)
+    """
+
+    def __init__(self, cond, is_test: bool = False, name=None):
+        raise NotImplementedError(
+            "While/while_op.block() rewrote the legacy block IR in place; "
+            "use paddle.static.nn.while_loop(cond_fn, body_fn, loop_vars) "
+            "(lax.while_loop underneath) — docs/DESIGN_DECISIONS.md")
+
+
 # reference path static/nn/common.py (doctests use static.nn.common.fc)
 from ..utils import register_submodule_aliases as _rsa
 import sys as _sys
-_rsa(__name__, {"common": _sys.modules[__name__]})
+_rsa(__name__, {"common": _sys.modules[__name__],
+                "control_flow": _sys.modules[__name__]})
 common = _sys.modules[__name__]   # attribute access: static.nn.common.fc
+control_flow = _sys.modules[__name__]
 
 
 def deformable_conv(input, offset, mask, num_filters, filter_size,

@@ -107,6 +107,9 @@ def assign(x, output=None):
     return jnp.asarray(x)
 
 
+# increment lives in extras.py (dtype-preserving; star-imported below)
+
+
 # -- random (reference: tensor/random.py; draws from the global RNG tracker) -
 
 def _key():
@@ -164,7 +167,8 @@ def bernoulli(x):
 def _pd_sig(f):
     """Paddle call-convention shim over a jnp ufunc: jnp parameters are
     POSITIONAL-ONLY, but the reference's examples call by keyword
-    (paddle.sign(x=x), paddle.pow(x=a, y=2)) and pass name=."""
+    (paddle.sign(x=x), paddle.pow(x=a, y=2)) and pass name=. Program
+    vars (static mode) record the op instead of evaluating."""
     import functools as _ft
 
     @_ft.wraps(f)
@@ -174,6 +178,11 @@ def _pd_sig(f):
             pos.insert(0, x)
         if y is not None:
             pos.insert(1 if pos else 0, y)
+        # builtins.any: this module defines a paddle `any` reduction that
+        # shadows the builtin
+        if builtins.any(_is_lazy(a) for a in pos):
+            from ..static import lazy_apply
+            return lazy_apply(f, *pos, **kw)
         return f(*pos, **kw)
     return g
 
@@ -356,20 +365,20 @@ def diff(x, n=1, axis=-1):
 
 # -- logic / compare (reference: tensor/logic.py) ----------------------------
 
-equal = jnp.equal
-not_equal = jnp.not_equal
-greater_than = jnp.greater
-greater_equal = jnp.greater_equal
-less_than = jnp.less
-less_equal = jnp.less_equal
-logical_and = jnp.logical_and
-logical_or = jnp.logical_or
-logical_not = jnp.logical_not
-logical_xor = jnp.logical_xor
-bitwise_and = jnp.bitwise_and
-bitwise_or = jnp.bitwise_or
-bitwise_xor = jnp.bitwise_xor
-bitwise_not = jnp.bitwise_not
+equal = _pd_sig(jnp.equal)
+not_equal = _pd_sig(jnp.not_equal)
+greater_than = _pd_sig(jnp.greater)
+greater_equal = _pd_sig(jnp.greater_equal)
+less_than = _pd_sig(jnp.less)
+less_equal = _pd_sig(jnp.less_equal)
+logical_and = _pd_sig(jnp.logical_and)
+logical_or = _pd_sig(jnp.logical_or)
+logical_not = _pd_sig(jnp.logical_not)
+logical_xor = _pd_sig(jnp.logical_xor)
+bitwise_and = _pd_sig(jnp.bitwise_and)
+bitwise_or = _pd_sig(jnp.bitwise_or)
+bitwise_xor = _pd_sig(jnp.bitwise_xor)
+bitwise_not = _pd_sig(jnp.bitwise_not)
 
 
 def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
@@ -392,7 +401,12 @@ def where(condition, x=None, y=None):
 
 # -- linalg (reference: tensor/linalg.py; matmul at :151) --------------------
 
-def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           name=None):
+    if _is_lazy(x) or _is_lazy(y):
+        from ..static import lazy_apply
+        return lazy_apply(matmul, x, y, transpose_x=transpose_x,
+                          transpose_y=transpose_y, name="matmul")
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
     if transpose_y:

@@ -283,7 +283,10 @@ def broadcast_tensors(input, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    return jnp.asarray(x) + value
+    # dtype-preserving (reference increment keeps the tensor's dtype; a
+    # bare python-float add would promote int counters to float)
+    x = jnp.asarray(x)
+    return x + jnp.asarray(value).astype(x.dtype)
 
 
 def tolist(x):

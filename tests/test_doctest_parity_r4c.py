@@ -1,0 +1,188 @@
+"""Round-4 doctest batch 4: Layer base-class surface, Program vars/IO,
+static control-flow constant-branch dispatch, py_func ecosystem."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_layer_name_scope_and_casts():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__(name_scope="demo_net")
+            self.fc = nn.Linear(3, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    assert m.full_name().startswith("demo_net")
+    assert list(m.children()) == [m.fc]
+    assert [n for n, _ in m.named_children()] == ["fc"]
+    m.bfloat16()
+    assert m.fc.weight.dtype == jnp.bfloat16
+    m.float()
+    assert m.fc.weight.dtype == jnp.float32
+    m.to(device="cpu", dtype="float32")        # string device resolves
+    sd = m.to_static_state_dict()
+    assert "fc.weight" in sd
+
+
+def test_program_list_vars_state_dict_roundtrip(tmp_path):
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name="img", shape=[4, 8],
+                                   dtype="float32")
+            y = paddle.static.nn.fc(x, size=3)
+        params = [v for v in prog.list_vars()
+                  if getattr(v, "persistable", False)]
+        assert any(list(v.shape) == [8, 3] for v in params)
+        assert params == paddle.static.get_program_persistable_vars(prog)
+        sd = prog.state_dict("param")
+        assert sd and all(hasattr(v, "shape") for v in sd.values())
+        # save/load a whole Program (descriptor + params)
+        p = str(tmp_path / "prog.pdmodel")
+        paddle.save(prog, p)
+        prog2 = paddle.load(p)
+        assert set(prog2.state_dict("param")) == set(sd)
+        # save_vars/load_vars round trip through the value handles
+        paddle.static.save_vars(dirname=str(tmp_path), vars=params,
+                                filename="vars_file", main_program=prog)
+        w = params[0]
+        orig = np.asarray(w.get_value())
+        w.set_value(np.zeros_like(orig))
+        paddle.static.load_vars(dirname=str(tmp_path), vars=params,
+                                filename="vars_file", main_program=prog)
+        np.testing.assert_allclose(np.asarray(w.get_value()), orig)
+    finally:
+        paddle.disable_static()
+
+
+def test_save_load_bytesio():
+    from io import BytesIO
+    buf = BytesIO()
+    obj = {"a": jnp.arange(4), "b": 3}
+    paddle.save(obj, buf)
+    buf.seek(0)
+    back = paddle.load(buf)
+    np.testing.assert_array_equal(np.asarray(back["a"]), [0, 1, 2, 3])
+
+
+def test_case_switch_constant_predicates_heterogeneous():
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.full(shape=[1], dtype="float32", fill_value=0.3)
+            y = paddle.full(shape=[1], dtype="float32", fill_value=0.1)
+            p_true = paddle.less_than(x=y, y=x)
+            p_false = paddle.less_than(x=x, y=y)
+            # branches with DIFFERENT shapes/dtypes: legal because the
+            # predicates are trace-time constants (python dispatch)
+            out1 = paddle.static.nn.case(
+                [(p_true, lambda: paddle.full([1, 2], 1.0)),
+                 (p_false, lambda: paddle.full([2, 2], 2, "int32"))],
+                default=lambda: paddle.full([3], 3, "int32"))
+            out2 = paddle.static.nn.switch_case(
+                paddle.full([1], 2, "int32"),
+                branch_fns=[(1, lambda: paddle.full([1, 2], 1.0)),
+                            (2, lambda: paddle.full([2, 2], 2, "int32"))],
+                default=lambda: paddle.full([3], 3, "int32"))
+            exe = paddle.static.Executor()
+            r1, r2 = exe.run(prog, fetch_list=[out1, out2])
+        assert r1.shape == (1, 2) and r2.shape == (2, 2)
+        # cond with tuple outputs + constant pred (reference cond doc)
+        t = paddle.static.nn.cond(
+            paddle.less_than(paddle.full([1], 0.1),
+                             paddle.full([1], 0.23)),
+            lambda: (paddle.full([1, 2], 1, "int32"),
+                     paddle.full([2, 3], True, "bool")),
+            lambda: (paddle.full([3, 4], 3.0),
+                     paddle.full([4, 5], 2, "int64")))
+        a, b = t
+        assert a.shape == (1, 2) and b.shape == (2, 3)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_assert_fires_without_fetch():
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.full([2, 3], 2.0, "float32")
+            cond = paddle.max(x) < 1.0
+            paddle.static.nn.Assert(cond, [x], 10, "demo_assert")
+        exe = paddle.static.Executor()
+        with pytest.raises(ValueError, match="Assert failed"):
+            exe.run(prog)     # no fetch: side-effect ops still build
+    finally:
+        paddle.disable_static()
+
+
+def test_legacy_while_and_conditional_block_raise():
+    with pytest.raises(NotImplementedError, match="while_loop"):
+        paddle.static.nn.While(cond=None)
+    with pytest.raises(NotImplementedError, match="cond"):
+        paddle.static.nn.ConditionalBlock([])
+
+
+def test_device_surface():
+    assert paddle.is_compiled_with_ipu() is False
+    assert paddle.device.is_compiled_with_ipu() is False
+    assert paddle.static.CPUPlace() == paddle.CPUPlace()
+
+
+def test_increment_and_keyword_comparisons():
+    i = paddle.full([1], 0, "int64")
+    j = paddle.increment(x=i, value=2)
+    assert int(np.asarray(j)[0]) == 2
+    assert bool(np.asarray(paddle.less_than(x=i, y=j))[0])
+
+
+def test_review_fixes_batch4():
+    # increment preserves dtype (int stays int; x64-off backend may store
+    # int64 as int32 — compare against the INPUT's dtype)
+    i = paddle.full([1], 0, "int64")
+    assert paddle.increment(i, 2).dtype == i.dtype
+    # bitwise keyword calls
+    a = paddle.to_tensor([1, 2], dtype="int32")
+    assert paddle.bitwise_xor(x=a, y=a).sum() == 0
+    # half(excluded_layers) keeps excluded layer fp32
+    m = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m.half(excluded_layers=[nn.LayerNorm])
+    assert m[0].weight.dtype == jnp.float16
+    assert m[1].weight.dtype == jnp.float32
+    # save(Program) materializes params for a never-run program
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data(name="x", shape=[2, 4], dtype="float32")
+            paddle.static.nn.fc(x, size=3)
+        import io as _io
+        buf = _io.BytesIO()
+        paddle.save(prog, buf)
+        buf.seek(0)
+        prog2 = paddle.load(buf)
+        assert prog2.state_dict("param"), "weights lost in save round trip"
+        # Assert recorded AFTER a cached fetch still fires
+        prog3 = paddle.static.Program()
+        with paddle.static.program_guard(prog3):
+            y = paddle.static.data(name="y", shape=[2], dtype="float32")
+            z = y * 2
+        exe = paddle.static.Executor()
+        exe.run(prog3, feed={"y": np.ones(2, "float32")}, fetch_list=[z])
+        with paddle.static.program_guard(prog3):
+            paddle.static.nn.Assert(paddle.full([1], False, "bool"))
+        with pytest.raises(ValueError, match="Assert failed"):
+            exe.run(prog3, feed={"y": np.ones(2, "float32")},
+                    fetch_list=[z])
+    finally:
+        paddle.disable_static()

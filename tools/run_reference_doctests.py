@@ -87,6 +87,10 @@ DEFAULT_MODULES = [
     "incubate/autograd/functional.py", "autograd/py_layer.py",
     "distribution/transformed_distribution.py",
     "distribution/independent.py", "distribution/exponential_family.py",
+    # batch 4 (round-4 tail): Layer base-class docs, device/profiler
+    # surfaces, static IO, legacy control flow
+    "nn/layer/layers.py", "device/__init__.py", "profiler/profiler.py",
+    "static/io.py", "framework/io.py", "static/nn/control_flow.py",
 ]
 
 # Idioms this framework documents as migration gaps (counted separately,
@@ -109,6 +113,10 @@ _SKIP_PATTERNS = [
     # would need to rewrite already-captured downstream closures; raises
     # with the ClipGradBy* migration pointer)
     r"_set_error_clip\(",
+    # legacy block-IR While op (mutating with-block + assign(output=));
+    # raises pointing at static.nn.while_loop
+    r"control_flow\.While\(",
+    r"ConditionalBlock\(",
     # jax sparse convention: BCOO indices/data are ATTRIBUTES — the
     # reference's .indices()/.values() method spelling cannot be
     # shadowed onto the registered pytree dataclass (ledger entry)
@@ -124,7 +132,8 @@ _SKIP_PATTERNS = [
     r"incubate\.autograd\.(forward_grad|grad)\(",
 ]
 _DIRECTIVE_SKIP = re.compile(
-    r"doctest:\s*\+(SKIP|REQUIRES\(env:\s*(GPU|XPU|DISTRIBUTED))",
+    r"doctest:\s*\+(SKIP|REQUIRES\(env:\s*(GPU|XPU|DISTRIBUTED|IPU|"
+    r"CUSTOM_DEVICE))",
     re.IGNORECASE)
 
 
@@ -166,7 +175,24 @@ def classify(code):
     return "run"
 
 
+def _reset_static_state():
+    """Fresh default programs per block: every reference example assumes
+    a clean default_main_program (their CI executes blocks in separate
+    processes); in this in-process harness, stale recorded ops — e.g. an
+    intentionally-failing Assert from a previous block — would otherwise
+    leak into later blocks' exe.run."""
+    try:
+        import paddle_tpu.static as _st
+        _st._default_program = _st.Program()
+        _st._STARTUP_PROGRAM = _st.Program()
+        _st._program_stack.clear()
+    except Exception:
+        pass
+
+
 def run_block(code, timeout_s=20):
+    _reset_static_state()
+
     def handler(signum, frame):
         raise _Timeout()
     old = signal.signal(signal.SIGALRM, handler)
