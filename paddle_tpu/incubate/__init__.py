@@ -5,6 +5,7 @@ reference's fused kernels, re-exported ahead of graduation to paddle_tpu.nn.
 """
 
 from . import nn
+from . import layers  # noqa: F401
 from . import asp
 from . import operators
 from . import autograd

@@ -1,0 +1,75 @@
+"""paddle.incubate.layers — the non-PS subset of the reference's
+incubate/layers/nn.py. The PS/recommendation-era ops there
+(fused_embedding_seq_pool, search_pyramid_hash, tdm_child/tdm_sampler,
+rank_attention, …) are ledgered non-goals (docs/DESIGN_DECISIONS.md
+parameter-server entry); the general tensor utilities are real ops here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["shuffle_batch", "partial_concat", "partial_sum"]
+
+
+def _col_slice(x, start_index: int, length: int):
+    x = jnp.asarray(x)
+    n = x.shape[-1]
+    start = start_index if start_index >= 0 else n + start_index
+    stop = n if length < 0 else start + length
+    return x[..., start:stop]
+
+
+def shuffle_batch(x, seed: Optional[int] = None):
+    """Random permutation along the batch dim (reference:
+    incubate/layers/nn.py shuffle_batch).
+
+    Static-mode note: with ``seed=None`` a fresh seed is drawn when the
+    op is RECORDED, so each call site shuffles differently — but the
+    compiled program replays that permutation on every run (compiled
+    executables are deterministic; feed an explicit per-run ``seed``
+    via a program input if you need per-run reshuffling)."""
+    from ..core.rng import rng_tracker
+    if isinstance(x, jax.Array) or not hasattr(x, "_build"):
+        key = (jax.random.PRNGKey(seed) if seed is not None
+               else rng_tracker().next_key())
+        return jax.random.permutation(key, jnp.asarray(x), axis=0)
+    # program var: record (static-mode path)
+    if seed is None:
+        import numpy as _np
+        seed = int(_np.random.SeedSequence().entropy % (2 ** 31))
+    from ..static import lazy_apply
+    return lazy_apply(lambda v: shuffle_batch(v, seed=seed), x,
+                      name="shuffle_batch")
+
+
+def _lazy_or(fn, inputs, **kw):
+    if any(hasattr(v, "_build") for v in inputs):
+        from ..static import lazy_apply
+        return lazy_apply(lambda *vs: fn(list(vs), **kw), *inputs,
+                          name=fn.__name__)
+    return fn(list(inputs), **kw)
+
+
+def partial_concat(input: Sequence, start_index: int = 0,
+                   length: int = -1):
+    """Concat the [start_index, start_index+length) column slice of every
+    input (reference: incubate/layers/nn.py partial_concat). Works on
+    arrays and on static program vars."""
+    def run(vals, start_index=start_index, length=length):
+        return jnp.concatenate(
+            [_col_slice(v, start_index, length) for v in vals], axis=-1)
+    run.__name__ = "partial_concat"
+    return _lazy_or(run, list(input))
+
+
+def partial_sum(input: Sequence, start_index: int = 0, length: int = -1):
+    """Sum the column slices across inputs (reference: partial_sum)."""
+    def run(vals, start_index=start_index, length=length):
+        parts = [_col_slice(v, start_index, length) for v in vals]
+        return sum(parts[1:], parts[0])
+    run.__name__ = "partial_sum"
+    return _lazy_or(run, list(input))

@@ -119,15 +119,15 @@ def _key():
     return rng_tracker().next_key()
 
 
-def rand(shape, dtype="float32"):
+def rand(shape, dtype="float32", name=None):
     return jax.random.uniform(_key(), tuple(shape), _dt.convert_dtype(dtype))
 
 
-def randn(shape, dtype="float32"):
+def randn(shape, dtype="float32", name=None):
     return jax.random.normal(_key(), tuple(shape), _dt.convert_dtype(dtype))
 
 
-def randint(low=0, high=None, shape=(1,), dtype="int64"):
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
     return jax.random.randint(_key(), tuple(shape), low, high,
