@@ -350,6 +350,38 @@ def _decode_bench(cfg, on_tpu):
             out["paged_decode_ctx"] = page * per_seq
         except Exception as e:
             out["paged_decode_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
+    try:
+        # long-context leg: s=8192 training on the flash kernel — the
+        # dense XLA attention path fails to COMPILE at this length on
+        # v5e (tune-sweep evidence), so the leg is flash-kernel-only and
+        # SKIPPED when the degradation ladder disabled Pallas; full
+        # recompute keeps activations in budget. Runs LAST, after the
+        # serving model is dropped, to free HBM first.
+        if on_tpu and not os.environ.get("PT_DISABLE_PALLAS"):
+            try:
+                del dmodel
+            except NameError:
+                pass
+            from paddle_tpu.models import LlamaConfig as _LC
+            from paddle_tpu.trainer import device_peak_flops as _pk
+            lcfg = _LC(vocab_size=32000, hidden_size=1024,
+                       intermediate_size=3072, num_hidden_layers=8,
+                       num_attention_heads=8, num_key_value_heads=4,
+                       max_position_embeddings=8192, dtype="bfloat16",
+                       recompute="full")
+            _log("long-context: compiling s=8192")
+            ltps, lstep, _stall, _loss, lmodel, _ps = _train_bench(
+                lcfg, 1, 8192, 5, 2)
+            ltps_chip = ltps / jax.device_count()
+            out["longctx_seq_len"] = 8192
+            out["longctx_tokens_per_sec_per_chip"] = round(ltps_chip, 1)
+            out["longctx_mfu"] = round(
+                ltps_chip * lmodel.flops_per_token(8192) / _pk(), 4)
+            out["longctx_params"] = lmodel.num_params()
+            _log("long-context: timed")
+    except Exception as e:
+        out["longctx_error"] = f"{type(e).__name__}: {str(e)[:150]}"
     return out
 
 
