@@ -161,20 +161,35 @@ class LlamaAttention(nn.Layer):
         q, k = rope_ops.apply_rotary_pos_emb(q, k, cos, sin, position_ids)
         return q, k, v
 
-    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None,
+                segment_ids=None):
         cfg = self.cfg
         b, s, d = x.shape
         n_h, hd = cfg.num_attention_heads, cfg.head_dim
         q, k, v = self._qkv_rope(x, cos, sin, position_ids)
-        out = self._sp_attention(q, k, v, attn_mask)
+        out = None
+        if segment_ids is None:
+            out = self._sp_attention(q, k, v, attn_mask)
+        elif cfg.sequence_parallel:
+            from ..parallel.mesh import current_mesh
+            hm = current_mesh()
+            if hm is not None and hm.axis_size("sep") > 1:
+                # loud failure beats silently gathering the seq-sharded
+                # activations into a full-sequence flash call
+                raise NotImplementedError(
+                    "segment_ids (packed sequences) is not supported with "
+                    "sequence parallelism (sep axis > 1): ring/ulysses "
+                    "attention has no segment-mask path yet. Unpack the "
+                    "batch or run with sequence_parallel=False.")
         if out is None:
             if cfg.use_flash_attention:
                 out = F.scaled_dot_product_attention(
                     q, k, v, attn_mask=attn_mask, is_causal=True,
-                    training=self.training)
+                    training=self.training, segment_ids=segment_ids)
             else:
                 from ..ops.attention import _sdpa_xla
-                out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True)
+                out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True,
+                                segment_ids=segment_ids)
         out = out.reshape(b, s, n_h * hd)
         return jnp.matmul(out, self.o_proj.astype(x.dtype))
 
@@ -405,9 +420,10 @@ class LlamaDecoderLayer(nn.Layer):
                                                    cfg.rms_norm_eps, dtype="float32")
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin, position_ids=None, attn_mask=None):
+    def forward(self, x, cos, sin, position_ids=None, attn_mask=None,
+                segment_ids=None):
         h = x + self.self_attn(self.input_layernorm(x), cos, sin, position_ids,
-                               attn_mask)
+                               attn_mask, segment_ids)
         return h + self.mlp(self.post_attention_layernorm(h))
 
     def prefill(self, x, cos, sin, max_len: int):
@@ -452,7 +468,8 @@ class LlamaModel(nn.Layer):
         sh = NamedSharding(hm.mesh, PartitionSpec(("dp", "fsdp"), "sep", None))
         return jax.lax.with_sharding_constraint(x, sh)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                segment_ids=None):
         x = jnp.take(self.embed_tokens, input_ids, axis=0)
         cos, sin = self.rope_cos, self.rope_sin
         if position_ids is None:
@@ -464,13 +481,15 @@ class LlamaModel(nn.Layer):
             policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                       if self.cfg.recompute == "selective" else None)
             ckpt = jax.checkpoint(
-                lambda layer, h: layer(h, cos, sin, position_ids, attn_mask),
+                lambda layer, h: layer(h, cos, sin, position_ids, attn_mask,
+                                       segment_ids),
                 static_argnums=(0,), policy=policy)
             for layer in self.layers:
                 x = self._seq_shard(ckpt(layer, x))
         else:
             for layer in self.layers:
-                x = self._seq_shard(layer(x, cos, sin, position_ids, attn_mask))
+                x = self._seq_shard(layer(x, cos, sin, position_ids, attn_mask,
+                                          segment_ids))
         return self.norm(x)
 
     # -- KV-cache inference paths ------------------------------------------
@@ -571,8 +590,14 @@ class LlamaForCausalLM(nn.Layer):
              if self.cfg.tie_word_embeddings else self.lm_head)
         return jnp.matmul(hidden, w.astype(hidden.dtype))
 
-    def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
-        hidden = self.model(input_ids, position_ids, attn_mask)
+    def forward(self, input_ids, labels=None, position_ids=None,
+                attn_mask=None, segment_ids=None):
+        """``segment_ids`` [b, s] packs multiple documents per row: the
+        flash kernel masks cross-segment attention in-kernel (reference
+        varlen API: flash_attn_kernel.cu:91 cu_seqlens). Pass per-segment
+        ``position_ids`` and -100 labels at segment boundaries for exact
+        packed-pretraining semantics."""
+        hidden = self.model(input_ids, position_ids, attn_mask, segment_ids)
         logits = self.logits(hidden)
         if labels is None:
             return logits
@@ -584,17 +609,24 @@ class LlamaForCausalLM(nn.Layer):
     def num_params(self) -> int:
         return sum(int(math.prod(p.shape)) for _, p in self.named_parameters())
 
-    def flops_per_token(self, seq_len: int) -> float:
+    def flops_per_token(self, seq_len: int, causal: bool = False) -> float:
         """Model fwd+bwd FLOPs per token (PaLM appendix-B convention:
         6*N_matmul + attention term 12*L*H*Q*T). The embedding gather is not
         a matmul, so the table is excluded from N unless tied (tied weights
         ARE the lm_head matmul). Reference analogue:
-        python/paddle/utils/flops.py per-op tables."""
+        python/paddle/utils/flops.py per-op tables.
+
+        ``causal=True`` halves the attention term to count only the FLOPs a
+        causal kernel actually executes (avg context (s+1)/2 per query):
+        the honest-utilization convention. Both are reported by bench.py;
+        the PaLM (non-causal) number is the cross-paper-comparable one."""
         cfg = self.cfg
         n = self.num_params()
         if not cfg.tie_word_embeddings:
             n -= cfg.vocab_size * cfg.hidden_size  # gather-only table
         attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        if causal:
+            attn *= (seq_len + 1) / (2 * seq_len)
         return 6 * n + attn
 
 

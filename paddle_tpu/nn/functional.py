@@ -628,16 +628,20 @@ def kl_div(input, label, reduction: str = "mean"):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p: float = 0.0, is_causal: bool = False,
-                                 training: bool = True):
+                                 training: bool = True, segment_ids=None):
     """[batch, seq, heads, head_dim] layout, matching the reference API
     (python/paddle/nn/functional/flash_attention.py:441). Dispatches to the
-    Pallas flash-attention kernel on TPU via paddle_tpu.ops.attention."""
+    Pallas flash-attention kernel on TPU via paddle_tpu.ops.attention.
+
+    ``segment_ids`` ([b, s] ints or a (q_seg, kv_seg) pair) restricts
+    attention to equal-id positions — the packed-sequence / varlen path
+    (reference: flash_attention.py's flash_attn_varlen surface)."""
     from ..amp.auto_cast import maybe_cast_inputs
     query, key, value = maybe_cast_inputs("attention", query, key, value)
     from ..ops import attention as attn_ops
     return attn_ops.flash_attention(query, key, value, attn_mask=attn_mask,
                                     dropout_p=dropout_p if training else 0.0,
-                                    causal=is_causal)
+                                    causal=is_causal, segment_ids=segment_ids)
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
