@@ -130,52 +130,70 @@ def _train_bench(cfg, batch_size, seq_len, steps, warmup):
             model, per_step)
 
 
-def _overlap_ab(on_tpu, step_on_s, degraded):
+def _spawn_probe(strip_flags):
+    """Run one overlap-probe child; returns its parsed JSON dict.
+    The child is IDENTICAL code either way — the only difference is
+    whether the overlap flag set is present in its XLA_FLAGS."""
+    import subprocess
+
+    from paddle_tpu.distributed.overlap import OVERLAP_XLA_FLAGS
+    env = dict(os.environ)
+    env["PT_BENCH_OVERLAP_PROBE"] = "1"
+    env.pop("PT_DISABLE_PALLAS", None)     # ladder state must not leak
+    if strip_flags:
+        # the parent's apply_overlap_flags wrote the flags into XLA_FLAGS;
+        # PT_NO_OVERLAP only stops the child ADDING them — strip them too,
+        # or the "off" leg runs with overlap on
+        env["PT_NO_OVERLAP"] = "1"
+        toks = set(OVERLAP_XLA_FLAGS.split())
+        env["XLA_FLAGS"] = " ".join(
+            t for t in env.get("XLA_FLAGS", "").split() if t not in toks)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    if not lines:
+        return {"step_time_s": None,
+                "error": f"probe produced no JSON (rc={r.returncode}): "
+                         f"{r.stderr[-300:]}"}
+    return json.loads(lines[-1])
+
+
+def _overlap_ab(on_tpu, degraded):
     """A/B the async-collective/latency-hiding XLA flag set (round-4
     verdict weak #7: the flags' value was vetted for safety but never
-    measured). XLA_FLAGS bind at backend init, so the OFF leg runs in a
-    fresh subprocess (PT_NO_OVERLAP=1 + PT_BENCH_OVERLAP_PROBE=1 → a
-    short train-only run that prints one JSON line) with the parent's
-    overlap flags STRIPPED from the inherited XLA_FLAGS; delta is
-    relative to the main run's step time. Skipped when the degradation
-    ladder changed the parent's config (the legs must differ only in
-    flags). Caveat recorded in the artifact: the legs run serially on a
-    shared chip, so the child reports its per-round spread — a delta
-    smaller than the spread is noise, not signal."""
+    measured). XLA_FLAGS bind at backend init, so BOTH legs run as fresh
+    subprocesses executing identical probe code (bare train_step
+    min-of-rounds, no input pipeline) — one inheriting the parent's
+    overlap flags, one with them stripped; comparing the parent's
+    loader-through mean against a bare child min would bias the delta.
+    Skipped when the degradation ladder changed the parent's config.
+    Caveat recorded in the artifact: the legs still run serially on a
+    shared chip, so each reports its per-round spread — a delta smaller
+    than the combined spread is noise, not signal."""
     out = {}
     if not on_tpu or degraded or os.environ.get("PT_BENCH_OVERLAP_PROBE") \
             or os.environ.get("PT_NO_OVERLAP"):
         return out
     try:
-        import subprocess
-
-        from paddle_tpu.distributed.overlap import OVERLAP_XLA_FLAGS
-        env = dict(os.environ)
-        env["PT_NO_OVERLAP"] = "1"
-        env["PT_BENCH_OVERLAP_PROBE"] = "1"
-        # the parent's apply_overlap_flags wrote the flags into XLA_FLAGS;
-        # PT_NO_OVERLAP only stops the child ADDING them — strip them too,
-        # or the "off" leg runs with overlap on
-        toks = set(OVERLAP_XLA_FLAGS.split())
-        env["XLA_FLAGS"] = " ".join(
-            t for t in env.get("XLA_FLAGS", "").split() if t not in toks)
         _log("overlap A/B: spawning flags-off probe subprocess")
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            capture_output=True, text=True, timeout=600,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        line = [ln for ln in r.stdout.splitlines()
-                if ln.startswith("{")][-1]
-        probe = json.loads(line)
-        off = probe.get("step_time_s")
-        if off:
+        p_off = _spawn_probe(strip_flags=True)
+        _log("overlap A/B: spawning flags-on probe subprocess")
+        p_on = _spawn_probe(strip_flags=False)
+        off, on = p_off.get("step_time_s"), p_on.get("step_time_s")
+        if off and on:
             out["overlap_off_step_time_s"] = off
-            out["overlap_off_spread_s"] = probe.get("spread_s")
-            # >0: flags help (off leg slower); serial legs on a shared
-            # chip — treat |delta| below the spread as noise
-            out["overlap_delta"] = round((off - step_on_s) / off, 4)
+            out["overlap_on_step_time_s"] = on
+            out["overlap_spread_s"] = round(
+                max(p_off.get("spread_s") or 0, p_on.get("spread_s") or 0),
+                4)
+            # >0: flags help (off leg slower)
+            out["overlap_delta"] = round((off - on) / off, 4)
         else:
-            out["overlap_ab_error"] = probe.get("error", "no step time")
+            out["overlap_ab_error"] = (p_off.get("error")
+                                       or p_on.get("error")
+                                       or "no step time")[:300]
     except Exception as e:
         out["overlap_ab_error"] = f"{type(e).__name__}: {str(e)[:150]}"
     return out
@@ -223,7 +241,9 @@ def _overlap_probe_main():
             rounds.append((time.perf_counter() - t0) / 3)
         _emit({"step_time_s": round(min(rounds), 4),
                "spread_s": round(max(rounds) - min(rounds), 4),
-               "overlap_flags": "off"})
+               "overlap_flags": ("on" if "async_collective"
+                                 in os.environ.get("XLA_FLAGS", "")
+                                 else "off")})
     except Exception as e:
         _emit({"step_time_s": None,
                "error": f"{type(e).__name__}: {str(e)[:200]}"})
@@ -480,6 +500,10 @@ def _decode_bench(cfg, on_tpu):
                         lcfg, lb, 8192, 5, 2)
                     break
                 except Exception as e:
+                    # clear frame locals: the traceback pins the failed
+                    # tier's model/opt device arrays, which would keep HBM
+                    # allocated while the fallback tier compiles
+                    traceback.clear_frames(e.__traceback__)
                     last_exc = e
             else:
                 raise RuntimeError("all longctx tiers failed") from last_exc
@@ -656,6 +680,10 @@ def _run(error_note):
                     attn_path = "xla-fallback"
             break
         except Exception as e:
+            # clear frame locals so the failed tier's device arrays are
+            # freed before the next tier compiles (the traceback would
+            # otherwise pin model+opt HBM through the retry)
+            traceback.clear_frames(e.__traceback__)
             last_exc = e
     else:
         # chain the real exception so main()'s traceback artifact shows
@@ -714,8 +742,7 @@ def _run(error_note):
     }
     # degraded = any ladder tier beyond as-configured (recompute=full
     # mutation or pallas-off): the A/B legs would differ in more than flags
-    detail.update(_overlap_ab(on_tpu, step_s,
-                              degraded=(tier != "as-configured")))
+    detail.update(_overlap_ab(on_tpu, degraded=(tier != "as-configured")))
     detail.update(_decode_bench(cfg, on_tpu))
 
     payload = {

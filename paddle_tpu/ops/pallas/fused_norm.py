@@ -129,13 +129,29 @@ def _rms_bwd_rule(eps, block_r, interpret, res, dy):
 _rms_norm_p.defvjp(_rms_fwd_rule, _rms_bwd_rule)
 
 
+def _pick_block_r(R: int, D: int, block_r: int = DEFAULT_BLOCK_R) -> int:
+    """Largest row block that (a) divides R, (b) is sublane-aligned, and
+    (c) keeps the BACKWARD kernel's VMEM working set under budget.
+
+    The bwd kernel holds ~6 fp32 [br, D] temporaries (x, dy, xhat, wdy
+    plus in/out copies) ≈ 30·br·D bytes of scoped VMEM; the hard limit is
+    16 MB (observed live: br=256 at D=4096 allocates 22.6 MB and Mosaic
+    aborts the compile — the Llama-3-8B hidden size). Budget 8 MB leaves
+    headroom for Mosaic's own stack."""
+    budget = 8 * 1024 * 1024
+    br = min(block_r, R)
+    while br > 8 and (R % br or 30 * br * D > budget):
+        br //= 2
+    return max(br, 8)
+
+
 def pallas_rms_supported(x, weight) -> bool:
     from ..registry import pallas_disabled
     if not _HAS_PLTPU or weight is None or pallas_disabled():
         return False
     D = x.shape[-1]
     R = max(x.size // D, 1)
-    br = min(DEFAULT_BLOCK_R, R)
+    br = _pick_block_r(R, D)
     return D % 128 == 0 and R % br == 0 and br % 8 == 0
 
 
@@ -149,7 +165,7 @@ def rms_norm_pallas(x, weight, epsilon: float = 1e-6,
     D = shape[-1]
     x2d = x.reshape(-1, D)
     out = _rms_norm_p(x2d, weight, float(epsilon),
-                      min(block_r, x2d.shape[0]), interpret)
+                      _pick_block_r(x2d.shape[0], D, block_r), interpret)
     return out.reshape(shape)
 
 
