@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generation import GenerationConfig, _sample_logits
+from .generation import GenerationConfig, sample_logits_batched
 
 
 @dataclass
@@ -44,6 +44,12 @@ class _Request:
     rid: int
     prompt: np.ndarray                  # [L] int32
     max_new_tokens: int
+    # per-request sampling knobs (engine defaults when not overridden)
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    do_sample: bool = False
+    eos_token_id: Optional[int] = None
     generated: List[int] = field(default_factory=list)
     done: bool = False
     slot: int = -1                      # active slot, -1 = queued/finished
@@ -81,6 +87,11 @@ class ContinuousBatchingEngine:
         self._free: List[int] = list(range(total - 1, 0, -1))  # stack; 0 kept
         self.tables = np.zeros((max_batch, self.pages_per_seq), np.int32)
         self.pos = np.zeros((max_batch,), np.int32)
+        # per-slot sampling knobs, fed to the compiled block as arrays
+        self._temp = np.ones((max_batch,), np.float32)
+        self._topk = np.zeros((max_batch,), np.int32)
+        self._topp = np.ones((max_batch,), np.float32)
+        self._dosample = np.zeros((max_batch,), bool)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._queue: List[_Request] = []
         self._requests: Dict[int, _Request] = {}
@@ -119,9 +130,23 @@ class ContinuousBatchingEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, input_ids, max_new_tokens: Optional[int] = None) -> int:
-        """Queue one request; returns its id."""
+    def submit(self, input_ids, max_new_tokens: Optional[int] = None,
+               generation_config: Optional[GenerationConfig] = None) -> int:
+        """Queue one request; returns its id.
+
+        ``generation_config`` overrides the engine's sampling knobs
+        (do_sample/temperature/top_k/top_p) and eos_token_id for THIS
+        request only; the token budget comes from the ``max_new_tokens``
+        PARAMETER (falling back to the engine default) — gc's own
+        max_new_tokens is deliberately ignored, since a caller passing a
+        config just to enable sampling would otherwise silently get the
+        dataclass default budget of 32. Knobs are per-slot arrays inside
+        the one compiled decode block (sample_logits_batched), so any
+        mix of greedy and sampled requests batches together with no
+        recompilation — the TPU analogue of the reference's per-row
+        top_p_sampling_kernel.cu."""
         ids = np.asarray(input_ids, np.int32).reshape(-1)
+        gc = generation_config or self.cfg
         new = (max_new_tokens if max_new_tokens is not None
                else self.cfg.max_new_tokens)
         if len(ids) == 0:
@@ -134,7 +159,11 @@ class ContinuousBatchingEngine:
         if -(-len(ids) // self.page_size) > self._total_pages:
             raise ValueError(f"prompt needs more pages than the pool holds "
                              f"({self._total_pages}); raise num_pages")
-        req = _Request(next(self._rid), ids, new)
+        req = _Request(next(self._rid), ids, new,
+                       temperature=float(gc.temperature),
+                       top_k=int(gc.top_k), top_p=float(gc.top_p),
+                       do_sample=bool(gc.do_sample),
+                       eos_token_id=gc.eos_token_id)
         req.submit_t = time.perf_counter()
         self._requests[req.rid] = req
         self._queue.append(req)
@@ -239,6 +268,10 @@ class ContinuousBatchingEngine:
             self.tables[slot, :len(pages)] = pages
             self._slots[slot] = req
             req.slot = slot
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._dosample[slot] = req.do_sample
             if self.chunked_prefill:
                 # pages claimed now; KV written one chunk per tick
                 req.prefilled = 0
@@ -312,20 +345,30 @@ class ContinuousBatchingEngine:
 
     # -- decode -------------------------------------------------------------
 
-    def _build_decode(self, K: int):
+    def _build_decode(self, K: int, any_sample: bool):
         """K sample+decode steps chained in one compiled lax.scan: one
-        dispatch + one [K, B] token readback per scheduler tick."""
-        core, model, cfg = self.core, self.model, self.cfg
+        dispatch + one [K, B] token readback per scheduler tick. Sampling
+        happens IN the scan via sample_logits_batched with per-slot knob
+        arrays — mixed greedy/sampled batches share one executable.
+        ``any_sample=False`` compiles the argmax-only body (no full-vocab
+        sorts in the scan) — the all-greedy common case keeps its old
+        cost; the flag is host state, so at most two executables per K."""
+        core, model = self.core, self.model
         head = model.logits if hasattr(model, "logits") else (lambda h: h)
 
-        def run(params, logits, pos, pools, tables, active, key):
+        def run(params, logits, pos, pools, tables, active, key,
+                temp, topk, topp, dosample):
             ctx = model._bind(params) if hasattr(model, "_bind") else None
             with ctx if ctx is not None else _null():
                 def body(carry, _):
                     logits, pos, pools, key = carry
                     key, sub = jax.random.split(key)
-                    tok = _sample_logits(logits.astype(jnp.float32), cfg,
-                                         sub)
+                    lf = logits.astype(jnp.float32)
+                    if any_sample:
+                        tok = sample_logits_batched(lf, temp, topk, topp,
+                                                    dosample, sub)
+                    else:
+                        tok = jnp.argmax(lf, axis=-1)
                     tok = jnp.where(active, tok, 0)
                     h, pools = core.decode_step_paged(tok, pos, pools,
                                                       tables)
@@ -394,9 +437,11 @@ class ContinuousBatchingEngine:
                         if self._decode_ready(s)]
         if not active_slots:
             return []
-        fn = self._decode_fns.get(K)
+        any_sample = bool(self._dosample[active_slots].any())
+        fn = self._decode_fns.get((K, any_sample))
         if fn is None:
-            fn = self._decode_fns[K] = self._build_decode(K)
+            fn = self._decode_fns[(K, any_sample)] = self._build_decode(
+                K, any_sample)
         active = np.zeros((self.max_batch,), bool)
         active[active_slots] = True
         # inactive rows masked to the garbage page: a mid-prefill slot
@@ -406,13 +451,18 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         toks, self._logits, self.pools = fn(
             self._params, self._logits, jnp.asarray(self.pos), self.pools,
-            jnp.asarray(tables_arg), jnp.asarray(active), sub)
+            jnp.asarray(tables_arg), jnp.asarray(active), sub,
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jnp.asarray(self._dosample))
         toks_host = np.asarray(toks)          # [K, max_batch]
         emitted = []
         now = time.perf_counter()
-        eos = self.cfg.eos_token_id
         for slot in active_slots:
             req = self._slots[slot]
+            # per-request eos wins over the engine default (the stop check
+            # is host-side per token, so honoring it costs nothing)
+            eos = req.eos_token_id if req.eos_token_id is not None \
+                else self.cfg.eos_token_id
             kept = 0
             for j in range(K):
                 t = int(toks_host[j, slot])

@@ -1155,7 +1155,15 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     dist = _np.zeros((B, 1), _np.float32)
     for i in range(B):
         d, n = _lev(a[i, :in_len[i]].tolist(), b[i, :lb_len[i]].tolist())
-        dist[i, 0] = d / n if (normalized and n) else d
+        # normalized divides UNCONDITIONALLY, mirroring the reference
+        # kernel (edit_distance divides by label length even when it is
+        # 0 -> inf/nan float semantics), rather than silently returning
+        # the raw distance for empty labels (round-4 advice)
+        if normalized:
+            dist[i, 0] = (d / n if n
+                          else (_np.inf if d else _np.nan))
+        else:
+            dist[i, 0] = d
     return jnp.asarray(dist), jnp.asarray([B], jnp.int64)
 
 

@@ -15,9 +15,15 @@ index. Routing memory is O(t·k + e·c·d): the round-2 one-hot GShard
 experts) are gone. The experts still run as ONE batched einsum on the MXU.
 
 Dropless mode (``capacity_factor=None``): no token is ever dropped — the
-sorted assignments feed megablox grouped-matmul (ragged MXU matmul over
-per-expert group sizes; jax's bundled gmm kernel), the TPU analogue of the
-reference's exact-count global_scatter path (moe/utils.py count_by_gate).
+sorted assignments feed a grouped matmul over per-expert group sizes, the
+TPU analogue of the reference's exact-count global_scatter path
+(moe/utils.py count_by_gate). Round-5 on-chip A/B at DeepSeekMoE scale
+(e=64, d=2048, f=1408, k=6, v5e): XLA's native ``lax.ragged_dot`` runs the
+same grouped matmul 1.7x faster than the bundled megablox Pallas gmm with
+bit-identical output, so ragged_dot is the primary path (gmm remains the
+fallback for jax builds without ragged_dot); the capacity-factor dense
+path is ~4x faster still at this scale but DROPS overflow tokens — the
+measured trade is recorded in ops/pallas/tune_db.json (moe_grouped_mm).
 
 Expert weights are sharded over the ("dp","fsdp") submesh — the "ep" axis
 aliases the data-parallel devices the way the reference reuses comm groups
@@ -196,6 +202,32 @@ def _constrain_experts(xe):
         xe, NamedSharding(hm.mesh, P(axes, *([P.UNCONSTRAINED] * (xe.ndim - 1)))))
 
 
+def _grouped_matmul(xs, w, group_sizes):
+    """Ragged grouped matmul: rows of ``xs`` [m, k] are split by
+    ``group_sizes`` [g] and each run multiplies its own ``w[g] `` [k, n].
+
+    lax.ragged_dot when this jax ships it (XLA-native; the round-5 v5e
+    A/B measured it 1.7x faster than megablox gmm with max|diff|=0 at
+    e=64, d=2048, f=1408); otherwise the bundled megablox Pallas kernel
+    (interpret mode off-TPU)."""
+    if hasattr(jax.lax, "ragged_dot"):
+        return jax.lax.ragged_dot(xs, w, group_sizes,
+                                  preferred_element_type=jnp.float32)
+    from jax.experimental.pallas.ops.tpu.megablox import gmm
+    from ..ops.registry import backend_kind
+
+    def tiling(m, kk, n):
+        # largest power-of-two tile <= 128 dividing each dim (gmm
+        # requires exact tiling; real configs are 128-multiples, tiny
+        # test shapes degrade gracefully)
+        g_ = lambda x: math.gcd(x, 128)
+        return (g_(m), g_(kk), g_(n))
+
+    return gmm(xs, w, group_sizes, preferred_element_type=jnp.float32,
+               tiling=tiling(xs.shape[0], w.shape[1], w.shape[2]),
+               interpret=backend_kind() != "tpu")
+
+
 class MoELayer(Layer):
     """Top-k routed MoE block (reference: MoELayer, moe_layer.py:263).
 
@@ -241,13 +273,12 @@ class MoELayer(Layer):
         return out.reshape(b, s, d), aux
 
     def _forward_dropless(self, flat, logits):
-        """Megablox grouped-matmul experts over exact per-expert counts —
-        the dropless path (reference analogue: global_scatter's exact
-        count_by_gate split sizes)."""
-        from jax.experimental.pallas.ops.tpu.megablox import gmm
-        from ..ops.registry import backend_kind
-        interpret = backend_kind() != "tpu"
-
+        """Grouped-matmul experts over exact per-expert counts — the
+        dropless path (reference analogue: global_scatter's exact
+        count_by_gate split sizes). Grouped matmul = lax.ragged_dot
+        (XLA-native; measured 1.7x faster than megablox gmm at
+        DeepSeekMoE-64 scale on v5e, identical numerics), megablox gmm
+        as fallback."""
         t, d = flat.shape
         e, k = self.num_experts, self.top_k
         probs = jax.nn.softmax(logits, axis=-1)
@@ -261,23 +292,10 @@ class MoELayer(Layer):
         w_gu = self.experts.w_gate_up.astype(flat.dtype)      # [e, d, 2f]
         w_dn = self.experts.w_down.astype(flat.dtype)         # [e, f, d2]
 
-        def tiling(m, kk, n):
-            # largest power-of-two tile <= 128 dividing each dim (gmm
-            # requires exact tiling; real configs are 128-multiples, tiny
-            # test shapes degrade gracefully)
-            g_ = lambda x: math.gcd(x, 128)
-            return (g_(m), g_(kk), g_(n))
-
-        gu = gmm(xs, w_gu, group_sizes,
-                 preferred_element_type=jnp.float32,
-                 tiling=tiling(xs.shape[0], w_gu.shape[1], w_gu.shape[2]),
-                 interpret=interpret).astype(flat.dtype)
+        gu = _grouped_matmul(xs, w_gu, group_sizes).astype(flat.dtype)
         g, u = jnp.split(gu, 2, axis=-1)
         h = F.silu(g) * u
-        ys = gmm(h, w_dn, group_sizes,
-                 preferred_element_type=jnp.float32,
-                 tiling=tiling(h.shape[0], w_dn.shape[1], w_dn.shape[2]),
-                 interpret=interpret).astype(flat.dtype)      # [k*t, d]
+        ys = _grouped_matmul(h, w_dn, group_sizes).astype(flat.dtype)
 
         # unsort to choice-major, weight, reduce over k
         y_cm = jnp.zeros_like(ys).at[order].set(ys).reshape(k, t, d)

@@ -934,9 +934,13 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             reg[outs[0].name] = lv
             return lv
 
-        def _once(env, _k="__pyfunc_" + lv.name):
+        def _once(env, _k="__pyfunc_%x_%s" % (id(lv), lv.name)):
             # memoized per trace env: each component indexes ONE host
-            # call, not one call per fetched output
+            # call, not one call per fetched output. id(lv) in the key:
+            # lv.name derives from input VAR names, so two multi-output
+            # py_func ops over the same inputs would otherwise collide
+            # and the second would silently read the first's results
+            # (round-4 advice, medium)
             if _k not in env:
                 env[_k] = lv._build(env)
             return env[_k]

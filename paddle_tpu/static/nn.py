@@ -479,6 +479,14 @@ def Assert(cond, data=None, summarize: int = 20, name=None):
             parts = []
             for d in (data or []):
                 v = d._build(env) if isinstance(d, _LazyVar) else d
+                if isinstance(v, jax.core.Tracer):
+                    # feed-dependent data inside the trace cannot be
+                    # materialized — report name/shape instead of masking
+                    # the ValueError with a TracerArrayConversionError
+                    # (round-4 advice)
+                    parts.append(f"{getattr(d, 'name', 'var')}: "
+                                 f"<traced {getattr(v, 'shape', '?')}>")
+                    continue
                 flat = np.asarray(v).ravel()[:summarize]
                 parts.append(f"{getattr(d, 'name', 'var')}: {flat}")
             raise ValueError(

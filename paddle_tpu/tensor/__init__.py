@@ -174,6 +174,15 @@ def _pd_sig(f):
     @_ft.wraps(f)
     def g(*args, x=None, y=None, name=None, **kw):
         pos = list(args)
+        # keyword x/y on top of positionals that already fill those slots
+        # must be a loud duplicate-argument error, not a silent operand
+        # swap (subtract(a, x=b) computed b - a; round-4 advice)
+        if x is not None and args:
+            raise TypeError(f"{f.__name__}() got multiple values for "
+                            f"argument 'x'")
+        if y is not None and len(args) >= 2:
+            raise TypeError(f"{f.__name__}() got multiple values for "
+                            f"argument 'y'")
         if x is not None:
             pos.insert(0, x)
         if y is not None:
@@ -709,9 +718,12 @@ def cast(x, dtype):
 
 
 def numel(x, name=None):
-    # returns a 0-d int64 Tensor like the reference (stat.py numel
-    # example calls .numpy() on it), not a python int
-    return jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64)
+    # returns a 0-d integer Tensor like the reference (stat.py numel
+    # example calls .numpy() on it), not a python int. int64 only when
+    # jax x64 is on: with x64 off (the default here) a literal jnp.int64
+    # emits a truncation UserWarning on every call (round-4 advice)
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, dt)
 
 
 def shape(x):

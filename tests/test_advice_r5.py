@@ -1,0 +1,96 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md)."""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import tensor as T
+
+
+def test_pyfunc_multi_output_no_collision():
+    """Two multi-output py_func ops over the SAME input vars must not
+    share a memo entry (medium: the second op silently returned the
+    first op's results)."""
+    from paddle_tpu import static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2], "float32")
+        o1a = static.data("o1a", [2], "float32")
+        o1b = static.data("o1b", [2], "float32")
+        o2a = static.data("o2a", [2], "float32")
+        o2b = static.data("o2b", [2], "float32")
+
+        r1 = static.py_func(lambda v: (np.asarray(v) + 1,
+                                       np.asarray(v) + 2),
+                            x=x, out=[o1a, o1b])
+        r2 = static.py_func(lambda v: (np.asarray(v) * 10,
+                                       np.asarray(v) * 20),
+                            x=x, out=[o2a, o2b])
+    exe = static.Executor()
+    vals = exe.run(prog, feed={"x": np.ones(2, np.float32)},
+                   fetch_list=[r1[0], r1[1], r2[0], r2[1]])
+    np.testing.assert_allclose(vals[0], [2, 2])
+    np.testing.assert_allclose(vals[1], [3, 3])
+    np.testing.assert_allclose(vals[2], [10, 10])   # was [2, 2] pre-fix
+    np.testing.assert_allclose(vals[3], [20, 20])
+
+
+def test_pd_sig_duplicate_keyword_raises():
+    a = jnp.asarray([3.0, 4.0])
+    b = jnp.asarray([1.0, 2.0])
+    # subtract(a, x=b) silently computed b - a before the fix
+    with pytest.raises(TypeError, match="multiple values.*'x'"):
+        T.subtract(a, x=b)
+    with pytest.raises(TypeError, match="multiple values.*'y'"):
+        T.subtract(a, b, y=b)
+    # legitimate forms still work
+    np.testing.assert_allclose(np.asarray(T.subtract(a, y=b)), [2, 2])
+    np.testing.assert_allclose(np.asarray(T.subtract(x=a, y=b)), [2, 2])
+    np.testing.assert_allclose(np.asarray(T.subtract(a, b)), [2, 2])
+
+
+def test_numel_no_truncation_warning():
+    x = jnp.ones((3, 4))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # any warning -> failure
+        n = T.numel(x)
+    assert int(n) == 12
+
+
+def test_static_assert_traced_data_reports_name_not_tracer_error():
+    """A constant-false Assert whose ``data`` is feed-dependent must
+    raise the Assert ValueError (naming the traced var), not mask it
+    with a TracerArrayConversionError when built under jit."""
+    import jax
+
+    from paddle_tpu import static
+    from paddle_tpu.static import nn as snn
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2], "float32")
+        var = snn.Assert(False, data=[x], summarize=2)
+
+    def run(xv):
+        return var._build({"x": xv})
+
+    with pytest.raises(ValueError, match="Assert failed"):
+        jax.jit(run)(np.zeros(2, np.float32))
+
+
+def test_edit_distance_normalized_empty_label():
+    from paddle_tpu.nn.functional_extras import edit_distance
+    hyp = jnp.asarray([[1, 2, 3]], jnp.int64)
+    ref = jnp.asarray([[4, 5, 6]], jnp.int64)
+    # zero-length label: reference divides anyway -> inf (d>0)
+    d, _ = edit_distance(hyp, ref, normalized=True,
+                         input_length=jnp.asarray([3]),
+                         label_length=jnp.asarray([0]))
+    assert np.isinf(np.asarray(d)[0, 0])
+    # and the normal case still normalizes by label length
+    d2, _ = edit_distance(hyp, ref, normalized=True)
+    np.testing.assert_allclose(np.asarray(d2)[0, 0], 1.0)
