@@ -79,8 +79,12 @@ def sample_logits_batched(logits, temperature, top_k, top_p, do_sample,
     x = jnp.where(x < kth, -jnp.inf, x)
     # top-p over the top-k-FILTERED distribution (filters compose
     # sequentially, matching _sample_logits): smallest prefix with mass
-    # >= p, always keeping the best token
-    sorted_m = jnp.sort(x, axis=-1)[:, ::-1]
+    # >= p, always keeping the best token. No second O(V log V) sort:
+    # top-k masking preserves descending order, so the masked sort is
+    # sorted_x with positions >= k_eff set to -inf (this runs inside the
+    # decode scan every step — the sort is the sampler's dominant cost)
+    sorted_m = jnp.where(jnp.arange(vocab)[None, :] < k_eff[:, None],
+                         sorted_x, -jnp.inf)
     probs = jax.nn.softmax(sorted_m, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)

@@ -22,10 +22,20 @@ line — the "clear graph-break error" contract):
   are already defined before the loop.
 - ``for i in range(...)`` — desugared to the while form.
 
-Not supported inside a converted construct (graph breaks): ``return``/
-``break``/``continue``, attribute/subscript assignment, ``for`` over
-arbitrary iterables with a traced condition. Python-level loops over
-concrete values still work untransformed (they trace-unroll as before).
+- ``break``/``continue`` inside converted loops and ``return`` anywhere
+  inside converted constructs — lowered to boolean guard flags carried
+  through the loop/branch state, the reference's approach
+  (transformers/break_continue_transformer.py, return_transformer.py):
+  the jump statement becomes ``flag = True``, downstream statements are
+  wrapped in ``if no_jump(flags): ...``, loop tests gain ``and not flag``
+  (lazily — the original test is not evaluated once a flag is set on the
+  Python path), and a range-``for``'s increment is break-guarded so the
+  loop variable keeps Python's post-break value.
+
+Not supported inside a converted construct (graph breaks):
+attribute/subscript assignment, ``for`` over arbitrary iterables with a
+traced condition. Python-level loops over concrete values still work
+untransformed (they trace-unroll as before).
 """
 
 from __future__ import annotations
@@ -61,6 +71,10 @@ def run_ifelse(pred, true_fn, false_fn, args: tuple):
         return true_fn(*args) if pred else false_fn(*args)
     try:
         pred = jnp.asarray(pred)
+        if pred.size == 1 and pred.shape != ():
+            # reference semantics: a numel-1 tensor IS a valid condition
+            # (their cond/bool conversion accepts [1]-shaped tensors)
+            pred = pred.reshape(())
         if pred.shape != ():
             raise Dy2StaticError(
                 "if-condition is a traced tensor with shape "
@@ -92,13 +106,18 @@ def run_ifelse(pred, true_fn, false_fn, args: tuple):
 
 
 def run_while(test_fn, body_fn, carry: tuple):
-    """convert_while_loop: Python while on concrete test, lax.while_loop
-    on traced."""
-    first = test_fn(*carry)
-    if not _is_traced(first):
-        while test_fn(*carry):
-            carry = body_fn(*carry)
-        return carry
+    """convert_while_loop: Python while on concrete tests, lax.while_loop
+    as soon as the test turns traced — including MID-LOOP (a break guard
+    flag set under a traced condition makes iteration N's test traced
+    even though iterations 0..N-1 ran concrete; the already-unrolled
+    prefix stays Python, the remainder lowers from the current carry)."""
+    while True:
+        t = test_fn(*carry)
+        if _is_traced(t):
+            break
+        if not t:
+            return carry
+        carry = body_fn(*carry)
     if any(c is UNDEF for c in carry):
         raise Dy2StaticError(
             "a loop-body temporary is undefined before a while/for loop "
@@ -106,8 +125,11 @@ def run_while(test_fn, body_fn, carry: tuple):
             "initial values for every carried variable) — initialize it "
             "before the loop")
     try:
-        return jax.lax.while_loop(lambda c: jnp.asarray(test_fn(*c)),
-                                  lambda c: body_fn(*c), carry)
+        def cond(c):
+            t = jnp.asarray(test_fn(*c))
+            # numel-1 conditions are scalars in reference semantics
+            return t.reshape(()) if t.size == 1 else t
+        return jax.lax.while_loop(cond, lambda c: body_fn(*c), carry)
     except TypeError as e:
         raise Dy2StaticError(
             "while-loop carried variables changed structure/shape/dtype "
@@ -134,8 +156,39 @@ class _Undef:
 
 UNDEF = _Undef()
 
+
+def no_jump(*flags):
+    """True while NO jump flag (break/continue/return guard) is set.
+    Concrete flags stay Python bools; any traced flag lifts the whole
+    expression to jnp logical ops (if/else over the result then routes
+    through run_ifelse/lax.cond)."""
+    if any(_is_traced(f) for f in flags):
+        r = jnp.logical_not(jnp.asarray(flags[0]))
+        for f in flags[1:]:
+            r = jnp.logical_and(r, jnp.logical_not(f))
+        return r
+    return not any(bool(f) for f in flags)
+
+
+def loop_test(test_thunk, *flags):
+    """Loop condition ``(not any(flags)) and test`` with Python's lazy
+    semantics on the concrete path (once a break/return flag is set the
+    original test is NOT evaluated — it may no longer be well-defined)
+    and jnp logical ops on the traced path."""
+    if not any(_is_traced(f) for f in flags):
+        if any(bool(f) for f in flags):
+            return False
+        return test_thunk()
+    r = jnp.asarray(test_thunk())
+    for f in flags:
+        r = jnp.logical_and(r, jnp.logical_not(jnp.asarray(f)))
+    return r
+
+
 _RUNTIME = {"run_ifelse": staticmethod(run_ifelse),
-            "run_while": staticmethod(run_while), "UNDEF": UNDEF}
+            "run_while": staticmethod(run_while),
+            "no_jump": staticmethod(no_jump),
+            "loop_test": staticmethod(loop_test), "UNDEF": UNDEF}
 
 
 # ---------------------------------------------------------------------------
@@ -180,17 +233,39 @@ def _walk_same_scope(node):
 
 
 def _forbid(nodes: Sequence[ast.stmt], where: str):
+    # The _JumpRewriter pass lowers break/continue/return to guard flags
+    # BEFORE this transformer runs, so reaching one here means the
+    # rewriter could not handle its position (e.g. inside a try block
+    # within a converted loop) — still a clear graph-break error, but a
+    # narrower one than the pre-round-5 blanket rejection. Break/continue
+    # are only jumps for THIS construct when not inside a nested loop
+    # (where they bind to that loop and work natively).
     for s in nodes:
         if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue             # nested defs keep their own returns
         for n in _walk_same_scope(s):
-            if isinstance(n, (ast.Return, ast.Break, ast.Continue)):
+            if isinstance(n, ast.Return):
+                raise Dy2StaticError(
+                    f"graph break at line {getattr(n, 'lineno', '?')}: "
+                    f"'return' in this position inside a converted "
+                    f"{where} is not convertible (supported positions "
+                    f"are lowered automatically) — restructure to assign "
+                    f"a variable and return after the block")
+    for s in nodes:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in _walk_loop_scope(s):
+            if isinstance(n, (ast.Break, ast.Continue)):
                 kind = type(n).__name__.lower()
                 raise Dy2StaticError(
                     f"graph break at line {getattr(n, 'lineno', '?')}: "
-                    f"'{kind}' inside a converted {where} is not "
-                    f"supported — restructure to assign a variable and "
-                    f"{kind == 'return' and 'return after the block' or 'use a loop condition'}")
+                    f"'{kind}' in this position inside a converted "
+                    f"{where} is not convertible (supported positions "
+                    f"are lowered automatically) — use a loop condition")
+    for s in nodes:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in _walk_same_scope(s):
             if isinstance(n, (ast.Assign, ast.AugAssign)):
                 targets = n.targets if isinstance(n, ast.Assign) else [n.target]
                 for t in targets:
@@ -211,6 +286,296 @@ def _names(ids: Sequence[str], ctx) -> List[ast.Name]:
 
 def _tuple_of(ids: Sequence[str], ctx) -> ast.expr:
     return ast.Tuple(elts=_names(ids, ctx), ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# jump lowering: break / continue / return -> guard flags
+# (reference: transformers/break_continue_transformer.py + return_transformer)
+# ---------------------------------------------------------------------------
+
+def _rt_attr(name):
+    return ast.Attribute(value=ast.Name(id=_RUNTIME_NAME, ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _const_assign(name, value):
+    return _assign(name, ast.Constant(value=value))
+
+
+def _contains_jump(nodes, kinds) -> bool:
+    """Any of ``kinds`` in these statements' own scope — NOT inside nested
+    loops (break/continue bind to the nearest loop) or nested defs."""
+    for s in nodes:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(s) if kinds == (ast.Return,) else _walk_loop_scope(s):
+            if isinstance(n, kinds):
+                return True
+    return False
+
+
+def _walk_loop_scope(node):
+    """Walk without descending into nested loops or function defs."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.While, ast.For)):
+            continue
+        yield from _walk_loop_scope(child)
+
+
+def _contains_return_same_fn(nodes) -> bool:
+    for s in nodes:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in _walk_same_scope(s):
+            if isinstance(n, ast.Return):
+                return True
+    return False
+
+
+class _JumpRewriter:
+    """Lowers break/continue/return to boolean guard flags BEFORE control
+    -flow conversion, exactly the reference's scheme: the jump becomes
+    ``flag = True`` (dead trailing statements dropped), statements after a
+    may-jump construct are wrapped in ``if no_jump(flags): ...`` (which
+    the later pass turns into lax.cond under tracing), loop tests become
+    ``loop_test(lambda: orig_test, flags...)``, and a range-for's
+    increment is break-guarded so the loop variable keeps Python's
+    post-break value. Flags use the ``__jst_`` prefix: they must be
+    REAL carried data (``__pt_`` names are invisible to the carry/out
+    analysis by design)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _fresh(self, kind):
+        self._n += 1
+        return f"__jst_{kind}_{self._n}"
+
+    def rewrite(self, fdef):
+        ret = None
+        if _contains_return_same_fn([s for s in fdef.body
+                                     if isinstance(s, (ast.If, ast.While,
+                                                       ast.For, ast.Try,
+                                                       ast.With))]):
+            # returns live inside convertible constructs: lower ALL of
+            # this function's returns to a (flag, value) pair
+            ret = (self._fresh("ret"), self._fresh("retval"))
+        body, _ = self._block(fdef.body, None, None, ret)
+        if ret is not None:
+            body = ([_const_assign(ret[0], False),
+                     _const_assign(ret[1], None)] + body
+                    + [ast.Return(value=ast.Name(id=ret[1], ctx=ast.Load()))])
+        fdef.body = body
+        return fdef
+
+    # -- block transform ---------------------------------------------------
+    # jump status of a statement sequence (what control does at its end):
+    _NO, _MAY, _ALWAYS = 0, 1, 2
+
+    @classmethod
+    def _seq(cls, a, b):
+        """Status of "a then b" (b runs only on a's non-jumped paths)."""
+        if a == cls._ALWAYS:
+            return a
+        if b == cls._ALWAYS:
+            # non-jumped paths all jump in b; jumped paths already did
+            return cls._ALWAYS
+        return max(a, b)
+
+    def _no_jump_if(self, flags, body):
+        return ast.If(
+            test=ast.Call(func=_rt_attr("no_jump"),
+                          args=_names(flags, ast.Load()), keywords=[]),
+            body=body, orelse=[])
+
+    def _block(self, stmts, brk, cont, ret):
+        """Returns (new_stmts, status in {_NO, _MAY, _ALWAYS}).
+        ``brk``/``cont`` are the nearest enclosing converted loop's flag
+        names (or None), ``ret`` the function's (flag, value) pair.
+
+        A branch that ALWAYS jumps lets the rest of the block chain into
+        the sibling branch (so under tracing both lax.cond branches
+        assign the same variables — no None-vs-array mismatch for early
+        returns). A branch that only MAY jump keeps the rest under a
+        runtime ``if no_jump(flags):`` guard instead — chaining there
+        would wrongly skip the rest on the not-jumped path (round-5
+        review: confirmed silent-wrong-result), and duplicating the rest
+        into both branches would blow up nested code."""
+        out = []
+        flags = [f for f in (brk, cont, ret and ret[0]) if f]
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break) and brk is not None:
+                out.append(ast.copy_location(_const_assign(brk, True), s))
+                return out, self._ALWAYS     # rest of the block is dead
+            if isinstance(s, ast.Continue) and cont is not None:
+                out.append(ast.copy_location(_const_assign(cont, True), s))
+                return out, self._ALWAYS
+            if isinstance(s, ast.Return) and ret is not None:
+                val = s.value if s.value is not None \
+                    else ast.Constant(value=None)
+                out.append(ast.copy_location(_const_assign(ret[0], True), s))
+                out.append(ast.copy_location(_assign(ret[1], val), s))
+                return out, self._ALWAYS
+            if isinstance(s, ast.If):
+                tb, ts = self._block(s.body, brk, cont, ret)
+                fb, fs = self._block(s.orelse, brk, cont, ret)
+                if ts == fs == self._ALWAYS:
+                    out.append(ast.copy_location(
+                        ast.If(test=s.test, body=tb or [ast.Pass()],
+                               orelse=fb), s))
+                    return out, self._ALWAYS    # rest dead on every path
+                if self._ALWAYS in (ts, fs):
+                    rest, rs = self._block(list(stmts[idx + 1:]),
+                                           brk, cont, ret)
+                    other = fs if ts == self._ALWAYS else ts
+                    if rest:
+                        attach = (rest if other == self._NO
+                                  else [self._no_jump_if(flags, rest)])
+                        if ts == self._ALWAYS:
+                            fb = fb + attach
+                        else:
+                            tb = tb + attach
+                    out.append(ast.copy_location(
+                        ast.If(test=s.test, body=tb or [ast.Pass()],
+                               orelse=fb), s))
+                    path = self._seq(other, rs)
+                    return out, (self._ALWAYS if path == self._ALWAYS
+                                 else self._MAY)
+                out.append(ast.copy_location(
+                    ast.If(test=s.test, body=tb or [ast.Pass()],
+                           orelse=fb), s))
+                if self._MAY in (ts, fs):
+                    rest, rs = self._block(list(stmts[idx + 1:]),
+                                           brk, cont, ret)
+                    if rest:
+                        out.append(self._no_jump_if(flags, rest))
+                    return out, (self._ALWAYS if rs == self._ALWAYS
+                                 else self._MAY)
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                new, may_ret = self._loop(s, ret)
+                out.extend(new)
+                if may_ret:
+                    # only the RETURN flag escapes a loop; guard the rest
+                    rest, rs = self._block(list(stmts[idx + 1:]),
+                                           brk, cont, ret)
+                    if rest:
+                        out.append(self._no_jump_if([ret[0]], rest))
+                    return out, (self._ALWAYS if rs == self._ALWAYS
+                                 else self._MAY)
+                continue
+            out.append(s)
+        return out, self._NO
+
+    # -- loops -------------------------------------------------------------
+    def _loop(self, node, ret):
+        """Lower one While/For's breaks+continues (and thread the return
+        flag through). Returns (stmts, may_return)."""
+        if isinstance(node, ast.For):
+            is_range = (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.orelse
+                        and isinstance(node.target, ast.Name))
+            if not is_range:
+                # non-range for stays a Python loop: break/continue work
+                # natively; a lowered-return function still needs returns
+                # INSIDE it lowered (the final `return retval` must see
+                # the flag) — but a native `return` also exits correctly,
+                # so leave its body alone apart from nested loops
+                body, _ = self._block(node.body, None, None, None)
+                node.body = body
+                return [node], False
+        has_brk = _contains_jump(node.body, (ast.Break,))
+        has_cont = _contains_jump(node.body, (ast.Continue,))
+        has_ret = ret is not None and _contains_jump(node.body, (ast.Return,))
+        brk = self._fresh("brk") if has_brk else None
+        cont = self._fresh("cont") if has_cont else None
+        body, _ = self._block(node.body, brk, cont, ret if has_ret else None)
+        if cont:
+            body = [_const_assign(cont, False)] + body   # reset each iter
+
+        exit_flags = [f for f in (brk, ret[0] if has_ret else None) if f]
+        init = [_const_assign(f, False) for f in (brk, cont) if f]
+
+        if isinstance(node, ast.For):
+            # desugar range-for here so the increment can be break-guarded
+            # (Python leaves the loop var at its break-time value)
+            a = node.iter.args
+
+            def _const_int(n):
+                # a negative literal parses as UnaryOp(USub, Constant)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    return n.value
+                if (isinstance(n, ast.UnaryOp)
+                        and isinstance(n.op, ast.USub)
+                        and isinstance(n.operand, ast.Constant)
+                        and isinstance(n.operand.value, int)):
+                    return -n.operand.value
+                return None
+
+            step = ast.Constant(value=1)
+            step_val = 1
+            if len(a) == 1:
+                start, stop = ast.Constant(value=0), a[0]
+            elif len(a) == 2:
+                start, stop = a
+            elif len(a) == 3 and _const_int(a[2]) not in (None, 0):
+                # constant non-zero step: supported (reference loop
+                # transformer handles arbitrary range forms; traced/zero
+                # steps stay a clear graph break)
+                start, stop, step = a
+                step_val = _const_int(a[2])
+            else:
+                raise Dy2StaticError(
+                    f"graph break at line {node.lineno}: range() with a "
+                    "non-constant step is not supported under "
+                    "to_static(full_graph=False); use a while loop")
+            ivar = node.target.id
+            incr = _assign(ivar, ast.BinOp(
+                left=ast.Name(id=ivar, ctx=ast.Load()), op=ast.Add(),
+                right=step))
+            if exit_flags:
+                incr = ast.If(
+                    test=ast.Call(func=_rt_attr("no_jump"),
+                                  args=_names(exit_flags, ast.Load()),
+                                  keywords=[]),
+                    body=[incr], orelse=[])
+            test = ast.Compare(
+                left=ast.Name(id=ivar, ctx=ast.Load()),
+                ops=[ast.Lt() if step_val > 0 else ast.Gt()],
+                comparators=[stop])
+            init.append(_assign(ivar, start))
+            body = body + [incr]
+        else:
+            test = node.test
+            if node.orelse:
+                raise Dy2StaticError(
+                    f"graph break at line {node.lineno}: while/else is "
+                    "not supported under to_static(full_graph=False)")
+
+        if exit_flags:
+            # loop_test(lambda: test, *flags): lazily skips the original
+            # test once a flag is set (it may no longer be well-defined)
+            test = ast.Call(
+                func=_rt_attr("loop_test"),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=test)] + _names(exit_flags, ast.Load()),
+                keywords=[])
+
+        wh = ast.copy_location(ast.While(test=test, body=body, orelse=[]),
+                               node)
+        return init + [wh], has_ret
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +753,10 @@ def convert(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise Dy2StaticError(f"expected a function def, got {type(fdef)}")
     fdef.decorator_list = []   # decorators already applied to the original
+    # pass 1: break/continue/return -> guard flags (must run before the
+    # control-flow conversion turns if-branches into helper functions)
+    _JumpRewriter().rewrite(fdef)
+    # pass 2: if/while/for -> runtime-dispatch lax control flow
     new = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new)
 

@@ -47,6 +47,13 @@ def full(shape, fill_value, dtype="float32", name=None):
     return jnp.full(shape, fill_value, _dt.convert_dtype(dtype))
 
 
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Legacy creation op (reference: tensor/creation.py fill_constant —
+    still the idiom throughout test/dygraph_to_static). ``force_cpu``/
+    ``out`` are accepted for signature parity; XLA owns placement."""
+    return jnp.full(shape, value, _dt.convert_dtype(dtype))
+
+
 def zeros_like(x, dtype=None):
     return jnp.zeros_like(x, dtype=_dt.convert_dtype(dtype) if dtype else None)
 

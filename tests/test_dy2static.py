@@ -129,17 +129,29 @@ def _range_step(x):
 
 
 class TestGraphBreakErrors:
-    def test_return_in_branch_is_clear_error(self):
-        with pytest.raises(Dy2StaticError, match="return"):
-            dy2static.convert(_with_return_in_branch)
+    def test_return_in_branch_converts(self):
+        # round-5: early return is lowered to a guard flag
+        # (return_transformer analogue), no longer a graph break
+        f = dy2static.convert(_with_return_in_branch)
+        pos = jnp.asarray([1.0, 2.0])
+        neg = jnp.asarray([-1.0, -2.0])
+        np.testing.assert_allclose(np.asarray(f(pos)), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(f(neg)), [-1.0, -2.0])
+        np.testing.assert_allclose(np.asarray(jax.jit(f)(pos)), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(jax.jit(f)(neg)),
+                                   [-1.0, -2.0])
 
     def test_subscript_store_is_clear_error(self):
         with pytest.raises(Dy2StaticError, match="subscript"):
             dy2static.convert(_with_subscript_store)
 
-    def test_range_step_is_clear_error(self):
-        with pytest.raises(Dy2StaticError, match="step"):
-            dy2static.convert(_range_step)
+    def test_range_constant_step_converts(self):
+        # round-5: constant steps are supported (traced steps remain a
+        # clear graph break — tests/test_dy2static_jumps.py)
+        f = dy2static.convert(_range_step)
+        x = jnp.asarray([1.0])
+        np.testing.assert_allclose(np.asarray(f(x)),
+                                   np.asarray(_range_step(x)))
 
     def test_nonscalar_pred_is_clear_error(self):
         def many(x):
