@@ -161,7 +161,14 @@ def launch(cfg: LaunchConfig, training_script: str,
            script_args: Sequence[str] = ()) -> int:
     """Run the job to completion; under cfg.max_restarts > 0 failed pods are
     relaunched (elastic fault-tolerance level, reference
-    fleet/elastic/manager.py:43 ElasticLevel.FAULT_TOLERANCE)."""
+    fleet/elastic/manager.py:43 ElasticLevel.FAULT_TOLERANCE).
+
+    ``nnodes > 1`` with ``master`` set takes the MULTI-NODE tier: pods
+    rendezvous through the master membership service (launch/master.py),
+    node ranks are auto-assigned by registration order, heartbeats and
+    restart epochs coordinate elastic recovery across hosts."""
+    if cfg.nnodes > 1 and cfg.master:
+        return _launch_multinode(cfg, training_script, script_args)
     attempt = 0
     while True:
         pod = build_pod(cfg, training_script, script_args)
@@ -174,6 +181,187 @@ def launch(cfg: LaunchConfig, training_script: str,
         attempt += 1
         print(f"[launch] pod failed (exit {code}); restart "
               f"{attempt}/{cfg.max_restarts}", file=sys.stderr)
+
+
+def _local_host() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _build_pod_multinode(cfg: LaunchConfig, training_script: str,
+                         script_args: Sequence[str], node_rank: int,
+                         peers: List[str]) -> Pod:
+    """Per-rank containers from the SYNCED peer list (each record is
+    "host:base_port:coord_port"); the jax coordinator is node 0's
+    host:coord_port."""
+    world = cfg.nnodes * cfg.nproc_per_node
+    parsed = [p.rsplit(":", 2) for p in peers]
+    endpoints = [f"{h}:{int(base) + lr}"
+                 for h, base, _ in parsed
+                 for lr in range(cfg.nproc_per_node)]
+    coord = f"{parsed[0][0]}:{parsed[0][2]}"
+    pod = Pod()
+    for local_rank in range(cfg.nproc_per_node):
+        rank = node_rank * cfg.nproc_per_node + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "MASTER_ADDR": coord.rsplit(":", 1)[0],
+            "MASTER_PORT": coord.rsplit(":", 1)[1],
+            "PADDLE_JOB_ID": cfg.job_id,
+            "JAX_COORDINATOR_ADDRESS": coord,
+            "JAX_NUM_PROCESSES": str(world),
+            "JAX_PROCESS_ID": str(rank),
+        }
+        if cfg.devices is not None:
+            devs = cfg.devices.split(",")
+            env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+        pod.containers.append(Container(
+            rank=rank, local_rank=local_rank, env=env,
+            cmd=[sys.executable, "-u", training_script, *script_args],
+            log_path=os.path.join(cfg.log_dir, f"workerlog.{rank}")))
+    return pod
+
+
+def _host_is_local(host: str) -> bool:
+    """Does ``host`` resolve to this machine? (Server election must only
+    be attempted on the master host — TCPStore's server start binds a
+    LOCAL port wherever it runs, so 'bind succeeded' on a non-master
+    host would just leave a stray server there.)"""
+    try:
+        target = socket.gethostbyname(host)
+    except OSError:
+        return False
+    if target.startswith("127.") or host in ("localhost", "0.0.0.0"):
+        return True
+    local = {"127.0.0.1"}
+    try:
+        local.update(info[4][0] for info in socket.getaddrinfo(
+            socket.gethostname(), None))
+    except OSError:
+        pass
+    return target in local
+
+
+def _launch_multinode(cfg: LaunchConfig, training_script: str,
+                      script_args: Sequence[str]) -> int:
+    """Multi-node controller (reference: controllers/master.py +
+    controllers/collective.py watcher). One controller per host; the one
+    whose bind of the master port succeeds hosts the membership store
+    (reference HTTPMaster: rank-0 hosts, peers connect). Elastic loop:
+    local failure bumps the restart epoch; every controller watching the
+    epoch tears down its pod and re-registers; heartbeat TTL catches
+    hosts that die without reporting."""
+    from .master import Master
+
+    host, port = cfg.master.rsplit(":", 1)
+    master = None
+    if _host_is_local(host):
+        # the master host's controller hosts the store; two controllers
+        # on one machine (tests) race the bind — loser falls to client
+        try:
+            master = Master(host, int(port), cfg.job_id, is_server=True)
+        except RuntimeError:
+            master = None
+    if master is None:
+        master = Master(host, int(port), cfg.job_id, is_server=False)
+
+    attempt = 0
+    code = 0
+    epoch = master.restart_epoch()
+    while True:
+        base_port, coord_port = _free_port(), _free_port()
+        rec = f"{_local_host()}:{base_port}:{coord_port}"
+        try:
+            peers, node_rank = master.sync_peers(rec, cfg.nnodes, epoch,
+                                                 timeout=60.0)
+        except TimeoutError:
+            # peers moved to a newer epoch between our read and sync —
+            # re-read and re-register (does not consume the budget)
+            new_epoch = master.restart_epoch()
+            if new_epoch == epoch:
+                raise        # genuinely missing peers: fail loudly
+            epoch = new_epoch
+            continue
+        others = [f"e{epoch}-n{i}" for i in range(cfg.nnodes)
+                  if i != node_rank]
+        pod = _build_pod_multinode(cfg, training_script, script_args,
+                                   node_rank, peers)
+        master.start_heartbeat(f"e{epoch}-n{node_rank}")
+        pod.start()
+        print(f"[launch] epoch {epoch}: node {node_rank}/{cfg.nnodes} "
+              f"up ({cfg.nproc_per_node} workers)", file=sys.stderr)
+
+        failed = False
+        while True:
+            bad = pod.failed()
+            if bad:
+                code = bad[0].exit_code or 1
+                print(f"[launch] epoch {epoch}: local worker failed "
+                      f"(exit {code}); signaling restart", file=sys.stderr)
+                master.bump_epoch()
+                pod.terminate()
+                failed = True
+                break
+            if master.restart_epoch() != epoch:
+                print(f"[launch] epoch {epoch}: peer signaled restart",
+                      file=sys.stderr)
+                pod.terminate()
+                code = 0
+                failed = True
+                break
+            dead = master.dead_pods(others, ttl=15.0)
+            if dead:
+                print(f"[launch] epoch {epoch}: peer heartbeat lost "
+                      f"({dead}); signaling restart", file=sys.stderr)
+                master.bump_epoch()
+                pod.terminate()
+                code = 1
+                failed = True
+                break
+            if not pod.alive():
+                break                        # all local workers exited 0
+            time.sleep(0.5)
+
+        if not failed:
+            # two-phase completion barrier — unless a peer fails first.
+            # Heartbeats KEEP RUNNING here: a pod whose workers finish
+            # early must not look dead to peers still training (their
+            # dead_pods watch would tear down a healthy job). Phase 2
+            # (ack) keeps the SERVER-hosting controller alive until
+            # every peer has observed completion: exiting earlier kills
+            # the in-process store under peers still polling.
+            master.store.add(master._k("e", epoch, "done"), 1)
+            while True:
+                n = master.store.add(master._k("e", epoch, "done"), 0)
+                if n >= cfg.nnodes:
+                    master.store.add(master._k("e", epoch, "ack"), 1)
+                    if master.is_server:
+                        deadline = time.time() + 60
+                        while (master.store.add(master._k("e", epoch,
+                                                          "ack"), 0)
+                               < cfg.nnodes and time.time() < deadline):
+                            time.sleep(0.2)
+                    master.stop_heartbeat()
+                    return 0
+                if master.restart_epoch() != epoch:
+                    failed = True
+                    code = 0
+                    break
+                time.sleep(0.3)
+        master.stop_heartbeat()
+
+        attempt += 1
+        if attempt > cfg.max_restarts:
+            print(f"[launch] restart budget exhausted "
+                  f"({cfg.max_restarts})", file=sys.stderr)
+            return code or 1
+        epoch = master.restart_epoch()
 
 
 def _parse_args(argv: Sequence[str]):

@@ -1,0 +1,158 @@
+"""Job master / membership service for multi-node launches.
+
+Reference analogue: launch/controllers/master.py — the HTTPMaster (rank-0
+hosts a KV store; peers sync_peers through it) and the ETCDMaster tier
+(registration + heartbeat + watch for elastic membership changes). TPU
+redesign: one service over the C++ TCPStore (csrc/pt_native.cc) covers
+both tiers — the same store that backs collective rendezvous does pod
+membership, so there is no second service to deploy:
+
+- ``sync_peers``: epoch-scoped registration — each pod atomically takes a
+  slot (store.add) and publishes its endpoint record; everyone blocks
+  until all ``nnodes`` records exist. Registration order IS the node
+  rank (reference HTTPMaster.sync_peers semantics, incl. rank -1
+  auto-assignment).
+- heartbeats: pods stamp ``hb/<pod>`` every ``interval``; anyone can ask
+  for pods whose stamp is older than a TTL (the ETCD lease analogue).
+- restart epochs: a pod that observes failure bumps ``epoch``; every
+  watcher sees the bump, tears down its local pod and re-registers under
+  the new epoch — the watch-triggered elastic restart of the reference's
+  ETCDMaster watcher, minus etcd.
+
+The server side lives wherever ``Master(..., is_server=True)`` runs
+(normally the node whose address is --master); clients retry-connect
+until it is up, so controller start order does not matter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Master:
+    """Membership service over one TCPStore endpoint."""
+
+    def __init__(self, host: str, port: int, job_id: str = "default",
+                 is_server: bool = False, timeout: float = 120.0,
+                 connect_retry_s: float = 60.0):
+        from ...native import TCPStore
+        self.job = job_id
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        if is_server:
+            self.store = TCPStore(host, port, is_master=True,
+                                  timeout=timeout)
+            return
+        deadline = time.time() + connect_retry_s
+        last: Optional[Exception] = None
+        while True:
+            try:
+                self.store = TCPStore(host, port, timeout=timeout)
+                return
+            except RuntimeError as e:       # server not up yet
+                last = e
+                if time.time() >= deadline:
+                    raise RuntimeError(
+                        f"Master: no server at {host}:{port} after "
+                        f"{connect_retry_s:.0f}s: {last}") from last
+                time.sleep(0.5)
+
+    def _k(self, *parts) -> str:
+        return "/".join(("ptmaster", self.job) + tuple(str(p) for p in parts))
+
+    @property
+    def is_server(self) -> bool:
+        """True when this Master hosts the store in-process — it must be
+        the LAST controller standing on success (its exit kills the
+        store)."""
+        return getattr(self.store, "_server", None) is not None
+
+    # -- peer sync ----------------------------------------------------------
+
+    def sync_peers(self, value: str, nnodes: int, epoch: int = 0,
+                   timeout: float = 120.0) -> Tuple[List[str], int]:
+        """Register this pod's record and wait for the full set.
+
+        Returns (records ordered by node rank, this pod's node rank).
+        Epoch-scoped: a new epoch is a fresh registration round (elastic
+        restarts re-sync without stale members)."""
+        rank = self.store.add(self._k("e", epoch, "count"), 1) - 1
+        if rank >= nnodes:
+            raise RuntimeError(
+                f"sync_peers: {rank + 1} pods registered for a {nnodes}-"
+                f"node job (duplicate launch or stale epoch?)")
+        self.store.set(self._k("e", epoch, "peer", rank), value)
+        deadline = time.time() + timeout
+        peers: List[str] = []
+        for i in range(nnodes):
+            left = max(deadline - time.time(), 0.1)
+            peers.append(self.store.get(
+                self._k("e", epoch, "peer", i), timeout=left).decode())
+        return peers, rank
+
+    def barrier_done(self, nnodes: int, epoch: int,
+                     timeout: float = 300.0) -> None:
+        """All-pods completion barrier for one epoch."""
+        me = self.store.add(self._k("e", epoch, "done"), 1)
+        deadline = time.time() + timeout
+        while me < nnodes:
+            time.sleep(0.2)
+            me = self.store.add(self._k("e", epoch, "done"), 0)
+            if time.time() > deadline:
+                raise TimeoutError("barrier_done timed out")
+
+    # -- heartbeats ---------------------------------------------------------
+
+    def heartbeat(self, pod_name: str) -> None:
+        self.store.set(self._k("hb", pod_name), repr(time.time()))
+
+    def start_heartbeat(self, pod_name: str, interval: float = 2.0) -> None:
+        """Background stamping thread (reference: ETCDMaster lease
+        keepalive). Re-armable: each start gets a fresh stop event, so
+        the elastic loop can stop/start across restart epochs."""
+        self._hb_stop = threading.Event()
+        self.heartbeat(pod_name)
+
+        def run():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.heartbeat(pod_name)
+                except Exception:
+                    return                   # store gone: job is over
+        self._hb_thread = threading.Thread(target=run, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+
+    def heartbeats(self, pod_names: List[str]) -> Dict[str, float]:
+        out = {}
+        for p in pod_names:
+            v = self.store.try_get(self._k("hb", p))
+            if v is not None:
+                out[p] = float(v.decode())
+        return out
+
+    def dead_pods(self, pod_names: List[str], ttl: float) -> List[str]:
+        """Pods whose last heartbeat is older than ``ttl`` (never-seen
+        pods are NOT dead — they may not have started stamping yet)."""
+        now = time.time()
+        hb = self.heartbeats(pod_names)
+        return [p for p, t in hb.items() if now - t > ttl]
+
+    # -- restart epochs -----------------------------------------------------
+
+    def restart_epoch(self) -> int:
+        return self.store.add(self._k("epoch"), 0)
+
+    def bump_epoch(self) -> int:
+        """Signal every pod to tear down and re-register (the watch event
+        of the reference's elastic manager)."""
+        return self.store.add(self._k("epoch"), 1)
+
+
+__all__ = ["Master"]

@@ -80,11 +80,13 @@ def sample_logits_batched(logits, temperature, top_k, top_p, do_sample,
     # top-p over the top-k-FILTERED distribution (filters compose
     # sequentially, matching _sample_logits): smallest prefix with mass
     # >= p, always keeping the best token. No second O(V log V) sort:
-    # top-k masking preserves descending order, so the masked sort is
-    # sorted_x with positions >= k_eff set to -inf (this runs inside the
-    # decode scan every step — the sort is the sampler's dominant cost)
-    sorted_m = jnp.where(jnp.arange(vocab)[None, :] < k_eff[:, None],
-                         sorted_x, -jnp.inf)
+    # the kept set is {x >= kth} and sorted_x is already descending, so
+    # the masked sort is the PREFIX of sorted_x with value >= kth — a
+    # value compare, NOT a position compare (ties at the kth value all
+    # survive the mask, exactly as the scalar reference's re-sort sees
+    # them). This runs inside the decode scan every step; the sort is
+    # the sampler's dominant cost.
+    sorted_m = jnp.where(sorted_x >= kth, sorted_x, -jnp.inf)
     probs = jax.nn.softmax(sorted_m, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1)

@@ -51,7 +51,7 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
     """Legacy creation op (reference: tensor/creation.py fill_constant —
     still the idiom throughout test/dygraph_to_static). ``force_cpu``/
     ``out`` are accepted for signature parity; XLA owns placement."""
-    return jnp.full(shape, value, _dt.convert_dtype(dtype))
+    return full(shape, value, dtype)
 
 
 def zeros_like(x, dtype=None):

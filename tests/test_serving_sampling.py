@@ -85,6 +85,22 @@ def test_batched_mixed_rows_respect_own_knobs():
     assert 0 <= toks[3] < 128
 
 
+def test_batched_matches_scalar_with_ties_at_kth():
+    """Ties at the k-th logit: every tied token survives top-k (the
+    scalar reference's re-sort sees them all), so the top-p normalizer
+    must include them — a position-based prefix mask got this wrong."""
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 1.0]], jnp.float32)
+    cfg = GenerationConfig(do_sample=True, temperature=1.0, top_k=3,
+                           top_p=0.85)
+    for seed in range(6):
+        key = jax.random.PRNGKey(seed)
+        ref = _sample_logits(logits, cfg, key)
+        got = sample_logits_batched(
+            logits, jnp.asarray([1.0]), jnp.asarray([3], jnp.int32),
+            jnp.asarray([0.85]), jnp.asarray([True]), key)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
 def test_top_p_always_keeps_best_token():
     logits = jnp.asarray([[0.0, 10.0, 0.0, 0.0]], jnp.float32)
     for _ in range(3):
