@@ -109,11 +109,45 @@ class Pod:
             c.terminate()
 
 
+def _make_pod(cfg: LaunchConfig, training_script: str,
+              script_args: Sequence[str], node_rank: int,
+              endpoints: List[str], coord: str) -> Pod:
+    """The ONE per-rank container builder (shared by the single-node and
+    multi-node tiers — only endpoint/coordinator derivation differs)."""
+    world = cfg.nnodes * cfg.nproc_per_node
+    coord_host, coord_port = coord.rsplit(":", 1)
+    pod = Pod()
+    for local_rank in range(cfg.nproc_per_node):
+        rank = node_rank * cfg.nproc_per_node + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "MASTER_ADDR": coord_host,
+            "MASTER_PORT": coord_port,
+            "PADDLE_JOB_ID": cfg.job_id,
+            # jax.distributed.initialize() reads these
+            "JAX_COORDINATOR_ADDRESS": coord,
+            "JAX_NUM_PROCESSES": str(world),
+            "JAX_PROCESS_ID": str(rank),
+        }
+        if cfg.devices is not None:
+            devs = cfg.devices.split(",")
+            env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
+        pod.containers.append(Container(
+            rank=rank, local_rank=local_rank, env=env,
+            cmd=[sys.executable, "-u", training_script, *script_args],
+            log_path=os.path.join(cfg.log_dir,
+                                  f"workerlog.{rank}")))
+    return pod
+
+
 def build_pod(cfg: LaunchConfig, training_script: str,
               script_args: Sequence[str]) -> Pod:
     """Construct per-rank containers with the collective env
     (reference controllers/collective.py:build_pod)."""
-    world = cfg.nnodes * cfg.nproc_per_node
     if cfg.master is None:
         master_host, master_port = "127.0.0.1", _free_port()
     else:
@@ -128,33 +162,22 @@ def build_pod(cfg: LaunchConfig, training_script: str,
         host = master_host if cfg.nnodes > 1 else "127.0.0.1"
         for lr in range(cfg.nproc_per_node):
             endpoints.append(f"{host}:{base_port + lr}")
+    return _make_pod(cfg, training_script, script_args, cfg.node_rank,
+                     endpoints, f"{master_host}:{master_port}")
 
-    pod = Pod()
-    for local_rank in range(cfg.nproc_per_node):
-        rank = cfg.node_rank * cfg.nproc_per_node + local_rank
-        env = {
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-            "MASTER_ADDR": master_host,
-            "MASTER_PORT": str(master_port),
-            "PADDLE_JOB_ID": cfg.job_id,
-            # jax.distributed.initialize() reads these
-            "JAX_COORDINATOR_ADDRESS": f"{master_host}:{master_port}",
-            "JAX_NUM_PROCESSES": str(world),
-            "JAX_PROCESS_ID": str(rank),
-        }
-        if cfg.devices is not None:
-            devs = cfg.devices.split(",")
-            env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
-        pod.containers.append(Container(
-            rank=rank, local_rank=local_rank, env=env,
-            cmd=[sys.executable, "-u", training_script, *script_args],
-            log_path=os.path.join(cfg.log_dir,
-                                  f"workerlog.{rank}")))
-    return pod
+
+def _build_pod_multinode(cfg: LaunchConfig, training_script: str,
+                         script_args: Sequence[str], node_rank: int,
+                         peers: List[str]) -> Pod:
+    """Per-rank containers from the SYNCED peer list (each record is
+    "host:base_port:coord_port"); the jax coordinator is node 0's
+    host:coord_port."""
+    parsed = [p.rsplit(":", 2) for p in peers]
+    endpoints = [f"{h}:{int(base) + lr}"
+                 for h, base, _ in parsed
+                 for lr in range(cfg.nproc_per_node)]
+    return _make_pod(cfg, training_script, script_args, node_rank,
+                     endpoints, f"{parsed[0][0]}:{parsed[0][2]}")
 
 
 def launch(cfg: LaunchConfig, training_script: str,
@@ -183,69 +206,41 @@ def launch(cfg: LaunchConfig, training_script: str,
               f"{attempt}/{cfg.max_restarts}", file=sys.stderr)
 
 
-def _local_host() -> str:
+def _local_host(master_host: str) -> str:
+    """This machine's address AS SEEN on the route to the master — the
+    address peers can reach us at. gethostbyname(hostname) is wrong on
+    stock Debian/Ubuntu (resolves to 127.0.1.1 via /etc/hosts); the
+    UDP connect trick reads the outbound interface without sending."""
     try:
-        return socket.gethostbyname(socket.gethostname())
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((master_host, 9))       # no packet is sent
+            return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
 
 
-def _build_pod_multinode(cfg: LaunchConfig, training_script: str,
-                         script_args: Sequence[str], node_rank: int,
-                         peers: List[str]) -> Pod:
-    """Per-rank containers from the SYNCED peer list (each record is
-    "host:base_port:coord_port"); the jax coordinator is node 0's
-    host:coord_port."""
-    world = cfg.nnodes * cfg.nproc_per_node
-    parsed = [p.rsplit(":", 2) for p in peers]
-    endpoints = [f"{h}:{int(base) + lr}"
-                 for h, base, _ in parsed
-                 for lr in range(cfg.nproc_per_node)]
-    coord = f"{parsed[0][0]}:{parsed[0][2]}"
-    pod = Pod()
-    for local_rank in range(cfg.nproc_per_node):
-        rank = node_rank * cfg.nproc_per_node + local_rank
-        env = {
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-            "MASTER_ADDR": coord.rsplit(":", 1)[0],
-            "MASTER_PORT": coord.rsplit(":", 1)[1],
-            "PADDLE_JOB_ID": cfg.job_id,
-            "JAX_COORDINATOR_ADDRESS": coord,
-            "JAX_NUM_PROCESSES": str(world),
-            "JAX_PROCESS_ID": str(rank),
-        }
-        if cfg.devices is not None:
-            devs = cfg.devices.split(",")
-            env["CUDA_VISIBLE_DEVICES"] = devs[local_rank % len(devs)]
-        pod.containers.append(Container(
-            rank=rank, local_rank=local_rank, env=env,
-            cmd=[sys.executable, "-u", training_script, *script_args],
-            log_path=os.path.join(cfg.log_dir, f"workerlog.{rank}")))
-    return pod
-
-
 def _host_is_local(host: str) -> bool:
-    """Does ``host`` resolve to this machine? (Server election must only
-    be attempted on the master host — TCPStore's server start binds a
-    LOCAL port wherever it runs, so 'bind succeeded' on a non-master
-    host would just leave a stray server there.)"""
+    """Does ``host`` name this machine? Decided by a BIND PROBE — only
+    the owning host can bind its own IP (getaddrinfo(hostname) commonly
+    omits NIC addresses on Debian-style images, which would leave a job
+    with no store server at all). Server election must only be attempted
+    on the master host: TCPStore's server start binds a LOCAL port
+    wherever it runs, so 'bind succeeded' elsewhere would just strand a
+    stray server."""
+    if host in ("localhost", "0.0.0.0"):
+        return True
     try:
         target = socket.gethostbyname(host)
     except OSError:
         return False
-    if target.startswith("127.") or host in ("localhost", "0.0.0.0"):
+    if target.startswith("127."):
         return True
-    local = {"127.0.0.1"}
     try:
-        local.update(info[4][0] for info in socket.getaddrinfo(
-            socket.gethostname(), None))
+        with socket.socket() as s:
+            s.bind((target, 0))
+        return True
     except OSError:
-        pass
-    return target in local
+        return False
 
 
 def _launch_multinode(cfg: LaunchConfig, training_script: str,
@@ -276,7 +271,7 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
     epoch = master.restart_epoch()
     while True:
         base_port, coord_port = _free_port(), _free_port()
-        rec = f"{_local_host()}:{base_port}:{coord_port}"
+        rec = f"{_local_host(host)}:{base_port}:{coord_port}"
         try:
             peers, node_rank = master.sync_peers(rec, cfg.nnodes, epoch,
                                                  timeout=60.0)
@@ -329,31 +324,15 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
             time.sleep(0.5)
 
         if not failed:
-            # two-phase completion barrier — unless a peer fails first.
-            # Heartbeats KEEP RUNNING here: a pod whose workers finish
-            # early must not look dead to peers still training (their
-            # dead_pods watch would tear down a healthy job). Phase 2
-            # (ack) keeps the SERVER-hosting controller alive until
-            # every peer has observed completion: exiting earlier kills
-            # the in-process store under peers still polling.
-            master.store.add(master._k("e", epoch, "done"), 1)
-            while True:
-                n = master.store.add(master._k("e", epoch, "done"), 0)
-                if n >= cfg.nnodes:
-                    master.store.add(master._k("e", epoch, "ack"), 1)
-                    if master.is_server:
-                        deadline = time.time() + 60
-                        while (master.store.add(master._k("e", epoch,
-                                                          "ack"), 0)
-                               < cfg.nnodes and time.time() < deadline):
-                            time.sleep(0.2)
-                    master.stop_heartbeat()
-                    return 0
-                if master.restart_epoch() != epoch:
-                    failed = True
-                    code = 0
-                    break
-                time.sleep(0.3)
+            # completion barrier (Master.done_barrier) — heartbeats KEEP
+            # RUNNING through it: a pod whose workers finish early must
+            # not look dead to peers still training (their dead_pods
+            # watch would tear down a healthy job)
+            if master.done_barrier(cfg.nnodes, epoch):
+                master.stop_heartbeat()
+                return 0
+            failed = True       # a peer failed during our barrier wait
+            code = 0
         master.stop_heartbeat()
 
         attempt += 1

@@ -41,6 +41,10 @@ class Master:
         self.job = job_id
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # (stamp, first-seen LOCAL time) per observed pod — staleness is
+        # judged by how long a stamp stays UNCHANGED on OUR clock, never
+        # by comparing the producer's wall clock to ours
+        self._hb_seen: Dict[str, Tuple[str, float]] = {}
         if is_server:
             self.store = TCPStore(host, port, is_master=True,
                                   timeout=timeout)
@@ -92,16 +96,28 @@ class Master:
                 self._k("e", epoch, "peer", i), timeout=left).decode())
         return peers, rank
 
-    def barrier_done(self, nnodes: int, epoch: int,
-                     timeout: float = 300.0) -> None:
-        """All-pods completion barrier for one epoch."""
-        me = self.store.add(self._k("e", epoch, "done"), 1)
-        deadline = time.time() + timeout
-        while me < nnodes:
-            time.sleep(0.2)
-            me = self.store.add(self._k("e", epoch, "done"), 0)
-            if time.time() > deadline:
-                raise TimeoutError("barrier_done timed out")
+    def done_barrier(self, nnodes: int, epoch: int) -> bool:
+        """Two-phase all-pods completion barrier for one epoch.
+
+        Returns True when every pod registered done; False if the
+        restart epoch moved first (a peer failed — caller should
+        restart). Phase 2 (ack) keeps the SERVER-hosting Master alive
+        until every peer has observed completion: exiting earlier kills
+        the in-process store under peers still polling."""
+        self.store.add(self._k("e", epoch, "done"), 1)
+        while True:
+            n = self.store.add(self._k("e", epoch, "done"), 0)
+            if n >= nnodes:
+                self.store.add(self._k("e", epoch, "ack"), 1)
+                if self.is_server:
+                    deadline = time.time() + 60
+                    while (self.store.add(self._k("e", epoch, "ack"), 0)
+                           < nnodes and time.time() < deadline):
+                        time.sleep(0.2)
+                return True
+            if self.restart_epoch() != epoch:
+                return False
+            time.sleep(0.3)
 
     # -- heartbeats ---------------------------------------------------------
 
@@ -138,11 +154,26 @@ class Master:
         return out
 
     def dead_pods(self, pod_names: List[str], ttl: float) -> List[str]:
-        """Pods whose last heartbeat is older than ``ttl`` (never-seen
-        pods are NOT dead — they may not have started stamping yet)."""
+        """Pods whose heartbeat stamp has not CHANGED for ``ttl`` seconds
+        of THIS observer's clock (never-seen pods are NOT dead — they may
+        not have started stamping yet). Staleness-of-stamp, not
+        stamp-vs-now: the producer's wall clock may be skewed by more
+        than the TTL (NTP not yet converged after a VM resume — exactly
+        the elastic-recovery scenario)."""
         now = time.time()
-        hb = self.heartbeats(pod_names)
-        return [p for p, t in hb.items() if now - t > ttl]
+        dead = []
+        for p in pod_names:
+            v = self.store.try_get(self._k("hb", p))
+            if v is None:
+                continue
+            stamp = v.decode()
+            prev = self._hb_seen.get(p)
+            if prev is None or prev[0] != stamp:
+                self._hb_seen[p] = (stamp, now)
+                continue
+            if now - prev[1] > ttl:
+                dead.append(p)
+        return dead
 
     # -- restart epochs -----------------------------------------------------
 

@@ -23,7 +23,8 @@ import numpy as np
 from jax import export as jexport
 
 __all__ = ["to_static", "not_to_static", "InputSpec", "save", "load",
-           "TranslatedLayer", "enable_to_static", "ignore_module"]
+           "save_deploy_bundle", "TranslatedLayer", "enable_to_static",
+           "ignore_module"]
 
 _TO_STATIC_ENABLED = True
 
@@ -165,19 +166,10 @@ def not_to_static(fn: Callable) -> Callable:
 # save / load: portable StableHLO artifacts
 # ---------------------------------------------------------------------------
 
-def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
-         **kwargs) -> None:
-    """Serialize computation + params for code-free reload.
-
-    Produces (reference shape: jit.save's .pdmodel/.pdiparams pair):
-      path.pdexport  — serialized StableHLO (jax.export bytes)
-      path.pdparams  — pickled numpy state dict
-      path.pdmeta    — json manifest
-    """
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-
+def _export_artifact(layer_or_fn, input_spec):
+    """Shared export preamble for save/save_deploy_bundle: spec lookup,
+    to_static unwrap, functional view, jax.export trace. Returns
+    (exported, state, with_params, arg_structs)."""
     if input_spec is None:
         # a to_static-wrapped target carries its spec (reference behavior:
         # jit.save reuses the spec the user gave to_static)
@@ -196,8 +188,8 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
         with_params = False
 
     if input_spec is None:
-        raise ValueError("jit.save requires input_spec (pass it here or to "
-                         "jit.to_static) to trace the export")
+        raise ValueError("jit export requires input_spec (pass it here or "
+                         "to jit.to_static) to trace the export")
     scope = jexport.SymbolicScope()
     arg_structs = [s.to_shape_struct(scope) if isinstance(s, InputSpec) else s
                    for s in input_spec]
@@ -208,6 +200,23 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
         exported = jexport.export(jax.jit(fn))(param_structs, *arg_structs)
     else:
         exported = jexport.export(jax.jit(fn))(*arg_structs)
+    return exported, state, with_params, arg_structs
+
+
+def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
+         **kwargs) -> None:
+    """Serialize computation + params for code-free reload.
+
+    Produces (reference shape: jit.save's .pdmodel/.pdiparams pair):
+      path.pdexport  — serialized StableHLO (jax.export bytes)
+      path.pdparams  — pickled numpy state dict
+      path.pdmeta    — json manifest
+    """
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    exported, state, with_params, input_spec = _export_artifact(
+        layer_or_fn, input_spec)
 
     with open(path + ".pdexport", "wb") as f:
         f.write(exported.serialize())
@@ -217,6 +226,76 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
         json.dump({"with_params": with_params,
                    "n_inputs": len(input_spec),
                    "format": "paddle_tpu.jit.v1"}, f)
+
+
+def save_deploy_bundle(layer_or_fn, path: str,
+                       input_spec: Optional[Sequence] = None) -> str:
+    """Export a PYTHON-FREE deploy bundle for csrc/pt_deploy_runner.cc.
+
+    Reference analogue: the save_inference_model artifact consumed by the
+    C++ AnalysisPredictor (paddle/fluid/inference/api/
+    analysis_predictor.cc) — a model a C++ binary can run without
+    Python. Here the bundle is portable StableHLO + raw parameter
+    binaries + the serialized CompileOptions the PJRT C API wants:
+
+        <path>/manifest.txt        line-based tensor manifest
+        <path>/module.stablehlo    portable StableHLO bytecode
+        <path>/compile_options.pb  serialized CompileOptionsProto
+        <path>/p<N>.bin            parameter leaves, call order
+
+    The runner feeds params (from the bundle) then runtime inputs in
+    manifest order — exactly the exported main's calling convention
+    (flattened (params, *args) pytree)."""
+    # the C++ runner feeds raw binaries against STATIC manifest shapes —
+    # symbolic (None) dims would serialize as dimension NAMES the runner
+    # cannot parse or feed; reject at export time, not deploy time
+    for s in (input_spec or getattr(layer_or_fn, "__input_spec__", None)
+              or []):
+        shape = getattr(s, "shape", s.shape if hasattr(s, "shape") else ())
+        if any(not isinstance(d, int) for d in shape):
+            raise ValueError(
+                f"save_deploy_bundle requires fully static input shapes "
+                f"(got {tuple(shape)}); the C++ runner feeds raw binaries "
+                f"against the manifest's concrete dims — export one "
+                f"bundle per batch size instead")
+    exported, state, with_params, arg_structs = _export_artifact(
+        layer_or_fn, input_spec)
+    if not with_params:
+        raise ValueError("save_deploy_bundle exports Layers (params are "
+                         "baked into the bundle); for pure functions use "
+                         "jit.save")
+    params = state["params"]
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "module.stablehlo"), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    from jax._src.lib import xla_client as _xc
+    with open(os.path.join(path, "compile_options.pb"), "wb") as f:
+        f.write(_xc.CompileOptions().SerializeAsString())
+
+    def dt(a):
+        name = np.dtype(a.dtype).name
+        return {"float32": "f32", "float16": "f16", "bfloat16": "bf16",
+                "float64": "f64", "int32": "i32", "int64": "i64",
+                "uint8": "u8", "int8": "i8", "bool": "pred"}[name]
+
+    lines = ["module module.stablehlo", "options compile_options.pb"]
+    leaves = jax.tree.leaves(params)
+    for i, leaf in enumerate(leaves):
+        fn = f"p{i}.bin"
+        with open(os.path.join(path, fn), "wb") as pf:
+            pf.write(np.ascontiguousarray(leaf).tobytes())
+        lines.append(f"param {fn} {dt(leaf)} "
+                     + " ".join(str(d) for d in leaf.shape))
+    for s in arg_structs:
+        lines.append(f"input {dt(s)} "
+                     + " ".join(str(d) for d in s.shape))
+    for o in exported.out_avals:
+        lines.append(f"output {dt(o)} "
+                     + " ".join(str(d) for d in o.shape))
+    with open(os.path.join(path, "manifest.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
 
 
 class TranslatedLayer:
