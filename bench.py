@@ -449,7 +449,8 @@ def _decode_bench(cfg, on_tpu):
                 dt = time.perf_counter() - t0
                 lat = eng2.latency_stats()
                 cp_res[label] = (sum(len(v) for v in res.values()) / dt,
-                                 lat.get("ttft_p99_s", 0.0))
+                                 lat.get("ttft_p99_s", 0.0),
+                                 lat.get("itl_p99_s", 0.0))
             out["chunked_prefill_long_tokens_per_sec"] = round(
                 cp_res["chunked"][0], 1)
             out["unchunked_long_tokens_per_sec"] = round(
@@ -458,6 +459,13 @@ def _decode_bench(cfg, on_tpu):
                 cp_res["chunked"][1], 4)
             out["unchunked_long_ttft_p99_s"] = round(
                 cp_res["unchunked"][1], 4)
+            # the fairness metric chunked prefill exists for: the worst
+            # per-tick stall a RUNNING request sees while the long
+            # prompt prefills
+            out["chunked_prefill_long_itl_p99_s"] = round(
+                cp_res["chunked"][2], 4)
+            out["unchunked_long_itl_p99_s"] = round(
+                cp_res["unchunked"][2], 4)
     except Exception as e:
         out["chunked_prefill_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 

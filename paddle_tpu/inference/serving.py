@@ -56,6 +56,8 @@ class _Request:
     submit_t: float = 0.0               # perf_counter at submit
     first_tok_t: float = 0.0            # TTFT timestamp (0 = none yet)
     done_t: float = 0.0                 # completion timestamp
+    last_emit_t: float = 0.0            # previous tick's emit timestamp
+    itl_gaps: List[float] = field(default_factory=list)  # per-TICK gaps
     prefilled: int = 0                  # KV tokens written (chunked mode)
     prefill_target: int = 0             # prompt+replay length to prefill
 
@@ -127,6 +129,9 @@ class ContinuousBatchingEngine:
         # reason — a long-lived engine must not grow per-request state)
         from collections import deque
         self._latencies = deque(maxlen=10_000)  # (ttft_s, total_s, n_tok)
+        # per-tick inter-token gaps of retired requests (incl. stalls a
+        # preemption or a long peer prefill inflicted on them)
+        self._itl_gaps = deque(maxlen=100_000)
 
     # -- public API ---------------------------------------------------------
 
@@ -459,6 +464,13 @@ class ContinuousBatchingEngine:
         now = time.perf_counter()
         for slot in active_slots:
             req = self._slots[slot]
+            # inter-token latency, measured per SCHEDULER TICK (a K-token
+            # block emits together; the stall a long prefill inflicts on
+            # running requests shows up as one big gap here — the metric
+            # chunked_prefill exists to bound)
+            if req.last_emit_t:
+                req.itl_gaps.append(now - req.last_emit_t)
+            req.last_emit_t = now
             # per-request eos wins over the engine default (the stop check
             # is host-side per token, so honoring it costs nothing)
             eos = req.eos_token_id if req.eos_token_id is not None \
@@ -481,6 +493,7 @@ class ContinuousBatchingEngine:
                     (req.first_tok_t - req.submit_t,
                      req.done_t - req.submit_t,
                      len(req.generated)))
+                self._itl_gaps.extend(req.itl_gaps)
                 # tokens past the stop point (and their KV) are dropped;
                 # _free_slot resets pos/tables so the garbage is unreachable
                 self._free_slot(slot)
@@ -492,6 +505,7 @@ class ContinuousBatchingEngine:
         """Drop the retired-request latency window (e.g. after a warmup
         phase whose TTFTs include one-time jit compiles)."""
         self._latencies.clear()
+        self._itl_gaps.clear()
 
     def latency_stats(self) -> Dict[str, float]:
         """TTFT / end-to-end latency percentiles over a sliding window of
@@ -503,7 +517,7 @@ class ContinuousBatchingEngine:
             return {}
         arr = np.asarray(self._latencies, np.float64)
         ttft, total = arr[:, 0], arr[:, 1]
-        return {
+        out = {
             "requests": int(arr.shape[0]),
             "tokens": int(arr[:, 2].sum()),
             "ttft_p50_s": float(np.percentile(ttft, 50)),
@@ -511,6 +525,14 @@ class ContinuousBatchingEngine:
             "latency_p50_s": float(np.percentile(total, 50)),
             "latency_p99_s": float(np.percentile(total, 99)),
         }
+        if self._itl_gaps:
+            gaps = np.asarray(self._itl_gaps, np.float64)
+            # per-TICK gaps (decode_block tokens emit together): the
+            # fairness number chunked_prefill exists to bound — a long
+            # peer prefill or a preemption shows up as one big gap
+            out["itl_p50_s"] = float(np.percentile(gaps, 50))
+            out["itl_p99_s"] = float(np.percentile(gaps, 99))
+        return out
 
 
 class _null:

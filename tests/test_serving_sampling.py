@@ -183,3 +183,29 @@ def test_sampled_stream_varies_and_decode_block_shares_executable(model):
     out = eng.run()
     assert len(out[r_greedy]) == 10 and len(out[r_sample]) == 10
     assert len(eng._decode_fns) == 1      # one executable served both
+
+
+@pytest.mark.slow
+def test_itl_stats_capture_prefill_stall(model):
+    """ITL percentiles: a long prompt admitted mid-decode stalls running
+    requests for one tick — the p99 inter-token gap must record it, and
+    the stats survive run()'s request release."""
+    import time as _time
+
+    eng = _engine(model, max_batch=2, max_len=96,
+                  generation_config=GenerationConfig(max_new_tokens=24,
+                                                     do_sample=False))
+    rs = np.random.RandomState(9)
+    eng.submit(rs.randint(0, 512, (8,)).astype(np.int32))
+    # drive a few decode ticks, then admit a LONG prompt into slot 2
+    for _ in range(6):
+        eng.step()
+    eng.submit(rs.randint(0, 512, (64,)).astype(np.int32),
+               max_new_tokens=8)
+    eng.run()
+    lat = eng.latency_stats()
+    assert lat["requests"] == 2
+    assert "itl_p50_s" in lat and "itl_p99_s" in lat
+    assert 0 < lat["itl_p50_s"] <= lat["itl_p99_s"]
+    eng.reset_latency_stats()
+    assert eng.latency_stats() == {}
