@@ -190,8 +190,6 @@ def test_itl_stats_capture_prefill_stall(model):
     """ITL percentiles: a long prompt admitted mid-decode stalls running
     requests for one tick — the p99 inter-token gap must record it, and
     the stats survive run()'s request release."""
-    import time as _time
-
     eng = _engine(model, max_batch=2, max_len=96,
                   generation_config=GenerationConfig(max_new_tokens=24,
                                                      do_sample=False))
