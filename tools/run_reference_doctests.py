@@ -28,6 +28,14 @@ import contextlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"   # force: the container pins axon
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# the env var alone does NOT win: the axon site hook registers its PJRT
+# plugin at interpreter start, before this module runs — every doctest
+# block was silently jit-compiling over the TPU tunnel (minutes per
+# big-vision model, the round-4 'timeout bucket'). The config-level
+# override beats the hook; it must land before first backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 REF = "/root/reference/python/paddle"
 
@@ -273,12 +281,13 @@ def main():
                 break
             ran += 1
             # big-vision model builders legitimately exceed the default
-            # budget when the machine is loaded; pin them to a
-            # deterministic 4x budget so the metric of record is stable
-            # (round-4 verdict weak #6: the timeout bucket flapped).
+            # budget: a single densenet variant's CPU jit compile runs
+            # minutes (measured: 180 s is NOT enough under load). Pin
+            # them to a deterministic 8x budget so the timeout bucket of
+            # the parity metric stops flapping (round-4 verdict weak #6).
             # Scales with --timeout-s so small explicit budgets still
             # bound a smoke run.
-            budget = (args.timeout_s * 4
+            budget = (args.timeout_s * 8
                       if mod.startswith("vision/models/")
                       else args.timeout_s)
             status, err = run_block(code, budget)
