@@ -167,20 +167,7 @@ class LlamaAttention(nn.Layer):
         b, s, d = x.shape
         n_h, hd = cfg.num_attention_heads, cfg.head_dim
         q, k, v = self._qkv_rope(x, cos, sin, position_ids)
-        out = None
-        if segment_ids is None:
-            out = self._sp_attention(q, k, v, attn_mask)
-        elif cfg.sequence_parallel:
-            from ..parallel.mesh import current_mesh
-            hm = current_mesh()
-            if hm is not None and hm.axis_size("sep") > 1:
-                # loud failure beats silently gathering the seq-sharded
-                # activations into a full-sequence flash call
-                raise NotImplementedError(
-                    "segment_ids (packed sequences) is not supported with "
-                    "sequence parallelism (sep axis > 1): ring/ulysses "
-                    "attention has no segment-mask path yet. Unpack the "
-                    "batch or run with sequence_parallel=False.")
+        out = self._sp_attention(q, k, v, attn_mask, segment_ids)
         if out is None:
             if cfg.use_flash_attention:
                 out = F.scaled_dot_product_attention(
@@ -193,10 +180,16 @@ class LlamaAttention(nn.Layer):
         out = out.reshape(b, s, n_h * hd)
         return jnp.matmul(out, self.o_proj.astype(x.dtype))
 
-    def _sp_attention(self, q, k, v, attn_mask):
+    def _sp_attention(self, q, k, v, attn_mask, segment_ids=None):
         """Long-context path over the "sep" axis (SURVEY §5): the K/V ring
         of flash blocks or Ulysses head all-to-all — never a dense [s, s]
-        score tensor. Returns None when sequence parallelism is inactive."""
+        score tensor. Returns None when sequence parallelism is inactive.
+        Packed sequences (``segment_ids``) route through the RING — the
+        segment ids rotate with their K/V blocks and the flash kernel
+        masks cross-segment pairs; Ulysses has no segment path (its
+        sep-degree GQA expansion and the segment tiles conflict), so
+        sp_mode='ulysses' + packing raises rather than silently
+        gathering the sequence."""
         cfg = self.cfg
         if not cfg.sequence_parallel or attn_mask is not None:
             return None
@@ -205,6 +198,12 @@ class LlamaAttention(nn.Layer):
         if hm is None or hm.axis_size("sep") <= 1:
             return None
         if cfg.sp_mode == "ulysses":
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "segment_ids (packed sequences) with sp_mode='ulysses' "
+                    "is not supported — use sp_mode='ring' (the ring "
+                    "rotates segment ids with their K/V blocks) or unpack "
+                    "the batch.")
             from ..parallel.ulysses import (ulysses_attention,
                                             ulysses_supported)
             if ulysses_supported(cfg.num_attention_heads,
@@ -212,7 +211,8 @@ class LlamaAttention(nn.Layer):
                                  hm.axis_size("sep")):
                 return ulysses_attention(q, k, v, causal=True)
         from ..parallel.ring_attention import ring_attention
-        return ring_attention(q, k, v, causal=True)
+        return ring_attention(q, k, v, causal=True,
+                              segment_ids=segment_ids)
 
     # -- KV-cache inference paths ------------------------------------------
 

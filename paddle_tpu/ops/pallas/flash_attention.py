@@ -704,18 +704,22 @@ def _flash_attention_tpu(q, k, v, attn_mask=None, dropout_p: float = 0.0,
 # ---------------------------------------------------------------------------
 
 def flash_fwd_block(q, k, v, scale, causal, block_q, block_k,
-                    interpret=False):
-    """Forward flash block returning (out [b,sq,h,d], lse [b,h,sq])."""
-    return _fwd(q, k, v, None, None, None, 0.0, scale, causal,
+                    interpret=False, q_seg=None, kv_seg=None):
+    """Forward flash block returning (out [b,sq,h,d], lse [b,h,sq]).
+
+    ``q_seg`` [b, sq] / ``kv_seg`` [b, sk] restrict attention to
+    equal-id positions (the ring's packed-sequence path); a q row whose
+    segment has no match in this kv block comes back with lse=NEG_INF,
+    which the ring's normalized merge treats as weight zero."""
+    return _fwd(q, k, v, q_seg, kv_seg, None, 0.0, scale, causal,
                 block_q, block_k, interpret)
 
 
 def flash_bwd_block(q, k, v, out, lse, dout, scale, causal, block_q, block_k,
-                    interpret=False):
+                    interpret=False, q_seg=None, kv_seg=None):
     """Backward flash block given the GLOBAL (out, lse) of the full
     attention (delta = rowsum(out*dout) is computed inside, as FA2 does).
     Returns (dq, dk, dv) for this q/kv block pair."""
-    res = (q, k, v, None, None, None, out, lse)
-    dq, dk, dv, _, _, _ = _bwd(0.0, scale, causal, block_q, block_k,
-                               interpret, res, dout)
-    return dq, dk, dv
+    res = (q, k, v, q_seg, kv_seg, None, out, lse)
+    outs = _bwd(0.0, scale, causal, block_q, block_k, interpret, res, dout)
+    return outs[0], outs[1], outs[2]

@@ -50,12 +50,14 @@ NEG_INF = -1e30
 
 def _block_attn(q, k, v, scale, mask):
     """One q-block vs one kv-block, returning (unnormalized acc, m, l).
-    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask broadcastable [sq, sk].
-    (dense fallback path)"""
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask [sq, sk] or [b, sq, sk]
+    (dense fallback path)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        if mask.ndim == 2:
+            mask = mask[None]
+        s = jnp.where(mask[:, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)                                   # [b,h,sq]
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)                                   # [b,h,sq]
@@ -76,15 +78,24 @@ def _merge(state, acc, m, l):
     return acc_new, m_new, l_new
 
 
-def _flash_blocks_ok(sl: int, h: int, h_kv: int, d: int) -> tuple:
+def _flash_blocks_ok(sl: int, h: int, h_kv: int, d: int,
+                     has_seg: bool = False,
+                     interpret: bool = False) -> tuple:
     """Pick (block_q, block_k) for the per-device flash blocks, or None if
-    the local shapes can't satisfy the kernel's divisibility rules."""
+    the local shapes can't satisfy the kernel's divisibility rules. With
+    segment ids on real hardware, block_k must additionally be
+    128-aligned or equal to the local length (Mosaic lane rule for the
+    kv-segment tile)."""
     if h % h_kv:
         return None
     bq = next((c for c in (512, 256, 128, 64, 32, 16, 8) if sl % c == 0),
               None)
     bk = bq
     if bq is None or d not in (32, 64, 128, 256):
+        return None
+    if has_seg and not interpret and bk % 128 and bk != sl:
+        # bk was already the LARGEST candidate dividing sl, so a
+        # 128-multiple cannot divide sl either — no recovery possible
         return None
     return bq, bk
 
@@ -100,28 +111,35 @@ def _merge_norm(out0, lse0, out1, lse1):
     return out0 * wt(w0) + out1 * wt(w1), lse_new
 
 
-def _ring_flash(q_l, k_l, v_l, axis, n, causal, scale, bq, bk, interpret):
+def _ring_flash(q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal, scale,
+                bq, bk, interpret):
     """shard_map-local ring attention on flash blocks with a hand-written
-    ring VJP. All inputs are the per-device shards [b, sl, h(_kv), d]."""
+    ring VJP. All inputs are the per-device shards [b, sl, h(_kv), d];
+    ``qseg_l``/``kseg_l`` [b, sl] (or None) carry packed-sequence segment
+    ids — kseg rotates WITH its k/v block, and the kernel masks
+    cross-segment pairs in VMEM (no dense mask in HBM)."""
     from ..ops.pallas.flash_attention import (flash_bwd_block,
                                               flash_fwd_block)
 
+    has_seg = qseg_l is not None
     perm = [(i, (i + 1) % n) for i in range(n)]          # rotate rightward
     # the flash-path shard_map runs check_vma=False (pallas_call out_shapes
     # carry no vma annotation), so no pcast bookkeeping is needed
     vary = lambda x: x
 
-    def step_fwd(my, t, q_l, k_cur, v_cur):
+    def step_fwd(my, t, q_l, k_cur, v_cur, ks_cur):
         """(out_i f32, lse_i) for the kv block that originated on device
         (my - t) mod n; fully-masked causal blocks are skipped."""
+        segs = dict(q_seg=qseg_l, kv_seg=ks_cur) if has_seg else {}
+
         def full(_):
             o, s = flash_fwd_block(q_l, k_cur, v_cur, scale, False, bq, bk,
-                                   interpret)
+                                   interpret, **segs)
             return o.astype(jnp.float32), s
 
         def diag(_):
             o, s = flash_fwd_block(q_l, k_cur, v_cur, scale, True, bq, bk,
-                                   interpret)
+                                   interpret, **segs)
             return o.astype(jnp.float32), s
 
         def skip(_):
@@ -135,47 +153,63 @@ def _ring_flash(q_l, k_l, v_l, axis, n, causal, scale, bq, bk, interpret):
         case = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
         return jax.lax.switch(case, (full, diag, skip), None)
 
+    # a dummy [b, 0] int array stands in for absent segs so the scan
+    # carry structure is static either way
+    def _seg0(x):
+        return jnp.zeros((x.shape[0], 0), jnp.int32)
+
     @jax.custom_vjp
-    def ring(q_l, k_l, v_l):
-        out, lse = _ring_fwd(q_l, k_l, v_l)[0]
+    def ring(q_l, k_l, v_l, qs_l, ks_l):
+        out, lse = _ring_fwd(q_l, k_l, v_l, qs_l, ks_l)[0]
         return out.astype(q_l.dtype)
 
-    def _ring_fwd(q_l, k_l, v_l):
+    def _ring_fwd(q_l, k_l, v_l, qs_l, ks_l):
         my = jax.lax.axis_index(axis)
         b, sl, h, d = q_l.shape
         out0 = vary(jnp.zeros((b, sl, h, d), jnp.float32))
         lse0 = vary(jnp.full((b, h, sl), NEG_INF, jnp.float32))
 
         def body(carry, t):
-            out, lse, k_cur, v_cur = carry
-            o_i, lse_i = step_fwd(my, t, q_l, k_cur, v_cur)
+            out, lse, k_cur, v_cur, ks_cur = carry
+            o_i, lse_i = step_fwd(my, t, q_l, k_cur, v_cur,
+                                  ks_cur if has_seg else None)
             out, lse = _merge_norm(out, lse, o_i, lse_i)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return (out, lse, k_nxt, v_nxt), None
+            ks_nxt = jax.lax.ppermute(ks_cur, axis, perm)
+            return (out, lse, k_nxt, v_nxt, ks_nxt), None
 
-        (out, lse, _, _), _ = jax.lax.scan(
-            body, (out0, lse0, k_l, v_l), jnp.arange(n))
+        (out, lse, _, _, _), _ = jax.lax.scan(
+            body, (out0, lse0, k_l, v_l,
+                   ks_l if has_seg else _seg0(k_l)), jnp.arange(n))
         return (out, lse), None
 
-    def ring_fwd_rule(q_l, k_l, v_l):
-        (out, lse), _ = _ring_fwd(q_l, k_l, v_l)
-        return out.astype(q_l.dtype), (q_l, k_l, v_l, out, lse)
+    def ring_fwd_rule(q_l, k_l, v_l, qs_l, ks_l):
+        (out, lse), _ = _ring_fwd(q_l, k_l, v_l, qs_l, ks_l)
+        return out.astype(q_l.dtype), (q_l, k_l, v_l, qs_l, ks_l, out, lse)
 
     def ring_bwd_rule(res, dout):
-        q_l, k_l, v_l, out, lse = res
+        q_l, k_l, v_l, qs_l, ks_l, out, lse = res
         my = jax.lax.axis_index(axis)
         out_c = out.astype(q_l.dtype)
         dout_c = dout.astype(q_l.dtype)
 
-        def step_bwd(t, k_cur, v_cur):
+        def step_bwd(t, k_cur, v_cur, ks_cur):
+            # qs_l (the RESIDUAL) — never the enclosing trace's qseg_l: a
+            # custom_vjp bwd rule is traced in its own context, and
+            # closing over a forward-trace tracer leaks it (hit live
+            # under the Trainer's donated step)
+            segs = dict(q_seg=qs_l, kv_seg=ks_cur) if has_seg else {}
+
             def full(_):
                 return flash_bwd_block(q_l, k_cur, v_cur, out_c, lse, dout_c,
-                                       scale, False, bq, bk, interpret)
+                                       scale, False, bq, bk, interpret,
+                                       **segs)
 
             def diag(_):
                 return flash_bwd_block(q_l, k_cur, v_cur, out_c, lse, dout_c,
-                                       scale, True, bq, bk, interpret)
+                                       scale, True, bq, bk, interpret,
+                                       **segs)
 
             def skip(_):
                 return (jnp.zeros_like(q_l), jnp.zeros_like(k_cur),
@@ -192,8 +226,9 @@ def _ring_flash(q_l, k_l, v_l, axis, n, causal, scale, bq, bk, interpret):
         dv0 = vary(jnp.zeros(v_l.shape, jnp.float32))
 
         def body(carry, t):
-            dq, k_cur, v_cur, dk_cur, dv_cur = carry
-            dq_i, dk_i, dv_i = step_bwd(t, k_cur, v_cur)
+            dq, k_cur, v_cur, ks_cur, dk_cur, dv_cur = carry
+            dq_i, dk_i, dv_i = step_bwd(t, k_cur, v_cur,
+                                        ks_cur if has_seg else None)
             dq = dq + dq_i.astype(jnp.float32)
             dk_cur = dk_cur + dk_i.astype(jnp.float32)
             dv_cur = dv_cur + dv_i.astype(jnp.float32)
@@ -202,33 +237,46 @@ def _ring_flash(q_l, k_l, v_l, axis, n, causal, scale, bq, bk, interpret):
             # contribution
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            ks_nxt = jax.lax.ppermute(ks_cur, axis, perm)
             dk_nxt = jax.lax.ppermute(dk_cur, axis, perm)
             dv_nxt = jax.lax.ppermute(dv_cur, axis, perm)
-            return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+            return (dq, k_nxt, v_nxt, ks_nxt, dk_nxt, dv_nxt), None
 
-        (dq, _, _, dk, dv), _ = jax.lax.scan(
-            body, (dq0, k_l, v_l, dk0, dv0), jnp.arange(n))
+        (dq, _, _, _, dk, dv), _ = jax.lax.scan(
+            body, (dq0, k_l, v_l, ks_l if has_seg else _seg0(k_l),
+                   dk0, dv0), jnp.arange(n))
+        import numpy as _np
+        zseg = lambda x: _np.zeros(x.shape, jax.dtypes.float0)
         return (dq.astype(q_l.dtype), dk.astype(k_l.dtype),
-                dv.astype(v_l.dtype))
+                dv.astype(v_l.dtype), zseg(qs_l), zseg(ks_l))
 
     ring.defvjp(ring_fwd_rule, ring_bwd_rule)
-    return ring(q_l, k_l, v_l)
+    return ring(q_l, k_l, v_l,
+                qseg_l if has_seg else _seg0(q_l),
+                kseg_l if has_seg else _seg0(k_l))
 
 
 def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
                    scale: Optional[float] = None, mesh=None,
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None, segment_ids=None):
     """Exact attention with K/V rotating over the ``axis`` ring.
 
     q/k/v: [b, s, h, d] GLOBAL arrays sharded (or shardable) along s over
     ``axis``. Returns [b, s, h, d] with the same sharding.
+
+    ``segment_ids`` [b, s] enables PACKED sequences under sequence
+    parallelism: ids shard along s with q (query side) and rotate around
+    the ring with their k/v blocks (kv side); the flash kernel masks
+    cross-segment pairs in VMEM. Causal block skipping still applies —
+    packing composes with the ring at full speed.
     """
     hm = current_mesh() if mesh is None else mesh
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if hm is None or hm.axis_size(axis) <= 1:
         from ..ops.attention import _sdpa_xla
-        return _sdpa_xla(q, k, v, causal=causal, scale=scale)
+        return _sdpa_xla(q, k, v, causal=causal, scale=scale,
+                         segment_ids=segment_ids)
 
     n = hm.axis_size(axis)
     mesh_ = hm.mesh
@@ -239,20 +287,33 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
     b, s, h, _ = q.shape
     h_kv = k.shape[2]
     sl = s // n
-    blocks = _flash_blocks_ok(sl, h, h_kv, d)
+    has_seg = segment_ids is not None
+    if has_seg:
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+    blocks = _flash_blocks_ok(sl, h, h_kv, d, has_seg=has_seg,
+                              interpret=interpret)
 
     if blocks is not None:
         bq, bk = blocks
+        kw = dict(axis=axis, n=n, causal=causal, scale=scale, bq=bq,
+                  bk=bk, interpret=interpret)
+        if has_seg:
+            fn = shard_map(
+                functools.partial(_ring_flash, **kw),
+                mesh=mesh_, axis_names=frozenset({axis}),
+                in_specs=(P(None, axis, None, None),) * 3
+                + (P(None, axis), P(None, axis)),
+                out_specs=P(None, axis, None, None), check_vma=False)
+            return fn(q, k, v, segment_ids, segment_ids)
         fn = shard_map(
-            functools.partial(_ring_flash, axis=axis, n=n, causal=causal,
-                              scale=scale, bq=bq, bk=bk, interpret=interpret),
+            functools.partial(_ring_flash, qseg_l=None, kseg_l=None, **kw),
             mesh=mesh_, axis_names=frozenset({axis}),
             in_specs=(P(None, axis, None, None),) * 3,
             out_specs=P(None, axis, None, None), check_vma=False)
         return fn(q, k, v)
 
     # dense fallback (unnormalized online-softmax ring; correctness-grade)
-    def local_fn(q_l, k_l, v_l):
+    def local_fn(q_l, k_l, v_l, qs_l, ks_l):
         my = jax.lax.axis_index(axis)
         b, sl, h, _ = q_l.shape
         rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
@@ -266,28 +327,35 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
         l0 = vary(jnp.zeros((b, h, sl), jnp.float32))
 
         def step(carry, t):
-            acc, m, l, k_cur, v_cur = carry
+            acc, m, l, k_cur, v_cur, ks_cur = carry
             src = (my - t) % n
             if causal:
                 visible = src < my
                 is_diag = src == my
                 base = jnp.where(is_diag, diag_mask,
                                  jnp.broadcast_to(visible, diag_mask.shape))
-                a, bm, bl = _block_attn(q_l, k_cur, v_cur, scale, base)
             else:
-                a, bm, bl = _block_attn(q_l, k_cur, v_cur, scale, None)
+                base = jnp.ones((sl, sl), bool)
+            base = jnp.broadcast_to(base[None], (b, sl, sl))
+            if has_seg:
+                base = base & (qs_l[:, :, None] == ks_cur[:, None, :])
+            a, bm, bl = _block_attn(q_l, k_cur, v_cur, scale, base)
             acc, m, l = _merge((acc, m, l), a, bm, bl)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return (acc, m, l, k_nxt, v_nxt), None
+            ks_nxt = jax.lax.ppermute(ks_cur, axis, perm)
+            return (acc, m, l, k_nxt, v_nxt, ks_nxt), None
 
-        (acc, m, l, _, _), _ = jax.lax.scan(
-            step, (acc0, m0, l0, k_l, v_l), jnp.arange(n))
+        (acc, m, l, _, _, _), _ = jax.lax.scan(
+            step, (acc0, m0, l0, k_l, v_l, ks_l), jnp.arange(n))
         l_t = l.transpose(0, 2, 1)[..., None]            # [b,sl,h,1]
         safe = jnp.where(l_t == 0.0, 1.0, l_t)
         return (acc / safe).astype(q_l.dtype)
 
     fn = shard_map(local_fn, mesh=mesh_, axis_names=frozenset({axis}),
-                   in_specs=(P(None, axis, None, None),) * 3,
+                   in_specs=(P(None, axis, None, None),) * 3
+                   + (P(None, axis), P(None, axis)),
                    out_specs=P(None, axis, None, None))
-    return fn(q, k, v)
+    # [b, 0] dummy when unpacked: nothing to shard, rotate, or read
+    seg = segment_ids if has_seg else jnp.zeros((b, 0), jnp.int32)
+    return fn(q, k, v, seg, seg)

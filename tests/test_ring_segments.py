@@ -1,0 +1,159 @@
+"""Packed sequences under sequence parallelism: ring attention with
+segment ids (round-5 follow-on to the flash segment path — previously a
+documented NotImplementedError).
+
+The segment ids shard along s with q and ROTATE around the ring with
+their K/V blocks; the oracle is the single-device flash/XLA segment
+path. Covers fwd + grads, flash and dense ring tiers, causal and not,
+and the Llama model routing (sequence_parallel + packed batch trains).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.attention import _sdpa_xla
+from paddle_tpu.parallel.mesh import HybridMesh
+from paddle_tpu.parallel.ring_attention import ring_attention
+
+pytestmark = pytest.mark.slow
+
+
+def _packed(b, s, h, hk, d, n_docs=2, seed=0):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(b, s, h, d).astype(np.float32)) * 0.5
+    k = jnp.asarray(rs.randn(b, s, hk, d).astype(np.float32)) * 0.5
+    v = jnp.asarray(rs.randn(b, s, hk, d).astype(np.float32)) * 0.5
+    seg = jnp.asarray(np.repeat(np.arange(n_docs), s // n_docs)[None]
+                      .repeat(b, 0).astype(np.int32))
+    return q, k, v, seg
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sep", [2, 4])
+def test_ring_segments_match_single_device(causal, sep):
+    b, s, h, d = 2, 64, 2, 16
+    q, k, v, seg = _packed(b, s, h, h, d)
+    ref = _sdpa_xla(q, k, v, causal=causal, segment_ids=(seg, seg))
+    hm = HybridMesh.build(sep=sep, devices=jax.devices()[:sep])
+    with hm:
+        out = jax.jit(lambda q, k, v, seg: ring_attention(
+            q, k, v, causal=causal, segment_ids=seg))(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_segments_grads_match_single_device():
+    b, s, h, d = 1, 32, 2, 8
+    q, k, v, seg = _packed(b, s, h, h, d)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_sdpa_xla(q, k, v, causal=True,
+                                 segment_ids=(seg, seg)) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    with hm:
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, causal=True,
+                                          segment_ids=seg) ** 2)
+        g = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, r, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_ring_segments_gqa_flash_tier():
+    """GQA + segments through the flash-block tier (h != h_kv exercises
+    the kernel's kv-head mapping together with the segment tiles)."""
+    b, s, h, hk, d = 1, 64, 4, 2, 32
+    q, k, v, seg = _packed(b, s, h, hk, d, n_docs=4)
+    ref = _sdpa_xla(q, k, v, causal=True, segment_ids=(seg, seg))
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    with hm:
+        out = jax.jit(lambda q, k, v, seg: ring_attention(
+            q, k, v, causal=True, segment_ids=seg))(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_no_segments_still_exact():
+    """The no-seg path (dummy [b,0] seg carry) is unchanged."""
+    b, s, h, d = 2, 64, 2, 16
+    q, k, v, _ = _packed(b, s, h, h, d)
+    ref = _sdpa_xla(q, k, v, causal=True)
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    with hm:
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v,
+                                                     causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_packed_trains_under_sequence_parallel():
+    """Model-level: a sequence_parallel Llama accepts a PACKED batch on a
+    sep mesh and its forward matches the same model without SP."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(sequence_parallel=True, sp_mode="ring",
+                           max_position_embeddings=256)
+    pt.seed(0)
+    m = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 64)))
+    pos = jnp.asarray(np.concatenate([np.arange(32)] * 2)[None]
+                      .repeat(2, 0).astype(np.int32))
+    seg = jnp.asarray(np.repeat([0, 1], 32)[None].repeat(2, 0)
+                      .astype(np.int32))
+
+    ref = m(ids, position_ids=pos, segment_ids=seg)   # no mesh: plain path
+
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    with hm:
+        out = jax.jit(lambda ids, pos, seg: m(
+            ids, position_ids=pos, segment_ids=seg))(ids, pos, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+    # ulysses + packing stays a loud error
+    cfg2 = LlamaConfig.tiny(sequence_parallel=True, sp_mode="ulysses",
+                            max_position_embeddings=256)
+    pt.seed(0)
+    m2 = LlamaForCausalLM(cfg2)
+    with hm:
+        with pytest.raises(NotImplementedError, match="ulysses"):
+            m2(ids, position_ids=pos, segment_ids=seg)
+
+
+def test_packed_ring_trains_through_trainer():
+    """The full training stack (Trainer, donated step, optimizer) over
+    packed sequences on a sep mesh — this is the context that exposed a
+    custom_vjp closure leaking a forward-trace tracer (the bwd rule must
+    read segment ids from its RESIDUALS, never the enclosing scope)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.trainer import Trainer
+
+    cfg = LlamaConfig.tiny(sequence_parallel=True, sp_mode="ring",
+                           max_position_embeddings=256)
+    pt.seed(0)
+    m = LlamaForCausalLM(cfg)
+    hm = HybridMesh.build(sep=4, devices=jax.devices()[:4])
+    rs = np.random.RandomState(7)
+    ids = rs.randint(0, cfg.vocab_size, (2, 65), np.int32)
+    lbl = ids[:, 1:].copy()
+    lbl[:, 31] = -100
+    batch = {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(lbl),
+        "position_ids": jnp.broadcast_to(jnp.asarray(
+            np.concatenate([np.arange(32)] * 2), jnp.int32)[None], (2, 64)),
+        "segment_ids": jnp.broadcast_to(jnp.asarray(
+            np.repeat([0, 1], 32), jnp.int32)[None], (2, 64)),
+    }
+    with hm:
+        tr = Trainer(m, AdamW(learning_rate=2e-3, parameters=m))
+        losses = [float(tr.train_step(batch)) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
