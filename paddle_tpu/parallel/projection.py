@@ -41,13 +41,9 @@ HBM_BW = {"v5e": 819e9, "v5p": 2765e9}          # bytes/s
 ICI_AGG = {"v5p": 600e9}                        # bytes/s per chip, aggregate
 
 
-def llama3_8b_counts(seq_len: int = 8192) -> Dict[str, float]:
-    """Analytic parameter/FLOP accounting for Llama-3-8B (no weights).
-
-    Matches LlamaForCausalLM.num_params()/flops_per_token() for
-    LlamaConfig.llama3_8b() — asserted by tests/test_projection.py."""
-    v, h, m, L = 128256, 4096, 14336, 32
-    n_h, n_kv, hd = 32, 8, 128
+def _llama_counts(v, h, m, L, n_h, n_kv, hd, seq_len) -> Dict[str, float]:
+    """Shared analytic accounting (matches LlamaForCausalLM's own
+    num_params()/flops_per_token() — asserted by tests/test_projection)."""
     layer = (h * (n_h + 2 * n_kv) * hd      # fused qkv
              + n_h * hd * h                 # o
              + h * 2 * m                    # fused gate+up
@@ -62,7 +58,35 @@ def llama3_8b_counts(seq_len: int = 8192) -> Dict[str, float]:
             + attn * (seq_len + 1) / (2 * seq_len),
             "layer_flops_per_token": 6 * layer + attn / L,
             "head_flops_per_token": 6 * v * h,
+            "vocab": v, "hidden": h, "num_layers": L,
             "seq_len": seq_len}
+
+
+def _fsdp_roofline(c, t_layer, t_head, t_embed, n_chips, ici_efficiency):
+    """Shared fsdp-axis comm/optimizer roofline: per-layer 2xAG + RS of
+    bf16 weights overlapped against the SAME layer's compute, the two
+    v*h tables likewise against head+embed, HBM-bound optimizer update.
+    Returns (t_step, parts dict)."""
+    L = c["num_layers"]
+    ici = ICI_AGG["v5p"] * ici_efficiency
+    layer_bytes = c["layer_params"] * 2
+    ag_rs = 3 * layer_bytes * (n_chips - 1) / n_chips
+    t_comm_layer = ag_rs / ici
+    exposed = max(0.0, t_comm_layer - t_layer)
+    head_embed_bytes = 3 * (2 * c["vocab"] * c["hidden"] * 2) \
+        * (n_chips - 1) / n_chips
+    exposed_he = max(0.0, head_embed_bytes / ici - (t_head + t_embed))
+    opt_bytes = c["params"] / n_chips * 16 * 2
+    t_opt = opt_bytes / HBM_BW["v5p"]
+    t_step = L * (t_layer + exposed) + t_head + t_embed + exposed_he + t_opt
+    return t_step, {"t_comm_layer_s": t_comm_layer,
+                    "t_comm_exposed_per_layer_s": exposed,
+                    "t_opt_s": t_opt}
+
+
+def llama3_8b_counts(seq_len: int = 8192) -> Dict[str, float]:
+    """Analytic parameter/FLOP accounting for Llama-3-8B (no weights)."""
+    return _llama_counts(128256, 4096, 14336, 32, 32, 8, 128, seq_len)
 
 
 def project_llama3_8b_v5p64(measured: Dict[str, float], *,
@@ -101,26 +125,13 @@ def project_llama3_8b_v5p64(measured: Dict[str, float], *,
     L = 32
     ici = ICI_AGG["v5p"] * ici_efficiency
 
-    # --- plan A: fsdp=64 ---
-    # per-layer collectives (bf16): all-gather params in fwd, all-gather
-    # again in bwd (ZeRO-3 re-gather), reduce-scatter grads — each moves
-    # (n-1)/n of the layer's bytes through each chip's ICI.
-    layer_bytes = c["layer_params"] * 2
-    ag_rs = 3 * layer_bytes * (n_chips - 1) / n_chips
-    t_comm_layer = ag_rs / ici
-    exposed = max(0.0, t_comm_layer - t_layer)      # assumption 3
-    # lm_head + embedding tables get the same 2xAG + RS treatment
-    # (8B is untied: two v*h tables)
-    head_embed_bytes = 3 * (2 * 128256 * 4096 * 2) * (n_chips - 1) / n_chips
-    t_comm_he = head_embed_bytes / ici
-    exposed_he = max(0.0, t_comm_he - (t_head + t_embed))
-    # optimizer update: HBM-bound read+write of fp32 master+m+v (12B) +
-    # bf16 param+grad (4B) per local param
-    opt_bytes = c["params"] / n_chips * 16 * 2
-    t_opt = opt_bytes / HBM_BW["v5p"]
-
-    t_step_a = (L * (t_layer + exposed) + t_head + t_embed + exposed_he
-                + t_opt)
+    # --- plan A: fsdp=64 (shared roofline: per-layer 2xAG + RS
+    # overlapped same-layer, assumption 3) ---
+    t_step_a, parts_a = _fsdp_roofline(c, t_layer, t_head, t_embed,
+                                       n_chips, ici_efficiency)
+    t_comm_layer = parts_a["t_comm_layer_s"]
+    exposed = parts_a["t_comm_exposed_per_layer_s"]
+    t_opt = parts_a["t_opt_s"]
     mfu_a = tokens * c["flops_per_token"] / (t_step_a * PEAK_BF16["v5p"])
 
     # --- plan B: pp=8 x fsdp=8, 1F1B, full remat, M=2*S microbatches ---
@@ -136,7 +147,7 @@ def project_llama3_8b_v5p64(measured: Dict[str, float], *,
     slot_pairs = ticks["steady"] + ticks["bubble_slot_pairs"]  # M + S - 1
     t_tick = layers_per_stage * t_layer_remat + t_head + t_embed
     # fsdp=8 comm inside the stage group, overlapped per layer as in plan A
-    ag_rs8 = 3 * layer_bytes * 7 / 8
+    ag_rs8 = 3 * (c["layer_params"] * 2) * 7 / 8
     exposed8 = max(0.0, ag_rs8 / ici - t_layer_remat)
     t_step_b = slot_pairs * t_tick + M * layers_per_stage * exposed8 + t_opt
     tokens_b = M * 8 * tokens          # M microbatches x fsdp-8 x 8192
@@ -190,5 +201,95 @@ def project_llama3_8b_v5p64(measured: Dict[str, float], *,
     }
 
 
-__all__ = ["llama3_8b_counts", "project_llama3_8b_v5p64", "PEAK_BF16",
-           "HBM_BW", "ICI_AGG"]
+def llama3_70b_counts(seq_len: int = 8192) -> Dict[str, float]:
+    """Analytic accounting for Llama-3-70B (h=8192, ffn=28672, 80 layers,
+    64/8 GQA heads, vocab 128256) — same conventions as the 8B counts."""
+    return _llama_counts(128256, 8192, 28672, 80, 64, 8, 128, seq_len)
+
+
+def project_llama3_70b_v5p64(measured: Dict[str, float], *,
+                             n_chips: int = 64,
+                             seq_len: int = 8192,
+                             microbatch: int = 1,
+                             xfer_derate: float = 1.10,
+                             ici_efficiency: float = 0.5) -> Dict:
+    """Project v5p-64 Llama-3-70B pretraining from v5e measurements.
+
+    ``measured`` (tools/bench_8b_layer.py --config llama3_70b; the layer
+    is measured at a SHORTER sequence and scaled: per-token layer cost =
+    matmul part (seq-independent) + attention part (linear in s under
+    the causal kernel's per-token average)):
+      layer_remat_us     one 70B layer fwd+bwd UNDER jax.checkpoint at
+                         ``layer_seq`` tokens (70B on v5p-64 needs full
+                         remat — parallel/scale.py: no-remat activations
+                         are ~2.3 GB/layer x 80 at s=8192)
+      layer_seq          the sequence length the layer was measured at
+      head_us_per_token  lm_head + fp32 CE slope at vocab=128256, h=8192
+      embed_us           embedding fwd+bwd (at layer_seq; amortized)
+
+    Plan: fsdp=64 (params/grads/opt 70e9*16/64 = 17.5 GB/chip), full
+    remat, local batch 1 x seq_len. Same conservative assumptions as the
+    8B projection (cited peaks, ICI at 50%, same-layer-only overlap)."""
+    c = llama3_70b_counts(seq_len)
+    peak_ratio = PEAK_BF16["v5e"] / PEAK_BF16["v5p"]
+    tokens = microbatch * seq_len
+
+    # split the measured layer time into seq-independent matmul work and
+    # seq-scaled attention work, then rebuild at the target seq_len
+    ls = int(measured["layer_seq"])
+    c_ls = llama3_70b_counts(ls)
+    # conservative guard: a grad-of-checkpoint microbench can measure
+    # FASTER than the plain layer (XLA DCEs part of the re-forward);
+    # real remat is never cheaper, so take the slower of the two
+    t_meas = max(measured["layer_remat_us"],
+                 measured.get("layer_us", 0.0)) * 1e-6
+    attn_frac = (c_ls["layer_flops_per_token"] - 6 * c_ls["layer_params"]) \
+        / c_ls["layer_flops_per_token"]
+    t_matmul_tok = t_meas * (1 - attn_frac) / ls
+    t_attn_tok_ls = t_meas * attn_frac / ls          # at avg ctx ls/2
+    t_layer = (t_matmul_tok + t_attn_tok_ls * (seq_len / ls)) * tokens \
+        * peak_ratio * xfer_derate
+    t_head = (measured["head_us_per_token"] * 1e-6 * tokens * peak_ratio
+              * xfer_derate)
+    t_embed = measured["embed_us"] * 1e-6 * peak_ratio * xfer_derate
+
+    t_step, parts = _fsdp_roofline(c, t_layer, t_head, t_embed,
+                                   n_chips, ici_efficiency)
+    exposed = parts["t_comm_exposed_per_layer_s"]
+    t_opt = parts["t_opt_s"]
+    mfu = tokens * c["flops_per_token"] / (t_step * PEAK_BF16["v5p"])
+    return {
+        "counts": c,
+        "inputs": dict(measured),
+        "assumptions": {
+            "peak_bf16_v5e": PEAK_BF16["v5e"],
+            "peak_bf16_v5p": PEAK_BF16["v5p"],
+            "ici_aggregate_v5p": ICI_AGG["v5p"],
+            "ici_efficiency": ici_efficiency,
+            "xfer_derate": xfer_derate,
+            "seq_scaling": "matmul part seq-independent; attention part "
+                           "linear in s (causal per-token average). The "
+                           "time split weights attention NON-causally — "
+                           "conservative: over-attributes measured time "
+                           "to the part that grows with s",
+            "plan": "fsdp=64, full remat, local batch 1 x seq_len",
+        },
+        "plan_fsdp64_remat": {
+            "mesh": {"fsdp": 64},
+            "t_layer_v5p_s": t_layer,
+            "t_comm_exposed_per_layer_s": exposed,
+            "t_head_s": t_head,
+            "t_opt_s": t_opt,
+            "t_step_s": t_step,
+            "tokens_per_step_per_chip": tokens,
+            "projected_mfu": mfu,
+            "projected_tokens_per_sec_per_chip": tokens / t_step,
+        },
+        "north_star": {"target_mfu": 0.40,
+                       "meets_target": bool(mfu >= 0.40)},
+    }
+
+
+__all__ = ["llama3_8b_counts", "llama3_70b_counts",
+           "project_llama3_8b_v5p64", "project_llama3_70b_v5p64",
+           "PEAK_BF16", "HBM_BW", "ICI_AGG"]

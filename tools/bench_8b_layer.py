@@ -56,7 +56,7 @@ def _min_rounds(fn, args, rounds, iters):
     return best
 
 
-def measure(rounds=4):
+def measure(rounds=4, config="llama3_8b"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -66,9 +66,13 @@ def measure(rounds=4):
     from paddle_tpu.models.llama import LlamaDecoderLayer, causal_lm_loss
     from paddle_tpu.ops import rope as rope_ops
 
-    cfg = LlamaConfig.llama3_8b(dtype="bfloat16")
-    S = 8192
-    out = {"config": "llama3_8b", "seq_len": S, "batch": 1,
+    # 70B layer (h=8192, ffn=28672: 1.9 GB bf16 params) fits the v5e
+    # chip for a per-layer microbench at a shorter sequence; the
+    # projection rebuilds per-token cost at the target s (matmul part is
+    # seq-independent, attention part scales linearly)
+    cfg = getattr(LlamaConfig, config)(dtype="bfloat16")
+    S = 8192 if config == "llama3_8b" else 2048
+    out = {"config": config, "seq_len": S, "layer_seq": S, "batch": 1,
            "device": getattr(jax.devices()[0], "device_kind", "unknown")}
 
     pt.seed(0)
@@ -87,14 +91,18 @@ def measure(rounds=4):
     def loss_remat(p, x):
         return jax.checkpoint(run_layer)(p, x).astype(jnp.float32).mean()
 
-    _log("compiling 8B layer fwd+bwd (no remat)...")
-    g_plain = jax.jit(jax.grad(loss_plain, argnums=(0, 1)))
+    # value_and_grad, NOT grad: under plain grad the primal loss value is
+    # unused, and with remat that lets XLA DCE the entire first forward —
+    # the "remat" microbench then measures re-fwd+bwd only and reads
+    # FASTER than the plain layer (observed live on the 70B shapes)
+    _log("compiling layer fwd+bwd (no remat)...")
+    g_plain = jax.jit(jax.value_and_grad(loss_plain, argnums=(0, 1)))
     out["layer_us"] = round(_min_rounds(g_plain, (params, x),
                                         rounds, 6) * 1e6, 1)
     _log(f"layer fwd+bwd: {out['layer_us']} us")
 
-    _log("compiling 8B layer fwd+bwd (remat)...")
-    g_remat = jax.jit(jax.grad(loss_remat, argnums=(0, 1)))
+    _log("compiling layer fwd+bwd (remat)...")
+    g_remat = jax.jit(jax.value_and_grad(loss_remat, argnums=(0, 1)))
     out["layer_remat_us"] = round(_min_rounds(g_remat, (params, x),
                                               rounds, 6) * 1e6, 1)
     _log(f"layer fwd+bwd remat: {out['layer_remat_us']} us")
@@ -138,8 +146,12 @@ def measure(rounds=4):
     _log(f"embed: {out['embed_us']} us")
 
     # observed per-layer MFU on v5e, for the artifact's sanity section
-    from paddle_tpu.parallel.projection import llama3_8b_counts, PEAK_BF16
-    c = llama3_8b_counts(S)
+    from paddle_tpu.parallel.projection import (llama3_8b_counts,
+                                                llama3_70b_counts,
+                                                PEAK_BF16)
+    counts = (llama3_8b_counts if config == "llama3_8b"
+              else llama3_70b_counts)
+    c = counts(S)
     out["layer_mfu_v5e"] = round(
         c["layer_flops_per_token"] * S / (out["layer_us"] * 1e-6)
         / PEAK_BF16["v5e"], 4)
@@ -150,18 +162,32 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--no-write", action="store_true")
+    ap.add_argument("--config", default="llama3_8b",
+                    choices=("llama3_8b", "llama3_70b"))
     args = ap.parse_args()
 
     from paddle_tpu.utils.hw_probe import probe_tpu
     ok, note = probe_tpu()
     if not ok:
-        _log(f"TPU unavailable ({note}); this tool measures real 8B shapes "
-             f"and needs the chip. No artifact written.")
+        _log(f"TPU unavailable ({note}); this tool measures real 8B/70B "
+             f"shapes and needs the chip. No artifact written.")
         sys.exit(1)
 
-    measured = measure(args.rounds)
-    from paddle_tpu.parallel.projection import project_llama3_8b_v5p64
-    proj = project_llama3_8b_v5p64(measured)
+    measured = measure(args.rounds, config=args.config)
+    from paddle_tpu.parallel.projection import (project_llama3_8b_v5p64,
+                                                project_llama3_70b_v5p64)
+    if args.config == "llama3_8b":
+        proj = project_llama3_8b_v5p64(measured)
+        summary = {
+            "plan_a_mfu": round(proj["plan_a_fsdp64"]["projected_mfu"], 4),
+            "plan_b_mfu": round(
+                proj["plan_b_pp8_fsdp8_1f1b"]["projected_mfu"], 4)}
+        artifact = ARTIFACT
+    else:
+        proj = project_llama3_70b_v5p64(measured)
+        summary = {"plan_mfu": round(
+            proj["plan_fsdp64_remat"]["projected_mfu"], 4)}
+        artifact = ARTIFACT.replace("8b", "70b")
 
     try:
         head = subprocess.run(["git", "rev-parse", "HEAD"],
@@ -169,25 +195,24 @@ def main():
                               timeout=10).stdout.strip()
     except Exception:
         head = "unknown"
-    art = {"kind": "llama3_8b_v5p64_projection",
+    art = {"kind": f"{args.config}_v5p64_projection",
            "git_head": head,
            "captured_at": datetime.datetime.now(
                datetime.timezone.utc).isoformat(),
            "measured": measured,
            "projection": proj}
     print(json.dumps({
+        "config": args.config,
         "layer_us": measured["layer_us"],
         "layer_mfu_v5e": measured["layer_mfu_v5e"],
         "head_us_per_token": measured["head_us_per_token"],
-        "plan_a_mfu": round(proj["plan_a_fsdp64"]["projected_mfu"], 4),
-        "plan_b_mfu": round(
-            proj["plan_b_pp8_fsdp8_1f1b"]["projected_mfu"], 4),
+        **summary,
         "meets_target": proj["north_star"]["meets_target"]}))
     if not args.no_write:
-        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
-        with open(ARTIFACT, "w") as f:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        with open(artifact, "w") as f:
             json.dump(art, f, indent=1)
-        _log(f"artifact written: {ARTIFACT} (commit it!)")
+        _log(f"artifact written: {artifact} (commit it!)")
 
 
 if __name__ == "__main__":
