@@ -114,19 +114,25 @@ def test_runner_matches_python_forward(bundle, tmp_path):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)   # the runner doesn't use jax at all
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    r = subprocess.run(
-        [runner, d, "--plugin", _AXON_PLUGIN, "--input", str(xin),
-         "--out", out_prefix,
-         # this plugin's required create_options (what jax's axon
-         # registration passes; a stock libtpu.so needs none of these)
-         "--opt-str", f"topology={gen}:1x1x1",
-         "--opt-str", f"session_id={uuid.uuid4()}",
-         "--opt-int", "remote_compile=1",
-         "--opt-int", "local_only=0",
-         "--opt-int", "priority=0",
-         "--opt-int", "n_slices=1",
-         "--opt-int", "rank=4294967295"],
-        capture_output=True, text=True, timeout=420, env=env)
+    try:
+        r = subprocess.run(
+            [runner, d, "--plugin", _AXON_PLUGIN, "--input", str(xin),
+             "--out", out_prefix,
+             # this plugin's required create_options (what jax's axon
+             # registration passes; a stock libtpu.so needs none of these)
+             "--opt-str", f"topology={gen}:1x1x1",
+             "--opt-str", f"session_id={uuid.uuid4()}",
+             "--opt-int", "remote_compile=1",
+             "--opt-int", "local_only=0",
+             "--opt-int", "priority=0",
+             "--opt-int", "n_slices=1",
+             "--opt-int", "rank=4294967295"],
+            capture_output=True, text=True, timeout=420, env=env)
+    except subprocess.TimeoutExpired:
+        # a WEDGED tunnel blocks inside the plugin (client create /
+        # remote compile) with no error surfaced — same skip condition
+        # as an unreachable one
+        pytest.skip("TPU tunnel hung (runner exceeded 420s)")
     if r.returncode != 0 and ("Client_Create" in r.stderr
                               or "UNAVAILABLE" in r.stderr):
         pytest.skip(f"TPU tunnel not reachable: {r.stderr[-300:]}")
