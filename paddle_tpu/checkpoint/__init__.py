@@ -54,11 +54,49 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     ck.wait_until_finished()
 
 
-def wait_until_finished() -> None:
+def wait_until_finished(watchdog=None, poll_s: float = 0.5,
+                        hang_timeout_s: Optional[float] = None) -> None:
     """Block until pending async saves are durable (reference: the implicit
-    barrier before the next save)."""
-    if _async_ckptr is not None:
+    barrier before the next save).
+
+    A background write failure is re-raised HERE — the caller must learn
+    the checkpoint is not durable before it matters, not at process exit.
+    When ``watchdog`` (a distributed.watchdog.StepWatchdog) is given it is
+    ticked every ``poll_s`` while waiting — a slow-but-healthy save must not
+    false-trip the hung-step detector — but only up to ``hang_timeout_s``
+    (default 4x the watchdog's own step timeout): past that budget the wait
+    goes silent, the armed watchdog stops seeing progress and fires, so a
+    truly hung GCS/NFS write is detected instead of stalling forever behind
+    a stream of fake progress ticks."""
+    if _async_ckptr is None:
+        return
+    if watchdog is None:
         _async_ckptr.wait_until_finished()
+        return
+    if hang_timeout_s is None:
+        wd_t = getattr(watchdog, "timeout_s", None)
+        hang_timeout_s = 4.0 * wd_t if wd_t else float("inf")
+    import threading
+    import time as _time
+    done = threading.Event()
+    err: list = []
+    def _wait():
+        try:
+            _async_ckptr.wait_until_finished()
+        except BaseException as e:  # noqa: BLE001 — carried to the caller
+            err.append(e)
+        finally:
+            done.set()
+    t = threading.Thread(target=_wait, daemon=True,
+                         name="pt-ckpt-wait")
+    t.start()
+    start = _time.monotonic()
+    while not done.wait(poll_s):
+        if _time.monotonic() - start < hang_timeout_s:
+            watchdog.tick()
+    t.join()
+    if err:
+        raise err[0]
 
 
 def _target_like(state_dict: Dict[str, Any], mesh: Optional[Mesh],
@@ -123,7 +161,10 @@ def save_training_state(path: str, step: int, params: Dict[str, jax.Array],
                         async_save: bool = False) -> None:
     """One-call trainer checkpoint (reference analogue: auto_checkpoint's
     TrainEpochRange snapshot — base/incubate/checkpoint/auto_checkpoint.py:278)."""
-    tree = {"step": np.int64(step), "params": params, "opt_state": opt_state}
+    # 0-d ndarray, not np.int64: orbax's StandardSave leaf whitelist is
+    # (int, float, np.ndarray, jax.Array)
+    tree = {"step": np.asarray(step, np.int64), "params": params,
+            "opt_state": opt_state}
     if extra:
         tree["extra"] = extra
     save_state_dict(tree, path, async_save=async_save)
@@ -134,27 +175,62 @@ def load_training_state(path: str, params_like: Dict[str, jax.Array],
                         mesh: Optional[Mesh] = None,
                         spec_tree: Optional[Dict[str, PartitionSpec]] = None
                         ) -> Dict[str, Any]:
-    tree = {"step": np.int64(0), "params": params_like,
+    tree = {"step": np.asarray(0, np.int64), "params": params_like,
             "opt_state": opt_state_like}
     return load_state_dict(path, tree, mesh=mesh, spec_tree=spec_tree)
 
 
+def is_complete_checkpoint(path: str) -> bool:
+    """True when ``path`` holds a fully-written checkpoint.
+
+    Completeness evidence, in order: a CheckpointManager ``_COMMITTED``
+    marker wins; a ``<path>.PENDING`` sidecar (manager save in flight or
+    died mid-save) disqualifies; bare orbax dirs (save_state_dict without
+    a manager) count when orbax's own metadata is present — orbax commits
+    via atomic tmp-dir rename, so the metadata's existence implies the
+    rename happened. An empty or unrecognizable dir (crash during
+    makedirs) never qualifies. (Corrupt dirs are MOVED to ``_quarantine/``
+    by the manager, so they never appear at a ``step_N`` path.)"""
+    path = _abs(path)
+    if not os.path.isdir(path):
+        return False
+    if os.path.isfile(os.path.join(path, "_COMMITTED")):
+        # marker wins over an orphan .PENDING sidecar: a crash between
+        # writing the marker and removing the sidecar leaves both, and the
+        # commit happened
+        return True
+    if os.path.isfile(path + ".PENDING"):
+        return False
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return "_CHECKPOINT_METADATA" in names or "manifest.ocdbt" in names
+
+
 def latest_step(root: str) -> Optional[int]:
-    """Scan ``root`` for step_N checkpoint dirs; return the largest N."""
+    """Scan ``root`` for step_N checkpoint dirs; return the largest N whose
+    dir is a COMPLETE checkpoint. Incomplete/uncommitted dirs (crash
+    mid-save) and in-progress orbax tmp dirs are skipped — auto-resume must
+    never pick up a partial write."""
     root = _abs(root)
     if not os.path.isdir(root):
         return None
     steps = []
     for name in os.listdir(root):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name.split("_", 1)[1]))
-            except ValueError:
-                pass
+        if not name.startswith("step_"):
+            continue
+        try:
+            n = int(name.split("_", 1)[1])
+        except ValueError:
+            continue          # orbax tmp dirs, quarantine tags, etc.
+        if is_complete_checkpoint(os.path.join(root, name)):
+            steps.append(n)
     return max(steps) if steps else None
 
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_until_finished",
-           "save_training_state", "load_training_state", "latest_step"]
+           "save_training_state", "load_training_state", "latest_step",
+           "is_complete_checkpoint"]
 
 from . import auto_checkpoint  # noqa: E402  (TrainEpochRange, LocalFS)

@@ -1,0 +1,76 @@
+"""Adapters pinning the modern jax surface onto older installed releases.
+
+The codebase targets the current `jax.shard_map` API — keyword-only, with
+``axis_names`` naming the *manual* axes and ``check_vma`` — while older jax
+releases (< 0.6) only ship ``jax.experimental.shard_map.shard_map`` with the
+complementary ``auto`` set and ``check_rep``. Importing this module installs
+a signature adapter as ``jax.shard_map`` when the attribute is missing, so
+every call site (including tests) can use the one modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, /, *, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None, auto=None):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        if auto is None and axis_names is not None and mesh is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_rep)
+        if auto:
+            kw["auto"] = frozenset(auto)
+        mapped = _legacy(f, **kw)
+        if auto:
+            # the legacy EAGER impl raises NotImplementedError for non-empty
+            # auto; tracing is the supported path, so route eager calls
+            # through jit (inside an outer jit this just inlines)
+            return jax.jit(mapped)
+        return mapped
+
+    jax.shard_map = shard_map
+
+
+def _install_jax_ffi() -> None:
+    """jax<0.5 ships the FFI surface as ``jax.extend.ffi``; alias it to the
+    modern ``jax.ffi`` spelling (same functions: ffi_call, ffi_lowering,
+    include_dir, register_ffi_target, pycapsule)."""
+    import importlib
+    import sys
+    try:
+        importlib.import_module("jax.ffi")
+        return
+    except ImportError:
+        pass
+    try:
+        from jax.extend import ffi as _ffi
+    except ImportError:
+        return
+    sys.modules["jax.ffi"] = _ffi
+    jax.ffi = _ffi
+
+
+def install_pallas_compat() -> None:
+    """Alias the modern ``pltpu.CompilerParams`` name onto releases that
+    only ship ``TPUCompilerParams`` (same dataclass, renamed in jax 0.6).
+    Called by ops.pallas at import so plain ``import paddle_tpu`` never
+    pays the pallas import."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:          # no pallas on this build
+        return
+    if not hasattr(pltpu, "CompilerParams") and hasattr(pltpu,
+                                                        "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+_install_shard_map()
+_install_jax_ffi()

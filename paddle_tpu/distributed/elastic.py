@@ -19,10 +19,23 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from enum import IntEnum
 from typing import Callable, Optional
+
+
+def backoff_delays(base: float, cap: float, attempts: int,
+                   rng: Optional[random.Random] = None):
+    """Jittered exponential backoff schedule ("full jitter": U(0, base·2^k),
+    capped). A restarting coordinator must not be stampeded by every worker
+    retrying in lockstep — the jitter spreads the reconnect wave."""
+    rng = rng or random.Random()
+    delay = float(base)
+    for _ in range(attempts):
+        yield rng.uniform(0.0, min(delay, cap))
+        delay = min(delay * 2.0, cap)
 
 
 class ElasticLevel(IntEnum):
@@ -53,7 +66,10 @@ class ElasticManager:
                  elastic_level: ElasticLevel = ElasticLevel.FAULT_TOLERANCE,
                  heartbeat_interval: float = 2.0,
                  heartbeat_timeout: float = 30.0, max_restarts: int = 3,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 reconnect_backoff_base: float = 0.5,
+                 reconnect_backoff_cap: float = 30.0,
+                 max_reconnect_attempts: int = 8):
         from paddle_tpu import native
         self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         self.elastic_level = elastic_level
@@ -71,9 +87,14 @@ class ElasticManager:
                                          is_master=is_master,
                                          world_size=self.np)
             self.host, self.port = host, int(port)
+        self.reconnect_backoff_base = reconnect_backoff_base
+        self.reconnect_backoff_cap = reconnect_backoff_cap
+        self.max_reconnect_attempts = max_reconnect_attempts
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self.restarts = 0
+        self.preemptions = 0
+        self.reconnects = 0
 
     # -- membership --------------------------------------------------------
 
@@ -81,11 +102,14 @@ class ElasticManager:
         """Announce membership and start heartbeating (reference register +
         etcd lease refresh). Node ids are also indexed through a shared
         counter because the store (like the reference's) has no prefix scan."""
+        self._register_keys()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _register_keys(self) -> None:
         slot = self.store.add("node_count", 1) - 1
         self.store.set(f"node_ids/{slot}", self.node_id)
         self._beat()
-        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
-        self._hb_thread.start()
 
     def _beat(self) -> None:
         self.store.set(f"node/{self.node_id}",
@@ -96,23 +120,47 @@ class ElasticManager:
             try:
                 self._beat()
             except Exception:
-                return
+                if not self._reregister():
+                    return
+
+    def _reregister(self) -> bool:
+        """Heartbeat hit a dead/restarting coordinator: retry registration
+        with jittered exponential backoff. All workers land in this path at
+        once when the master restarts; without jitter they would retry in
+        lockstep and stampede the fresh store."""
+        for delay in backoff_delays(self.reconnect_backoff_base,
+                                    self.reconnect_backoff_cap,
+                                    self.max_reconnect_attempts):
+            if self._stop.wait(delay):
+                return False
+            try:
+                self._register_keys()
+                self.reconnects += 1
+                return True
+            except Exception:
+                continue
+        return False
 
     def alive_nodes(self) -> list[str]:
         """Nodes whose latest heartbeat is inside the timeout window."""
         alive = []
-        slot = 0
-        while True:
+        # scan every ALLOCATED slot (add(k, 0) reads the counter), skipping
+        # holes: a registration that died between the slot add and the id
+        # set must not truncate the scan and hide later registrants
+        total = self.store.add("node_count", 0)
+        for slot in range(total):
             raw = self.store.try_get(f"node_ids/{slot}")
             if raw is None:
-                break
+                continue
             node_id = raw.decode()
-            hb = self.store.try_get(f"node/{node_id}")
-            if hb is not None:
-                data = json.loads(hb)
-                if time.time() - data["ts"] <= self.heartbeat_timeout:
-                    alive.append(node_id)
-            slot += 1
+            # re-registration after a coordinator restart can index the same
+            # node under a second slot — count each node once
+            if node_id not in alive:
+                hb = self.store.try_get(f"node/{node_id}")
+                if hb is not None:
+                    data = json.loads(hb)
+                    if time.time() - data["ts"] <= self.heartbeat_timeout:
+                        alive.append(node_id)
         return alive
 
     def watch(self) -> str:
@@ -129,15 +177,33 @@ class ElasticManager:
 
     # -- restart policy ----------------------------------------------------
 
-    def run(self, train_fn: Callable[[int], None]) -> bool:
+    def run(self, train_fn: Callable[[int], None],
+            max_preemptions: int = 100) -> bool:
         """Run with restart-on-failure (the relaunch half of manager.py; the
         reference shells out to launch — here train_fn encapsulates it).
         train_fn receives the restart ordinal (0 = first run) and should
-        resume from its latest checkpoint when > 0."""
+        resume from its latest checkpoint when > 0.
+
+        A :class:`~paddle_tpu.resilience.TrainingPreempted` exit (or a
+        SystemExit carrying RESUMABLE_EXIT_CODE) is an ORDERLY preemption:
+        state was checkpointed, so the relaunch resumes without consuming
+        the failure-restart budget (bounded separately by
+        ``max_preemptions`` so a flapping host still terminates)."""
+        from ..resilience.preemption import RESUMABLE_EXIT_CODE
         while True:
             try:
-                train_fn(self.restarts)
+                train_fn(self.restarts + self.preemptions)
                 return True
+            except SystemExit as e:
+                if e.code != RESUMABLE_EXIT_CODE:
+                    raise
+                if self.preemptions >= max_preemptions:
+                    print(f"[elastic] giving up after {self.preemptions} "
+                          f"preemptions")
+                    return False
+                self.preemptions += 1
+                print(f"[elastic] preempted (checkpointed); resume "
+                      f"{self.preemptions}/{max_preemptions}")
             except Exception as e:  # noqa: BLE001 — any training failure
                 if self.restarts >= self.max_restarts:
                     print(f"[elastic] giving up after {self.restarts} "

@@ -17,6 +17,11 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
+# eager on purpose: importing THIS module already initializes the
+# paddle_tpu parent package (python imports parents first, `-m` included),
+# so a lazy import here would not make the launcher any lighter
+from paddle_tpu.resilience.preemption import RESUMABLE_EXIT_CODE  # 75
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -35,6 +40,7 @@ class LaunchConfig:
     job_id: str = "default"
     devices: Optional[str] = None          # visible-device list per rank
     max_restarts: int = 0                  # >0 enables elastic relaunch
+    max_preempt_relaunches: int = 100      # resumable exits don't burn budget
     run_mode: str = "collective"
 
 
@@ -75,6 +81,16 @@ class Container:
         self.proc.kill()
 
 
+def _pod_exit_code(bad: List["Container"]) -> int:
+    """Exit code for a failed pod. Resumable (75) ONLY when EVERY failed
+    container exited 75: one real crash inside a preempted pod must burn
+    the failure budget, not ride the preemption path."""
+    codes = [c.exit_code or 1 for c in bad]
+    if all(c == RESUMABLE_EXIT_CODE for c in codes):
+        return RESUMABLE_EXIT_CODE
+    return next(c for c in codes if c != RESUMABLE_EXIT_CODE)
+
+
 @dataclasses.dataclass
 class Pod:
     """This node's containers (reference: launch/job/pod.py)."""
@@ -99,7 +115,7 @@ class Pod:
             if bad:
                 for c in self.containers:
                     c.terminate()
-                return bad[0].exit_code or 1
+                return _pod_exit_code(bad)
             if not self.alive():
                 return 0
             time.sleep(poll)
@@ -192,13 +208,22 @@ def launch(cfg: LaunchConfig, training_script: str,
     restart epochs coordinate elastic recovery across hosts."""
     if cfg.nnodes > 1 and cfg.master:
         return _launch_multinode(cfg, training_script, script_args)
-    attempt = 0
+    attempt = preempts = 0
     while True:
         pod = build_pod(cfg, training_script, script_args)
         pod.start()
         code = pod.join()
         if code == 0:
             return 0
+        if code == RESUMABLE_EXIT_CODE:
+            # orderly preemption: the worker checkpointed and asked to be
+            # resumed — relaunch without consuming the failure budget
+            if preempts >= cfg.max_preempt_relaunches:
+                return code
+            preempts += 1
+            print(f"[launch] pod preempted (resumable); relaunch "
+                  f"{preempts}/{cfg.max_preempt_relaunches}", file=sys.stderr)
+            continue
         if attempt >= cfg.max_restarts:
             return code
         attempt += 1
@@ -266,8 +291,12 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
     if master is None:
         master = Master(host, int(port), cfg.job_id, is_server=False)
 
-    attempt = 0
+    attempt = preempts = 0
     code = 0
+    # preempt counter FIRST, epoch second — the mirror of bump_epoch's
+    # write order, so a concurrent preempt bump can only surface as
+    # "failure" (budget-burning, fail-safe), never the reverse
+    seen_pre = master.preempt_epochs()
     epoch = master.restart_epoch()
     while True:
         base_port, coord_port = _free_port(), _free_port()
@@ -278,6 +307,7 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
         except TimeoutError:
             # peers moved to a newer epoch between our read and sync —
             # re-read and re-register (does not consume the budget)
+            seen_pre = master.preempt_epochs()   # counter-then-epoch order
             new_epoch = master.restart_epoch()
             if new_epoch == epoch:
                 raise        # genuinely missing peers: fail loudly
@@ -296,10 +326,13 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
         while True:
             bad = pod.failed()
             if bad:
-                code = bad[0].exit_code or 1
+                code = _pod_exit_code(bad)
                 print(f"[launch] epoch {epoch}: local worker failed "
                       f"(exit {code}); signaling restart", file=sys.stderr)
-                master.bump_epoch()
+                # tell the peers WHY: a resumable (preemption) exit must not
+                # burn their failure budget either
+                master.bump_epoch("preempt" if code == RESUMABLE_EXIT_CODE
+                                  else "failure")
                 pod.terminate()
                 failed = True
                 break
@@ -335,12 +368,33 @@ def _launch_multinode(cfg: LaunchConfig, training_script: str,
             code = 0
         master.stop_heartbeat()
 
-        attempt += 1
-        if attempt > cfg.max_restarts:
-            print(f"[launch] restart budget exhausted "
-                  f"({cfg.max_restarts})", file=sys.stderr)
-            return code or 1
-        epoch = master.restart_epoch()
+        new_pre = master.preempt_epochs()   # counter-then-epoch order
+        new_epoch = master.restart_epoch()
+        # every bump in the window was preemption-reasoned → resumable;
+        # any failure in the mix burns the budget (fail-safe)
+        resumable = (code == RESUMABLE_EXIT_CODE
+                     or (code == 0 and new_epoch > epoch
+                         and new_pre - seen_pre >= new_epoch - epoch))
+        seen_pre = new_pre
+        if resumable:
+            # orderly preemption (local exit 75, or a PEER's — the epoch
+            # reason says so): same contract as the single-node loop —
+            # relaunch into a resume without consuming the failure budget,
+            # bounded separately
+            preempts += 1
+            if preempts > cfg.max_preempt_relaunches:
+                print(f"[launch] preemption budget exhausted "
+                      f"({cfg.max_preempt_relaunches})", file=sys.stderr)
+                return code or RESUMABLE_EXIT_CODE
+            print(f"[launch] node preempted (resumable); relaunch "
+                  f"{preempts}/{cfg.max_preempt_relaunches}", file=sys.stderr)
+        else:
+            attempt += 1
+            if attempt > cfg.max_restarts:
+                print(f"[launch] restart budget exhausted "
+                      f"({cfg.max_restarts})", file=sys.stderr)
+                return code or 1
+        epoch = new_epoch
 
 
 def _parse_args(argv: Sequence[str]):

@@ -180,10 +180,30 @@ class Master:
     def restart_epoch(self) -> int:
         return self.store.add(self._k("epoch"), 0)
 
-    def bump_epoch(self) -> int:
+    def bump_epoch(self, reason: str = "failure") -> int:
         """Signal every pod to tear down and re-register (the watch event
-        of the reference's elastic manager)."""
+        of the reference's elastic manager). ``reason`` ("failure" or
+        "preempt") tells watchers whether the restart should consume their
+        failure budget — an orderly preemption anywhere in the job must
+        not.
+
+        The reason rides a parallel atomic COUNTER, not a per-epoch key:
+        concurrent bumpers would race a key write and mislabel each other's
+        epoch. The preempt counter is advanced FIRST, so any observer of
+        the epoch move sees it; observers compare deltas, and a mixed
+        failure+preempt window counts as failure (the budget-burning,
+        fail-safe reading). Residual window: with no multi-key transaction
+        in the store, a preempt bumper stalled BETWEEN its two adds while a
+        failure bumper completes can make that one failure window read as
+        resumable — one relaunch that skips the failure budget, still
+        bounded by max_preempt_relaunches."""
+        if reason == "preempt":
+            self.store.add(self._k("preempt_epochs"), 1)
         return self.store.add(self._k("epoch"), 1)
+
+    def preempt_epochs(self) -> int:
+        """Total preemption-reason bumps so far (see bump_epoch)."""
+        return self.store.add(self._k("preempt_epochs"), 0)
 
 
 __all__ = ["Master"]

@@ -183,6 +183,47 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+        self._batches_served = 0
+        self._skip_batches = 0
+
+    # -- iteration cursor (resilience: resume/rollback positions the loader)
+
+    def state_dict(self):
+        """Cursor of the current iteration pass: how many batches have been
+        handed out (skipped-on-resume batches included, so a resumed pass
+        continues the count). Checkpointed by Trainer.fit as the
+        data-iterator cursor."""
+        return {"batches_served": self._batches_served}
+
+    def set_state_dict(self, sd) -> None:
+        """Fast-forward the NEXT iteration pass past ``batches_served``
+        batches. Batches are still fetched and dropped (not re-indexed), so
+        for DETERMINISTIC samplers the resumed pass is bit-identical to an
+        uninterrupted one. An unseeded shuffle draws a fresh permutation per
+        pass — the skip-ahead then replays a different order (warned below);
+        pass a seeded ``RandomSampler(data, generator=...)`` via
+        ``batch_sampler`` for bit-exact shuffled resume."""
+        self._skip_batches = max(0, int(sd.get("batches_served", 0)))
+        # baseline the cursor NOW, not lazily at the pass's first next():
+        # a checkpoint taken before the resumed pass yields its first batch
+        # (e.g. preemption latched during restore) must not persist a stale
+        # count from before this call
+        self._batches_served = self._skip_batches
+        samp = getattr(self.batch_sampler, "sampler", None)
+        if self._skip_batches > 0 and isinstance(samp, RandomSampler):
+            import warnings
+            # unseeded: each pass draws fresh OS entropy. Seeded: the shared
+            # generator's state advanced during the interrupted pass, so a
+            # new pass STILL permutes differently. Either way the skip-ahead
+            # replays a different order.
+            warnings.warn(
+                "resuming a shuffle=True DataLoader: a new pass draws a new "
+                "permutation (RandomSampler state is not checkpointed), so "
+                f"skipping the first {self._skip_batches} batches does not "
+                "reproduce the pre-crash order — already-seen samples may "
+                "repeat this epoch. Use shuffle=False (or a deterministic "
+                "per-epoch sampler) for bit-exact resume.",
+                RuntimeWarning, stacklevel=2)
 
     # -- iteration ---------------------------------------------------------
 
@@ -345,31 +386,74 @@ class DataLoader:
         return iter(self)
 
     def __iter__(self):
-        host = self._iter_batches_host()
+        skip = self._skip_batches
+        self._skip_batches = 0
+        # the replayed prefix counts as served so a resumed pass continues
+        # the cursor; the per-yield increment below counts only batches the
+        # CONSUMER actually received (prefetched-but-unconsumed batches in
+        # the device queue must not advance the checkpointed cursor)
+        self._batches_served = skip
+
+        def host_skipped():
+            n = 0
+            for b in self._iter_batches_host():
+                n += 1
+                if n <= skip:
+                    continue   # fast-forward host-side: no device transfer
+                yield b
+
+        for batch in self._iter_all(host_skipped()):
+            self._batches_served += 1
+            yield batch
+
+    def _iter_all(self, host):
         if not self.prefetch_to_device:
             yield from host
             return
         # async device prefetch: keep `prefetch_factor` batches in flight
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         _END = object()
+        stop = threading.Event()
+
+        def bounded_put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for b in host:
-                    q.put(self._device_put(b))
-                q.put(_END)
+                    if not bounded_put(self._device_put(b)):
+                        return             # consumer gone (close/rollback)
+                bounded_put(_END)
             except BaseException as e:  # propagate into the consumer
-                q.put(e)
+                bounded_put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # abandoned mid-pass (generator .close(), trainer rollback):
+            # unblock and retire the producer so it cannot keep device
+            # buffers pinned for the rest of the run
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
 
 
 class WorkerInfo:

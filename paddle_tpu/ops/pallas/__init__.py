@@ -1,6 +1,10 @@
 """Pallas TPU kernels (flash attention, fused norms). Importing registers
 the TPU-backend kernels with the op registry."""
 
-from . import flash_attention  # noqa: F401
+from ...core.jax_compat import install_pallas_compat
+
+install_pallas_compat()    # pltpu.CompilerParams name on jax<0.6
+
+from . import flash_attention  # noqa: F401,E402
 from . import fused_norm  # noqa: F401
 from . import paged_attention  # noqa: F401

@@ -1,0 +1,42 @@
+"""paddle_tpu.resilience — the fault-tolerant training runtime.
+
+Glues the previously disconnected islands (checkpoint/, distributed/elastic,
+distributed/watchdog) into one loop that survives host preemption, wedged
+collectives, and loss blow-ups:
+
+* :class:`CheckpointManager` — periodic + on-demand saves with an atomic
+  commit marker, manifest checksums, quarantine, retention, and
+  retry-with-backoff (reference analogue: incubate/checkpoint/
+  auto_checkpoint.py hardened for preemption);
+* :class:`PreemptionGuard` — SIGTERM/SIGINT → final synchronous checkpoint
+  at the next step boundary → exit with :data:`RESUMABLE_EXIT_CODE`
+  (reference analogue: fleet/elastic/manager.py signal path);
+* :class:`AnomalyGuard` — NaN/Inf + EWMA loss-spike detection driving
+  skip / rollback-to-checkpoint / abort policies with bounded budgets.
+
+``Trainer.fit(..., checkpoint_manager=..., resume="auto")`` wires all three
+into the step loop; ``distributed/elastic.py`` and ``distributed/launch``
+recognize the resumable exit status and relaunch into a resume instead of a
+restart.
+
+Import note: this package stays light — preemption/anomaly are stdlib-only
+and :class:`CheckpointManager` (which pulls jax/orbax) loads lazily. (The
+paddle_tpu PARENT package still initializes on any dotted import, so this
+buys zero-added-weight within a loaded process — e.g. elastic's lazy
+exit-code lookup — not a jax-free launcher.)
+"""
+
+from .preemption import (PreemptionGuard, TrainingPreempted,
+                         RESUMABLE_EXIT_CODE)
+from .anomaly import AnomalyGuard, DivergenceError
+
+__all__ = ["CheckpointManager", "CheckpointCorruption", "PreemptionGuard",
+           "TrainingPreempted", "RESUMABLE_EXIT_CODE", "AnomalyGuard",
+           "DivergenceError"]
+
+
+def __getattr__(name):
+    if name in ("CheckpointManager", "CheckpointCorruption"):
+        from . import checkpoint_manager as _cm
+        return getattr(_cm, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

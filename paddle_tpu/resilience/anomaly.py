@@ -1,0 +1,127 @@
+"""AnomalyGuard — NaN/Inf and loss-spike detection with bounded recovery.
+
+A multi-day pretraining run hits two loss pathologies: *poison batches*
+(one bad document → NaN loss → NaN grads → every parameter NaN within one
+step) and *divergence* (loss blows up over tens of steps). The guard
+watches the per-step loss against an EWMA band and maps each anomaly to a
+policy:
+
+* ``"skip"``     — undo this step's update and move past the batch
+                   (transient poison batch);
+* ``"rollback"`` — restore the last good checkpoint and replay
+                   (state already corrupted, or skip unavailable);
+* ``"abort"``    — raise :class:`DivergenceError` immediately.
+
+Both recovery policies carry a **bounded budget** (``max_skips`` /
+``max_rollbacks``): a persistent divergence exhausts it and the run fails
+loudly instead of silently replaying the same collapse forever.
+
+Detection is host-side and adds no device computation, but it does force a
+device→host sync of the loss scalar EVERY step (the plain metrics loop only
+syncs every ``log_every``), trading some async-dispatch overlap for
+step-granular detection — the point of the guard is that one poisoned
+update never reaches step N+1. Spike test: after ``warmup_steps`` accepted
+losses, ``loss > ewma + spike_factor * ewma_dev`` (EWMA of absolute
+deviation — a cheap robust scale estimate) flags an anomaly; NaN/Inf flags
+unconditionally, warmup included.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+__all__ = ["AnomalyGuard", "DivergenceError",
+           "OK", "SKIP", "ROLLBACK", "ABORT"]
+
+OK = "ok"
+SKIP = "skip"
+ROLLBACK = "rollback"
+ABORT = "abort"
+
+_POLICIES = (SKIP, ROLLBACK, ABORT)
+
+
+class DivergenceError(RuntimeError):
+    """Loss anomaly with no recovery budget left (or policy='abort')."""
+
+
+class AnomalyGuard:
+    def __init__(self, policy: str = ROLLBACK, *, spike_factor: float = 6.0,
+                 ewma_alpha: float = 0.05, warmup_steps: int = 20,
+                 max_skips: int = 10, max_rollbacks: int = 3,
+                 min_rel_dev: float = 1e-3):
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}")
+        self.policy = policy
+        self.min_rel_dev = float(min_rel_dev)
+        self.spike_factor = float(spike_factor)
+        self.ewma_alpha = float(ewma_alpha)
+        self.warmup_steps = int(warmup_steps)
+        self.max_skips = int(max_skips)
+        self.max_rollbacks = int(max_rollbacks)
+        self.skips = 0
+        self.rollbacks = 0
+        self.anomalies = 0
+        self.last_reason: Optional[str] = None
+        self._ewma: Optional[float] = None
+        self._dev = 0.0
+        self._seen = 0
+
+    # -- detection ----------------------------------------------------------
+
+    def is_anomalous(self, loss: float) -> Optional[str]:
+        """Reason string when ``loss`` is anomalous, else None (no state
+        change)."""
+        if not math.isfinite(loss):
+            return "non-finite loss"
+        if self._ewma is not None and self._seen >= self.warmup_steps:
+            # relative floor on the deviation: after a long flat plateau
+            # _dev decays toward 0 and an ABSOLUTE floor would flag benign
+            # fp jitter as a spike, draining the recovery budget
+            floor = max(self.min_rel_dev * abs(self._ewma), 1e-12)
+            band = self.spike_factor * max(self._dev, floor)
+            if loss > self._ewma + band:
+                return (f"loss spike {loss:.4g} > ewma {self._ewma:.4g} "
+                        f"+ {self.spike_factor}*dev {self._dev:.4g}")
+        return None
+
+    def record(self, loss: float) -> None:
+        """Fold an ACCEPTED loss into the EWMA band."""
+        a = self.ewma_alpha
+        if self._ewma is None:
+            self._ewma = float(loss)
+        else:
+            self._dev = (1 - a) * self._dev + a * abs(loss - self._ewma)
+            self._ewma = (1 - a) * self._ewma + a * float(loss)
+        self._seen += 1
+
+    # -- decision -----------------------------------------------------------
+
+    def check(self, loss: float) -> str:
+        """One per-step verdict: OK (loss recorded), or SKIP / ROLLBACK /
+        ABORT per policy and remaining budget."""
+        reason = self.is_anomalous(float(loss))
+        if reason is None:
+            self.record(float(loss))
+            self.last_reason = None
+            return OK
+        self.anomalies += 1
+        self.last_reason = reason
+        if self.policy == ABORT:
+            return ABORT
+        if self.policy == SKIP:
+            self.skips += 1
+            return SKIP if self.skips <= self.max_skips else ABORT
+        self.rollbacks += 1
+        return ROLLBACK if self.rollbacks <= self.max_rollbacks else ABORT
+
+    def raise_divergence(self, step: int, loss: float) -> None:
+        raise DivergenceError(
+            f"loss anomaly at step {step} ({self.last_reason or loss}) with "
+            f"recovery budget exhausted (skips={self.skips}/{self.max_skips},"
+            f" rollbacks={self.rollbacks}/{self.max_rollbacks})")
+
+    @property
+    def ewma(self) -> Optional[float]:
+        return self._ewma

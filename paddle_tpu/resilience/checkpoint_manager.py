@@ -1,0 +1,366 @@
+"""CheckpointManager — crash-safe periodic checkpointing with retention.
+
+Reference analogue: python/paddle/base/incubate/checkpoint/auto_checkpoint.py
+(``TrainEpochRange`` periodic snapshots + GC) hardened for the preemption
+realities of a multi-day TPU pod run. The manager wraps
+``paddle_tpu.checkpoint`` (orbax storage) with:
+
+* an **atomic commit protocol** — a ``step_N.PENDING`` sidecar is created
+  before the orbax write and a ``_COMMITTED`` marker (carrying the manifest
+  checksum) is written inside the step dir only after the write is durable,
+  so a crash at ANY point mid-save can never be mistaken for a valid
+  checkpoint;
+* a **manifest** (`_MANIFEST.json`): every file's size + sha256, verified on
+  restore — bit-rot or a torn write quarantines the step instead of loading
+  garbage into a 8B-param run;
+* **retention**: keep-last-N (rolling window) plus keep-every-M (permanent
+  milestones for post-hoc eval);
+* **quarantine** of corrupt/uncommitted step dirs under ``_quarantine/`` —
+  evidence is preserved, resume falls back to the previous good step;
+* **retry with jittered exponential backoff** on transient I/O failures
+  (GCS 5xx, NFS hiccups) — a single flaky write must not kill the run.
+
+Single-writer assumption: one manager instance (the rank-0 driver of a
+single-program run) owns ``root``; orbax itself fans the actual shard writes
+out across hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import re
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import checkpoint as _ckpt
+
+__all__ = ["CheckpointManager", "CheckpointCorruption"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+MANIFEST_NAME = "_MANIFEST.json"
+COMMIT_MARKER = "_COMMITTED"
+QUARANTINE_DIR = "_quarantine"
+
+
+class CheckpointCorruption(RuntimeError):
+    """A checkpoint failed manifest verification (and was quarantined)."""
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20,
+                 watchdog=None) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+            if watchdog is not None:
+                watchdog.tick()    # a multi-GB shard hashes for minutes
+    return h.hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class CheckpointManager:
+    """Crash-safe checkpoint directory of ``step_N`` orbax checkpoints.
+
+    Layout under ``root``::
+
+        step_300/            committed checkpoint (has _MANIFEST + _COMMITTED)
+        step_400.PENDING     sidecar: step_400 save is in flight / died
+        step_400/            NOT valid until _COMMITTED exists
+        _quarantine/         corrupt or uncommitted dirs moved aside
+
+    ``save`` is synchronous by default; with ``async_save=True`` the orbax
+    write happens on a background thread and the commit marker is written by
+    :meth:`finalize` (called automatically at the next save/restore/close).
+    """
+
+    def __init__(self, root: str, *, save_interval_steps: int = 100,
+                 keep_last_n: int = 3, keep_every_m: int = 0,
+                 async_save: bool = False, max_retries: int = 3,
+                 backoff_base_s: float = 0.25, backoff_max_s: float = 30.0,
+                 mesh=None, spec_tree=None):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        self.save_interval_steps = max(1, int(save_interval_steps))
+        self.keep_last_n = max(1, int(keep_last_n))
+        self.keep_every_m = max(0, int(keep_every_m))
+        self.async_save = bool(async_save)
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.mesh = mesh
+        self.spec_tree = spec_tree
+        self._pending: Optional[int] = None
+        self._rng = random.Random()
+        os.makedirs(self.root, exist_ok=True)
+        self._sweep_stale()
+
+    # -- paths -------------------------------------------------------------
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step)}")
+
+    def _pending_path(self, step: int) -> str:
+        return self.step_dir(step) + ".PENDING"
+
+    # -- inventory ---------------------------------------------------------
+
+    def committed_steps(self) -> List[int]:
+        """Steps with a commit marker, ascending (uncommitted dirs from a
+        crashed save are invisible here by construction)."""
+        steps = []
+        if not os.path.isdir(self.root):
+            return steps
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            d = os.path.join(self.root, name)
+            if os.path.isfile(os.path.join(d, COMMIT_MARKER)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_committed(self) -> Optional[int]:
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, tree: Dict[str, Any], *,
+             async_save: Optional[bool] = None, force: bool = False,
+             watchdog=None) -> bool:
+        """Checkpoint ``tree`` as ``step_N``. Returns False if the step is
+        already committed (and ``force`` is unset). Async saves are
+        committed by the next :meth:`finalize`. ``watchdog`` is ticked
+        through the synchronous commit (manifest hashing) so a large sync
+        save — notably the final preemption save — is not misread as a
+        hung step and killed mid-checkpoint."""
+        step = int(step)
+        self.finalize(watchdog=watchdog)    # previous async save first
+        if not force and os.path.isfile(
+                os.path.join(self.step_dir(step), COMMIT_MARKER)):
+            return False
+        use_async = self.async_save if async_save is None else bool(async_save)
+        sdir = self.step_dir(step)
+        if os.path.isdir(sdir):         # failed earlier attempt: clear it
+            shutil.rmtree(sdir, ignore_errors=True)
+        _atomic_write(self._pending_path(step),
+                      json.dumps({"step": step, "ts": time.time()}).encode())
+        self._with_retries(
+            lambda: _ckpt.save_state_dict(tree, sdir, async_save=use_async),
+            what=f"save step_{step}")
+        if use_async:
+            self._pending = step
+        else:
+            self._commit(step, watchdog=watchdog)
+        return True
+
+    def finalize(self, watchdog=None) -> Optional[int]:
+        """Commit the in-flight async save (if any): wait for durability,
+        then write manifest + marker. A background write failure is
+        re-raised here (never swallowed) after quarantining the partial
+        step dir. ``watchdog`` (a StepWatchdog) is ticked across the wait
+        so a hung remote write is still detected as a stall."""
+        if self._pending is None:
+            return None
+        step, self._pending = self._pending, None
+        try:
+            _ckpt.wait_until_finished(watchdog=watchdog)
+        except Exception:
+            self._quarantine(step, "async-save-failed")
+            raise
+        self._commit(step, watchdog=watchdog)
+        return step
+
+    def wait(self, watchdog=None) -> Optional[int]:
+        """Alias for :meth:`finalize` (drain pending writes)."""
+        return self.finalize(watchdog=watchdog)
+
+    def close(self) -> None:
+        self.finalize()
+
+    def _commit(self, step: int, watchdog=None) -> None:
+        sdir = self.step_dir(step)
+        manifest = self._build_manifest(sdir, step, watchdog=watchdog)
+        payload = json.dumps(manifest, sort_keys=True).encode()
+        self._with_retries(
+            lambda: _atomic_write(os.path.join(sdir, MANIFEST_NAME), payload),
+            what=f"manifest step_{step}")
+        marker = json.dumps({
+            "step": step, "ts": time.time(),
+            "manifest_sha256": hashlib.sha256(payload).hexdigest(),
+        }, sort_keys=True).encode()
+        self._with_retries(
+            lambda: _atomic_write(os.path.join(sdir, COMMIT_MARKER), marker),
+            what=f"commit step_{step}")
+        try:
+            os.remove(self._pending_path(step))
+        except FileNotFoundError:
+            pass
+        self._gc()
+
+    @staticmethod
+    def _build_manifest(sdir: str, step: int,
+                        watchdog=None) -> Dict[str, Any]:
+        """Hash every payload file. This runs on the CALLING thread — for a
+        multi-GB checkpoint it stalls the step loop for the hash duration
+        (the price of an end-to-end integrity check); the watchdog is
+        ticked per file so the stall is never misread as a hung step."""
+        files = {}
+        for dirpath, _dirs, names in os.walk(sdir):
+            for name in names:
+                if name in (MANIFEST_NAME, COMMIT_MARKER):
+                    continue
+                if watchdog is not None:
+                    watchdog.tick()
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, sdir)
+                files[rel] = {"size": os.path.getsize(full),
+                              "sha256": _sha256_file(full,
+                                                     watchdog=watchdog)}
+        return {"step": step, "files": files}
+
+    # -- verify / quarantine ------------------------------------------------
+
+    def verify(self, step: int, watchdog=None) -> bool:
+        """Recheck a committed step against its manifest: marker parses,
+        manifest bytes match the marker's checksum, every listed file exists
+        with matching size + sha256. ``watchdog`` is ticked through the
+        hashing (mid-fit rollback restores run with the step watchdog
+        armed)."""
+        sdir = self.step_dir(step)
+        try:
+            with open(os.path.join(sdir, COMMIT_MARKER), "rb") as f:
+                marker = json.loads(f.read())
+            with open(os.path.join(sdir, MANIFEST_NAME), "rb") as f:
+                payload = f.read()
+            if hashlib.sha256(payload).hexdigest() != marker["manifest_sha256"]:
+                return False
+            manifest = json.loads(payload)
+            for rel, meta in manifest["files"].items():
+                if watchdog is not None:
+                    watchdog.tick()
+                full = os.path.join(sdir, rel)
+                if not os.path.isfile(full):
+                    return False
+                if os.path.getsize(full) != meta["size"]:
+                    return False
+                if _sha256_file(full, watchdog=watchdog) != meta["sha256"]:
+                    return False
+            return True
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        sdir = self.step_dir(step)
+        if not os.path.isdir(sdir):
+            return
+        qroot = os.path.join(self.root, QUARANTINE_DIR)
+        os.makedirs(qroot, exist_ok=True)
+        base = os.path.join(qroot, f"step_{step}-{reason}")
+        dst, k = base, 0
+        while os.path.exists(dst):
+            k += 1
+            dst = f"{base}-{k}"
+        shutil.move(sdir, dst)
+        try:
+            os.remove(self._pending_path(step))
+        except FileNotFoundError:
+            pass
+
+    def quarantined(self) -> List[str]:
+        qroot = os.path.join(self.root, QUARANTINE_DIR)
+        if not os.path.isdir(qroot):
+            return []
+        return sorted(os.listdir(qroot))
+
+    def _sweep_stale(self) -> None:
+        """At startup, quarantine step dirs a crashed predecessor left
+        mid-save (PENDING sidecar, no commit marker) and drop orphan
+        sidecars. Restores then see only committed checkpoints."""
+        for name in list(os.listdir(self.root)):
+            if not name.endswith(".PENDING"):
+                continue
+            stem = name[:-len(".PENDING")]
+            m = _STEP_RE.match(stem)
+            if m is None:
+                continue
+            step = int(m.group(1))
+            sdir = self.step_dir(step)
+            if os.path.isdir(sdir) and not os.path.isfile(
+                    os.path.join(sdir, COMMIT_MARKER)):
+                self._quarantine(step, "uncommitted")
+            else:
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except FileNotFoundError:
+                    pass
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, like_tree: Dict[str, Any], *, step: Optional[int] = None,
+                mesh=None, spec_tree=None, watchdog=None):
+        """Load the newest committed checkpoint (or ``step``) into the
+        structure of ``like_tree``. A step failing manifest verification is
+        quarantined and the previous committed step is tried — resume after
+        corruption degrades, it does not crash. Returns ``(step, tree)`` or
+        ``None`` when nothing valid exists."""
+        self.finalize(watchdog=watchdog)
+        mesh = mesh if mesh is not None else self.mesh
+        spec_tree = spec_tree if spec_tree is not None else self.spec_tree
+        candidates = ([int(step)] if step is not None
+                      else list(reversed(self.committed_steps())))
+        for s in candidates:
+            if not self.verify(s, watchdog=watchdog):
+                self._quarantine(s, "corrupt")
+                continue
+            tree = self._with_retries(
+                lambda s=s: _ckpt.load_state_dict(
+                    self.step_dir(s), like_tree, mesh=mesh,
+                    spec_tree=spec_tree),
+                what=f"restore step_{s}")
+            return s, tree
+        return None
+
+    # -- retention ----------------------------------------------------------
+
+    def _gc(self) -> None:
+        steps = self.committed_steps()
+        keep = set(steps[-self.keep_last_n:])
+        if self.keep_every_m:
+            keep.update(s for s in steps if s % self.keep_every_m == 0)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self.step_dir(s), ignore_errors=True)
+
+    # -- retry --------------------------------------------------------------
+
+    def _with_retries(self, fn, what: str = "io"):
+        """Run ``fn`` retrying transient failures with jittered exponential
+        backoff (the ONE schedule implementation:
+        distributed.elastic.backoff_delays)."""
+        from ..distributed.elastic import backoff_delays
+        delays = backoff_delays(self.backoff_base_s, self.backoff_max_s,
+                                self.max_retries, rng=self._rng)
+        for attempt in range(self.max_retries + 1):
+            try:
+                return fn()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                if attempt >= self.max_retries:
+                    raise
+                time.sleep(next(delays))

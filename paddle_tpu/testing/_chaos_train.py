@@ -1,0 +1,119 @@
+"""Deterministic chaos training script (subprocess side of testing.chaos).
+
+``python -m paddle_tpu.testing._chaos_train --ckpt-dir D --steps N [...]``
+trains a tiny regression model with the full resilience stack wired in
+(CheckpointManager + PreemptionGuard + resume="auto") and prints one
+machine-readable ``CHAOS_RESULT {...}`` line. Fault flags:
+
+* ``--hard-exit-at K``   — os._exit(137) when step K completes (SIGKILL
+  shape: no final checkpoint, no commit of the in-flight async save);
+* ``--self-sigterm-at K``— SIGTERM to self at step K (preemption shape:
+  the guard latches it, fit writes a final sync checkpoint and exits with
+  the RESUMABLE status);
+* ``--fail-at K``        — raise RuntimeError at step K (plain crash; the
+  relauncher's failure budget, not the preemption path).
+
+Relaunching with the same --ckpt-dir resumes from the newest committed
+checkpoint; an uninterrupted run and a killed+resumed run print identical
+digests (the bit-exact contract tests assert on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+
+def build(seed: int = 0):
+    import paddle_tpu as pt
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer import Layer
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.trainer import Trainer
+
+    pt.seed(seed)
+
+    class TinyReg(Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 1)
+
+        def forward(self, x, y):
+            h = jnp.tanh(self.l1(x))
+            return jnp.mean((self.l2(h) - y) ** 2)
+
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(512, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    ds = TensorDataset([xs, ys])
+    loader = DataLoader(ds, batch_size=16, shuffle=False, drop_last=True,
+                        collate_fn=lambda items: {
+                            "x": np.stack([i[0] for i in items]),
+                            "y": np.stack([i[1] for i in items])})
+    model = TinyReg()
+    opt = SGD(learning_rate=0.05, parameters=model)
+    return Trainer(model, opt, donate=False), loader
+
+
+def params_digest(params) -> str:
+    import numpy as np
+    h = hashlib.sha256()
+    for k in sorted(params):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(np.asarray(params[k])).tobytes())
+    return h.hexdigest()[:16]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--save-interval", type=int, default=5)
+    p.add_argument("--async-save", action="store_true")
+    p.add_argument("--hard-exit-at", type=int, default=None)
+    p.add_argument("--self-sigterm-at", type=int, default=None)
+    p.add_argument("--fail-at", type=int, default=None)
+    args = p.parse_args(argv)
+
+    from paddle_tpu.resilience import CheckpointManager, PreemptionGuard
+    from paddle_tpu.testing import chaos
+
+    tr, loader = build()
+    mgr = CheckpointManager(args.ckpt_dir,
+                            save_interval_steps=args.save_interval,
+                            keep_last_n=3, async_save=args.async_save)
+
+    def cb(m):
+        if args.hard_exit_at is not None and m.step >= args.hard_exit_at:
+            os._exit(137)
+        if args.fail_at is not None and m.step >= args.fail_at:
+            raise RuntimeError(f"injected failure at step {m.step}")
+
+    on_metrics = cb if (args.hard_exit_at is not None
+                        or args.fail_at is not None) else None
+    if args.self_sigterm_at is not None:
+        on_metrics = chaos.kill_at_step(args.self_sigterm_at)
+
+    with PreemptionGuard() as guard:
+        hist = tr.fit(loader, steps=args.steps, log_every=1,
+                      on_metrics=on_metrics, checkpoint_manager=mgr,
+                      resume="auto", preemption_guard=guard)
+
+    losses = [m.loss for m in hist]
+    print("CHAOS_RESULT " + json.dumps({
+        "step": tr._step,
+        "final_loss": losses[-1] if losses else None,
+        "digest": params_digest(tr.params),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

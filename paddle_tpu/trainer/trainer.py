@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.rng import rng_tracker
 from ..nn.layer import Layer
@@ -198,7 +199,25 @@ class Trainer:
 
     def fit(self, data: Iterable[Dict[str, jax.Array]], steps: int,
             log_every: int = 10, on_metrics: Optional[Callable] = None,
-            seq_len: Optional[int] = None):
+            seq_len: Optional[int] = None, checkpoint_manager=None,
+            resume=None, anomaly_guard=None, preemption_guard=None):
+        """Run the training loop. Beyond the metrics loop, this is the
+        fault-tolerant runtime (resilience subsystem):
+
+        * ``checkpoint_manager`` (resilience.CheckpointManager): periodic
+          saves every ``save_interval_steps`` plus a final synchronous save;
+        * ``resume="auto"``: restore params/opt_state/step/LR-scheduler from
+          the newest COMMITTED checkpoint and fast-forward the data cursor
+          (via ``data.set_state_dict`` when the loader supports it). With
+          resume, ``steps`` is the TOTAL step budget of the run — a relaunch
+          trains to the same target as an uninterrupted run;
+        * ``preemption_guard`` (resilience.PreemptionGuard): on SIGTERM the
+          loop writes one final sync checkpoint at the next step boundary
+          and raises TrainingPreempted (exit code = resumable);
+        * ``anomaly_guard`` (resilience.AnomalyGuard): NaN/Inf or loss-spike
+          steps are skipped (undo the update; needs donate=False) or rolled
+          back to the last good checkpoint, within bounded budgets.
+        """
         # hung-step watchdog (PT_STEP_TIMEOUT_S): armed only for the
         # duration of this bounded loop — inter-step gaps here ARE steps
         # (device sync + next-batch wait), so a stall is a real hang, and
@@ -208,27 +227,64 @@ class Trainer:
         from ..distributed.watchdog import watchdog_from_env
         if self._watchdog is None:
             self._watchdog = watchdog_from_env()
+        if resume and checkpoint_manager is None:
+            raise ValueError("resume requires a checkpoint_manager")
+        if (anomaly_guard is not None and anomaly_guard.policy == "skip"
+                and self._donate):
+            raise ValueError(
+                "AnomalyGuard(policy='skip') requires Trainer(donate=False): "
+                "undoing a poisoned update needs pre-step parameter "
+                "references, which buffer donation invalidates. Use "
+                "policy='rollback' (with a checkpoint_manager) or disable "
+                "donation.")
+        if resume and checkpoint_manager is not None:
+            self._resume_from(checkpoint_manager, data)
+            target = int(steps)
+        else:
+            target = self._step + int(steps)
         it = iter(data)
         history = []
         t_last = time.perf_counter()
         tokens_since = 0
         loss = None
         try:
-            return self._fit_loop(it, steps, log_every, on_metrics, seq_len,
-                                  history, t_last, tokens_since, loss)
+            return self._fit_loop(it, target, log_every, on_metrics, seq_len,
+                                  history, t_last, tokens_since, loss,
+                                  mgr=checkpoint_manager,
+                                  anomaly=anomaly_guard,
+                                  guard=preemption_guard, data=data)
         finally:
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
 
-    def _fit_loop(self, it, steps, log_every, on_metrics, seq_len,
-                  history, t_last, tokens_since, loss):
-        for _ in range(steps):
-            batch = next(it)
+    def _fit_loop(self, it, target, log_every, on_metrics, seq_len,
+                  history, t_last, tokens_since, loss, mgr=None, anomaly=None,
+                  guard=None, data=None):
+        while self._step < target:
+            if guard is not None and guard.preempted:
+                self._preempt_exit(mgr, data)
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
             ids = batch.get("input_ids")
             ntok = int(ids.shape[0] * ids.shape[1]) if ids is not None else 0
+            prev = None
+            if anomaly is not None and not self._donate:
+                # pre-step references (immutable jax arrays — free to hold)
+                # let "skip" undo a poisoned update without any checkpoint
+                sched = self.optimizer.lr_scheduler
+                prev = (self.params, self.opt_state,
+                        sched.state_dict() if sched is not None else None)
             loss = self.train_step(batch)
             tokens_since += ntok
+            if anomaly is not None:
+                verdict = anomaly.check(float(loss))
+                if verdict != "ok":
+                    it = self._handle_anomaly(verdict, anomaly, mgr, prev,
+                                              data, it, float(loss))
+                    continue
             if self._step % log_every == 0:
                 loss_v = float(loss)  # blocks; amortized over log_every
                 now = time.perf_counter()
@@ -249,10 +305,128 @@ class Trainer:
                     on_metrics(m)
                 t_last = time.perf_counter()
                 tokens_since = 0
+            if guard is not None and guard.preempted:
+                self._preempt_exit(mgr, data)
+            if (mgr is not None
+                    and self._step % mgr.save_interval_steps == 0
+                    and self._step < target):
+                mgr.save(self._step, self._ckpt_tree(data),
+                         watchdog=self._watchdog)
+        if guard is not None and guard.preempted:
+            self._preempt_exit(mgr, data)
+        if mgr is not None:
+            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
+                     watchdog=self._watchdog)
         # write trained params back into the Layer (imperative view);
         # train_step already does this when donation is on
         self.sync_model()
         return history
+
+    # -- resilience runtime --------------------------------------------------
+
+    def _ckpt_tree(self, data=None):
+        """Full training state as one checkpointable tree. The structure is
+        FIXED (extra always present, same keys) so the restore target always
+        matches the saved layout."""
+        sched = self.optimizer.lr_scheduler
+        if data is not None and hasattr(data, "state_dict"):
+            # the loader's own count: batches actually handed out this pass.
+            # NOT self._step — anomaly skips consume a batch without keeping
+            # the step, so the two drift apart exactly when resume must not
+            # replay the poisoned batch
+            cursor = int(data.state_dict().get("batches_served", self._step))
+        else:
+            cursor = self._step    # 1 batch per step for stateless iterables
+        return {
+            "step": np.asarray(self._step, np.int64),
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "extra": {
+                "sched_last_epoch": np.asarray(
+                    sched.last_epoch if sched is not None else -1, np.int64),
+                # last_lr as VALUE, not formula: adaptive schedulers
+                # (ReduceOnPlateau) cannot recompute it from last_epoch
+                "sched_last_lr": np.asarray(
+                    sched.last_lr if sched is not None else -1.0, np.float64),
+                "data_cursor": np.asarray(cursor, np.int64),
+            },
+        }
+
+    def _apply_restored(self, tree) -> int:
+        self.params = tree["params"]
+        self.opt_state = tree["opt_state"]
+        if self._offload:
+            self.opt_state = self._place_opt_state("pinned_host")
+        self._step = int(np.asarray(tree["step"]))
+        sched = self.optimizer.lr_scheduler
+        le = int(np.asarray(tree["extra"]["sched_last_epoch"]))
+        llr = float(np.asarray(tree["extra"]["sched_last_lr"]))
+        if sched is not None and le >= 0:
+            # set_state_dict, NOT step(epoch=le): ReduceOnPlateau.step is a
+            # no-op without metrics, which would silently reset its decayed
+            # LR to the constructor value
+            sched.set_state_dict({"last_epoch": le, "last_lr": (
+                llr if llr >= 0 else sched.last_lr)})
+        self.sync_model()
+        return int(np.asarray(tree["extra"]["data_cursor"]))
+
+    def _resume_from(self, mgr, data) -> Optional[int]:
+        """resume="auto": restore the newest committed checkpoint (corrupt
+        ones are quarantined by the manager and the previous step is used)
+        and position the data cursor."""
+        res = mgr.restore(self._ckpt_tree(), watchdog=self._watchdog)
+        if res is None:
+            return None          # nothing saved yet: cold start
+        step, tree = res
+        cursor = self._apply_restored(tree)
+        if hasattr(data, "set_state_dict"):
+            data.set_state_dict({"batches_served": cursor})
+        return step
+
+    def _preempt_exit(self, mgr, data=None):
+        """Step-boundary preemption: one final SYNCHRONOUS checkpoint, then
+        exit with the resumable status (the elastic relauncher resumes
+        instead of restarting)."""
+        from ..resilience.preemption import TrainingPreempted
+        if mgr is not None:
+            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
+                     watchdog=self._watchdog)
+        self.sync_model()
+        raise TrainingPreempted(self._step)
+
+    def _handle_anomaly(self, verdict, anomaly, mgr, prev, data, it, loss):
+        """Apply the anomaly verdict; returns the (possibly replaced) data
+        iterator."""
+        from ..resilience.anomaly import SKIP
+        if verdict == SKIP and prev is not None:
+            # undo this step's (poisoned) update in memory and move past
+            # the batch
+            params, opt_state, sched_sd = prev
+            self.params, self.opt_state = params, opt_state
+            sched = self.optimizer.lr_scheduler
+            if sched is not None and sched_sd is not None:
+                sched.set_state_dict(sched_sd)
+            self._step -= 1
+            self.sync_model()
+            return it
+        if verdict == "abort" or mgr is None:
+            # no checkpoint to roll back to (or policy says die): fail loudly
+            anomaly.raise_divergence(self._step, loss)
+        res = mgr.restore(self._ckpt_tree(), watchdog=self._watchdog)
+        if res is None:
+            anomaly.raise_divergence(self._step, loss)
+        _, tree = res
+        cursor = self._apply_restored(tree)
+        if data is not None and hasattr(data, "set_state_dict"):
+            # replay from the checkpointed cursor; without a stateful
+            # loader the current iterator continues forward (documented:
+            # rollback then sees new batches rather than a replay)
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()        # retire the old pass (and its prefetch thread)
+            data.set_state_dict({"batches_served": cursor})
+            return iter(data)
+        return it
 
     def sync_model(self):
         for k, v in self.params.items():

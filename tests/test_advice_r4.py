@@ -1,4 +1,6 @@
 """Regression tests for the round-3 advisor findings (ADVICE.md)."""
+import os
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -159,6 +161,9 @@ class TestTensorMethods:
         assert out.shape == (2, 1)
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/python/paddle"),
+    reason="reference doctest corpus not present in this container")
 def test_reference_doctests_subset(tmp_path):
     """Fast regression: a 3-module slice of the reference-doctest sweep
     must stay green (full matrix: tools/run_reference_doctests.py,

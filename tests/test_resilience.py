@@ -1,0 +1,845 @@
+"""Resilience runtime tests: CheckpointManager crash-safety, preemption
+checkpoint-and-exit, anomaly skip/rollback, and the save→crash→auto-resume
+round-trip contract (bit-exact on the CPU backend).
+
+Fault injection comes from paddle_tpu.testing.chaos; the `chaos` marker tags
+every test that simulates a failure (kill-mid-save, corruption, NaN batch,
+SIGTERM-mid-fit). Fast variants run in tier-1; the real multi-process
+kill/relaunch variants are additionally marked `slow`.
+"""
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.resilience import (AnomalyGuard, CheckpointManager,
+                                   DivergenceError, PreemptionGuard,
+                                   RESUMABLE_EXIT_CODE, TrainingPreempted)
+from paddle_tpu.testing import chaos
+from paddle_tpu.trainer import Trainer
+
+chaosmark = pytest.mark.chaos
+
+
+# -- fixtures ---------------------------------------------------------------
+
+def small_tree(v: float = 1.0):
+    return {"w": jnp.full((8, 8), v, jnp.float32),
+            "b": jnp.arange(8, dtype=jnp.float32) * v}
+
+
+class TinyReg(Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 16)
+        self.l2 = nn.Linear(16, 1)
+
+    def forward(self, x, y):
+        h = jnp.tanh(self.l1(x))
+        return jnp.mean((self.l2(h) - y) ** 2)
+
+
+def build(seed=0, n=320, batch=16, poison_batch=None):
+    """Deterministic tiny regression trainer + loader (data is seed-fixed so
+    every build sees the identical batch stream). ``poison_batch`` NaNs out
+    that batch's inputs in the underlying dataset."""
+    pt.seed(seed)
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(n, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    if poison_batch is not None:
+        xs[poison_batch * batch:(poison_batch + 1) * batch] = np.nan
+    loader = DataLoader(TensorDataset([xs, ys]), batch_size=batch,
+                        shuffle=False, drop_last=True,
+                        collate_fn=lambda items: {
+                            "x": np.stack([i[0] for i in items]),
+                            "y": np.stack([i[1] for i in items])})
+    model = TinyReg()
+    opt = SGD(learning_rate=0.05, parameters=model)
+    return Trainer(model, opt, donate=False), loader
+
+
+def digest(params):
+    import hashlib
+    h = hashlib.sha256()
+    for k in sorted(params):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(np.asarray(params[k])).tobytes())
+    return h.hexdigest()
+
+
+def batches_of(loader):
+    return list(loader)
+
+
+# -- CheckpointManager: commit protocol, retention, verification ------------
+
+def test_manager_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    tree = small_tree(3.0)
+    assert mgr.save(10, tree) is True
+    assert mgr.committed_steps() == [10]
+    assert mgr.latest_committed() == 10
+    # an already-committed step is not rewritten
+    assert mgr.save(10, tree) is False
+    step, out = mgr.restore(small_tree(0.0))
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.asarray(tree["b"]))
+    assert mgr.verify(10)
+
+
+def test_manager_retention_keep_last_and_every(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2, keep_every_m=4)
+    for s in range(1, 7):
+        mgr.save(s, small_tree(float(s)))
+    # last 2 = {5, 6}; every-4 milestones = {4}
+    assert mgr.committed_steps() == [4, 5, 6]
+
+
+def test_manager_restore_specific_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    for s in (3, 7):
+        mgr.save(s, small_tree(float(s)))
+    step, out = mgr.restore(small_tree(0.0), step=3)
+    assert step == 3
+    assert float(np.asarray(out["w"])[0, 0]) == 3.0
+
+
+@chaosmark
+def test_latest_step_skips_uncommitted(tmp_path):
+    """Satellite: checkpoint.latest_step must never hand auto-resume a
+    partial (crashed mid-save) checkpoint."""
+    root = str(tmp_path)
+    mgr = CheckpointManager(root, keep_last_n=5)
+    mgr.save(5, small_tree(5.0))
+    torn = chaos.kill_mid_save(mgr, 9, small_tree(9.0))
+    assert os.path.isdir(torn)                    # payload is durable...
+    assert not ckpt.is_complete_checkpoint(torn)  # ...but not committed
+    assert ckpt.latest_step(root) == 5            # torn step_9 is skipped
+    # a plain orbax dir (no manager) still counts as complete
+    ckpt.save_state_dict(small_tree(1.0), os.path.join(root, "step_11"))
+    assert ckpt.latest_step(root) == 11
+
+
+@chaosmark
+def test_committed_marker_wins_over_orphan_sidecar(tmp_path):
+    """Crash BETWEEN writing _COMMITTED and removing the .PENDING sidecar:
+    the commit happened, so the step must still count as complete."""
+    root = str(tmp_path)
+    mgr = CheckpointManager(root)
+    mgr.save(5, small_tree(5.0))
+    with open(os.path.join(root, "step_5.PENDING"), "w") as f:
+        f.write("{}")                        # resurrect the orphan sidecar
+    assert ckpt.is_complete_checkpoint(mgr.step_dir(5))
+    assert ckpt.latest_step(root) == 5
+    # a fresh manager's sweep drops the orphan instead of quarantining
+    mgr2 = CheckpointManager(root)
+    assert mgr2.committed_steps() == [5]
+    assert mgr2.quarantined() == []
+    assert not os.path.exists(os.path.join(root, "step_5.PENDING"))
+
+
+@chaosmark
+def test_startup_sweep_quarantines_torn_save(tmp_path):
+    root = str(tmp_path)
+    mgr = CheckpointManager(root, keep_last_n=5)
+    mgr.save(5, small_tree(5.0))
+    chaos.kill_mid_save(mgr, 9, small_tree(9.0))
+    # "relaunch": a fresh manager sweeps the torn dir into quarantine
+    mgr2 = CheckpointManager(root, keep_last_n=5)
+    assert mgr2.committed_steps() == [5]
+    assert any(q.startswith("step_9") for q in mgr2.quarantined())
+    assert not os.path.exists(os.path.join(root, "step_9.PENDING"))
+    step, _ = mgr2.restore(small_tree(0.0))
+    assert step == 5
+
+
+@chaosmark
+@pytest.mark.parametrize("mode", ["flip", "truncate", "delete", "manifest"])
+def test_restore_quarantines_corruption_and_falls_back(tmp_path, mode):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    mgr.save(5, small_tree(5.0))
+    mgr.save(9, small_tree(9.0))
+    chaos.corrupt_checkpoint(mgr.step_dir(9), mode=mode)
+    assert not mgr.verify(9)
+    step, out = mgr.restore(small_tree(0.0))
+    assert step == 5
+    assert float(np.asarray(out["w"])[0, 0]) == 5.0
+    assert any(q.startswith("step_9-corrupt") for q in mgr.quarantined())
+    # quarantined dir no longer shows up as a committed candidate
+    assert mgr.committed_steps() == [5]
+
+
+@chaosmark
+def test_async_save_failure_reraised_and_quarantined(tmp_path, monkeypatch):
+    """Satellite: a background write failure surfaces at finalize(), never
+    silently at process exit."""
+    mgr = CheckpointManager(str(tmp_path))
+    sdir = mgr.step_dir(7)
+    os.makedirs(sdir)
+    with open(os.path.join(sdir, "data.bin"), "wb") as f:
+        f.write(b"partial")
+    mgr._pending = 7
+    from paddle_tpu.resilience import checkpoint_manager as cm
+    monkeypatch.setattr(cm._ckpt, "wait_until_finished",
+                        lambda watchdog=None: (_ for _ in ()).throw(
+                            RuntimeError("gcs write failed")))
+    with pytest.raises(RuntimeError, match="gcs write failed"):
+        mgr.finalize()
+    assert mgr._pending is None
+    assert any(q.startswith("step_7-async-save-failed")
+               for q in mgr.quarantined())
+
+
+@chaosmark
+def test_wait_until_finished_ticks_watchdog_and_reraises(monkeypatch):
+    """Satellite: the step watchdog keeps ticking across a checkpoint wait
+    (a hung GCS write must still be detected) and async errors re-raise."""
+    class SlowFailingCkptr:
+        def wait_until_finished(self):
+            time.sleep(0.3)
+            raise RuntimeError("bg boom")
+
+    class WD:
+        ticks = 0
+
+        def tick(self):
+            self.ticks += 1
+
+    wd = WD()
+    monkeypatch.setattr(ckpt, "_async_ckptr", SlowFailingCkptr())
+    with pytest.raises(RuntimeError, match="bg boom"):
+        ckpt.wait_until_finished(watchdog=wd, poll_s=0.05)
+    assert wd.ticks >= 2
+
+
+@chaosmark
+def test_hung_checkpoint_wait_trips_watchdog(monkeypatch):
+    """A truly HUNG remote write must not be masked by progress ticks: past
+    the hang budget the wait goes silent and the armed watchdog fires."""
+    from paddle_tpu.distributed.watchdog import StepWatchdog
+
+    class HungCkptr:
+        def __init__(self):
+            self.release = threading.Event()
+
+        def wait_until_finished(self):
+            self.release.wait(20.0)   # "GCS write wedged"
+
+    hung = HungCkptr()
+    monkeypatch.setattr(ckpt, "_async_ckptr", hung)
+    wd = StepWatchdog(timeout_s=0.1, action="log",
+                      poll_interval_s=0.02).start()
+    try:
+        wd.tick()
+        waiter = threading.Thread(
+            target=lambda: ckpt.wait_until_finished(
+                watchdog=wd, poll_s=0.02, hang_timeout_s=0.15),
+            daemon=True)
+        waiter.start()
+        deadline = time.time() + 5.0
+        while not wd.fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert wd.fired                # the hang was detected
+    finally:
+        hung.release.set()
+        waiter.join(timeout=5.0)
+        wd.stop()
+
+
+def test_manager_retries_transient_io(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_retries=3,
+                            backoff_base_s=0.001, backoff_max_s=0.002)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert mgr._with_retries(flaky) == "ok"
+    assert calls["n"] == 3
+    with pytest.raises(OSError):
+        mgr._with_retries(lambda: (_ for _ in ()).throw(OSError("always")))
+
+
+# -- backoff / elastic ------------------------------------------------------
+
+def test_backoff_delays_jittered_and_capped():
+    from paddle_tpu.distributed.elastic import backoff_delays
+    delays = list(backoff_delays(1.0, 8.0, 7, rng=random.Random(0)))
+    assert len(delays) == 7
+    for k, d in enumerate(delays):
+        assert 0.0 <= d <= min(2.0 ** k, 8.0)
+    # jitter: different seeds give different schedules
+    assert delays != list(backoff_delays(1.0, 8.0, 7, rng=random.Random(1)))
+
+
+def test_elastic_reregister_backs_off_until_store_returns():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    mgr = ElasticManager(np=1, reconnect_backoff_base=0.001,
+                         reconnect_backoff_cap=0.01,
+                         max_reconnect_attempts=8)
+
+    class FlakyStore:
+        def __init__(self, inner, failures):
+            self.inner, self.failures = inner, failures
+
+        def add(self, *a):
+            if self.failures > 0:
+                self.failures -= 1
+                raise ConnectionError("coordinator restarting")
+            return self.inner.add(*a)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    mgr.store = FlakyStore(mgr.store, failures=3)
+    assert mgr._reregister() is True
+    assert mgr.reconnects == 1
+    # exhausted budget → gives up (heartbeat thread exits)
+    mgr.store.failures = 99
+    assert mgr._reregister() is False
+
+
+def test_elastic_run_resumes_on_preemption_without_burning_restarts():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    mgr = ElasticManager(np=1, max_restarts=0)
+    calls = []
+
+    def train(ordinal):
+        calls.append(ordinal)
+        if len(calls) == 1:
+            raise TrainingPreempted(5)   # orderly: state was checkpointed
+
+    assert mgr.run(train) is True
+    assert calls == [0, 1]
+    assert mgr.preemptions == 1 and mgr.restarts == 0
+
+
+def test_elastic_run_preemption_budget_bounded():
+    from paddle_tpu.distributed.elastic import ElasticManager
+    mgr = ElasticManager(np=1, max_restarts=0)
+
+    def always_preempted(ordinal):
+        raise TrainingPreempted(1)
+
+    assert mgr.run(always_preempted, max_preemptions=3) is False
+    assert mgr.preemptions == 3
+
+
+def test_master_preempt_counter_propagates_reason():
+    """Multinode contract: the epoch bump carries WHY — peers must know a
+    restart is an orderly preemption so they don't burn failure budget.
+    Reasons ride an atomic counter (delta comparison); a window mixing a
+    failure with a preemption reads as failure — the fail-safe direction."""
+    from paddle_tpu.distributed.launch.main import _free_port
+    from paddle_tpu.distributed.launch.master import Master
+    m = Master("127.0.0.1", _free_port(), "reasonjob", is_server=True)
+    e0, p0 = m.restart_epoch(), m.preempt_epochs()
+    e1 = m.bump_epoch("preempt")
+    assert (m.preempt_epochs() - p0) >= (e1 - e0)      # pure-preempt window
+    e2, p1 = e1, m.preempt_epochs()
+    e3 = m.bump_epoch("preempt")
+    e3 = m.bump_epoch()                                # mixed window
+    assert (m.preempt_epochs() - p1) < (e3 - e2)       # reads as failure
+
+
+def test_alive_nodes_tolerates_registration_hole():
+    """A registration that died between the slot add and the id set leaves
+    a hole in node_ids — the membership scan must skip it, not stop."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    master = ElasticManager(np=3, heartbeat_timeout=30.0, node_id="n-a")
+    master._register_keys()                            # no hb thread needed
+    master.store.add("node_count", 1)                  # slot allocated...
+    # ...but node_ids/<slot> never written (worker died mid-register)
+    worker = ElasticManager(f"127.0.0.1:{master.port}", np=3,
+                            heartbeat_timeout=30.0, node_id="n-b")
+    worker._register_keys()                            # lands past the hole
+    assert set(master.alive_nodes()) == {"n-a", "n-b"}
+
+
+@chaosmark
+def test_watchdog_fires_then_elastic_relaunch():
+    """Satellite: hung step → watchdog fires → worker dies → elastic
+    relaunches it and the retry succeeds."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    from paddle_tpu.distributed.watchdog import StepWatchdog
+    mgr = ElasticManager(np=1, max_restarts=2)
+    fired = []
+
+    def train(ordinal):
+        if ordinal == 0:
+            wd = StepWatchdog(timeout_s=0.05, action="log",
+                              poll_interval_s=0.01).start()
+            try:
+                wd.tick()
+                time.sleep(0.3)          # the wedged collective
+                assert wd.fired
+                fired.append(True)
+            finally:
+                wd.stop()
+            raise RuntimeError("step hung; worker aborted")
+
+    assert mgr.run(train) is True
+    assert fired == [True]
+    assert mgr.restarts == 1
+
+
+# -- AnomalyGuard -----------------------------------------------------------
+
+def test_anomaly_nan_detected_immediately():
+    g = AnomalyGuard(policy="skip", warmup_steps=5)
+    assert g.check(float("nan")) == "skip"      # warmup does not shield NaN
+    assert g.check(float("inf")) == "skip"
+    assert g.skips == 2 and g.anomalies == 2
+    assert g.check(1.0) == "ok"
+
+
+def test_anomaly_spike_after_warmup():
+    g = AnomalyGuard(policy="rollback", warmup_steps=10, spike_factor=6.0)
+    rs = np.random.RandomState(0)
+    for _ in range(30):
+        assert g.check(1.0 + 0.01 * rs.randn()) == "ok"
+    assert g.check(100.0) == "rollback"
+    assert "spike" in g.last_reason
+    # spikes during warmup are tolerated (loss is wild early)
+    g2 = AnomalyGuard(policy="rollback", warmup_steps=50)
+    for v in (10.0, 1.0, 40.0, 2.0):
+        assert g2.check(v) == "ok"
+
+
+def test_anomaly_plateau_jitter_not_flagged():
+    """After a flat plateau the EWMA deviation decays to ~0; benign fp
+    jitter must stay inside the (relative-floored) band."""
+    g = AnomalyGuard(policy="abort", warmup_steps=10)
+    for _ in range(200):
+        assert g.check(2.0) == "ok"         # dev → 0
+    assert g.check(2.0 + 1e-6) == "ok"      # jitter, not a spike
+    assert g.check(2.0 * 1.5) == "abort"    # a real jump still trips
+
+
+def test_anomaly_budgets_exhaust_to_abort():
+    g = AnomalyGuard(policy="skip", max_skips=2)
+    assert g.check(float("nan")) == "skip"
+    assert g.check(float("nan")) == "skip"
+    assert g.check(float("nan")) == "abort"
+    g = AnomalyGuard(policy="rollback", max_rollbacks=1)
+    assert g.check(float("nan")) == "rollback"
+    assert g.check(float("nan")) == "abort"
+    with pytest.raises(DivergenceError, match="budget exhausted"):
+        g.raise_divergence(12, float("nan"))
+    g = AnomalyGuard(policy="abort")
+    assert g.check(float("nan")) == "abort"
+
+
+@chaosmark
+def test_trainer_skip_policy_survives_poison_batch(tmp_path):
+    """NaN batch → skip: the poisoned update is undone in memory and the
+    run finishes with finite params, no checkpoint involved."""
+    tr, loader = build()
+    guard = AnomalyGuard(policy="skip", warmup_steps=100)  # NaN-only trigger
+    data = chaos.nan_injector(batches_of(loader), at=3, fields=["x"])
+    hist = tr.fit(data, steps=8, log_every=1, anomaly_guard=guard)
+    assert guard.skips == 1 and guard.anomalies == 1
+    assert tr._step == 8
+    assert all(np.isfinite(m.loss) for m in hist)
+    for v in tr.params.values():
+        assert np.all(np.isfinite(np.asarray(v)))
+
+
+@chaosmark
+def test_trainer_rollback_policy_restores_last_good(tmp_path):
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=4)
+    guard = AnomalyGuard(policy="rollback", warmup_steps=100)
+    data = chaos.nan_injector(batches_of(loader), at=9, fields=["x"])
+    hist = tr.fit(data, steps=12, log_every=1, checkpoint_manager=mgr,
+                  anomaly_guard=guard)
+    assert guard.rollbacks == 1
+    assert tr._step == 12
+    assert all(np.isfinite(m.loss) for m in hist)
+    for v in tr.params.values():
+        assert np.all(np.isfinite(np.asarray(v)))
+
+
+@chaosmark
+def test_trainer_persistent_divergence_fails_loudly(tmp_path):
+    tr, loader = build()
+    guard = AnomalyGuard(policy="skip", warmup_steps=100, max_skips=2)
+    batches = batches_of(loader)
+    poisoned = [chaos.nan_batch(b, fields=["x"]) for b in batches]
+    with pytest.raises(DivergenceError):
+        tr.fit(iter(poisoned), steps=10, log_every=1, anomaly_guard=guard)
+
+
+# -- PreemptionGuard --------------------------------------------------------
+
+def test_resumable_exit_code_contract():
+    assert RESUMABLE_EXIT_CODE == 75
+    exc = TrainingPreempted(42)
+    assert isinstance(exc, SystemExit)
+    assert exc.code == RESUMABLE_EXIT_CODE
+    assert "42" in str(exc)
+
+
+def test_preemption_guard_latches_signal():
+    with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+        assert guard.installed and not guard.preempted
+        os.kill(os.getpid(), signal.SIGTERM)   # latched, not fatal
+        deadline = time.time() + 2.0
+        while not guard.preempted and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.preempted
+    assert not guard.installed                 # handlers restored
+
+
+def test_preemption_guard_clear_for_reuse():
+    """A guard reused across in-process relaunches must be clearable, or
+    the resumed fit re-preempts at its first step boundary."""
+    g = PreemptionGuard()
+    g.trigger()
+    assert g.preempted
+    g.clear()
+    assert not g.preempted
+
+
+def test_pod_exit_code_mixed_crash_burns_budget():
+    """A pod is resumable only when EVERY failed worker exited 75 — one
+    real crash inside a preempted pod must take the failure path."""
+    from paddle_tpu.distributed.launch.main import _pod_exit_code
+
+    class C:
+        def __init__(self, code):
+            self.exit_code = code
+
+    assert _pod_exit_code([C(RESUMABLE_EXIT_CODE),
+                           C(RESUMABLE_EXIT_CODE)]) == RESUMABLE_EXIT_CODE
+    assert _pod_exit_code([C(RESUMABLE_EXIT_CODE), C(139)]) == 139
+    assert _pod_exit_code([C(139), C(RESUMABLE_EXIT_CODE)]) == 139
+    assert _pod_exit_code([C(1)]) == 1
+
+
+def test_preemption_guard_second_sigint_escapes():
+    guard = PreemptionGuard()
+    guard._handler(signal.SIGINT, None)
+    assert guard.preempted
+    with pytest.raises(KeyboardInterrupt):
+        guard._handler(signal.SIGINT, None)
+
+
+@chaosmark
+def test_fit_preempted_writes_final_checkpoint(tmp_path):
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=100)
+    guard = PreemptionGuard()                  # not installed: trigger()-driven
+
+    def on_metrics(m):
+        if m.step >= 6:
+            guard.trigger()                    # SIGTERM-shaped latch
+
+    with pytest.raises(TrainingPreempted) as ei:
+        tr.fit(iter(batches_of(loader)), steps=12, log_every=1,
+               on_metrics=on_metrics, checkpoint_manager=mgr,
+               preemption_guard=guard)
+    assert ei.value.code == RESUMABLE_EXIT_CODE
+    assert mgr.latest_committed() == 6         # final sync save happened
+    assert mgr.verify(6)
+
+
+# -- DataLoader cursor ------------------------------------------------------
+
+def test_dataloader_cursor_fast_forward():
+    _, loader = build()
+    full = batches_of(loader)
+    assert loader.state_dict() == {"batches_served": len(full)}
+    _, loader2 = build()
+    loader2.set_state_dict({"batches_served": 5})
+    rest = batches_of(loader2)
+    assert len(rest) == len(full) - 5
+    np.testing.assert_array_equal(rest[0]["x"], full[5]["x"])
+    np.testing.assert_array_equal(rest[-1]["y"], full[-1]["y"])
+    # cursor counts skipped batches too, so a resumed pass continues it
+    assert loader2.state_dict() == {"batches_served": len(full)}
+    # the restored cursor is visible IMMEDIATELY, not at the first next():
+    # a checkpoint between restore and the first batch must not persist 0
+    _, loader3 = build()
+    loader3.set_state_dict({"batches_served": 5})
+    assert loader3.state_dict() == {"batches_served": 5}
+
+
+def test_dataloader_cursor_with_device_prefetch():
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(160, 8).astype(np.float32)
+
+    def mk():
+        return DataLoader(TensorDataset([xs]), batch_size=16, shuffle=False,
+                          drop_last=True, prefetch_to_device=True,
+                          collate_fn=lambda it: {
+                              "x": np.stack([i[0] for i in it])})
+
+    full = list(mk())
+    assert len(full) == 10
+    # cursor counts CONSUMED batches only — prefetched-but-unread batches
+    # sitting in the device queue must not advance it
+    l2 = mk()
+    it = iter(l2)
+    for _ in range(3):
+        next(it)
+    assert l2.state_dict() == {"batches_served": 3}
+    it.close()                 # retires the prefetch producer thread
+    l3 = mk()
+    l3.set_state_dict({"batches_served": 3})
+    rest = list(l3)
+    assert len(rest) == 7
+    np.testing.assert_array_equal(np.asarray(rest[0]["x"]),
+                                  np.asarray(full[3]["x"]))
+    assert l3.state_dict() == {"batches_served": 10}
+
+
+@chaosmark
+def test_cursor_accounts_for_skipped_batches(tmp_path):
+    """An anomaly SKIP consumes a batch without keeping the step, so the
+    checkpointed data cursor must track batches SERVED, not the step —
+    otherwise resume replays the poison batch and diverges."""
+    def fit_poisoned(tr, dl, root, **kw):
+        mgr = CheckpointManager(root, save_interval_steps=4)
+        guard = AnomalyGuard(policy="skip", warmup_steps=100)
+        tr.fit(dl, steps=10, log_every=1, checkpoint_manager=mgr,
+               anomaly_guard=guard, **kw)
+        return mgr, guard
+
+    # oracle: uninterrupted run over the poisoned stream with skip policy
+    trA, dlA = build(poison_batch=3)
+    _, gA = fit_poisoned(trA, dlA, str(tmp_path / "a"))
+    assert gA.skips == 1
+
+    # same run preempted AFTER the skip, then auto-resumed
+    trB, dlB = build(poison_batch=3)
+    pre = PreemptionGuard()
+    with pytest.raises(TrainingPreempted):
+        fit_poisoned(trB, dlB, str(tmp_path / "b"), preemption_guard=pre,
+                     on_metrics=lambda m: pre.trigger() if m.step >= 6
+                     else None)
+    trC, dlC = build(seed=17, poison_batch=3)
+    mgrC = CheckpointManager(str(tmp_path / "b"), save_interval_steps=4)
+    guardC = AnomalyGuard(policy="skip", warmup_steps=100)
+    trC.fit(dlC, steps=10, log_every=1, checkpoint_manager=mgrC,
+            anomaly_guard=guardC, resume="auto")
+    assert guardC.anomalies == 0          # the poison batch was NOT replayed
+    assert trC._step == 10
+    assert digest(trC.params) == digest(trA.params)
+
+
+# -- end-to-end: save → crash → auto-resume (the acceptance contract) -------
+
+def _uninterrupted(tmp_path, steps=12):
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=4,
+                            async_save=True)
+    hist = tr.fit(loader, steps=steps, log_every=1, checkpoint_manager=mgr)
+    return digest(tr.params), [m.loss for m in hist]
+
+
+@chaosmark
+def test_e2e_preempt_then_auto_resume_bit_exact(tmp_path):
+    """SIGTERM-mid-fit → final sync checkpoint → relaunch with resume="auto"
+    → params/opt_state/step restored and the finished run is bit-identical
+    to an uninterrupted one."""
+    ref_digest, ref_losses = _uninterrupted(tmp_path / "a")
+
+    root = str(tmp_path / "b")
+    tr1, loader1 = build()
+    mgr1 = CheckpointManager(root, save_interval_steps=4, async_save=True)
+    guard = PreemptionGuard()
+    with pytest.raises(TrainingPreempted):
+        tr1.fit(loader1, steps=12, log_every=1, checkpoint_manager=mgr1,
+                preemption_guard=guard,
+                on_metrics=lambda m: guard.trigger() if m.step >= 6 else None)
+    assert ckpt.latest_step(root) == 6
+
+    # relaunch: DIFFERENT init seed proves state comes from the checkpoint
+    tr2, loader2 = build(seed=99)
+    mgr2 = CheckpointManager(root, save_interval_steps=4, async_save=True)
+    hist2 = tr2.fit(loader2, steps=12, log_every=1, checkpoint_manager=mgr2,
+                    resume="auto")
+    assert tr2._step == 12
+    assert digest(tr2.params) == ref_digest
+    assert [m.step for m in hist2] == list(range(7, 13))
+    assert [m.loss for m in hist2] == ref_losses[6:]
+
+
+@chaosmark
+def test_e2e_sigkill_after_async_save_auto_resume(tmp_path):
+    """Hard death mid-run AFTER an async save: the in-flight (uncommitted)
+    step is quarantined on relaunch and resume restores the newest COMMITTED
+    step, finishing bit-identically to an uninterrupted run."""
+    ref_digest, _ = _uninterrupted(tmp_path / "a")
+
+    class Killed(BaseException):
+        pass
+
+    root = str(tmp_path / "b")
+    tr1, loader1 = build()
+    mgr1 = CheckpointManager(root, save_interval_steps=4, async_save=True)
+
+    def killer(m):
+        if m.step >= 10:
+            raise Killed                 # SIGKILL shape: no finalize, ever
+
+    with pytest.raises(Killed):
+        tr1.fit(loader1, steps=12, log_every=1, checkpoint_manager=mgr1,
+                on_metrics=killer)
+    ckpt.wait_until_finished()           # settle background writes, then die
+    # post-mortem state: step_4 committed at step 8's finalize; step_8's
+    # async save is durable but was never committed
+    assert ckpt.latest_step(root) == 4
+    assert not ckpt.is_complete_checkpoint(os.path.join(root, "step_8"))
+
+    tr2, loader2 = build(seed=7)
+    mgr2 = CheckpointManager(root, save_interval_steps=4, async_save=True)
+    assert any(q.startswith("step_8") for q in mgr2.quarantined())
+    tr2.fit(loader2, steps=12, log_every=1, checkpoint_manager=mgr2,
+            resume="auto")
+    assert tr2._step == 12
+    assert digest(tr2.params) == ref_digest
+
+
+@chaosmark
+def test_e2e_corrupt_newest_falls_back_and_matches(tmp_path):
+    """A deliberately corrupted NEWEST checkpoint is quarantined; resume
+    falls back to the previous step and still converges bit-exactly."""
+    ref_digest, _ = _uninterrupted(tmp_path / "a")
+
+    root = str(tmp_path / "b")
+    tr1, loader1 = build()
+    mgr1 = CheckpointManager(root, save_interval_steps=4)
+    tr1.fit(loader1, steps=8, log_every=1, checkpoint_manager=mgr1)
+    assert mgr1.committed_steps() == [4, 8]
+    chaos.corrupt_checkpoint(mgr1.step_dir(8), mode="flip")
+
+    tr2, loader2 = build(seed=31)
+    mgr2 = CheckpointManager(root, save_interval_steps=4)
+    tr2.fit(loader2, steps=12, log_every=1, checkpoint_manager=mgr2,
+            resume="auto")
+    assert any(q.startswith("step_8-corrupt") for q in mgr2.quarantined())
+    assert tr2._step == 12
+    assert digest(tr2.params) == ref_digest
+
+
+@chaosmark
+def test_resume_restores_lr_scheduler(tmp_path):
+    from paddle_tpu.optimizer.lr import StepDecay
+    root = str(tmp_path)
+    tr1, loader1 = build()
+    sched = StepDecay(learning_rate=0.05, step_size=3, gamma=0.5)
+    tr1.optimizer.set_lr_scheduler(sched)
+    mgr1 = CheckpointManager(root, save_interval_steps=3)
+    tr1.fit(loader1, steps=6, log_every=1, checkpoint_manager=mgr1)
+    lr_after_6 = tr1.optimizer.get_lr()
+
+    tr2, loader2 = build(seed=5)
+    sched2 = StepDecay(learning_rate=0.05, step_size=3, gamma=0.5)
+    tr2.optimizer.set_lr_scheduler(sched2)
+    mgr2 = CheckpointManager(root, save_interval_steps=3)
+    tr2.fit(loader2, steps=6, log_every=1, checkpoint_manager=mgr2,
+            resume="auto")
+    # restored run is already at step 6: scheduler state must match
+    assert tr2.optimizer.get_lr() == pytest.approx(lr_after_6)
+    assert sched2.last_epoch == sched.last_epoch
+
+
+@chaosmark
+def test_resume_restores_adaptive_lr_value(tmp_path):
+    """ReduceOnPlateau's LR is a stateful VALUE (step(epoch=) is a no-op
+    without metrics): resume must restore last_lr itself, not replay the
+    step count."""
+    from paddle_tpu.optimizer.lr import ReduceOnPlateau
+    root = str(tmp_path)
+    tr1, loader1 = build()
+    sched = ReduceOnPlateau(learning_rate=0.05, factor=0.1)
+    tr1.optimizer.set_lr_scheduler(sched)
+    sched.last_lr = 0.005          # "decayed" by earlier plateau steps
+    mgr1 = CheckpointManager(root, save_interval_steps=3)
+    tr1.fit(loader1, steps=6, log_every=1, checkpoint_manager=mgr1)
+
+    tr2, loader2 = build(seed=5)
+    sched2 = ReduceOnPlateau(learning_rate=0.05, factor=0.1)
+    tr2.optimizer.set_lr_scheduler(sched2)
+    mgr2 = CheckpointManager(root, save_interval_steps=3)
+    tr2.fit(loader2, steps=6, log_every=1, checkpoint_manager=mgr2,
+            resume="auto")
+    assert tr2.optimizer.get_lr() == pytest.approx(0.005)  # not reset to 0.05
+
+
+def test_skip_policy_requires_donate_false():
+    tr, loader = build()
+    tr._donate = True              # the Trainer default this guards against
+    with pytest.raises(ValueError, match="donate=False"):
+        tr.fit(loader, steps=2, anomaly_guard=AnomalyGuard(policy="skip"))
+
+
+# -- real multi-process kill/relaunch (slow tier) ---------------------------
+
+def _chaos_result(proc, timeout=240):
+    out, _ = proc.communicate(timeout=timeout)
+    text = out.decode(errors="replace")
+    for line in text.splitlines():
+        if line.startswith("CHAOS_RESULT "):
+            return proc.returncode, json.loads(line[len("CHAOS_RESULT "):])
+    return proc.returncode, None
+
+
+@chaosmark
+@pytest.mark.slow
+def test_subprocess_sigkill_resume_bit_exact(tmp_path):
+    rc, ref = _chaos_result(chaos.spawn_trainer(
+        str(tmp_path / "a"), steps=14,
+        extra_args=["--save-interval", "4", "--async-save"]))
+    assert rc == 0 and ref is not None
+
+    root = str(tmp_path / "b")
+    rc, res = _chaos_result(chaos.spawn_trainer(
+        root, steps=14,
+        extra_args=["--save-interval", "4", "--async-save",
+                    "--hard-exit-at", "9"]))
+    assert rc == 137 and res is None
+    rc, res = _chaos_result(chaos.spawn_trainer(
+        root, steps=14, extra_args=["--save-interval", "4", "--async-save"]))
+    assert rc == 0
+    assert res["step"] == 14
+    assert res["digest"] == ref["digest"]
+
+
+@chaosmark
+@pytest.mark.slow
+def test_subprocess_sigterm_exits_resumable_then_resumes(tmp_path):
+    rc, ref = _chaos_result(chaos.spawn_trainer(
+        str(tmp_path / "a"), steps=14, extra_args=["--save-interval", "4"]))
+    assert rc == 0
+
+    root = str(tmp_path / "b")
+    rc, res = _chaos_result(chaos.spawn_trainer(
+        root, steps=14,
+        extra_args=["--save-interval", "4", "--self-sigterm-at", "6"]))
+    assert rc == RESUMABLE_EXIT_CODE           # the relauncher's contract
+    rc, res = _chaos_result(chaos.spawn_trainer(
+        root, steps=14, extra_args=["--save-interval", "4"]))
+    assert rc == 0
+    assert res["step"] == 14
+    assert res["digest"] == ref["digest"]
