@@ -53,7 +53,7 @@ _T0 = time.perf_counter()
 from paddle_tpu.utils.hw_probe import force_host_sync as _sync
 
 
-def _make_loader(cfg, batch_size, seq_len, steps):
+def _make_loader(cfg, batch_size, seq_len, steps, extra_batches=4):
     """Synthetic LM batches through the real input pipeline (worker
     threads, collate, device prefetch)."""
     import numpy as np
@@ -61,7 +61,7 @@ def _make_loader(cfg, batch_size, seq_len, steps):
 
     class SyntheticLM(Dataset):
         def __len__(self):
-            return batch_size * (steps + 4)
+            return batch_size * (steps + extra_batches)
 
         def __getitem__(self, i):
             rs = np.random.RandomState(i)
@@ -73,9 +73,10 @@ def _make_loader(cfg, batch_size, seq_len, steps):
                       drop_last=True)
 
 
-def _train_bench(cfg, batch_size, seq_len, steps, warmup):
+def _train_bench(cfg, batch_size, seq_len, steps, warmup,
+                 superstep_probe=False):
     """Returns (tokens_per_sec_total, step_time_s, input_stall_s, loss,
-    model, fenced_per_step_times)."""
+    model, fenced_per_step_times, superstep_detail)."""
     import jax
 
     import paddle_tpu as pt
@@ -88,7 +89,9 @@ def _train_bench(cfg, batch_size, seq_len, steps, warmup):
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01, parameters=model)
     tr = Trainer(model, opt)
 
-    loader = _make_loader(cfg, batch_size, seq_len, steps + warmup)
+    # the superstep A/B leg consumes K(warm) + 2*n_ab extra batches
+    loader = _make_loader(cfg, batch_size, seq_len, steps + warmup,
+                          extra_batches=4 + (24 if superstep_probe else 0))
     it = iter(loader)
 
     loss = None
@@ -125,9 +128,46 @@ def _train_bench(cfg, batch_size, seq_len, steps, warmup):
     except Exception as e:
         _log(f"fenced-step loop failed (headline kept): {e}")
 
+    # superstep A/B (ISSUE 2): per-step HOST dispatch overhead (wall time
+    # spent enqueueing compiled programs, not waiting on them) with K=1 vs
+    # K=4 over the same trainer — the amortization the superstep runtime
+    # exists for. Never lets a probe failure touch the headline.
+    superstep = {}
+    if superstep_probe:
+        try:
+            K, n_ab = 4, 8
+            _log("superstep: compiling K=4 scan")
+            warm = [next(it) for _ in range(K)]
+            tr.fit(iter(warm), steps=K, log_every=10 ** 9,
+                   steps_per_dispatch=K)          # compile off the clock
+            ab1 = [next(it) for _ in range(n_ab)]
+            abk = [next(it) for _ in range(n_ab)]
+            _log("superstep: timing K=1 vs K=4 dispatch overhead")
+            tr.dispatch_stats = {"steps": 0, "dispatches": 0,
+                                 "dispatch_host_s": 0.0}
+            tr.fit(iter(ab1), steps=n_ab, log_every=10 ** 9)
+            o1 = (tr.dispatch_stats["dispatch_host_s"]
+                  / max(tr.dispatch_stats["steps"], 1))
+            tr.dispatch_stats = {"steps": 0, "dispatches": 0,
+                                 "dispatch_host_s": 0.0}
+            tr.fit(iter(abk), steps=n_ab, log_every=10 ** 9,
+                   steps_per_dispatch=K)
+            ok = (tr.dispatch_stats["dispatch_host_s"]
+                  / max(tr.dispatch_stats["steps"], 1))
+            superstep = {
+                "steps_per_dispatch": K,
+                "dispatch_overhead_s_per_step_k1": round(o1, 7),
+                f"dispatch_overhead_s_per_step_k{K}": round(ok, 7),
+                # headline key = the superstep value (K>1 must beat k1)
+                "dispatch_overhead_s_per_step": round(ok, 7),
+            }
+        except Exception as e:
+            superstep = {"superstep_error":
+                         f"{type(e).__name__}: {str(e)[:150]}"}
+
     tokens = batch_size * seq_len * steps
     return (tokens / dt, dt / steps, stall / steps, float(loss),
-            model, per_step)
+            model, per_step, superstep)
 
 
 def _spawn_probe(strip_flags):
@@ -637,8 +677,8 @@ def _decode_bench(cfg, on_tpu):
                            recompute=lrec)
                 _log(f"long-context: compiling s=8192 b={lb} recompute={lrec}")
                 try:
-                    ltps, lstep, _stall, _loss, lmodel, _ps = _train_bench(
-                        lcfg, lb, 8192, 5, 2)
+                    (ltps, lstep, _stall, _loss, lmodel,
+                     _ps, _ss) = _train_bench(lcfg, lb, 8192, 5, 2)
                     break
                 except Exception as e:
                     # clear frame locals: the traceback pins the failed
@@ -867,8 +907,9 @@ def _run(error_note):
     for tier, apply in attempts:
         apply()
         try:
-            tps, step_s, stall_s, loss, model, per_step = _train_bench(
-                cfg, batch_size, seq_len, steps, warmup)
+            (tps, step_s, stall_s, loss, model, per_step,
+             superstep) = _train_bench(cfg, batch_size, seq_len, steps,
+                                       warmup, superstep_probe=True)
             if tier != "as-configured":
                 note = (f"degraded to {tier} after: "
                         f"{type(last_exc).__name__}: {str(last_exc)[:200]}")
@@ -937,6 +978,12 @@ def _run(error_note):
         "mfu_fenced_causal": mfu_fenced_causal,
         "final_loss": loss,
     }
+    detail.update(superstep)
+    # compile/AOT cache counters (core/compile_cache.py): hit/miss across
+    # this whole process — miss-only means cold; persistent_dir records
+    # whether PT_COMPILE_CACHE_DIR wiring was active for this run
+    from paddle_tpu.core import compile_cache
+    detail["compile_cache"] = compile_cache.stats()
     # degraded = any ladder tier beyond as-configured (recompute=full
     # mutation or pallas-off): the A/B legs would differ in more than flags
     detail.update(_overlap_ab(on_tpu, degraded=(tier != "as-configured")))

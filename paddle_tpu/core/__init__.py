@@ -1,5 +1,10 @@
-"""paddle_tpu.core — flags, dtypes, RNG."""
+"""paddle_tpu.core — flags, dtypes, RNG, compile cache."""
 
-from . import dtype, flags, rng
+from . import compile_cache, dtype, flags, rng
+from .compile_cache import configure_compilation_cache
 from .flags import set_flags, get_flags, define_flag
 from .rng import seed, rng_tracker
+
+# opt-in persistent XLA compile cache: strict no-op unless
+# PT_COMPILE_CACHE_DIR is set in the environment (see compile_cache.py)
+configure_compilation_cache()

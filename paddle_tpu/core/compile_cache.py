@@ -1,0 +1,293 @@
+"""Persistent compile / AOT cache for jitted training steps.
+
+Reference analogue: the new executor's program cache + CINN's compiled-kernel
+serialization (SURVEY §L5) — a process start must not re-pay tracing and XLA
+compilation for a step function it has compiled before. Three layers, each
+opt-in and independently useful:
+
+1. **In-process executable cache** — ``acquire()`` maps a *fingerprint*
+   (model/optimizer structure + hyperparameters + argument avals + backend)
+   to a ``jax.stages.Compiled`` executable. A second cold construction of
+   the same step function (fresh ``Trainer`` over an identically-shaped
+   model) reuses the executable: no retrace, no recompile. Hit/miss/trace
+   counters make this testable.
+
+2. **On-disk AOT artifacts** — ``save_aot``/``load_aot`` serialize the step
+   via ``jax.export`` next to the checkpoint directory, so a preempted
+   worker's relaunch deserializes StableHLO instead of re-tracing Python.
+   Artifacts are keyed by the same fingerprint (stored in a sidecar meta
+   JSON) plus the jax version and backend; any mismatch falls through to a
+   normal compile — a stale artifact can never produce wrong numerics.
+
+3. **XLA persistent compilation cache** — ``configure_compilation_cache``
+   wires ``jax_compilation_cache_dir`` (env ``PT_COMPILE_CACHE_DIR`` or an
+   explicit path) so even the StableHLO→executable step is disk-cached
+   across processes. Strictly a no-op when no directory is configured.
+
+Fingerprints are deliberately conservative: model class + config scalars +
+sublayer structure + optimizer class/hyperparameters + donation/accumulation
+flags + full argument aval signature. Anything that changes the traced
+program should change the fingerprint; anything that doesn't (buffer
+contents, devices' wall clock) must not.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "acquire", "aval_signature", "fingerprint", "configure_compilation_cache",
+    "save_aot", "load_aot", "stats", "reset_stats", "clear", "note_trace",
+]
+
+_LOCK = threading.Lock()
+_EXECUTABLES: "OrderedDict[str, Any]" = OrderedDict()
+_MAX_EXECUTABLES = 64
+
+_STATS = {"hits": 0, "misses": 0, "aot_hits": 0, "traces": 0}
+_PERSISTENT_DIR: Optional[str] = None
+
+AOT_META_SUFFIX = ".meta.json"
+AOT_BIN_SUFFIX = ".stablehlo.bin"
+
+
+def note_trace() -> None:
+    """Called from inside step-function bodies: increments once per Python
+    trace (jit retrace, scan-body trace, export trace). The proof counter
+    for "this path did not rebuild"."""
+    with _LOCK:
+        _STATS["traces"] += 1
+
+
+def stats() -> Dict[str, Any]:
+    with _LOCK:
+        out = dict(_STATS)
+    out["persistent_dir"] = _PERSISTENT_DIR
+    out["executables"] = len(_EXECUTABLES)
+    return out
+
+
+def reset_stats() -> None:
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def clear() -> None:
+    """Drop cached executables + counters (tests use this to simulate a
+    process restart without spawning one)."""
+    with _LOCK:
+        _EXECUTABLES.clear()
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+# -- fingerprinting ----------------------------------------------------------
+
+def aval_signature(tree) -> Tuple:
+    """Stable (treedef, shape, dtype, sharding) signature of a pytree of
+    arrays / ShapeDtypeStructs — the dynamic half of a fingerprint.
+    Sharding is part of the key: a Compiled executable is specialized to
+    its inputs' placement, and two same-shape trainers on different meshes
+    must not share one. Python-scalar leaves (jit-legal weak-typed args)
+    key on their TYPE, not value — jit does not bake the value either."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    sig = tuple(
+        (str(l.shape), str(l.dtype), str(getattr(l, "sharding", None)))
+        if hasattr(l, "shape") and hasattr(l, "dtype")
+        else ("py", type(l).__name__)
+        for l in leaves)
+    return (str(treedef), sig)
+
+
+def to_avals(tree):
+    """Sharding-preserving aval view of a pytree: arrays become
+    ShapeDtypeStructs carrying their placement (a Compiled executable is
+    placement-specialized); python scalars pass through unchanged
+    (jit-legal weak-typed arguments). The ONE conversion used by both the
+    AOT serializer and Trainer.precompile, so the artifact and the
+    in-process executable can never diverge."""
+    import jax
+
+    def conv(l):
+        if hasattr(l, "shape") and hasattr(l, "dtype"):
+            return jax.ShapeDtypeStruct(
+                l.shape, l.dtype, sharding=getattr(l, "sharding", None))
+        return l
+    return jax.tree.map(conv, tree)
+
+
+def fingerprint(parts) -> str:
+    """sha256 over a JSON rendering of ``parts`` (nested tuples/dicts of
+    scalars and strings)."""
+    blob = json.dumps(parts, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# -- in-process executable cache ---------------------------------------------
+
+def _store(fp: str, fn) -> None:
+    with _LOCK:
+        _EXECUTABLES[fp] = fn
+        _EXECUTABLES.move_to_end(fp)
+        while len(_EXECUTABLES) > _MAX_EXECUTABLES:
+            _EXECUTABLES.popitem(last=False)
+
+
+def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
+            name: str = "step", save_artifact: bool = False,
+            donate_argnums: Tuple[int, ...] = ()):
+    """Return ``(callable, outcome)`` for fingerprint ``fp``.
+
+    Lookup order: in-process executable ("hit") → serialized AOT artifact
+    under ``aot_dir`` ("aot_hit") → lower+compile ``jitted`` on ``args``
+    ("miss", optionally writing the artifact). ``args`` may be concrete
+    arrays or ShapeDtypeStructs. ``donate_argnums`` re-establishes buffer
+    donation on the deserialized-artifact path (jax.export's call wrapper
+    does not inherit the original jit's donation). If AOT lowering is
+    unavailable for this function/backend the live jitted callable is
+    cached instead — caching never changes semantics, only who pays the
+    compile.
+    """
+    with _LOCK:
+        fn = _EXECUTABLES.get(fp)
+        if fn is not None:
+            _EXECUTABLES.move_to_end(fp)
+            _STATS["hits"] += 1
+            hit = fn
+        else:
+            hit = None
+    if hit is not None:
+        if aot_dir and save_artifact and not _artifact_matches(
+                aot_dir, name, fp):
+            # precompile-after-train: the executable was already resident,
+            # but the restart artifact must still land on disk
+            try:
+                save_aot(aot_dir, name, fp, jitted, args)
+            except Exception:
+                pass
+        return hit, "hit"
+    if aot_dir:
+        fn = load_aot(aot_dir, name, fp, donate_argnums=donate_argnums)
+        if fn is not None:
+            _store(fp, fn)
+            with _LOCK:
+                _STATS["aot_hits"] += 1
+            return fn, "aot_hit"
+    try:
+        fn = jitted.lower(*args).compile()
+    except Exception:
+        # exotic arg types: fall back to live dispatch WITHOUT caching —
+        # the jitted closure pins its Trainer's model/optimizer, and a
+        # process-global cache entry would leak that graph (and alias it
+        # into fingerprint-equal later Trainers)
+        with _LOCK:
+            _STATS["misses"] += 1
+        return jitted, "miss"
+    with _LOCK:
+        _STATS["misses"] += 1
+    if aot_dir and save_artifact:
+        try:
+            save_aot(aot_dir, name, fp, jitted, args)
+        except Exception:
+            pass             # artifact write is best-effort, never fatal
+    _store(fp, fn)
+    return fn, "miss"
+
+
+# -- on-disk AOT artifacts (jax.export) --------------------------------------
+
+def _artifact_base(aot_dir: str, name: str) -> str:
+    return os.path.join(aot_dir, f"aot_{name}")
+
+
+def _artifact_matches(aot_dir: str, name: str, fp: str) -> bool:
+    try:
+        with open(_artifact_base(aot_dir, name) + AOT_META_SUFFIX) as f:
+            return json.load(f).get("fingerprint") == fp
+    except Exception:
+        return False
+
+
+def save_aot(aot_dir: str, name: str, fp: str, jitted, args) -> str:
+    """Serialize ``jitted`` specialized to ``args``' avals via ``jax.export``
+    and write it (plus a meta sidecar carrying the fingerprint) under
+    ``aot_dir``. Returns the artifact path."""
+    import jax
+    from jax import export
+
+    exp = export.export(jitted)(*to_avals(args))
+    data = exp.serialize()
+    os.makedirs(aot_dir, exist_ok=True)
+    base = _artifact_base(aot_dir, name)
+    tmp = base + AOT_BIN_SUFFIX + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, base + AOT_BIN_SUFFIX)
+    meta = {"fingerprint": fp, "jax_version": jax.__version__,
+            "backend": jax.default_backend(), "name": name}
+    tmp = base + AOT_META_SUFFIX + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    os.replace(tmp, base + AOT_META_SUFFIX)
+    return base + AOT_BIN_SUFFIX
+
+
+def load_aot(aot_dir: str, name: str, fp: str,
+             donate_argnums: Tuple[int, ...] = ()):
+    """Deserialize the ``name`` artifact if its meta matches ``fp`` (and the
+    current jax version/backend); returns a jitted callable or None. A
+    mismatched or unreadable artifact is ignored — the caller compiles.
+    ``donate_argnums`` must restate the original jit's donation: the
+    exported call wrapper does not carry it, and silently dropping it
+    would double the params+opt-state HBM footprint on the resume path."""
+    import jax
+    from jax import export
+
+    base = _artifact_base(aot_dir, name)
+    try:
+        with open(base + AOT_META_SUFFIX) as f:
+            meta = json.load(f)
+        if (meta.get("fingerprint") != fp
+                or meta.get("jax_version") != jax.__version__
+                or meta.get("backend") != jax.default_backend()):
+            return None
+        with open(base + AOT_BIN_SUFFIX, "rb") as f:
+            data = f.read()
+        exported = export.deserialize(data)
+        # jit the calling convention once; the original Python body is
+        # never re-traced (note_trace() stays untouched on this path)
+        return jax.jit(exported.call, donate_argnums=donate_argnums)
+    except Exception:
+        return None
+
+
+# -- XLA persistent compilation cache ----------------------------------------
+
+def configure_compilation_cache(cache_dir: Optional[str] = None) -> bool:
+    """Opt-in wiring of jax's persistent compilation cache.
+
+    ``cache_dir`` defaults to env ``PT_COMPILE_CACHE_DIR``. When neither is
+    set this is a strict NO-OP (returns False, jax config untouched) —
+    guaranteed by test_superstep. When set, every XLA compile is disk-cached
+    so process restarts (preemption resume!) skip compilation entirely.
+    """
+    global _PERSISTENT_DIR
+    cache_dir = cache_dir or os.environ.get("PT_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default thresholds skip "cheap" compiles; a resume wants everything
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _PERSISTENT_DIR = cache_dir
+    return True

@@ -10,12 +10,13 @@ from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
 from .sampler import (Sampler, SequenceSampler, RandomSampler, SubsetRandomSampler,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn, get_worker_info, WorkerInfo
+from .dataloader import (DataLoader, default_collate_fn, get_worker_info,
+                         WorkerInfo, stack_batches, superbatches)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler",
-    "DataLoader", "default_collate_fn",
+    "DataLoader", "default_collate_fn", "stack_batches", "superbatches",
 ]

@@ -57,6 +57,36 @@ def default_collate_fn(batch):
         return list(batch)
 
 
+def stack_batches(batches):
+    """Stack a list of per-step batches into one superstep feed: every leaf
+    gains a leading ``[K, ...]`` dispatch dimension (the trainer's
+    ``fit(steps_per_dispatch=K)`` scans over it). Stacking happens with
+    jnp so device-prefetched batches stay on device — no host round trip.
+    Composes with gradient accumulation: ``[A, ...]`` microbatch arrays
+    stack to ``[K, A, ...]``."""
+    import jax
+    import jax.numpy as jnp
+
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def superbatches(iterable, k: int, drop_last: bool = False):
+    """Group an iterable of batches into stacked superstep feeds of ``k``
+    (the final partial group is yielded unstacked-shorter unless
+    ``drop_last``). Useful for feeding ``Trainer.fit(steps_per_dispatch=k)``
+    from a pipeline that wants the stacking off the training thread."""
+    buf = []
+    for b in iterable:
+        buf.append(b)
+        if len(buf) == k:
+            yield stack_batches(buf)
+            buf = []
+    if buf and not drop_last:
+        yield stack_batches(buf)
+
+
 def _fetch_map(dataset, indices, collate_fn):
     return collate_fn([dataset[i] for i in indices])
 
@@ -384,6 +414,12 @@ class DataLoader:
         DataLoader is callable and returns its iterator
         (python/paddle/io/reader.py doctest usage)."""
         return iter(self)
+
+    def superbatches(self, k: int, drop_last: bool = False):
+        """Iterate stacked superstep feeds of ``k`` batches each (see
+        :func:`stack_batches`). The cursor (``batches_served``) still counts
+        MICRObatches, so checkpoint resume positions are step-granular."""
+        return superbatches(iter(self), k, drop_last=drop_last)
 
     def __iter__(self):
         skip = self._skip_batches

@@ -50,8 +50,16 @@ class TuneDB:
             try:
                 with open(path) as f:
                     self._db.update(json.load(f))
-            except (OSError, ValueError):
-                pass
+            except OSError:
+                pass      # absent DB is normal (no offline sweep run yet)
+            except ValueError as e:
+                # corrupt JSON: merging nothing SILENTLY would make
+                # offline-tuned configs vanish without a trace — say so once
+                import warnings
+                warnings.warn(
+                    f"ignoring corrupt kernel tune DB at {path} ({e}); "
+                    f"offline-tuned configs from that file will not be "
+                    f"applied", RuntimeWarning, stacklevel=2)
         self._loaded = True
 
     @staticmethod

@@ -19,6 +19,13 @@ _SCHED_SERIAL = [0]   # names must stay unique after collection
 
 
 class LRScheduler:
+    #: True when :meth:`lr_of` is a pure jnp-traceable function of ``step``
+    #: (closed-form schedule) — the Trainer then evaluates the LR *inside*
+    #: the compiled step/superstep instead of transferring a host scalar.
+    #: May be overridden per-instance (e.g. LinearWarmup wrapping a
+    #: non-functional scheduler).
+    functional = False
+
     def __init__(self, learning_rate: float = 0.1, last_epoch: int = -1, verbose=False):
         self.base_lr = learning_rate
         self.last_epoch = last_epoch
@@ -42,6 +49,25 @@ class LRScheduler:
 
     def get_last_lr(self) -> float:
         return self.last_lr
+
+    def lr_of(self, step):
+        """Functional view of the schedule: the LR this scheduler applies at
+        trainer step ``step`` (i.e. ``get_lr()`` with ``last_epoch=step``),
+        WITHOUT mutating scheduler state.
+
+        The base implementation evaluates host-side (works for every
+        closed-form scheduler; stateful ones like ReduceOnPlateau simply
+        return their current LR for any step). Schedulers with
+        ``functional = True`` override it with a jnp-traceable version so a
+        compiled (super)step can derive the LR on-device from the step
+        counter — zero host→device LR transfers.
+        """
+        prev_epoch, prev_lr = self.last_epoch, self.last_lr
+        try:
+            self.last_epoch = int(step)
+            return float(self.get_lr())
+        finally:
+            self.last_epoch, self.last_lr = prev_epoch, prev_lr
 
     def state_dict(self):
         return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
@@ -70,6 +96,16 @@ class NoamDecay(LRScheduler):
         return (self.base_lr * self.d_model ** -0.5 *
                 min(step ** -0.5, step * self.warmup_steps ** -1.5))
 
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.maximum(jnp.asarray(step, jnp.float32), 1.0)
+        return jnp.asarray(
+            self.base_lr * self.d_model ** -0.5
+            * jnp.minimum(s ** -0.5, s * self.warmup_steps ** -1.5),
+            jnp.float32)
+
 
 class PiecewiseDecay(LRScheduler):
     def __init__(self, boundaries: Sequence[int], values: Sequence[float],
@@ -84,6 +120,14 @@ class PiecewiseDecay(LRScheduler):
                 return v
         return self.values[len(self.boundaries)]
 
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries, jnp.int32),
+                               jnp.asarray(step, jnp.int32), side="right")
+        return jnp.asarray(self.values, jnp.float32)[idx]
+
 
 class NaturalExpDecay(LRScheduler):
     def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
@@ -93,6 +137,14 @@ class NaturalExpDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr * math.exp(-self.gamma * self.last_epoch)
+
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        return jnp.asarray(self.base_lr * jnp.exp(-self.gamma * s),
+                           jnp.float32)
 
 
 class ExponentialDecay(LRScheduler):
@@ -104,6 +156,13 @@ class ExponentialDecay(LRScheduler):
     def get_lr(self):
         return self.base_lr * self.gamma ** self.last_epoch
 
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        return jnp.asarray(self.base_lr * self.gamma ** s, jnp.float32)
+
 
 class InverseTimeDecay(LRScheduler):
     def __init__(self, learning_rate: float, gamma: float, last_epoch: int = -1,
@@ -113,6 +172,13 @@ class InverseTimeDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        return jnp.asarray(self.base_lr / (1 + self.gamma * s), jnp.float32)
 
 
 class PolynomialDecay(LRScheduler):
@@ -136,6 +202,21 @@ class PolynomialDecay(LRScheduler):
         return ((self.base_lr - self.end_lr) *
                 (1 - step / decay_steps) ** self.power + self.end_lr)
 
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            div = jnp.maximum(jnp.ceil(s / self.decay_steps), 1.0)
+            decay = self.decay_steps * div
+        else:
+            decay = jnp.asarray(self.decay_steps, jnp.float32)
+            s = jnp.minimum(s, decay)
+        return jnp.asarray(
+            (self.base_lr - self.end_lr) * (1 - s / decay) ** self.power
+            + self.end_lr, jnp.float32)
+
 
 class LinearWarmup(LRScheduler):
     def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
@@ -144,6 +225,10 @@ class LinearWarmup(LRScheduler):
         self.warmup_steps = warmup_steps
         self.start_lr = start_lr
         self.end_lr = end_lr
+        # functional iff the post-warmup target is (the warmup ramp itself
+        # is closed-form; a wrapped stateful scheduler pins us host-side)
+        self.functional = (not isinstance(learning_rate, LRScheduler)
+                           or getattr(learning_rate, "functional", False))
         super().__init__(start_lr, last_epoch, verbose)
 
     def get_lr(self):
@@ -154,6 +239,30 @@ class LinearWarmup(LRScheduler):
             self.lr_after.step(self.last_epoch - self.warmup_steps)
             return self.lr_after.get_last_lr()
         return self.lr_after
+
+    def lr_of(self, step):
+        if not self.functional:
+            # host fallback; get_lr() advances the wrapped scheduler, so
+            # snapshot+restore its FULL state around the probe —
+            # state_dict() alone misses e.g. ReduceOnPlateau's
+            # best/num_bad/cooldown_counter, which the probe would corrupt
+            inner = {k: (list(v) if isinstance(v, list) else v)
+                     for k, v in vars(self.lr_after).items()}
+            try:
+                return super().lr_of(step)
+            finally:
+                self.lr_after.__dict__.update(inner)
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        warm = ((self.end_lr - self.start_lr) * s
+                / max(self.warmup_steps, 1) + self.start_lr)
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.lr_of(
+                jnp.asarray(step, jnp.int32) - self.warmup_steps)
+        else:
+            after = jnp.asarray(self.lr_after, jnp.float32)
+        return jnp.asarray(jnp.where(s < self.warmup_steps, warm, after),
+                           jnp.float32)
 
 
 class CosineAnnealingDecay(LRScheduler):
@@ -167,6 +276,15 @@ class CosineAnnealingDecay(LRScheduler):
         return (self.eta_min + (self.base_lr - self.eta_min) *
                 (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2)
 
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        s = jnp.asarray(step, jnp.float32)
+        return jnp.asarray(
+            self.eta_min + (self.base_lr - self.eta_min)
+            * (1 + jnp.cos(jnp.pi * s / self.T_max)) / 2, jnp.float32)
+
 
 class StepDecay(LRScheduler):
     def __init__(self, learning_rate: float, step_size: int, gamma: float = 0.1,
@@ -177,6 +295,14 @@ class StepDecay(LRScheduler):
 
     def get_lr(self):
         return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
+
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        n = (jnp.asarray(step, jnp.int32) // self.step_size).astype(
+            jnp.float32)
+        return jnp.asarray(self.base_lr * self.gamma ** n, jnp.float32)
 
 
 class MultiStepDecay(LRScheduler):
@@ -189,6 +315,15 @@ class MultiStepDecay(LRScheduler):
     def get_lr(self):
         n = sum(1 for m in self.milestones if self.last_epoch >= m)
         return self.base_lr * self.gamma ** n
+
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        n = jnp.sum(jnp.asarray(step, jnp.int32)
+                    >= jnp.asarray(self.milestones, jnp.int32)).astype(
+            jnp.float32)
+        return jnp.asarray(self.base_lr * self.gamma ** n, jnp.float32)
 
 
 class LambdaDecay(LRScheduler):
@@ -441,6 +576,16 @@ class LinearLR(LRScheduler):
         factor = self.start_factor + (self.end_factor
                                       - self.start_factor) * frac
         return self.base_lr * factor
+
+    functional = True
+
+    def lr_of(self, step):
+        import jax.numpy as jnp
+        t = jnp.minimum(jnp.asarray(step, jnp.float32),
+                        float(self.total_steps))
+        factor = (self.start_factor + (self.end_factor - self.start_factor)
+                  * t / float(self.total_steps))
+        return jnp.asarray(self.base_lr * factor, jnp.float32)
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

@@ -16,13 +16,23 @@ Both recovery policies carry a **bounded budget** (``max_skips`` /
 ``max_rollbacks``): a persistent divergence exhausts it and the run fails
 loudly instead of silently replaying the same collapse forever.
 
-Detection is host-side and adds no device computation, but it does force a
-device→host sync of the loss scalar EVERY step (the plain metrics loop only
-syncs every ``log_every``), trading some async-dispatch overlap for
-step-granular detection — the point of the guard is that one poisoned
-update never reaches step N+1. Spike test: after ``warmup_steps`` accepted
-losses, ``loss > ewma + spike_factor * ewma_dev`` (EWMA of absolute
-deviation — a cheap robust scale estimate) flags an anomaly; NaN/Inf flags
+Detection is host-side and adds no device computation. How often it forces
+a device→host sync of the loss is policy-dependent:
+
+* ``policy="skip"`` fences EVERY step — undoing a poisoned update needs the
+  pre-step references held from before the NEXT step runs, so the verdict
+  must land before the next dispatch. That per-step fence is the price of
+  checkpoint-free recovery, and the Trainer keeps it regardless of
+  ``check_every``.
+* ``policy="rollback"``/``"abort"`` can consume a batched **loss window**:
+  set ``check_every=W`` and the Trainer stacks W device losses and performs
+  ONE sync per window (and per superstep drain), keeping async dispatch
+  overlap. Detection latency grows to ≤W steps, which rollback absorbs by
+  construction — it restores the last good checkpoint either way.
+
+Spike test: after ``warmup_steps`` accepted losses,
+``loss > ewma + spike_factor * ewma_dev`` (EWMA of absolute deviation — a
+cheap robust scale estimate) flags an anomaly; NaN/Inf flags
 unconditionally, warmup included.
 """
 
@@ -50,10 +60,13 @@ class AnomalyGuard:
     def __init__(self, policy: str = ROLLBACK, *, spike_factor: float = 6.0,
                  ewma_alpha: float = 0.05, warmup_steps: int = 20,
                  max_skips: int = 10, max_rollbacks: int = 3,
-                 min_rel_dev: float = 1e-3):
+                 min_rel_dev: float = 1e-3, check_every: int = 1):
         if policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}")
         self.policy = policy
+        # loss-window size for batched verdicts (ignored — per-step — when
+        # policy="skip"; see module docstring)
+        self.check_every = max(1, int(check_every))
         self.min_rel_dev = float(min_rel_dev)
         self.spike_factor = float(spike_factor)
         self.ewma_alpha = float(ewma_alpha)

@@ -4,7 +4,24 @@ Reference analogue: the hapi Model.fit loop (python/paddle/hapi/model.py:1756)
 + fleet's hybrid training step (SURVEY.md §3.3), redesigned around one jitted
 functional step: params/opt-state are donated pytrees, the loss fn comes from
 the Layer functional bridge, randomness enters as a key argument, and the LR
-is a scalar argument (scheduler stays host-side, never retraces).
+is either a pure on-device function of the step counter (functional
+schedulers) or a cached scalar argument.
+
+**Superstep dispatch** (reference analogue: the new executor's async
+dispatch + GradientMerge, SURVEY §L5): ``fit(steps_per_dispatch=K)`` fuses K
+optimizer steps into ONE compiled ``lax.scan`` over a device-stacked batch
+feed. Per-step host work — key creation, LR transfer, loss fence — leaves
+the critical path entirely: PRNG keys derive on-device via
+``fold_in(base_key, step)`` from the opt-state step counter, the LR is
+evaluated in-jit (``scheduler.lr_of(step)``), and per-step losses accumulate
+into a device array the host fetches in batches at log/anomaly/checkpoint
+boundaries only. The scan body IS the per-step function, so K>1 is
+bit-identical to K=1.
+
+**Compile/AOT cache** (core/compile_cache.py): step executables are cached
+process-wide by a structural fingerprint; ``precompile()`` AOT-lowers and
+serializes them via ``jax.export`` next to the checkpoint dir so a resumed
+worker restarts without re-tracing.
 
 MFU = achieved_flops / peak_flops, with model FLOPs from
 ``model.flops_per_token`` (PaLM convention) and per-chip peak from a small
@@ -14,15 +31,16 @@ from day one).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import compile_cache
 from ..core.rng import rng_tracker
 from ..nn.layer import Layer
 from ..optimizer.optimizer import Optimizer
@@ -71,16 +89,24 @@ class Trainer:
     device for the (donated) update and pushes the result back, one
     batched transfer each way. Device HBM then holds params+grads+acts
     plus only a transient optimizer copy — the TPU analogue of the
-    reference's GroupSharded CPU offload."""
+    reference's GroupSharded CPU offload.
+
+    ``seed`` fixes the base PRNG key; step keys derive on-device as
+    ``fold_in(key(seed), step)`` so neither the per-step nor the superstep
+    path ever creates a key host-side."""
 
     def __init__(self, model: Layer, optimizer: Optimizer,
                  loss_key: Optional[str] = None, donate: bool = True,
                  accumulate_steps: int = 1,
-                 offload_opt_state: Optional[bool] = None):
+                 offload_opt_state: Optional[bool] = None,
+                 seed: int = 0):
         self.model = model
         self.optimizer = optimizer
         self._named = dict(model.named_parameters())
-        self.params = model.raw_parameters()
+        # plain dict, not raw_parameters()' OrderedDict: apply_gradients
+        # rebuilds plain dicts, and a treedef flip between the first and
+        # second dispatch would cost a spurious recompile
+        self.params = dict(model.raw_parameters())
         self.opt_state = optimizer.init_state(self.params)
         # None = inherit from the optimizer flag (group_sharded_parallel /
         # fleet set it); an explicit True/False always wins, including over
@@ -92,12 +118,30 @@ class Trainer:
         self._offload = bool(offload_opt_state)
         if self._offload:
             self.opt_state = self._place_opt_state("pinned_host")
-        self._step_fn = None
         self._donate = donate
         self._step = 0
+        self._seed = int(seed)
         self._peak = device_peak_flops()
         self._watchdog = None
         self.accumulate_steps = max(1, int(accumulate_steps))
+        # compiled-step machinery (built lazily on first dispatch)
+        self._one_step = None          # shared python body (step == scan body)
+        self._lr_fn = None
+        self._step_jit = None
+        self._superstep_jit = None
+        self._step_exec: Dict = {}     # aval-signature -> compiled callable
+        self._superstep_exec: Dict = {}
+        self._fast_exec: Dict = {}     # (kind, batch shapes) -> callable
+        self._built_sched = None
+        self._lr_cache = None          # (host float, device f32 scalar)
+        self._base_key_data = None
+        self._aot_dir: Optional[str] = None
+        #: host-side dispatch accounting: `dispatch_host_s` is the wall time
+        #: spent ENQUEUEING compiled programs (not waiting on them) — the
+        #: per-step host overhead the superstep amortizes (bench.py reports
+        #: dispatch_overhead_s_per_step = dispatch_host_s / steps).
+        self.dispatch_stats = {"steps": 0, "dispatches": 0,
+                               "dispatch_host_s": 0.0}
 
     # -- step function -------------------------------------------------------
 
@@ -111,6 +155,16 @@ class Trainer:
         fused = (getattr(model, "pp_schedule", None) == "1f1b"
                  and hasattr(model, "loss_and_grads"))
 
+        sched = opt.lr_scheduler
+        # functional scheduler: LR becomes a pure on-device function of the
+        # step counter, evaluated inside the compiled program — the same
+        # derivation in the per-step jit and the superstep scan body, so
+        # the two paths stay bit-identical
+        lr_fn = (sched.lr_of
+                 if sched is not None and getattr(sched, "functional", False)
+                 else None)
+        self._built_sched = sched
+
         def loss_of(params, batch, key):
             if fused:
                 with rng_tracker().scope(key):
@@ -123,7 +177,14 @@ class Trainer:
                 return loss
             return jax.value_and_grad(loss_fn)(params)
 
-        def step_fn(params, opt_state, batch, lr, key):
+        def one_step(params, opt_state, batch, lr, key_data):
+            compile_cache.note_trace()
+            # the opt-state step counter IS the trainer step (both restored
+            # together on resume/rollback): derive key + LR from it on-device
+            step = opt_state["step"]
+            key = jax.random.fold_in(jax.random.wrap_key_data(key_data),
+                                     step)
+            lr_t = lr_fn(step) if lr_fn is not None else lr
             if accum == 1:
                 loss, grads = loss_of(params, batch, key)
             else:
@@ -146,32 +207,255 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 loss = loss_sum / accum
             new_params, new_opt_state = opt.apply_gradients(params, grads,
-                                                            opt_state, lr=lr)
+                                                            opt_state,
+                                                            lr=lr_t)
             return new_params, new_opt_state, loss
 
+        def superstep(params, opt_state, batch_stack, lr_stack, key_data):
+            # K fused steps, one dispatch: the scan body IS one_step, so
+            # numerics are bit-identical to K calls of the per-step jit.
+            # raw_parameters() hands an OrderedDict while apply_gradients
+            # rebuilds plain dicts — normalize so the scan carry structure
+            # is closed under the body
+            params = dict(params)
+
+            def body(carry, inp):
+                p, s = carry
+                mb, lr_i = inp
+                p, s, loss = one_step(p, s, mb, lr_i, key_data)
+                return (p, s), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (batch_stack, lr_stack))
+            return params, opt_state, losses
+
         donate = (0, 1) if self._donate else ()
-        self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+        self._one_step = one_step
+        self._lr_fn = lr_fn
+        self._step_jit = jax.jit(one_step, donate_argnums=donate)
+        self._superstep_jit = jax.jit(superstep, donate_argnums=donate)
+        self._step_exec = {}
+        self._superstep_exec = {}
+        self._fast_exec = {}
+        self._static_fp = None
+
+    def _ensure_built(self):
+        if (self._one_step is None
+                or self.optimizer.lr_scheduler is not self._built_sched):
+            self._build_step()
+
+    # -- compile-cache plumbing ---------------------------------------------
+
+    def _fp_parts(self):
+        """Structural fingerprint of the traced program: everything that
+        changes the compiled step WITHOUT changing argument avals (model
+        wiring, optimizer/scheduler hyperparameters, donation/accum flags).
+        Conservative by design — an over-keyed miss costs one compile, an
+        under-keyed hit would be a correctness bug."""
+        if getattr(self, "_static_fp", None) is not None:
+            return self._static_fp
+
+        def scalars(obj):
+            # "name" is a process-serial label (LRScheduler registry), not
+            # program structure — keying on it would defeat reuse. Scalar
+            # SEQUENCES (milestones/boundaries/values...) and CALLABLE attrs
+            # (a resolved activation fn: relu vs gelu with identical shapes)
+            # are constants the trace bakes in, so they must key too.
+            out = []
+            for k, v in vars(obj).items():
+                if k == "name":
+                    continue
+                if isinstance(v, (int, float, bool, str)):
+                    out.append((k, v))
+                elif isinstance(v, (list, tuple)) and all(
+                        isinstance(x, (int, float, bool, str)) for x in v):
+                    out.append((k, tuple(v)))
+                elif callable(v) and not isinstance(v, Layer):
+                    # qualname, never repr(): a repr with an object address
+                    # would be unique per construction and kill reuse
+                    out.append((k, f"{getattr(v, '__module__', '?')}."
+                                   f"{getattr(v, '__qualname__', type(v).__name__)}"))
+            return sorted(out)
+
+        model, opt = self.model, self.optimizer
+        cfg = getattr(model, "cfg", None)
+        try:
+            # per-sublayer SCALAR attrs too, not just the type: Dropout p,
+            # norm eps, a scale constant — all baked into the trace with no
+            # aval footprint. (Python closures can never be fingerprinted
+            # exhaustively; this covers every attribute-carried constant.)
+            structure = tuple(
+                (n, type(l).__qualname__, tuple(scalars(l)))
+                for n, l in model.named_sublayers())
+        except Exception:
+            structure = ()
+        sched, clip = opt.lr_scheduler, opt.grad_clip
+
+        def sched_constants(s):
+            # the schedule FORMULA is baked into the trace (in-jit lr_of):
+            # key on its constants — including those of a WRAPPED scheduler
+            # (LinearWarmup.lr_after) — but NOT on mutable progress state
+            # (last_epoch/last_lr advance every step — including them would
+            # break artifact reuse across a resume, the whole point)
+            from ..optimizer.lr import LRScheduler
+            mutable = set(s.state_dict())
+            consts = [(k, v) for k, v in scalars(s) if k not in mutable]
+            nested = tuple(
+                (k, type(v).__qualname__, sched_constants(v))
+                for k, v in sorted(vars(s).items())
+                if isinstance(v, LRScheduler))
+            return (tuple(consts), nested)
+
+        sched_part = ()
+        if sched is not None and self._lr_fn is not None:
+            sched_part = sched_constants(sched)
+        self._static_fp = (
+            jax.__version__, jax.default_backend(),
+            type(model).__qualname__, scalars(model),
+            scalars(cfg) if cfg is not None and hasattr(cfg, "__dict__")
+            else (),
+            structure,
+            type(opt).__qualname__, scalars(opt),
+            type(sched).__qualname__ if sched is not None else None,
+            sched_part,
+            bool(self._lr_fn),
+            type(clip).__qualname__ if clip is not None else None,
+            scalars(clip) if clip is not None else (),
+            self._donate, self.accumulate_steps,
+        )
+        return self._static_fp
+
+    def _dispatch(self, kind: str, args):
+        """Dispatch one compiled program through the process-wide compile
+        cache (core/compile_cache.py): first call per argument-shape
+        signature resolves an executable (in-process hit → AOT artifact →
+        lower+compile); subsequent calls are a dict lookup + enqueue."""
+        t0 = time.perf_counter()
+        # fast path: params/opt_state avals are fixed between builds, so
+        # steady-state lookup keys only on the batch leaves' shapes —
+        # flattening the full param tree per step is exactly the recurring
+        # host work this runtime exists to strip
+        batch = args[2]
+        try:
+            fast = (kind, tuple(sorted((k, v.shape)
+                                       for k, v in batch.items())))
+        except Exception:
+            fast = None
+        fn = self._fast_exec.get(fast) if fast is not None else None
+        if fn is None:
+            jitted = (self._step_jit if kind == "step"
+                      else self._superstep_jit)
+            exec_cache = (self._step_exec if kind == "step"
+                          else self._superstep_exec)
+            sig = compile_cache.aval_signature(args)
+            fn = exec_cache.get(sig)
+            if fn is None:
+                fp = compile_cache.fingerprint((self._fp_parts(), kind, sig))
+                fn, _ = compile_cache.acquire(
+                    fp, jitted, args, aot_dir=self._aot_dir, name=kind,
+                    donate_argnums=(0, 1) if self._donate else ())
+                exec_cache[sig] = fn
+            if fast is not None:
+                self._fast_exec[fast] = fn
+        out = fn(*args)
+        self.dispatch_stats["dispatches"] += 1
+        self.dispatch_stats["dispatch_host_s"] += time.perf_counter() - t0
+        return out
+
+    def _key_data(self):
+        """Cached base-key data (uint32): created ONCE, folded with the step
+        counter on-device — never a fresh jax.random.key per step."""
+        if self._base_key_data is None:
+            self._base_key_data = jax.random.key_data(
+                jax.random.key(self._seed))
+        return self._base_key_data
+
+    def _lr_scalar(self):
+        """Device LR scalar, re-transferred only when the host scheduler
+        actually changed the value (satellite: trainer.py no longer pays a
+        host→device LR copy per step). With a functional scheduler the lr
+        argument is dead (one_step computes lr_of(step) in-jit) — a fixed
+        zero avoids re-syncing a value nobody reads."""
+        if self._lr_fn is not None:
+            if self._lr_cache is None or self._lr_cache[0] is not None:
+                self._lr_cache = (None, jnp.zeros((), jnp.float32))
+            return self._lr_cache[1]
+        host = float(self.optimizer.get_lr())
+        if self._lr_cache is None or self._lr_cache[0] != host:
+            self._lr_cache = (host, jnp.asarray(host, jnp.float32))
+        return self._lr_cache[1]
+
+    def precompile(self, sample_batch: Dict[str, jax.Array],
+                   steps_per_dispatch: int = 1,
+                   cache_dir: Optional[str] = None) -> Dict[str, Any]:
+        """AOT-lower and compile the training (super)step before the first
+        batch arrives, and persist a ``jax.export`` artifact for restarts.
+
+        ``cache_dir`` (defaults to the dir wired by a previous call or by
+        ``fit(checkpoint_manager=...)``, i.e. ``<ckpt_root>/_compile_cache``)
+        receives the serialized StableHLO + fingerprint sidecar; a relaunch
+        whose fingerprint matches deserializes it instead of re-tracing
+        (``compile_cache.stats()["traces"]`` proves it). Returns
+        ``{"kind", "outcome" (hit|aot_hit|miss), "fingerprint", "aot_dir"}``.
+        """
+        self._ensure_built()
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._aot_dir = cache_dir
+        k = max(1, int(steps_per_dispatch))
+        lr = self._lr_scalar()
+        kd = self._key_data()
+        if k == 1:
+            kind = "step"
+            args = (self.params, self.opt_state, sample_batch, lr, kd)
+            jitted, exec_cache = self._step_jit, self._step_exec
+        else:
+            from ..io.dataloader import stack_batches
+            kind = "superstep"
+            stack = stack_batches([sample_batch] * k)
+            lr_stack = jnp.zeros((k,), jnp.float32)
+            args = (self.params, self.opt_state, stack, lr_stack, kd)
+            jitted, exec_cache = self._superstep_jit, self._superstep_exec
+        # avals keep the inputs' SHARDINGS (compile_cache.to_avals): the
+        # executable is specialized to placement, and the cache key
+        # (aval_signature) includes it — an unsharded lowering stored under
+        # a sharded key would blow up at the first real dispatch
+        avals = compile_cache.to_avals(args)
+        sig = compile_cache.aval_signature(args)
+        fp = compile_cache.fingerprint((self._fp_parts(), kind, sig))
+        fn, outcome = compile_cache.acquire(
+            fp, jitted, avals, aot_dir=self._aot_dir, name=kind,
+            save_artifact=self._aot_dir is not None,
+            donate_argnums=(0, 1) if self._donate else ())
+        exec_cache[sig] = fn
+        return {"kind": kind, "outcome": outcome, "fingerprint": fp,
+                "aot_dir": self._aot_dir}
 
     def _place_opt_state(self, kind: str):
         from ..optimizer.optimizer import place_opt_state
         return place_opt_state(self.opt_state, self.params, kind)
 
-    def train_step(self, batch: Dict[str, jax.Array]) -> float:
-        """One optimization step. ``batch`` maps forward kwarg names to
-        arrays (e.g. {"input_ids": ..., "labels": ...})."""
+    def _adopt_offload_flag(self):
+        """group_sharded_parallel(offload=True) may run AFTER this Trainer
+        was built — honor the optimizer's flag from here on (unless the
+        caller explicitly passed offload_opt_state=False). Shared by the
+        per-step and superstep entry points."""
         if (not self._offload and not self._offload_explicit
                 and getattr(self.optimizer, "_offload_opt_state", False)):
-            # group_sharded_parallel(offload=True) ran AFTER this Trainer
-            # was built — honor the flag from here on (unless the caller
-            # explicitly passed offload_opt_state=False)
             self._offload = True
             self.opt_state = self._place_opt_state("pinned_host")
-        if self._step_fn is None:
-            self._build_step()
+
+    def train_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
+        """One optimization step. ``batch`` maps forward kwarg names to
+        arrays (e.g. {"input_ids": ..., "labels": ...}). Returns the loss
+        as a DEVICE scalar — callers fence (float()) only when they need
+        the value."""
+        self._adopt_offload_flag()
+        self._ensure_built()
         if self._watchdog is not None:
             self._watchdog.tick()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = jax.random.key(self._step)
+        lr = self._lr_scalar()
+        kd = self._key_data()
         if self._offload:
             # pull the state up for the step, push the update back down:
             # host<->device streams around a device-resident step (the
@@ -180,11 +464,12 @@ class Trainer:
             # mixed-space operands are rejected by XLA and the CPU test
             # backend lacks annotate_device_placement entirely.
             self.opt_state = self._place_opt_state("device")
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, batch, lr, key)
+        self.params, self.opt_state, loss = self._dispatch(
+            "step", (self.params, self.opt_state, batch, lr, kd))
         if self._offload:
             self.opt_state = self._place_opt_state("pinned_host")
         self._step += 1
+        self.dispatch_stats["steps"] += 1
         if self._donate:
             # donation invalidates the previous param buffers, which the
             # Layer's Parameters still reference — rebind them to the new
@@ -200,7 +485,8 @@ class Trainer:
     def fit(self, data: Iterable[Dict[str, jax.Array]], steps: int,
             log_every: int = 10, on_metrics: Optional[Callable] = None,
             seq_len: Optional[int] = None, checkpoint_manager=None,
-            resume=None, anomaly_guard=None, preemption_guard=None):
+            resume=None, anomaly_guard=None, preemption_guard=None,
+            steps_per_dispatch: int = 1):
         """Run the training loop. Beyond the metrics loop, this is the
         fault-tolerant runtime (resilience subsystem):
 
@@ -216,7 +502,22 @@ class Trainer:
           and raises TrainingPreempted (exit code = resumable);
         * ``anomaly_guard`` (resilience.AnomalyGuard): NaN/Inf or loss-spike
           steps are skipped (undo the update; needs donate=False) or rolled
-          back to the last good checkpoint, within bounded budgets.
+          back to the last good checkpoint, within bounded budgets. With
+          ``check_every > 1`` (and a non-skip policy) loss verdicts are
+          consumed as a batched window — ONE device fence per window instead
+          of one per step;
+        * ``steps_per_dispatch=K`` (superstep): K steps compiled into one
+          ``lax.scan`` dispatch over stacked batches; losses are fetched
+          asynchronously at log/anomaly/checkpoint boundaries. Bit-identical
+          to K=1 (shared step body). Checkpoint/anomaly cadence aligns to
+          dispatch boundaries (first boundary at-or-after the configured
+          interval); resume may land mid-superstep — the next dispatch is
+          simply sized ``min(K, target - step)``. Incompatible with
+          ``policy="skip"`` (a mid-scan poisoned update cannot be undone
+          from pre-step references). The hung-step watchdog
+          (``PT_STEP_TIMEOUT_S``) is ticked per DISPATCH and around window
+          fetches, so calibrate it against ``ring_depth*K`` step times, not
+          one.
         """
         # hung-step watchdog (PT_STEP_TIMEOUT_S): armed only for the
         # duration of this bounded loop — inter-step gaps here ARE steps
@@ -237,6 +538,21 @@ class Trainer:
                 "references, which buffer donation invalidates. Use "
                 "policy='rollback' (with a checkpoint_manager) or disable "
                 "donation.")
+        K = max(1, int(steps_per_dispatch))
+        if K > 1 and anomaly_guard is not None \
+                and anomaly_guard.policy == "skip":
+            raise ValueError(
+                "steps_per_dispatch>1 cannot honor AnomalyGuard("
+                "policy='skip'): a poisoned update inside a compiled "
+                "superstep cannot be undone from pre-step references. Use "
+                "policy='rollback' (checkpoint-backed) or "
+                "steps_per_dispatch=1.")
+        if checkpoint_manager is not None and self._aot_dir is None:
+            # precompiled AOT artifacts live next to the checkpoints — a
+            # resumed worker picks them up without re-tracing
+            d = os.path.join(checkpoint_manager.root, "_compile_cache")
+            if os.path.isdir(d):
+                self._aot_dir = d
         if resume and checkpoint_manager is not None:
             self._resume_from(checkpoint_manager, data)
             target = int(steps)
@@ -248,6 +564,12 @@ class Trainer:
         tokens_since = 0
         loss = None
         try:
+            if K > 1:
+                return self._fit_superstep(it, target, K, log_every,
+                                           on_metrics, seq_len, history,
+                                           mgr=checkpoint_manager,
+                                           anomaly=anomaly_guard,
+                                           guard=preemption_guard, data=data)
             return self._fit_loop(it, target, log_every, on_metrics, seq_len,
                                   history, t_last, tokens_since, loss,
                                   mgr=checkpoint_manager,
@@ -261,8 +583,18 @@ class Trainer:
     def _fit_loop(self, it, target, log_every, on_metrics, seq_len,
                   history, t_last, tokens_since, loss, mgr=None, anomaly=None,
                   guard=None, data=None):
+        # anomaly windowing: policy="skip" must fence every step (the undo
+        # needs pre-step references from BEFORE the next step runs);
+        # rollback/abort verdicts can consume a batched loss window — one
+        # device fence per check_every steps (satellite: trainer.py:283)
+        window = []
+        per_step_check = (anomaly is None or anomaly.policy == "skip"
+                          or getattr(anomaly, "check_every", 1) <= 1)
         while self._step < target:
             if guard is not None and guard.preempted:
+                if window:
+                    it, _ = self._drain_loss_window(window, anomaly, mgr,
+                                                    data, it)
                 self._preempt_exit(mgr, data)
             try:
                 batch = next(it)
@@ -280,11 +612,20 @@ class Trainer:
             loss = self.train_step(batch)
             tokens_since += ntok
             if anomaly is not None:
-                verdict = anomaly.check(float(loss))
-                if verdict != "ok":
-                    it = self._handle_anomaly(verdict, anomaly, mgr, prev,
-                                              data, it, float(loss))
-                    continue
+                if per_step_check:
+                    verdict = anomaly.check(float(loss))
+                    if verdict != "ok":
+                        it = self._handle_anomaly(verdict, anomaly, mgr,
+                                                  prev, data, it,
+                                                  float(loss))
+                        continue
+                else:
+                    window.append((self._step, loss))
+                    if len(window) >= anomaly.check_every:
+                        it, rolled = self._drain_loss_window(
+                            window, anomaly, mgr, data, it)
+                        if rolled:
+                            continue
             if self._step % log_every == 0:
                 loss_v = float(loss)  # blocks; amortized over log_every
                 now = time.perf_counter()
@@ -306,12 +647,31 @@ class Trainer:
                 t_last = time.perf_counter()
                 tokens_since = 0
             if guard is not None and guard.preempted:
+                if window:
+                    it, _ = self._drain_loss_window(window, anomaly, mgr,
+                                                    data, it)
                 self._preempt_exit(mgr, data)
             if (mgr is not None
                     and self._step % mgr.save_interval_steps == 0
                     and self._step < target):
+                if window:
+                    # never checkpoint params the guard has not cleared
+                    it, rolled = self._drain_loss_window(window, anomaly,
+                                                         mgr, data, it)
+                    if rolled:
+                        continue
                 mgr.save(self._step, self._ckpt_tree(data),
                          watchdog=self._watchdog)
+        if window:
+            it, rolled = self._drain_loss_window(window, anomaly, mgr,
+                                                 data, it)
+            if rolled and self._step < target:
+                # rollback at the tail re-enters training for the remainder
+                return self._fit_loop(it, target, log_every, on_metrics,
+                                      seq_len, history,
+                                      time.perf_counter(), 0, loss, mgr=mgr,
+                                      anomaly=anomaly, guard=guard,
+                                      data=data)
         if guard is not None and guard.preempted:
             self._preempt_exit(mgr, data)
         if mgr is not None:
@@ -322,7 +682,197 @@ class Trainer:
         self.sync_model()
         return history
 
+    # -- superstep loop ------------------------------------------------------
+
+    def _fit_superstep(self, it, target, K, log_every, on_metrics, seq_len,
+                       history, mgr=None, anomaly=None, guard=None,
+                       data=None):
+        """K-steps-per-dispatch loop: stack K batches → ONE compiled scan →
+        append the [K] device loss vector to a small in-flight ring. The
+        host only fences at boundaries (ring full / log / anomaly window /
+        checkpoint / end), so between boundaries the device queue stays
+        full and per-step host work is one dict lookup + enqueue."""
+        from ..io.dataloader import stack_batches
+        self._adopt_offload_flag()
+        self._ensure_built()
+        ring = []          # (last_step, [ntok per step], device losses [k])
+        ring_depth = 2
+        state = {"tokens": 0, "steps": 0, "t_last": time.perf_counter(),
+                 "sl": seq_len or 1}
+        last_saved = self._step
+        exhausted = False
+
+        def drain(it):
+            """Fetch every pending loss window with ONE host sync, then run
+            anomaly verdicts + metric emission in step order."""
+            nonlocal exhausted
+            if not ring:
+                return it, False
+            entries = list(ring)
+            ring.clear()
+            if self._watchdog is not None:
+                self._watchdog.tick()    # the fetch below blocks on device
+            flat = np.asarray(jnp.concatenate([e[2] for e in entries]))
+            if self._watchdog is not None:
+                self._watchdog.tick()
+            # amortized timing: every step since the last emission shares
+            # the wall span [t_last, now] equally — multiple log boundaries
+            # inside ONE drain must not each claim a microsecond window
+            # (that read as multi-million tokens/sec)
+            now = time.perf_counter()
+            new_steps = sum(len(e[1]) for e in entries)
+            span = max(now - state["t_last"], 1e-9)
+            per_step_s = span / max(state["steps"] + new_steps, 1)
+            i = 0
+            for last_step, ntoks, _ in entries:
+                first = last_step - len(ntoks) + 1
+                for j, ntok in enumerate(ntoks):
+                    step = first + j
+                    v = float(flat[i])
+                    i += 1
+                    if anomaly is not None:
+                        verdict = anomaly.check(v)
+                        if verdict != "ok":
+                            # a rollback rewinds a stateful loader to the
+                            # checkpoint cursor — the replay pass may have
+                            # batches even if the old iterator ran dry
+                            exhausted = False
+                            return self._handle_anomaly(
+                                verdict, anomaly, mgr, None, data, it,
+                                v), True
+                    state["tokens"] += ntok
+                    state["steps"] += 1
+                    if step % log_every == 0:
+                        dt = per_step_s * max(state["steps"], 1)
+                        tps = state["tokens"] / dt if dt > 0 else 0.0
+                        n_dev = jax.device_count()
+                        fpt = (self.model.flops_per_token(state["sl"])
+                               if hasattr(self.model, "flops_per_token")
+                               else 0.0)
+                        mfu = (tps / n_dev) * fpt / self._peak if fpt else 0.0
+                        sched = self.optimizer.lr_scheduler
+                        # the host scheduler mirror has already advanced past
+                        # this window — report the LR AT the logged step
+                        # (same convention as the per-step loop: lr of
+                        # metric.step)
+                        lr_at = (float(np.asarray(sched.lr_of(step)))
+                                 if sched is not None
+                                 else self.optimizer.get_lr())
+                        m = TrainMetrics(
+                            step=step, loss=v,
+                            step_time_s=per_step_s,
+                            tokens_per_sec=tps,
+                            tokens_per_sec_per_chip=tps / n_dev,
+                            mfu=mfu, lr=lr_at)
+                        history.append(m)
+                        if on_metrics:
+                            on_metrics(m)
+                        # advance by the consumed share; the steps after the
+                        # last boundary keep their slice of the span
+                        state["t_last"] += dt
+                        state["tokens"] = 0
+                        state["steps"] = 0
+            return it, False
+
+        while True:
+            if guard is not None and guard.preempted:
+                it, _ = drain(it)
+                self._preempt_exit(mgr, data)
+            if self._step >= target or exhausted:
+                it, rolled = drain(it)
+                if rolled and self._step < target:
+                    # re-anchor the save cadence at the restored step, or
+                    # the whole replay window would go uncheckpointed
+                    last_saved = self._step
+                    continue
+                break
+            k = min(K, target - self._step)
+            batches = []
+            try:
+                while len(batches) < k:
+                    batches.append(next(it))
+            except StopIteration:
+                exhausted = True
+                if not batches:
+                    continue
+                k = len(batches)   # loader tail: smaller final dispatch
+            if self._watchdog is not None:
+                self._watchdog.tick()
+            ids = batches[-1].get("input_ids")
+            if seq_len is None and ids is not None:
+                state["sl"] = ids.shape[1]
+            ntoks = [int(b["input_ids"].shape[0] * b["input_ids"].shape[1])
+                     if b.get("input_ids") is not None else 0
+                     for b in batches]
+            start = self._step
+            sched = self.optimizer.lr_scheduler
+            if sched is not None and getattr(sched, "functional", False):
+                # LR computed in-jit from the step counter; the stack is a
+                # dead scan input (zeros keep the signature K-shaped)
+                lr_stack = jnp.zeros((k,), jnp.float32)
+            elif sched is not None:
+                lr_stack = jnp.asarray(
+                    [sched.lr_of(start + i) for i in range(k)], jnp.float32)
+            else:
+                lr_stack = jnp.full((k,), float(self.optimizer.get_lr()),
+                                    jnp.float32)
+            stack = stack_batches(batches)
+            if self._offload:
+                self.opt_state = self._place_opt_state("device")
+            self.params, self.opt_state, losses = self._dispatch(
+                "superstep", (self.params, self.opt_state, stack, lr_stack,
+                              self._key_data()))
+            if self._offload:
+                self.opt_state = self._place_opt_state("pinned_host")
+            self._step += k
+            self.dispatch_stats["steps"] += k
+            if self._donate:
+                self.sync_model()
+            if sched is not None:
+                for _ in range(k):     # host mirror advances at boundaries
+                    sched.step()
+            ring.append((self._step, ntoks, losses))
+            crossed_log = (self._step // log_every) > (start // log_every)
+            if len(ring) >= ring_depth or crossed_log:
+                it, rolled = drain(it)
+                if rolled:
+                    last_saved = self._step
+                    continue
+            if (mgr is not None and self._step < target
+                    and (self._step // mgr.save_interval_steps)
+                    > (last_saved // mgr.save_interval_steps)):
+                it, rolled = drain(it)   # validate before checkpointing
+                last_saved = self._step
+                if rolled:
+                    continue
+                mgr.save(self._step, self._ckpt_tree(data),
+                         watchdog=self._watchdog)
+        if guard is not None and guard.preempted:
+            self._preempt_exit(mgr, data)
+        if mgr is not None:
+            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
+                     watchdog=self._watchdog)
+        self.sync_model()
+        return history
+
     # -- resilience runtime --------------------------------------------------
+
+    def _drain_loss_window(self, window, anomaly, mgr, data, it):
+        """Consume a pending (step, device-loss) window with ONE device→host
+        sync; returns ``(iterator, rolled_back)``. Verdicts run in step
+        order so budgets/EWMA see the same sequence the per-step path
+        would."""
+        entries = list(window)
+        window.clear()
+        if self._watchdog is not None:
+            self._watchdog.tick()        # the fetch below blocks on device
+        vals = np.asarray(jnp.stack([l for _, l in entries]))
+        for (s, _), v in zip(entries, vals):
+            verdict = anomaly.check(float(v))
+            if verdict != "ok":
+                return self._handle_anomaly(verdict, anomaly, mgr, None,
+                                            data, it, float(v)), True
+        return it, False
 
     def _ckpt_tree(self, data=None):
         """Full training state as one checkpointable tree. The structure is
@@ -367,6 +917,8 @@ class Trainer:
             # LR to the constructor value
             sched.set_state_dict({"last_epoch": le, "last_lr": (
                 llr if llr >= 0 else sched.last_lr)})
+        self._lr_cache = None     # host LR may have moved: re-sync the scalar
+        self._fast_exec = {}      # restored arrays may carry new placements
         self.sync_model()
         return int(np.asarray(tree["extra"]["data_cursor"]))
 

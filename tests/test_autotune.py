@@ -47,6 +47,27 @@ def test_record_save_load_roundtrip(tmp_path, monkeypatch):
     assert key in data and "other|key" in data
 
 
+def test_corrupt_user_db_warns_with_path(tmp_path, monkeypatch):
+    """Satellite (ISSUE 2): a corrupt user DB must not silently merge
+    nothing — offline-tuned configs vanishing without a trace. One warning
+    naming the path, then lookups proceed on the shipped DB."""
+    import warnings
+
+    path = str(tmp_path / "corrupt.json")
+    with open(path, "w") as f:
+        f.write("{not valid json")
+    monkeypatch.setenv("PT_TUNE_DB", path)
+    db = TuneDB()
+    with pytest.warns(RuntimeWarning, match="corrupt kernel tune DB"):
+        db.lookup("whatever|key")
+    # a MISSING user DB stays silent (the common no-sweep-yet case)
+    monkeypatch.setenv("PT_TUNE_DB", str(tmp_path / "absent.json"))
+    fresh = TuneDB()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        fresh.lookup("whatever|key")
+
+
 def test_dispatch_uses_db_on_tpu(monkeypatch, tmp_path):
     """flash_attention_config consults the DB when the backend is TPU."""
     from paddle_tpu.ops.pallas import autotune
