@@ -361,17 +361,21 @@ def _decode_bench(cfg, on_tpu):
         # fewer slots than requests (admission + retirement + lazy paging
         # on the clock) — the serving-system layer over the paged kernel
         from paddle_tpu.inference import ContinuousBatchingEngine
-        # decode_block=16: one compiled 16-token scan per scheduler tick,
-        # so the tunnel round trip is paid per-block, not per-token (the
-        # raw kernel decode rate is decode_tokens_per_sec above)
+        # decode_block: one compiled K-token scan per scheduler tick, so
+        # the tunnel round trip is paid per-block, not per-token (the
+        # raw kernel decode rate is decode_tokens_per_sec above). The
+        # async engine's on-device stop detection keeps any K exact, and
+        # its depth-2 dispatch window (inflight_depth below) hides the
+        # host bookkeeping of block N under the device's block N+1.
         n_req, slots = (16, 4) if on_tpu else (4, 2)
         s_new = min(new_tokens, 64 if on_tpu else 24)
+        s_block = 16 if on_tpu else 8
         eng = ContinuousBatchingEngine(
             dmodel, max_batch=slots, page_size=128 if on_tpu else 8,
             max_len=(prompt_len + new_tokens + 128) if on_tpu else 32,
             generation_config=GenerationConfig(max_new_tokens=s_new,
                                                do_sample=False),
-            decode_block=16 if on_tpu else 1)
+            decode_block=s_block)
         rs = np.random.RandomState(1)
         stag = 8 if on_tpu else 2
         lens = [prompt_len - (i % 3) * stag for i in range(n_req)]
@@ -417,6 +421,14 @@ def _decode_bench(cfg, on_tpu):
         out["serving_requests"] = n_req
         out["serving_sampled_requests"] = n_sampled
         out["serving_slots"] = slots
+        out["serving_decode_block"] = s_block
+        out["inflight_depth"] = eng.async_depth
+        # how much of the raw paged-decode rate the serving layer keeps:
+        # the host-overhead tax the async engine exists to eliminate
+        if out.get("paged_decode_tokens_per_sec"):
+            out["serving_decode_efficiency"] = round(
+                out["serving_tokens_per_sec"]
+                / out["paged_decode_tokens_per_sec"], 3)
         # per-window delta: eng.preemptions is a lifetime counter
         out["serving_preemptions"] = eng.preemptions - pre0
         lat = eng.latency_stats()
@@ -425,6 +437,30 @@ def _decode_bench(cfg, on_tpu):
             out["serving_ttft_p99_s"] = round(lat["ttft_p99_s"], 4)
             out["serving_latency_p50_s"] = round(lat["latency_p50_s"], 4)
             out["serving_latency_p99_s"] = round(lat["latency_p99_s"], 4)
+
+        # strict per-tick row (decode_block=1, CPU tier): like-for-like
+        # with rounds <= 5, which timed the engine at K=1 — isolates the
+        # async-loop win (device-resident state + pipelined dispatch)
+        # from the larger decode block on-device stop detection enables
+        if not on_tpu:
+            eng1 = ContinuousBatchingEngine(
+                dmodel, max_batch=slots, page_size=8, max_len=32,
+                generation_config=GenerationConfig(max_new_tokens=s_new,
+                                                   do_sample=False),
+                decode_block=1)
+            for L in sorted(set(lens)):
+                eng1.submit(reqs[lens.index(L)][:L])
+            eng1.run()
+            for L in sorted(set(lens)):
+                eng1.submit(reqs[lens.index(L)][:L],
+                            generation_config=sample_gc)
+            eng1.run()
+            _submit_mix(eng1, reqs)
+            t0 = time.perf_counter()
+            results1 = eng1.run()
+            dt1 = time.perf_counter() - t0
+            out["serving_k1_tokens_per_sec"] = round(
+                sum(len(v) for v in results1.values()) / dt1, 1)
 
         # 64-request mixed-length load ON the chip (round-4 weak #3: the
         # load test ran only on CPU). Same buckets + decode blocks as the
@@ -852,15 +888,23 @@ def _latest_tpu_artifact():
             return None
         # order by the embedded capture time, not fs mtime (fresh clones
         # assign arbitrary near-identical mtimes)
-        def cap_time(path):
+        def load(path):
             try:
                 with open(path) as f:
-                    return json.load(f).get("captured_at", "")
+                    return json.load(f)
             except Exception:
-                return ""
-        newest = max(files, key=cap_time)
-        with open(newest) as f:
-            art = json.load(f)
+                return {}
+        arts = {path: load(path) for path in files}
+
+        def cap_time(path):
+            return arts[path].get("captured_at") or ""
+        # prefer the newest artifact with a REAL headline value: a
+        # null-value record (e.g. a projection sheet, BENCH_r05's case)
+        # must not shadow auditable TPU numbers; fall back to plain
+        # newest only if no artifact carries a value
+        valued = [p for p in files if arts[p].get("value") is not None]
+        newest = max(valued or files, key=cap_time)
+        art = arts[newest]
         return {"file": os.path.relpath(newest, os.path.dirname(_ARTIFACT_DIR)),
                 "git_head": art.get("git_head"),
                 "captured_at": art.get("captured_at"),

@@ -50,26 +50,11 @@ def _sample_logits(logits, cfg: GenerationConfig, key):
     return jax.random.categorical(key, logits, axis=-1)
 
 
-def sample_logits_batched(logits, temperature, top_k, top_p, do_sample,
-                          key):
-    """Per-ROW sampling: [b, vocab] logits + per-row knob arrays → [b].
-
-    The serving-engine sampler (reference analogue: the dedicated per-row
-    kernel phi/kernels/gpu/top_p_sampling_kernel.cu:1, whose ``ps`` input
-    is per batch row). All knobs are TRACED ARRAYS, so one compiled
-    decode block serves any mix of greedy and sampled requests with any
-    per-request temperature/top-k/top-p — no recompile per config:
-
-      temperature [b] f32   (<=0 treated as 1e-6)
-      top_k       [b] i32   (0 = disabled)
-      top_p       [b] f32   (1.0 = disabled)
-      do_sample   [b] bool  (False = argmax row)
-
-    Rows draw independent samples from one key via
-    ``jax.random.categorical`` over the jointly masked logits.
-    """
+def _mask_logits_rowwise(logits, temperature, top_k, top_p):
+    """Shared temperature/top-k/top-p masking for the per-row samplers:
+    [b, vocab] logits + per-row knob arrays → masked [b, vocab] logits
+    ready for ``jax.random.categorical``."""
     b, vocab = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
     x = logits / jnp.maximum(temperature, 1e-6)[:, None]
 
     # top-k: keep each row's k best (k=0 -> vocab = keep all)
@@ -96,10 +81,61 @@ def sample_logits_batched(logits, temperature, top_k, top_p, do_sample,
     # thousands of tokens early at real vocab sizes (measured on v5e:
     # 22604/32000 tokens wrongly masked), so `cum < 1.0` is NOT a no-op
     cutoff = jnp.where((top_p < 1.0)[:, None], cutoff, -jnp.inf)
-    x = jnp.where(x < cutoff, -jnp.inf, x)
+    return jnp.where(x < cutoff, -jnp.inf, x)
 
+
+def sample_logits_batched(logits, temperature, top_k, top_p, do_sample,
+                          key):
+    """Per-ROW sampling: [b, vocab] logits + per-row knob arrays → [b].
+
+    The serving-engine sampler (reference analogue: the dedicated per-row
+    kernel phi/kernels/gpu/top_p_sampling_kernel.cu:1, whose ``ps`` input
+    is per batch row). All knobs are TRACED ARRAYS, so one compiled
+    decode block serves any mix of greedy and sampled requests with any
+    per-request temperature/top-k/top-p — no recompile per config:
+
+      temperature [b] f32   (<=0 treated as 1e-6)
+      top_k       [b] i32   (0 = disabled)
+      top_p       [b] f32   (1.0 = disabled)
+      do_sample   [b] bool  (False = argmax row)
+
+    Rows draw independent samples from one key via
+    ``jax.random.categorical`` over the jointly masked logits.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    x = _mask_logits_rowwise(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, x, axis=-1)
     return jnp.where(do_sample, sampled, greedy)
+
+
+def sample_logits_per_slot(logits, temperature, top_k, top_p, do_sample,
+                           keys):
+    """``sample_logits_batched`` with per-ROW keys ([b] stacked PRNG
+    keys): each row draws from its OWN key instead of a shared per-step
+    key. The async serving engine derives row keys as
+    ``fold_in(fold_in(base, request_id), token_index)``, which makes a
+    request's sampled stream a pure function of (seed, request, token
+    index) — independent of batching, speculative-dispatch depth, and
+    preemption/replay interleaving, so a pipelined engine stays
+    token-identical to its synchronous (depth-1) schedule."""
+    greedy = jnp.argmax(logits, axis=-1)
+    x = _mask_logits_rowwise(logits, temperature, top_k, top_p)
+    sampled = jax.vmap(jax.random.categorical)(keys, x)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+def decode_stop_update(tok, active, budget, eos_id):
+    """On-device stop detection for one decode step (the sampling body's
+    ``done`` bookkeeping). ``tok`` [b] is the token just emitted for rows
+    where ``active``; ``budget`` [b] counts remaining allowed tokens;
+    ``eos_id`` [b] is the per-row stop id (-1 = disabled). Returns
+    ``(new_active, new_budget)`` — a row deactivates AFTER emitting its
+    eos/budget-exhausting token (that token is kept, matching the host
+    scheduler's append-then-check semantics), so the host never needs a
+    block's tokens to decide whether the next block may dispatch."""
+    budget = budget - active.astype(budget.dtype)
+    stop = active & ((budget <= 0) | ((eos_id >= 0) & (tok == eos_id)))
+    return active & ~stop, budget
 
 
 def generate(model, input_ids, generation_config: GenerationConfig = None,

@@ -1,4 +1,4 @@
-"""Continuous-batching serving engine over the paged-KV decode path.
+"""Async continuous-batching serving engine over the paged-KV decode path.
 
 Reference capability: the block/paged KV-cache serving stack
 (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and the
@@ -6,7 +6,7 @@ fleet dist-inference helpers). The reference exposes the kernel; serving
 systems built on it (vLLM-style) add a page allocator + request scheduler.
 This module is that scheduler, TPU-shaped:
 
-- ONE compiled decode step over ``max_batch`` fixed slots (static shapes;
+- ONE compiled decode block over ``max_batch`` fixed slots (static shapes;
   no recompilation as requests come and go). Inactive slots write their
   K/V into a reserved garbage page and their sampled token is ignored.
 - A host-side free-list page allocator over a global pool. Prompt pages
@@ -21,22 +21,50 @@ This module is that scheduler, TPU-shaped:
   (bucketed → bounded executable count); the first-token logits are taken
   at the true last-prompt index.
 
+ASYNC hot loop (vLLM SOSP'23 / NanoFlow-style host-overlap, TPU-shaped):
+
+- Stop detection runs ON DEVICE: the decode scan carries per-slot eos ids
+  and remaining-token budgets, deactivates a slot the step AFTER it emits
+  its stop token, masks later tokens to pad and routes their K/V to the
+  garbage page. The host never needs block N's tokens to decide whether
+  block N+1 may dispatch.
+- Dispatches are PIPELINED: block N+1 is issued while block N is still in
+  flight (bounded window, ``async_depth``, default 2). Block N's [K, B]
+  tokens + done flags drain via an async device→host copy and are
+  reconciled at block boundaries — retirements, admissions and page
+  bookkeeping all happen one block behind the device, hidden under its
+  compute. A slot retired by block N's results had its speculative
+  block-N+1 writes routed to the garbage page by the same on-device
+  active mask, so rollback is free and outputs are bit-identical to the
+  synchronous (``async_depth=1``) schedule.
+- Scheduler state is DEVICE-RESIDENT: pos, active mask, budgets, sampling
+  knobs and last logits persist as device arrays threaded from block to
+  block; admissions/evictions touch them through small jitted update fns.
+  The per-tick host work of the old engine (seven ``jnp.asarray`` uploads
+  + a host ``jax.random.split``) is gone; sampling keys fold on-device
+  from (seed, request id, token index), making sampled streams
+  schedule-independent (and exact across preemption/replay).
+
 The engine is exact: greedy outputs match ``generate_scan`` per request
-regardless of batching/preemption interleaving (tests/test_serving.py).
+regardless of batching/preemption/pipelining interleaving
+(tests/test_serving.py, tests/test_serving_async.py).
 """
 
 from __future__ import annotations
 
 import itertools
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generation import GenerationConfig, sample_logits_batched
+from ..profiler import RecordEvent
+from .generation import (GenerationConfig, decode_stop_update,
+                         sample_logits_per_slot)
 
 
 @dataclass
@@ -62,16 +90,42 @@ class _Request:
     prefill_target: int = 0             # prompt+replay length to prefill
 
 
+@dataclass
+class _InflightBlock:
+    """One dispatched decode block awaiting host reconciliation. The
+    device arrays are the block's OUTPUTS (fresh buffers, never donated),
+    async-copied to host at dispatch; ``participants`` snapshots the
+    (slot, request) pairs the host believed live at dispatch time —
+    a slot that stopped on-device in an earlier in-flight block simply
+    drains an all-False kept column here."""
+    toks: object                        # [K, B] device int32
+    kept: object                        # [K, B] device bool (prefix mask)
+    pos: object                         # [B] device int32, post-block
+    active: object                      # [B] device bool, post-block
+    participants: List[Tuple[int, "_Request"]]
+    K: int
+
+
+class _PoolDry(Exception):
+    """Page pool exhausted while speculative blocks are still in flight:
+    drain them first (retirements may free pages) before preempting."""
+
+
 class ContinuousBatchingEngine:
     """vLLM-style continuous batching over a model exposing the paged-KV
     trio (``alloc_paged_caches`` / ``prefill_paged`` / ``decode_step_paged``
-    on its core, e.g. ``LlamaForCausalLM``)."""
+    on its core, e.g. ``LlamaForCausalLM``).
+
+    ``async_depth``: bounded in-flight dispatch window. 1 = synchronous
+    (dispatch → drain → bookkeep, the pre-async engine's schedule, kept
+    bit-identical); 2 (default) overlaps host scheduling/bookkeeping of
+    block N with the device computing block N+1."""
 
     def __init__(self, model, max_batch: int = 8, page_size: int = 128,
                  max_len: int = 2048, num_pages: Optional[int] = None,
                  generation_config: Optional[GenerationConfig] = None,
                  decode_block: int = 1, chunked_prefill: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None, async_depth: int = 2):
         self.model = model
         self.core = getattr(model, "model", model)
         self.cfg = generation_config or GenerationConfig()
@@ -88,30 +142,40 @@ class ContinuousBatchingEngine:
         self._total_pages = total - 1
         self._free: List[int] = list(range(total - 1, 0, -1))  # stack; 0 kept
         self.tables = np.zeros((max_batch, self.pages_per_seq), np.int32)
+        self._tables_dev = None
+        self._tables_dirty = True
+        # reconciled positions (exact up to the last drained block) and
+        # the device-side PROJECTION including in-flight blocks — the
+        # allocator claims pages against the projection, so speculative
+        # writes always land in owned pages. For a live (not-stopped)
+        # slot projection == device pos; an early eos only ever makes the
+        # projection an over-claim, freed wholesale at retirement.
         self.pos = np.zeros((max_batch,), np.int32)
-        # per-slot sampling knobs, fed to the compiled block as arrays
-        self._temp = np.ones((max_batch,), np.float32)
-        self._topk = np.zeros((max_batch,), np.int32)
-        self._topp = np.ones((max_batch,), np.float32)
+        self._proj_pos = np.zeros((max_batch,), np.int64)
+        self._proj_gen = np.zeros((max_batch,), np.int64)
+        # host mirrors of the per-slot sampling knobs (device copies are
+        # updated by the jitted activation fn; the mirror only drives the
+        # any_sample executable choice)
         self._dosample = np.zeros((max_batch,), bool)
         self._slots: List[Optional[_Request]] = [None] * max_batch
-        self._queue: List[_Request] = []
+        self._queue: Deque[_Request] = deque()
         self._requests: Dict[int, _Request] = {}
         self._rid = itertools.count()
         self._params = (model.raw_parameters()
                         if hasattr(model, "raw_parameters") else {})
-        self._key = jax.random.PRNGKey(self.cfg.seed)
+        self._base_key = jax.random.PRNGKey(self.cfg.seed)
         self._prefill_cache: Dict[int, object] = {}
         # decode_block = tokens generated per compiled scheduler tick. One
         # tick costs ONE dispatch + ONE host readback regardless of K, so
         # over a high-latency link (tunneled TPU; real pods to a lesser
-        # degree) throughput scales ~K until compute dominates. Tokens a
-        # slot generates past its own EOS/max_new inside a block are
-        # discarded on the host (their garbage KV sits beyond the slot's
-        # position and is overwritten by later writes), so outputs are
-        # EXACT for any K under greedy decoding.
+        # degree) throughput scales ~K until compute dominates. The scan
+        # deactivates a slot at its own EOS/max_new ON DEVICE, so tokens
+        # past the stop are pad + garbage-page KV and outputs are EXACT
+        # for any K.
         self.decode_block = max(1, int(decode_block))
-        self._decode_fns: Dict[int, object] = {}  # K -> compiled block
+        self._decode_fns: Dict[int, object] = {}  # (K, any_sample) -> fn
+        self.async_depth = max(1, int(async_depth))
+        self._inflight: Deque[_InflightBlock] = deque()
         # chunked prefill (Sarathi/vLLM prefill-extend): admission claims
         # pages but prefill proceeds one chunk per scheduler tick,
         # interleaved with decode of running slots — bounds the per-tick
@@ -123,11 +187,20 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prefill_chunk ({self.prefill_chunk}) must "
                              f"be a multiple of page_size ({page_size})")
         self._chunk_fn = None
-        self._logits = None                # device [max_batch, vocab]
+        # device-resident scheduler state, created at first activation:
+        #   state = (logits [B,V], pos [B], active [B], budget [B], gen [B])
+        #   knobs = dict(rseed, eos, temp, topk, topp, dosample)  [B] each
+        self._state = None
+        self._knobs = None
+        self._act_fn = None
+        self._deact_fn = None
         self.preemptions = 0
+        # times a dry pool was answered by draining the in-flight window
+        # (instead of immediately evicting) — retirements it reveals often
+        # free pages without costing anyone a replay
+        self.pool_dry_drains = 0
         # bounded window (run() releases _Request objects for the same
         # reason — a long-lived engine must not grow per-request state)
-        from collections import deque
         self._latencies = deque(maxlen=10_000)  # (ttft_s, total_s, n_tok)
         # per-tick inter-token gaps of retired requests (incl. stalls a
         # preemption or a long peer prefill inflicted on them)
@@ -146,7 +219,7 @@ class ContinuousBatchingEngine:
         max_new_tokens is deliberately ignored, since a caller passing a
         config just to enable sampling would otherwise silently get the
         dataclass default budget of 32. Knobs are per-slot arrays inside
-        the one compiled decode block (sample_logits_batched), so any
+        the one compiled decode block (sample_logits_per_slot), so any
         mix of greedy and sampled requests batches together with no
         recompilation — the TPU analogue of the reference's per-row
         top_p_sampling_kernel.cu."""
@@ -178,13 +251,27 @@ class ContinuousBatchingEngine:
         return bool(self._queue) or any(s is not None for s in self._slots)
 
     def step(self) -> List[tuple]:
-        """Admit what fits, advance at most one prefill chunk (chunked
-        mode), decode a block for every decode-ready slot. Returns
-        [(rid, token), ...] emitted this step."""
-        self._admit()
+        """One scheduler tick: reconcile drained blocks, admit what fits,
+        advance at most one prefill chunk (chunked mode), dispatch the
+        next decode block. Returns [(rid, token), ...] whose results
+        ARRIVED this tick — with ``async_depth > 1`` a token is emitted
+        the tick its block drains, one block behind its dispatch."""
+        emitted: List[tuple] = []
+        with RecordEvent("serving::admit"):
+            self._admit()
         if self.chunked_prefill:
             self._prefill_tick()
-        return self._decode()
+        dispatched = self._dispatch_block(emitted)
+        if not dispatched and self._inflight:
+            # nothing new to dispatch: force progress on the oldest block
+            emitted.extend(self._reconcile_one())
+        # bounded window: block on the oldest until at most depth-1 remain
+        while len(self._inflight) > self.async_depth - 1:
+            emitted.extend(self._reconcile_one())
+        # opportunistic: drain blocks whose results already landed
+        while self._inflight and self._block_ready(self._inflight[0]):
+            emitted.extend(self._reconcile_one())
+        return emitted
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive until all submitted requests complete; returns
@@ -193,6 +280,11 @@ class ContinuousBatchingEngine:
         every request it ever served)."""
         while self.has_work():
             self.step()
+        # leftover speculative blocks are fully masked on device (every
+        # participant already stopped); reconcile them so allocator and
+        # position mirrors stay exact for the next run
+        while self._inflight:
+            self._reconcile_one()
         out = {rid: np.asarray(r.generated, np.int32)
                for rid, r in self._requests.items() if r.done}
         for rid in out:
@@ -203,7 +295,8 @@ class ContinuousBatchingEngine:
         return {"free_pages": len(self._free),
                 "active": sum(s is not None for s in self._slots),
                 "queued": len(self._queue),
-                "preemptions": self.preemptions}
+                "preemptions": self.preemptions,
+                "inflight": len(self._inflight)}
 
     # -- page allocator -----------------------------------------------------
 
@@ -218,11 +311,86 @@ class ContinuousBatchingEngine:
         # leak a boundary page granted earlier in the same scheduling pass
         self._free.extend(int(p) for p in self.tables[slot] if p != 0)
         self.tables[slot] = 0
+        self._tables_dirty = True
         self.pos[slot] = 0
+        self._proj_pos[slot] = 0
+        self._proj_gen[slot] = 0
         self._slots[slot] = None
         if req is not None:
             req.slot = -1
             req.prefilled = 0     # freed pages took the written KV along
+
+    # -- device-resident scheduler state ------------------------------------
+
+    def _init_state(self, logits_row):
+        B = self.max_batch
+        vocab = logits_row.shape[-1]
+        self._state = (jnp.zeros((B, vocab), logits_row.dtype),
+                       jnp.zeros((B,), jnp.int32),
+                       jnp.zeros((B,), bool),
+                       jnp.zeros((B,), jnp.int32),
+                       jnp.zeros((B,), jnp.int32))
+        self._knobs = {"rseed": jnp.zeros((B,), jnp.uint32),
+                       "eos": jnp.full((B,), -1, jnp.int32),
+                       "temp": jnp.ones((B,), jnp.float32),
+                       "topk": jnp.zeros((B,), jnp.int32),
+                       "topp": jnp.ones((B,), jnp.float32),
+                       "dosample": jnp.zeros((B,), bool)}
+
+    def _build_act_fn(self):
+        def run(state, knobs, slot, logits_row, pos0, budget0, gen0,
+                rseed0, eos0, temp0, topk0, topp0, dos0):
+            logits, pos, active, budget, gen = state
+            state = (logits.at[slot].set(logits_row.astype(logits.dtype)),
+                     pos.at[slot].set(pos0),
+                     active.at[slot].set(True),
+                     budget.at[slot].set(budget0),
+                     gen.at[slot].set(gen0))
+            knobs = {"rseed": knobs["rseed"].at[slot].set(rseed0),
+                     "eos": knobs["eos"].at[slot].set(eos0),
+                     "temp": knobs["temp"].at[slot].set(temp0),
+                     "topk": knobs["topk"].at[slot].set(topk0),
+                     "topp": knobs["topp"].at[slot].set(topp0),
+                     "dosample": knobs["dosample"].at[slot].set(dos0)}
+            return state, knobs
+
+        # no donation: in-flight blocks hold references to prior state
+        # arrays for their async host drains
+        return jax.jit(run)
+
+    def _activate(self, slot: int, req: _Request, logits_row):
+        """Flip a slot live on device after its prefill finished: one
+        small jitted dispatch setting the slot's row in every scheduler
+        array (pos/active/budget/gen/knobs) + its first-token logits."""
+        if self._state is None:
+            self._init_state(logits_row)
+        if self._act_fn is None:
+            self._act_fn = self._build_act_fn()
+        L = req.prefill_target
+        eos = req.eos_token_id if req.eos_token_id is not None \
+            else self.cfg.eos_token_id
+        self._state, self._knobs = self._act_fn(
+            self._state, self._knobs, np.int32(slot), logits_row,
+            np.int32(L), np.int32(req.max_new_tokens - len(req.generated)),
+            np.int32(len(req.generated)),
+            np.uint32(req.rid & 0x7FFFFFFF),
+            np.int32(-1 if eos is None else eos),
+            np.float32(req.temperature), np.int32(req.top_k),
+            np.float32(req.top_p), np.bool_(req.do_sample))
+        self.pos[slot] = L
+        self._proj_pos[slot] = L
+        self._proj_gen[slot] = len(req.generated)
+        self._dosample[slot] = req.do_sample
+
+    def _deactivate(self, slot: int):
+        if self._state is None:
+            return
+        if self._deact_fn is None:
+            self._deact_fn = jax.jit(
+                lambda active, slot: active.at[slot].set(False))
+        logits, pos, active, budget, gen = self._state
+        self._state = (logits, pos, self._deact_fn(active, np.int32(slot)),
+                       budget, gen)
 
     # -- admission / prefill ------------------------------------------------
 
@@ -266,33 +434,33 @@ class ContinuousBatchingEngine:
                         f"request {req.rid} needs {need} pages but the pool "
                         f"holds {self._total_pages}; raise num_pages")
                 return                       # wait for pages to free up
-            self._queue.pop(0)
+            self._queue.popleft()
             # replay = prompt + anything generated before a preemption
             toks = np.concatenate([req.prompt,
                                    np.asarray(req.generated, np.int32)])
             self.tables[slot, :len(pages)] = pages
+            self._tables_dirty = True
             self._slots[slot] = req
             req.slot = slot
-            self._temp[slot] = req.temperature
-            self._topk[slot] = req.top_k
-            self._topp[slot] = req.top_p
             self._dosample[slot] = req.do_sample
+            req.prefill_target = L
             if self.chunked_prefill:
                 # pages claimed now; KV written one chunk per tick
                 req.prefilled = 0
-                req.prefill_target = L
                 self.pos[slot] = 0
+                self._proj_pos[slot] = 0
+                self._proj_gen[slot] = 0
                 continue
             bucket = self._bucket(L)
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :L] = toks
-            self.pos[slot] = L
-            req.prefilled = req.prefill_target = L
-            logits, self.pools = self._prefill_fn(bucket)(
-                self._params, jnp.asarray(ids), self.pools,
-                jnp.asarray(self.tables[slot:slot + 1]),
-                jnp.int32(L - 1))
-            self._set_slot_logits(slot, logits)
+            req.prefilled = L
+            with RecordEvent("serving::prefill"):
+                logits, self.pools = self._prefill_fn(bucket)(
+                    self._params, jnp.asarray(ids), self.pools,
+                    jnp.asarray(self.tables[slot:slot + 1]),
+                    jnp.int32(L - 1))
+            self._activate(slot, req, logits)
 
     def _decode_ready(self, req) -> bool:
         return req is not None and req.prefilled >= req.prefill_target
@@ -333,73 +501,98 @@ class ContinuousBatchingEngine:
         if self._chunk_fn is None:
             self._chunk_fn = self._build_chunk_fn()
         last_idx = req.prefill_target - 1
-        logits, self.pools = self._chunk_fn(
-            self._params, jnp.asarray(ids), jnp.int32(off), self.pools,
-            jnp.asarray(self.tables[slot:slot + 1]),
-            jnp.int32(min(last_idx, off + C - 1)))
+        with RecordEvent("serving::prefill"):
+            logits, self.pools = self._chunk_fn(
+                self._params, jnp.asarray(ids), jnp.int32(off), self.pools,
+                jnp.asarray(self.tables[slot:slot + 1]),
+                jnp.int32(min(last_idx, off + C - 1)))
         req.prefilled = min(off + C, self._bucket(req.prefill_target))
         if req.prefilled >= req.prefill_target:
-            self.pos[slot] = req.prefill_target
-            self._set_slot_logits(slot, logits)
-
-    def _set_slot_logits(self, slot: int, logits):
-        if self._logits is None:
-            vocab = logits.shape[-1]
-            self._logits = jnp.zeros((self.max_batch, vocab), logits.dtype)
-        self._logits = self._logits.at[slot].set(logits)
+            self._activate(slot, req, logits)
 
     # -- decode -------------------------------------------------------------
 
     def _build_decode(self, K: int, any_sample: bool):
         """K sample+decode steps chained in one compiled lax.scan: one
-        dispatch + one [K, B] token readback per scheduler tick. Sampling
-        happens IN the scan via sample_logits_batched with per-slot knob
-        arrays — mixed greedy/sampled batches share one executable.
+        dispatch + one async [K, B] token readback per scheduler tick.
+        The scan body samples with per-slot knob arrays, then runs the
+        ON-DEVICE stop update: a slot that emits its eos or exhausts its
+        budget deactivates for the REST of the scan (and for any
+        speculatively dispatched later block — the carry's active mask is
+        the block-to-block state), its tokens masked to pad and its K/V
+        routed to the garbage page via the per-step table mask.
         ``any_sample=False`` compiles the argmax-only body (no full-vocab
         sorts in the scan) — the all-greedy common case keeps its old
         cost; the flag is host state, so at most two executables per K."""
         core, model = self.core, self.model
         head = model.logits if hasattr(model, "logits") else (lambda h: h)
 
-        def run(params, logits, pos, pools, tables, active, key,
-                temp, topk, topp, dosample):
+        def run(params, pools, tables, base_key, state, knobs):
             ctx = model._bind(params) if hasattr(model, "_bind") else None
             with ctx if ctx is not None else _null():
                 def body(carry, _):
-                    logits, pos, pools, key = carry
-                    key, sub = jax.random.split(key)
+                    logits, pos, active, budget, gen = carry[0]
+                    pools = carry[1]
                     lf = logits.astype(jnp.float32)
                     if any_sample:
-                        tok = sample_logits_batched(lf, temp, topk, topp,
-                                                    dosample, sub)
+                        # key = f(seed, request, token index): sampled
+                        # streams are schedule- and replay-independent
+                        keys = jax.vmap(
+                            lambda r, n: jax.random.fold_in(
+                                jax.random.fold_in(base_key, r), n)
+                        )(knobs["rseed"], gen)
+                        tok = sample_logits_per_slot(
+                            lf, knobs["temp"], knobs["topk"],
+                            knobs["topp"], knobs["dosample"], keys)
                     else:
                         tok = jnp.argmax(lf, axis=-1)
-                    tok = jnp.where(active, tok, 0)
-                    h, pools = core.decode_step_paged(tok, pos, pools,
-                                                      tables)
+                    tok = jnp.where(active, tok, 0).astype(jnp.int32)
+                    # inactive rows masked to the garbage page: mid-prefill
+                    # slots HOLD real pages, stopped slots' speculative
+                    # writes must be unreachable — one mask serves both
+                    tbl = tables * active[:, None].astype(tables.dtype)
+                    h, pools = core.decode_step_paged(tok, pos, pools, tbl)
                     new_logits = head(h[:, 0, :])
-                    pos = jnp.where(active, pos + 1, pos)
-                    return (new_logits, pos, pools, key), tok
+                    new_active, budget = decode_stop_update(
+                        tok, active, budget, knobs["eos"])
+                    adv = active.astype(jnp.int32)
+                    new_state = (new_logits, pos + adv, new_active,
+                                 budget, gen + adv)
+                    return (new_state, pools), (tok, active)
 
-                (logits, pos, pools, key), toks = jax.lax.scan(
-                    body, (logits, pos, pools, key), None, length=K)
-            return toks, logits, pools
+                (state, pools), (toks, kept) = jax.lax.scan(
+                    body, (state, pools), None, length=K)
+            return toks, kept, state, pools
 
-        return jax.jit(run, donate_argnums=(3,))
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _participants(self) -> List[Tuple[int, _Request]]:
+        """Slots the NEXT block decodes for: prefill done and not yet
+        scheduled through their whole token budget (a slot whose budget
+        is fully in flight has nothing left to dispatch — the device
+        would mask every step anyway)."""
+        return [(s, r) for s in range(self.max_batch)
+                if self._decode_ready(r := self._slots[s])
+                and int(self._proj_gen[s]) < r.max_new_tokens]
 
     def _ensure_decode_pages(self, K: int = 1):
-        """Claim every page any active slot will KEEP writes in within the
-        next K decode steps; preempt (recompute policy) when the pool is
-        dry. A slot's claim span is capped by its remaining max_new
-        budget — in-block steps past that produce discarded tokens whose
-        KV lands in the garbage page (tables entry 0), so claiming for
-        them would evict victims for pages never legitimately written."""
+        """Claim every page any active slot may KEEP writes in within the
+        next K decode steps (against the in-flight PROJECTION of its
+        position); preempt (recompute policy) when the pool is dry. A
+        slot's claim span is capped by its remaining max_new budget —
+        in-block steps past that are masked on device, so claiming for
+        them would evict victims for pages never legitimately written.
+        With speculative blocks outstanding a dry pool raises _PoolDry
+        instead: draining may retire slots and free pages without an
+        eviction."""
         for slot in range(self.max_batch):
             req = self._slots[slot]
             if not self._decode_ready(req):
                 continue              # mid-prefill slots claim at admission
-            pos = int(self.pos[slot])
-            span = min(K, req.max_new_tokens - len(req.generated))
+            pos = int(self._proj_pos[slot])
+            span = min(K, req.max_new_tokens - int(self._proj_gen[slot]))
+            if span <= 0:
+                continue              # budget fully in flight already
             first = pos // self.page_size    # ceil == floor at a boundary;
             # a mid-page pos's current page is already held (tables check)
             last = (pos + span - 1) // self.page_size
@@ -410,6 +603,8 @@ class ContinuousBatchingEngine:
                     continue                  # already holds this page
                 page = self._alloc_pages(1)
                 while page is None:
+                    if self._inflight:
+                        raise _PoolDry()
                     victim = max((i for i in range(self.max_batch)
                                   if self._slots[i] is not None
                                   and i != slot),
@@ -420,85 +615,128 @@ class ContinuousBatchingEngine:
                             "page pool too small for one request")
                     self.preemptions += 1
                     vreq = self._slots[victim]
+                    self._deactivate(victim)
                     self._free_slot(victim)
-                    self._queue.insert(0, vreq)
+                    self._queue.appendleft(vreq)
                     page = self._alloc_pages(1)
                 self.tables[slot, pidx] = page[0]
+                self._tables_dirty = True
 
-    def _decode(self) -> List[tuple]:
-        active_slots = [i for i, s in enumerate(self._slots)
-                        if self._decode_ready(s)]
-        if not active_slots:
-            return []
-        # block length this tick: the configured K, capped so no slot's
-        # in-block writes can run past its page-table capacity
-        cap = self.pages_per_seq * self.page_size
-        K = min(self.decode_block,
-                min(cap - int(self.pos[i]) for i in active_slots))
-        K = max(K, 1)
-        self._ensure_decode_pages(K)
-        # a preemption may have emptied every slot
-        active_slots = [i for i, s in enumerate(self._slots)
-                        if self._decode_ready(s)]
-        if not active_slots:
-            return []
-        any_sample = bool(self._dosample[active_slots].any())
+    def _dispatch_block(self, emitted: List[tuple]) -> bool:
+        """Issue the next decode block WITHOUT waiting for in-flight
+        ones. Returns False when no decode-ready slot has budget left."""
+        while True:
+            parts = self._participants()
+            if not parts:
+                return False
+            # block length this tick: the configured K, capped so no
+            # slot's in-block writes can run past its page-table capacity
+            cap = self.pages_per_seq * self.page_size
+            K = min(self.decode_block,
+                    min(cap - int(self._proj_pos[s]) for s, _ in parts))
+            K = max(K, 1)
+            try:
+                self._ensure_decode_pages(K)
+            except _PoolDry:
+                # drain the pipeline: retirements it reveals may free
+                # pages; only preempt once the engine is fully caught up
+                self.pool_dry_drains += 1
+                emitted.extend(self._drain_all())
+                continue
+            # a preemption may have emptied or reshuffled the slots
+            parts = self._participants()
+            if not parts:
+                return False
+            break
+        any_sample = bool(any(self._dosample[s] for s, _ in parts))
         fn = self._decode_fns.get((K, any_sample))
         if fn is None:
             fn = self._decode_fns[(K, any_sample)] = self._build_decode(
                 K, any_sample)
-        active = np.zeros((self.max_batch,), bool)
-        active[active_slots] = True
-        # inactive rows masked to the garbage page: a mid-prefill slot
-        # HOLDS real pages, and the compiled block writes KV for every
-        # slot — without the mask those writes would corrupt its prefix
-        tables_arg = self.tables * active[:, None]
-        self._key, sub = jax.random.split(self._key)
-        toks, self._logits, self.pools = fn(
-            self._params, self._logits, jnp.asarray(self.pos), self.pools,
-            jnp.asarray(tables_arg), jnp.asarray(active), sub,
-            jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), jnp.asarray(self._dosample))
-        toks_host = np.asarray(toks)          # [K, max_batch]
-        emitted = []
+        if self._tables_dirty:
+            self._tables_dev = jnp.asarray(self.tables)
+            self._tables_dirty = False
+        with RecordEvent("serving::dispatch"):
+            toks, kept, self._state, self.pools = fn(
+                self._params, self.pools, self._tables_dev,
+                self._base_key, self._state, self._knobs)
+            # start the device→host copies NOW so reconciliation (one or
+            # more blocks later) finds the bytes already on host
+            for arr in (toks, kept, self._state[1], self._state[2]):
+                copy = getattr(arr, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
+        for s, req in parts:
+            steps = min(K, req.max_new_tokens - int(self._proj_gen[s]))
+            self._proj_gen[s] += steps
+            self._proj_pos[s] += steps
+        self._inflight.append(_InflightBlock(
+            toks, kept, self._state[1], self._state[2], parts, K))
+        return True
+
+    def _block_ready(self, blk: _InflightBlock) -> bool:
+        try:
+            return bool(blk.toks.is_ready()) and bool(blk.active.is_ready())
+        except Exception:
+            return False
+
+    def _drain_all(self) -> List[tuple]:
+        emitted: List[tuple] = []
+        while self._inflight:
+            emitted.extend(self._reconcile_one())
+        return emitted
+
+    def _reconcile_one(self) -> List[tuple]:
+        """Drain the OLDEST in-flight block and run the host bookkeeping
+        the device already moved past: append kept tokens, retire slots
+        whose done flag came back, record arrival-time latency metrics."""
+        blk = self._inflight.popleft()
+        with RecordEvent("serving::drain"):
+            toks = np.asarray(blk.toks)            # [K, B]
+            kept = np.asarray(blk.kept)            # [K, B] prefix mask
+            pos_after = np.asarray(blk.pos)
+            active_after = np.asarray(blk.active)
+        emitted: List[tuple] = []
+        # TTFT/ITL stamp at token-ARRIVAL time: under pipelining a
+        # block's tokens only exist on host once its drain completes, so
+        # percentiles stay honest about what a client would observe
         now = time.perf_counter()
-        for slot in active_slots:
-            req = self._slots[slot]
-            # inter-token latency, measured per SCHEDULER TICK (a K-token
-            # block emits together; the stall a long prefill inflicts on
-            # running requests shows up as one big gap here — the metric
-            # chunked_prefill exists to bound)
-            if req.last_emit_t:
-                req.itl_gaps.append(now - req.last_emit_t)
-            req.last_emit_t = now
-            # per-request eos wins over the engine default (the stop check
-            # is host-side per token, so honoring it costs nothing)
-            eos = req.eos_token_id if req.eos_token_id is not None \
-                else self.cfg.eos_token_id
-            kept = 0
-            for j in range(K):
-                t = int(toks_host[j, slot])
+        for slot, req in blk.participants:
+            if self._slots[slot] is not req or req.done:
+                continue      # retired by an earlier block's reconcile
+            nk = 0
+            for j in range(blk.K):
+                if not kept[j, slot]:
+                    break     # active only falls within a block: prefix
+                t = int(toks[j, slot])
                 req.generated.append(t)
-                kept += 1
+                nk += 1
                 if req.first_tok_t == 0.0:
                     req.first_tok_t = now
                 emitted.append((req.rid, t))
-                if (len(req.generated) >= req.max_new_tokens
-                        or (eos is not None and t == eos)):
-                    req.done = True
-                    break
-            if req.done:
+            if nk:
+                # inter-token latency, measured per SCHEDULER TICK (a
+                # K-token block emits together; the stall a long prefill
+                # inflicts on running requests shows up as one big gap —
+                # the metric chunked_prefill exists to bound)
+                if req.last_emit_t:
+                    req.itl_gaps.append(now - req.last_emit_t)
+                req.last_emit_t = now
+            if not active_after[slot]:
+                # the device's done flag: eos or budget hit inside this
+                # block. Tokens past the stop were masked on device and
+                # their KV routed to the garbage page; _free_slot resets
+                # tables so even the kept KV becomes unreachable.
+                req.done = True
                 req.done_t = now
                 self._latencies.append(
                     (req.first_tok_t - req.submit_t,
                      req.done_t - req.submit_t,
                      len(req.generated)))
                 self._itl_gaps.extend(req.itl_gaps)
-                # tokens past the stop point (and their KV) are dropped;
-                # _free_slot resets pos/tables so the garbage is unreachable
                 self._free_slot(slot)
             else:
-                self.pos[slot] += kept        # kept == K here
+                self.pos[slot] = int(pos_after[slot])
         return emitted
 
     def reset_latency_stats(self) -> None:
@@ -512,7 +750,9 @@ class ContinuousBatchingEngine:
         the most recent 10,000 retired requests (survives run()'s request
         release; ``requests``/``tokens`` count the window, not lifetime) —
         the serving SLO numbers (reference: PaddleNLP llm serving
-        benchmarks report the same trio: throughput, TTFT, p99)."""
+        benchmarks report the same trio: throughput, TTFT, p99).
+        Timestamps are token-ARRIVAL times (post-drain), so pipelined
+        dispatch cannot flatter the percentiles."""
         if not self._latencies:
             return {}
         arr = np.asarray(self._latencies, np.float64)

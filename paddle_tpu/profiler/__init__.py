@@ -26,8 +26,17 @@ from typing import Callable, Iterable, Optional
 __all__ = [
     "ProfilerTarget", "ProfilerState", "make_scheduler", "RecordEvent",
     "Profiler", "export_chrome_tracing", "export_protobuf", "load_profiler_result",
-    "SummaryView", "benchmark",
+    "SummaryView", "benchmark", "SERVING_EVENTS", "serving_trace",
 ]
+
+# tick-level spans the async ContinuousBatchingEngine emits through
+# RecordEvent (near-zero cost unless a Profiler is recording): request
+# admission, per-slot prefill (full or chunked), decode-block dispatch,
+# and the async device→host drain/reconcile. A chrome trace of one
+# serving run shows dispatch N+1 opening before drain N closes — the
+# overlap the engine's in-flight window exists to create.
+SERVING_EVENTS = ("serving::admit", "serving::prefill",
+                  "serving::dispatch", "serving::drain")
 
 
 class ProfilerTarget(Enum):
@@ -405,6 +414,32 @@ _benchmark = _BenchmarkTimer()
 def benchmark() -> _BenchmarkTimer:
     """Global ips timer (reference: paddle.profiler.utils.benchmark)."""
     return _benchmark
+
+
+class serving_trace:
+    """Context manager tracing a serving-engine run into a chrome trace:
+
+        with profiler.serving_trace("./prof") as p:
+            engine.run()
+        # ./prof/<worker>_step0.json: admit/prefill/dispatch/drain spans
+
+    Wraps a RECORD-always Profiler wired to ``export_chrome_tracing`` so
+    the engine's SERVING_EVENTS spans (and any other RecordEvent in the
+    process) land in one chrome://tracing JSON per recording."""
+
+    def __init__(self, dir_name: str, worker_name: Optional[str] = None,
+                 trace_device: bool = False):
+        self._prof = Profiler(
+            on_trace_ready=export_chrome_tracing(dir_name, worker_name),
+            trace_device=trace_device)
+
+    def __enter__(self) -> Profiler:
+        self._prof.start()
+        return self._prof
+
+    def __exit__(self, *exc):
+        self._prof.stop()
+        return False
 
 
 class SortedKeys:

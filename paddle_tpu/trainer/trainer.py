@@ -337,7 +337,11 @@ class Trainer:
         # host work this runtime exists to strip
         batch = args[2]
         try:
-            fast = (kind, tuple(sorted((k, v.shape)
+            # shape AND dtype: a same-shape batch whose leaf dtype drifts
+            # (e.g. labels int32 → int64 from a numpy default) must fall
+            # through to the aval-keyed slow path and recompile, not hit a
+            # stale executable and die on an aval-mismatch TypeError
+            fast = (kind, tuple(sorted((k, v.shape, str(v.dtype))
                                        for k, v in batch.items())))
         except Exception:
             fast = None
