@@ -37,8 +37,12 @@ import hashlib
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
+
+from ..observability.goodput import ledger as _goodput_ledger
+from ..observability.metrics import REGISTRY as _REG
 
 __all__ = [
     "acquire", "aval_signature", "fingerprint", "configure_compilation_cache",
@@ -175,14 +179,22 @@ def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
                 pass
         return hit, "hit"
     if aot_dir:
-        fn = load_aot(aot_dir, name, fp, donate_argnums=donate_argnums)
+        with _goodput_ledger().span("compile"):
+            fn = load_aot(aot_dir, name, fp, donate_argnums=donate_argnums)
         if fn is not None:
             _store(fp, fn)
             with _LOCK:
                 _STATS["aot_hits"] += 1
             return fn, "aot_hit"
     try:
-        fn = jitted.lower(*args).compile()
+        t0 = time.perf_counter()
+        with _goodput_ledger().span("compile"):
+            fn = jitted.lower(*args).compile()
+        if _REG.enabled:
+            _REG.histogram("pt_compile_seconds",
+                           "trace+lower+XLA-compile wall time per "
+                           "executable", "s").observe(
+                time.perf_counter() - t0, name=name)
     except Exception:
         # exotic arg types: fall back to live dispatch WITHOUT caching —
         # the jitted closure pins its Trainer's model/optimizer, and a

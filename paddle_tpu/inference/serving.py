@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.metrics import REGISTRY as _REG
 from ..profiler import RecordEvent
 from .generation import (GenerationConfig, decode_stop_update,
                          sample_logits_per_slot)
@@ -205,6 +206,26 @@ class ContinuousBatchingEngine:
         # per-tick inter-token gaps of retired requests (incl. stalls a
         # preemption or a long peer prefill inflicted on them)
         self._itl_gaps = deque(maxlen=100_000)
+        # metrics-plane lifetime counters (plain attrs: zero cost until
+        # publish_metrics mirrors them into the registry as deltas)
+        self._tokens_emitted = 0
+        self._requests_retired = 0
+        self._published: Dict[str, float] = {}
+        # gauge handles resolved ONCE (registry.reset() keeps metric
+        # objects valid): the per-tick path must not pay a registry
+        # name-lookup per gauge per tick
+        self._g_queue = _REG.gauge("pt_serving_queue_depth",
+                                   "requests waiting for a slot")
+        self._g_inflight = _REG.gauge(
+            "pt_serving_inflight_blocks",
+            "decode blocks dispatched but not yet drained")
+        self._g_active = _REG.gauge("pt_serving_active_slots",
+                                    "slots holding a request")
+        self._g_free = _REG.gauge("pt_serving_free_pages",
+                                  "KV pool pages unclaimed")
+        self._g_occupancy = _REG.gauge(
+            "pt_serving_page_pool_occupancy",
+            "fraction of the KV page pool claimed")
 
     # -- public API ---------------------------------------------------------
 
@@ -271,6 +292,8 @@ class ContinuousBatchingEngine:
         # opportunistic: drain blocks whose results already landed
         while self._inflight and self._block_ready(self._inflight[0]):
             emitted.extend(self._reconcile_one())
+        if _REG.enabled:
+            self._tick_gauges()
         return emitted
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -289,6 +312,8 @@ class ContinuousBatchingEngine:
                for rid, r in self._requests.items() if r.done}
         for rid in out:
             del self._requests[rid]
+        if _REG.enabled:
+            self.publish_metrics()
         return out
 
     def stats(self) -> Dict[str, int]:
@@ -297,6 +322,56 @@ class ContinuousBatchingEngine:
                 "queued": len(self._queue),
                 "preemptions": self.preemptions,
                 "inflight": len(self._inflight)}
+
+    # -- metrics plane -------------------------------------------------------
+
+    def _tick_gauges(self) -> None:
+        """Per-tick point-in-time view (cheap: five cached-handle gauge
+        sets, and only ever reached when the registry is enabled)."""
+        self._g_queue.set(len(self._queue))
+        self._g_inflight.set(len(self._inflight))
+        self._g_active.set(sum(s is not None for s in self._slots))
+        self._g_free.set(len(self._free))
+        self._g_occupancy.set(
+            1.0 - len(self._free) / max(self._total_pages, 1))
+
+    def publish_metrics(self) -> Dict[str, float]:
+        """Mirror the engine's telemetry into the process metrics registry
+        — the counters/percentiles ``stats()``/``latency_stats()`` used to
+        be the only window onto. Lifetime counters publish as DELTAS since
+        the previous publish, so registry counters stay monotonic across
+        repeated calls; called automatically at ``run()`` completion and
+        safe to call any time. Returns ``latency_stats()`` for
+        convenience."""
+        lat = self.latency_stats()
+        if not _REG.enabled:
+            return lat
+        for name, val, help in (
+                ("pt_serving_preemptions_total", self.preemptions,
+                 "recompute-policy slot evictions"),
+                ("pt_serving_pool_dry_drains_total", self.pool_dry_drains,
+                 "dry pools answered by draining the in-flight window"),
+                ("pt_serving_tokens_total", self._tokens_emitted,
+                 "tokens emitted to clients"),
+                ("pt_serving_requests_total", self._requests_retired,
+                 "requests retired")):
+            prev = self._published.get(name, 0)
+            if val > prev:
+                _REG.counter(name, help).inc(val - prev)
+            self._published[name] = val
+        for key, metric in (("ttft", "pt_serving_ttft_seconds"),
+                            ("latency", "pt_serving_latency_seconds"),
+                            ("itl", "pt_serving_itl_seconds")):
+            for q in ("p50", "p99"):
+                v = lat.get(f"{key}_{q}_s")
+                if v is not None:
+                    _REG.gauge(metric, f"{key} percentile over the retired-"
+                                       f"request window", "s").set(v, q=q)
+        _REG.gauge("pt_serving_window_requests",
+                   "retired requests in the latency window").set(
+            lat.get("requests", 0))
+        self._tick_gauges()
+        return lat
 
     # -- page allocator -----------------------------------------------------
 
@@ -715,6 +790,7 @@ class ContinuousBatchingEngine:
                     req.first_tok_t = now
                 emitted.append((req.rid, t))
             if nk:
+                self._tokens_emitted += nk
                 # inter-token latency, measured per SCHEDULER TICK (a
                 # K-token block emits together; the stall a long prefill
                 # inflicts on running requests shows up as one big gap —
@@ -729,6 +805,7 @@ class ContinuousBatchingEngine:
                 # tables so even the kept KV becomes unreachable.
                 req.done = True
                 req.done_t = now
+                self._requests_retired += 1
                 self._latencies.append(
                     (req.first_tok_t - req.submit_t,
                      req.done_t - req.submit_t,

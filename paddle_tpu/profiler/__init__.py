@@ -20,13 +20,14 @@ import os
 import threading
 import time
 from collections import defaultdict
-from enum import Enum
+from enum import Enum, IntEnum
 from typing import Callable, Iterable, Optional
 
 __all__ = [
     "ProfilerTarget", "ProfilerState", "make_scheduler", "RecordEvent",
     "Profiler", "export_chrome_tracing", "export_protobuf", "load_profiler_result",
-    "SummaryView", "benchmark", "SERVING_EVENTS", "serving_trace",
+    "SummaryView", "SortedKeys", "benchmark", "SERVING_EVENTS",
+    "serving_trace",
 ]
 
 # tick-level spans the async ContinuousBatchingEngine emits through
@@ -121,6 +122,20 @@ class _Collector:
 
 _collector = _Collector()
 
+# optional second sink: a bounded deque the observability flight recorder
+# attaches so the last few hundred spans survive to a crash dump EVEN when
+# no Profiler is recording. None (the default) keeps RecordEvent's
+# near-zero disabled cost: one module-global load + None check.
+_flight_sink = None
+
+
+def set_flight_sink(sink) -> None:
+    """Attach/detach (None) the flight-recorder span ring. Entries are
+    ``(name, start_ns, end_ns, tid, event_type)`` tuples appended at span
+    end; the deque's maxlen bounds memory."""
+    global _flight_sink
+    _flight_sink = sink
+
 
 class RecordEvent:
     """Instrumentation span (reference: paddle.profiler.RecordEvent; C++
@@ -142,6 +157,10 @@ class RecordEvent:
             _collector.add(_HostEvent(self.name, self._start_ns,
                                       time.perf_counter_ns(),
                                       threading.get_ident(), self.event_type))
+        sink = _flight_sink
+        if sink is not None:
+            sink.append((self.name, self._start_ns, time.perf_counter_ns(),
+                         threading.get_ident(), self.event_type))
         self._start_ns = None
 
     def __enter__(self):
@@ -220,6 +239,34 @@ class SummaryView(Enum):
     UDFView = 8
 
 
+class SortedKeys(IntEnum):
+    """Summary sort orders (reference: python/paddle/profiler/profiler.py
+    SortedKeys enum). IntEnum: reference code compares members to ints.
+    Host events are the only table here (the device side is xplane), so
+    the GPU* keys sort by the same host aggregates as their CPU twins."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+# sort key per order: aggregate of the per-name duration list, table sorted
+# DESCENDING on it (largest first — the reference's convention); *Min uses
+# the smallest single call so "which op has the worst best-case" reads off
+# the top.
+_SORT_AGG = {
+    SortedKeys.CPUTotal: sum, SortedKeys.GPUTotal: sum,
+    SortedKeys.CPUAvg: lambda d: sum(d) / len(d),
+    SortedKeys.GPUAvg: lambda d: sum(d) / len(d),
+    SortedKeys.CPUMax: max, SortedKeys.GPUMax: max,
+    SortedKeys.CPUMin: min, SortedKeys.GPUMin: min,
+}
+
+
 # ---------------------------------------------------------------------------
 # Profiler
 # ---------------------------------------------------------------------------
@@ -266,6 +313,7 @@ class Profiler:
         self._record_start_step = 0
         self._export_count = 0
         self._step_times: list[float] = []
+        self._samples_total = 0
         self._last_step_t: Optional[float] = None
         self._device_tracing = False
 
@@ -335,8 +383,11 @@ class Profiler:
         now = time.perf_counter()
         if self._last_step_t is not None:
             self._step_times.append(now - self._last_step_t)
+            if num_samples is not None:
+                # samples processed by the step that just finished —
+                # accumulated so step_info can report TRUE samples/sec
+                self._samples_total += int(num_samples)
         self._last_step_t = now
-        self._num_samples = num_samples
         self.step_num += 1
         self._transition(self.scheduler(self.step_num))
 
@@ -354,23 +405,40 @@ class Profiler:
     # -- reporting ---------------------------------------------------------
 
     def step_info(self, unit: str = "samples/sec") -> str:
+        """Throughput line. When ``step(num_samples=...)`` supplied sample
+        counts, reports accumulated-samples / elapsed ("<rate> <unit>");
+        otherwise the rate is steps/sec and is LABELED steps/sec — the old
+        behavior reported steps/sec under a "samples/sec" banner."""
         if not self._step_times:
             return "no steps recorded"
-        avg = sum(self._step_times) / len(self._step_times)
-        return (f"avg step time {avg * 1000:.2f} ms "
-                f"({1.0 / avg:.2f} steps/sec)")
+        total = sum(self._step_times)
+        avg = total / len(self._step_times)
+        if self._samples_total and total > 0:
+            rate, label = self._samples_total / total, unit
+        else:
+            rate, label = 1.0 / avg, "steps/sec"
+        return f"avg step time {avg * 1000:.2f} ms ({rate:.2f} {label})"
 
     def summary(self, sorted_by=None, views=None) -> str:
-        """Aggregated per-name host-event table (profiler_statistic shape)."""
+        """Aggregated per-name host-event table (profiler_statistic shape),
+        sorted by ``sorted_by`` (a :class:`SortedKeys`, its int value, or
+        None = CPUTotal)."""
+        if sorted_by is None:
+            sorted_by = SortedKeys.CPUTotal
+        elif not isinstance(sorted_by, SortedKeys):
+            sorted_by = SortedKeys(sorted_by)
+        agg_fn = _SORT_AGG[sorted_by]
         agg: dict[str, list[float]] = defaultdict(list)
         events = self.result.events if self.result else []
         for ev in events:
             agg[ev.name].append((ev.end_ns - ev.start_ns) / 1e6)
-        rows = sorted(agg.items(), key=lambda kv: -sum(kv[1]))
-        lines = [f"{'Name':<40} {'Calls':>6} {'Total(ms)':>12} {'Avg(ms)':>10}"]
+        rows = sorted(agg.items(), key=lambda kv: -agg_fn(kv[1]))
+        lines = [f"{'Name':<40} {'Calls':>6} {'Total(ms)':>12} "
+                 f"{'Avg(ms)':>10} {'Max(ms)':>10} {'Min(ms)':>10}"]
         for name, durs in rows:
             lines.append(f"{name[:40]:<40} {len(durs):>6} {sum(durs):>12.3f} "
-                         f"{sum(durs) / len(durs):>10.3f}")
+                         f"{sum(durs) / len(durs):>10.3f} {max(durs):>10.3f} "
+                         f"{min(durs):>10.3f}")
         lines.append(self.step_info())
         return "\n".join(lines)
 
@@ -440,16 +508,3 @@ class serving_trace:
     def __exit__(self, *exc):
         self._prof.stop()
         return False
-
-
-class SortedKeys:
-    """Summary sort orders (reference: python/paddle/profiler/profiler.py
-    SortedKeys enum)."""
-    CPUTotal = 0
-    CPUAvg = 1
-    CPUMax = 2
-    CPUMin = 3
-    GPUTotal = 4
-    GPUAvg = 5
-    GPUMax = 6
-    GPUMin = 7

@@ -39,7 +39,11 @@ unconditionally, warmup included.
 from __future__ import annotations
 
 import math
+from collections import deque
 from typing import Optional
+
+from ..observability import flight_recorder as _flight
+from ..observability.metrics import REGISTRY as _REG
 
 __all__ = ["AnomalyGuard", "DivergenceError",
            "OK", "SKIP", "ROLLBACK", "ABORT"]
@@ -80,6 +84,13 @@ class AnomalyGuard:
         self._ewma: Optional[float] = None
         self._dev = 0.0
         self._seen = 0
+        # the final loss window a flight-recorder dump ships for the
+        # post-mortem: every CHECKED loss, anomalous or not, in order
+        self.recent_losses = deque(maxlen=64)
+        # counter handle resolved once (check() can run per STEP; the
+        # registry name-lookup must not ride the training loop)
+        self._verdict_counter = _REG.counter(
+            "pt_anomaly_verdicts_total", "AnomalyGuard verdicts by outcome")
 
     # -- detection ----------------------------------------------------------
 
@@ -114,22 +125,39 @@ class AnomalyGuard:
     def check(self, loss: float) -> str:
         """One per-step verdict: OK (loss recorded), or SKIP / ROLLBACK /
         ABORT per policy and remaining budget."""
+        self.recent_losses.append(float(loss))
         reason = self.is_anomalous(float(loss))
         if reason is None:
             self.record(float(loss))
             self.last_reason = None
-            return OK
+            return self._verdict(OK)
         self.anomalies += 1
         self.last_reason = reason
         if self.policy == ABORT:
-            return ABORT
+            return self._verdict(ABORT)
         if self.policy == SKIP:
             self.skips += 1
-            return SKIP if self.skips <= self.max_skips else ABORT
+            return self._verdict(
+                SKIP if self.skips <= self.max_skips else ABORT)
         self.rollbacks += 1
-        return ROLLBACK if self.rollbacks <= self.max_rollbacks else ABORT
+        return self._verdict(
+            ROLLBACK if self.rollbacks <= self.max_rollbacks else ABORT)
+
+    def _verdict(self, verdict: str) -> str:
+        if _REG.enabled:
+            self._verdict_counter.inc(verdict=verdict)
+        return verdict
 
     def raise_divergence(self, step: int, loss: float) -> None:
+        # ship the post-mortem before dying: the flight dump carries the
+        # final loss window + the last trainer/serving spans (no-op when
+        # the recorder is not active)
+        _flight.maybe_dump("anomaly_abort", extra={
+            "step": int(step), "loss": float(loss),
+            "reason": self.last_reason,
+            "loss_window": list(self.recent_losses),
+            "skips": self.skips, "rollbacks": self.rollbacks,
+        })
         raise DivergenceError(
             f"loss anomaly at step {step} ({self.last_reason or loss}) with "
             f"recovery budget exhausted (skips={self.skips}/{self.max_skips},"

@@ -37,6 +37,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import checkpoint as _ckpt
+from ..observability.goodput import ledger as _ledger
+from ..observability.metrics import REGISTRY as _REG
 
 __all__ = ["CheckpointManager", "CheckpointCorruption"]
 
@@ -149,23 +151,38 @@ class CheckpointManager:
         save — notably the final preemption save — is not misread as a
         hung step and killed mid-checkpoint."""
         step = int(step)
-        self.finalize(watchdog=watchdog)    # previous async save first
-        if not force and os.path.isfile(
-                os.path.join(self.step_dir(step), COMMIT_MARKER)):
-            return False
-        use_async = self.async_save if async_save is None else bool(async_save)
-        sdir = self.step_dir(step)
-        if os.path.isdir(sdir):         # failed earlier attempt: clear it
-            shutil.rmtree(sdir, ignore_errors=True)
-        _atomic_write(self._pending_path(step),
-                      json.dumps({"step": step, "ts": time.time()}).encode())
-        self._with_retries(
-            lambda: _ckpt.save_state_dict(tree, sdir, async_save=use_async),
-            what=f"save step_{step}")
-        if use_async:
-            self._pending = step
-        else:
-            self._commit(step, watchdog=watchdog)
+        t0 = time.perf_counter()
+        # host-blocking extent books as checkpoint_save in the goodput
+        # ledger (async saves: only the enqueue + previous-save drain —
+        # the background write itself never owns the step loop's clock)
+        with _ledger().span("checkpoint_save"):
+            self.finalize(watchdog=watchdog)    # previous async save first
+            if not force and os.path.isfile(
+                    os.path.join(self.step_dir(step), COMMIT_MARKER)):
+                return False
+            use_async = (self.async_save if async_save is None
+                         else bool(async_save))
+            sdir = self.step_dir(step)
+            if os.path.isdir(sdir):     # failed earlier attempt: clear it
+                shutil.rmtree(sdir, ignore_errors=True)
+            _atomic_write(
+                self._pending_path(step),
+                json.dumps({"step": step, "ts": time.time()}).encode())
+            self._with_retries(
+                lambda: _ckpt.save_state_dict(tree, sdir,
+                                              async_save=use_async),
+                what=f"save step_{step}")
+            if use_async:
+                self._pending = step
+            else:
+                self._commit(step, watchdog=watchdog)
+        if _REG.enabled:
+            mode = "async" if use_async else "sync"
+            _REG.counter("pt_checkpoint_saves_total",
+                         "checkpoints written").inc(mode=mode)
+            _REG.histogram("pt_checkpoint_save_seconds",
+                           "host-blocking save duration", "s").observe(
+                time.perf_counter() - t0, mode=mode)
         return True
 
     def finalize(self, watchdog=None) -> Optional[int]:
@@ -177,12 +194,13 @@ class CheckpointManager:
         if self._pending is None:
             return None
         step, self._pending = self._pending, None
-        try:
-            _ckpt.wait_until_finished(watchdog=watchdog)
-        except Exception:
-            self._quarantine(step, "async-save-failed")
-            raise
-        self._commit(step, watchdog=watchdog)
+        with _ledger().span("checkpoint_save"):
+            try:
+                _ckpt.wait_until_finished(watchdog=watchdog)
+            except Exception:
+                self._quarantine(step, "async-save-failed")
+                raise
+            self._commit(step, watchdog=watchdog)
         return step
 
     def wait(self, watchdog=None) -> Optional[int]:
@@ -276,6 +294,10 @@ class CheckpointManager:
             k += 1
             dst = f"{base}-{k}"
         shutil.move(sdir, dst)
+        if _REG.enabled:
+            _REG.counter("pt_checkpoint_quarantines_total",
+                         "step dirs moved aside as suspect").inc(
+                reason=reason)
         try:
             os.remove(self._pending_path(step))
         except FileNotFoundError:
@@ -323,16 +345,24 @@ class CheckpointManager:
         spec_tree = spec_tree if spec_tree is not None else self.spec_tree
         candidates = ([int(step)] if step is not None
                       else list(reversed(self.committed_steps())))
-        for s in candidates:
-            if not self.verify(s, watchdog=watchdog):
-                self._quarantine(s, "corrupt")
-                continue
-            tree = self._with_retries(
-                lambda s=s: _ckpt.load_state_dict(
-                    self.step_dir(s), like_tree, mesh=mesh,
-                    spec_tree=spec_tree),
-                what=f"restore step_{s}")
-            return s, tree
+        t0 = time.perf_counter()
+        with _ledger().span("restore"):
+            for s in candidates:
+                if not self.verify(s, watchdog=watchdog):
+                    self._quarantine(s, "corrupt")
+                    continue
+                tree = self._with_retries(
+                    lambda s=s: _ckpt.load_state_dict(
+                        self.step_dir(s), like_tree, mesh=mesh,
+                        spec_tree=spec_tree),
+                    what=f"restore step_{s}")
+                if _REG.enabled:
+                    _REG.counter("pt_checkpoint_restores_total",
+                                 "checkpoint restores").inc()
+                    _REG.histogram("pt_checkpoint_restore_seconds",
+                                   "verify+load duration", "s").observe(
+                        time.perf_counter() - t0)
+                return s, tree
         return None
 
     # -- retention ----------------------------------------------------------

@@ -40,10 +40,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..core import compile_cache
 from ..core.rng import rng_tracker
 from ..nn.layer import Layer
 from ..optimizer.optimizer import Optimizer
+from ..profiler import RecordEvent
+
+# span names the trainer emits through RecordEvent (profiler traces and
+# the flight recorder's span ring both see them; near-zero cost when
+# neither is attached — same contract as SERVING_EVENTS)
+TRAINER_EVENTS = ("trainer::dispatch", "trainer::checkpoint")
 
 # bf16 peak TFLOP/s per chip
 PEAK_FLOPS = {
@@ -361,7 +368,8 @@ class Trainer:
                 exec_cache[sig] = fn
             if fast is not None:
                 self._fast_exec[fast] = fn
-        out = fn(*args)
+        with RecordEvent("trainer::dispatch"):
+            out = fn(*args)
         self.dispatch_stats["dispatches"] += 1
         self.dispatch_stats["dispatch_host_s"] += time.perf_counter() - t0
         return out
@@ -557,17 +565,29 @@ class Trainer:
             d = os.path.join(checkpoint_manager.root, "_compile_cache")
             if os.path.isdir(d):
                 self._aot_dir = d
-        if resume and checkpoint_manager is not None:
-            self._resume_from(checkpoint_manager, data)
-            target = int(steps)
-        else:
-            target = self._step + int(steps)
-        it = iter(data)
-        history = []
-        t_last = time.perf_counter()
-        tokens_since = 0
-        loss = None
+        if (checkpoint_manager is not None
+                and _obs.flight_recorder.recorder().active):
+            # crash dumps land next to the quarantine dir so a post-mortem
+            # ships with the checkpoint state it describes
+            _obs.flight_recorder.set_dir(
+                os.path.join(checkpoint_manager.root, "_flight"))
+        # goodput ledger: the whole fit window is accounted wall-time;
+        # everything not claimed by a span (compile/save/restore/preempt)
+        # books as productive_step, and metering happens only at the
+        # boundaries this loop already crosses — no new device fences
+        led = _obs.ledger()
+        led.run_start()
         try:
+            if resume and checkpoint_manager is not None:
+                self._resume_from(checkpoint_manager, data)
+                target = int(steps)
+            else:
+                target = self._step + int(steps)
+            it = iter(data)
+            history = []
+            t_last = time.perf_counter()
+            tokens_since = 0
+            loss = None
             if K > 1:
                 return self._fit_superstep(it, target, K, log_every,
                                            on_metrics, seq_len, history,
@@ -580,6 +600,9 @@ class Trainer:
                                   anomaly=anomaly_guard,
                                   guard=preemption_guard, data=data)
         finally:
+            led.run_end()
+            if _obs.enabled():
+                _obs.publish()       # goodput buckets + snapshot -> exporters
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
@@ -646,6 +669,7 @@ class Trainer:
                                  tokens_per_sec_per_chip=tps / n_dev,
                                  mfu=mfu, lr=self.optimizer.get_lr())
                 history.append(m)
+                _obs.observe_train_metrics(m)
                 if on_metrics:
                     on_metrics(m)
                 t_last = time.perf_counter()
@@ -664,8 +688,7 @@ class Trainer:
                                                          mgr, data, it)
                     if rolled:
                         continue
-                mgr.save(self._step, self._ckpt_tree(data),
-                         watchdog=self._watchdog)
+                self._save_ckpt(mgr, data)
         if window:
             it, rolled = self._drain_loss_window(window, anomaly, mgr,
                                                  data, it)
@@ -679,8 +702,7 @@ class Trainer:
         if guard is not None and guard.preempted:
             self._preempt_exit(mgr, data)
         if mgr is not None:
-            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
-                     watchdog=self._watchdog)
+            self._save_ckpt(mgr, data, async_save=False)
         # write trained params back into the Layer (imperative view);
         # train_step already does this when donation is on
         self.sync_model()
@@ -769,6 +791,7 @@ class Trainer:
                             tokens_per_sec_per_chip=tps / n_dev,
                             mfu=mfu, lr=lr_at)
                         history.append(m)
+                        _obs.observe_train_metrics(m)
                         if on_metrics:
                             on_metrics(m)
                         # advance by the consumed share; the steps after the
@@ -849,17 +872,26 @@ class Trainer:
                 last_saved = self._step
                 if rolled:
                     continue
-                mgr.save(self._step, self._ckpt_tree(data),
-                         watchdog=self._watchdog)
+                self._save_ckpt(mgr, data)
         if guard is not None and guard.preempted:
             self._preempt_exit(mgr, data)
         if mgr is not None:
-            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
-                     watchdog=self._watchdog)
+            self._save_ckpt(mgr, data, async_save=False)
         self.sync_model()
         return history
 
     # -- resilience runtime --------------------------------------------------
+
+    def _save_ckpt(self, mgr, data, async_save=None):
+        """One checkpoint save from the fit loop: traced as a
+        trainer::checkpoint span, watermarked in the goodput ledger (the
+        anchor a later rollback reclassifies against)."""
+        with RecordEvent("trainer::checkpoint"):
+            # async_save=None = manager default (same contract as
+            # CheckpointManager.save itself)
+            mgr.save(self._step, self._ckpt_tree(data),
+                     async_save=async_save, watchdog=self._watchdog)
+        _obs.ledger().note_checkpoint(self._step)
 
     def _drain_loss_window(self, window, anomaly, mgr, data, it):
         """Consume a pending (step, device-loss) window with ONE device→host
@@ -944,10 +976,18 @@ class Trainer:
         exit with the resumable status (the elastic relauncher resumes
         instead of restarting)."""
         from ..resilience.preemption import TrainingPreempted
-        if mgr is not None:
-            mgr.save(self._step, self._ckpt_tree(data), async_save=False,
-                     watchdog=self._watchdog)
-        self.sync_model()
+        # the wind-down books as preemption_lost (minus the nested
+        # checkpoint_save span the manager opens for the final save)
+        with _obs.ledger().span("preemption_lost"):
+            if mgr is not None:
+                self._save_ckpt(mgr, data, async_save=False)
+            self.sync_model()
+            if _obs.REGISTRY.enabled:
+                _obs.REGISTRY.counter(
+                    "pt_preemptions_total",
+                    "orderly SIGTERM checkpoint-and-exit events").inc()
+            _obs.flight_recorder.maybe_dump(
+                "preemption", extra={"step": self._step})
         raise TrainingPreempted(self._step)
 
     def _handle_anomaly(self, verdict, anomaly, mgr, prev, data, it, loss):
@@ -973,6 +1013,9 @@ class Trainer:
             anomaly.raise_divergence(self._step, loss)
         _, tree = res
         cursor = self._apply_restored(tree)
+        # productive time since the restored step's watermark is replayed
+        # ground: reclassify it as rollback_wasted
+        _obs.ledger().note_rollback(self._step)
         if data is not None and hasattr(data, "set_state_dict"):
             # replay from the checkpointed cursor; without a stateful
             # loader the current iterator continues forward (documented:

@@ -1,0 +1,448 @@
+"""Observability subsystem: metrics registry, goodput ledger, exporters,
+flight recorder, and the trainer/serving/resilience instrumentation
+(ISSUE 4).
+
+Contract under test:
+
+* registry instruments are exact when enabled and no-ops when disabled;
+* the goodput ledger's buckets sum to its accounted wall-time by
+  construction, rollback reclassifies replayed productive time, and a
+  metrics-enabled ``Trainer.fit`` fills the compile/checkpoint/restore
+  buckets without adding device fences;
+* exporters: JSONL parses line-by-line (torn tail tolerated), Prometheus
+  text round-trips the minimal parser, the stdlib HTTP endpoint serves it;
+* the flight recorder dumps STRICT JSON on anomaly abort / preemption,
+  carrying the final loss window and the last trainer/serving spans,
+  written next to the CheckpointManager quarantine dir.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.observability as obs
+from paddle_tpu import nn
+from paddle_tpu.core import compile_cache
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.observability.exporters import (JSONLExporter,
+                                                PrometheusExporter,
+                                                parse_prometheus,
+                                                render_prometheus)
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.resilience import (AnomalyGuard, CheckpointManager,
+                                   DivergenceError, PreemptionGuard,
+                                   TrainingPreempted)
+from paddle_tpu.testing import chaos
+from paddle_tpu.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    obs.ledger().reset()
+
+
+# -- fixtures ---------------------------------------------------------------
+
+class TinyReg(Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 16)
+        self.l2 = nn.Linear(16, 1)
+
+    def forward(self, x, y):
+        h = jnp.tanh(self.l1(x))
+        return jnp.mean((self.l2(h) - y) ** 2)
+
+
+def build(seed=0, n=320, batch=16, poison_batch=None):
+    pt.seed(seed)
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(n, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    if poison_batch is not None:
+        xs[poison_batch * batch:(poison_batch + 1) * batch] = np.nan
+    loader = DataLoader(TensorDataset([xs, ys]), batch_size=batch,
+                        shuffle=False, drop_last=True,
+                        collate_fn=lambda items: {
+                            "x": np.stack([i[0] for i in items]),
+                            "y": np.stack([i[1] for i in items])})
+    model = TinyReg()
+    opt = SGD(learning_rate=0.05, parameters=model)
+    return Trainer(model, opt, donate=False), loader
+
+
+def tiny_engine():
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    pt.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    return ContinuousBatchingEngine(
+        model, max_batch=2, page_size=8, max_len=32,
+        generation_config=GenerationConfig(max_new_tokens=6,
+                                           do_sample=False),
+        decode_block=3)
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_counter_gauge_histogram_with_labels():
+    obs.REGISTRY.enable()
+    c = obs.REGISTRY.counter("t_req_total", "requests")
+    c.inc(phase="train")
+    c.inc(2, phase="train")
+    c.inc(phase="serve")
+    assert c.value(phase="train") == 3
+    assert c.value(phase="serve") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = obs.REGISTRY.gauge("t_depth")
+    g.set(7)
+    g.add(-2)
+    assert g.value() == 5
+    h = obs.REGISTRY.histogram("t_lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0, 0.5):
+        h.observe(v)
+    snap = {e["name"]: e for e in obs.REGISTRY.collect()}
+    assert snap["t_lat"]["count"] == 4
+    assert snap["t_lat"]["buckets"] == [[0.1, 1], [1.0, 3], ["+Inf", 4]]
+    assert snap["t_lat"]["sum"] == pytest.approx(6.05)
+    assert snap["t_lat"]["p50"] == 0.5
+
+
+def test_disabled_registry_is_noop():
+    assert not obs.REGISTRY.enabled
+    c = obs.REGISTRY.counter("t_noop_total")
+    c.inc(100)
+    obs.REGISTRY.gauge("t_noop_g").set(3)
+    obs.REGISTRY.histogram("t_noop_h").observe(1.0)
+    obs.REGISTRY.enable()
+    assert c.value() == 0
+    # no series materialized while disabled
+    assert obs.REGISTRY.collect() == []
+
+
+def test_metric_kind_conflict_raises():
+    obs.REGISTRY.counter("t_kind")
+    with pytest.raises(TypeError):
+        obs.REGISTRY.gauge("t_kind")
+
+
+# -- exporters --------------------------------------------------------------
+
+def test_prometheus_render_parse_round_trip():
+    obs.REGISTRY.enable()
+    obs.REGISTRY.counter("t_rt_total").inc(3, job='a"b', shard="x,y")
+    obs.REGISTRY.gauge("t_rt_g").set(2.5)
+    obs.REGISTRY.histogram("t_rt_h", buckets=(1.0,)).observe(0.5)
+    text = render_prometheus(obs.REGISTRY.collect())
+    parsed = parse_prometheus(text)
+    assert parsed["t_rt_total"][(("job", 'a"b'), ("shard", "x,y"))] == 3.0
+    assert parsed["t_rt_g"][()] == 2.5
+    assert parsed["t_rt_h_count"][()] == 1.0
+    assert parsed["t_rt_h_bucket"][(("le", "1.0"),)] == 1.0
+
+
+def test_jsonl_appends_and_tolerates_torn_tail(tmp_path):
+    obs.REGISTRY.enable()
+    obs.REGISTRY.counter("t_jl_total").inc(5)
+    path = str(tmp_path / "m.jsonl")
+    ex = JSONLExporter(path)
+    ex.export(obs.REGISTRY.collect())
+    ex.export(obs.REGISTRY.collect())
+    ex.close()
+    # simulate a crash mid-write: torn final line must be skipped
+    with open(path, "a") as f:
+        f.write('{"name": "t_jl_total", "val')
+    recs = JSONLExporter.load_jsonl(path)
+    assert len(recs) == 2
+    assert all(r["name"] == "t_jl_total" and "ts" in r for r in recs)
+    # torn line NOT at the tail is corruption and must raise
+    with open(path, "a") as f:
+        f.write('\n{"name": "ok", "value": 1}\n')
+    with pytest.raises(ValueError):
+        JSONLExporter.load_jsonl(path)
+
+
+def test_prometheus_http_endpoint(tmp_path):
+    obs.REGISTRY.enable()
+    obs.REGISTRY.gauge("t_http_g").set(42)
+    ex = PrometheusExporter(http_port=0)
+    try:
+        ex.export(obs.REGISTRY.collect())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert parse_prometheus(text)["t_http_g"][()] == 42.0
+    finally:
+        ex.close()
+
+
+# -- goodput ledger ---------------------------------------------------------
+
+def test_ledger_buckets_sum_to_wall_time():
+    led = obs.GoodputLedger()
+    t0 = time.perf_counter()
+    led.run_start()
+    time.sleep(0.02)
+    with led.span("compile"):
+        time.sleep(0.03)
+        with led.span("checkpoint_save"):   # nested: inner owns the clock
+            time.sleep(0.02)
+    time.sleep(0.01)
+    led.run_end()
+    wall = time.perf_counter() - t0
+    t = led.totals()
+    bucket_sum = sum(t[b] for b in obs.goodput.BUCKETS)
+    assert bucket_sum == pytest.approx(t["total_s"], rel=1e-9)
+    assert abs(bucket_sum - wall) <= 0.01 * wall + 0.002
+    assert t["compile"] >= 0.03
+    assert t["checkpoint_save"] >= 0.02
+    assert t["compile"] < 0.03 + wall - 0.05 + 0.02  # inner slice excluded
+    assert t["productive_step"] >= 0.03
+    assert 0 < t["goodput_fraction"] < 1
+    # outside a run, spans are timing no-ops
+    before = led.totals()["total_s"]
+    with led.span("restore"):
+        time.sleep(0.005)
+    assert led.totals()["total_s"] == pytest.approx(before)
+
+
+def test_ledger_rollback_reclassifies_productive_time():
+    led = obs.GoodputLedger()
+    led.run_start()
+    time.sleep(0.02)
+    led.note_checkpoint(10)
+    time.sleep(0.03)
+    led.note_rollback(10)
+    led.run_end()
+    t = led.totals()
+    assert t["rollback_wasted"] >= 0.03 - 0.001
+    assert t["productive_step"] == pytest.approx(0.02, abs=0.015)
+    assert led.rollbacks == 1
+    # a rollback with NO watermark wastes everything since run start
+    led2 = obs.GoodputLedger()
+    led2.run_start()
+    time.sleep(0.02)
+    led2.note_rollback(5)
+    led2.run_end()
+    assert led2.totals()["productive_step"] == pytest.approx(0.0, abs=2e-3)
+
+
+# -- trainer integration ----------------------------------------------------
+
+def test_fit_emits_metrics_and_goodput_buckets(tmp_path):
+    compile_cache.clear()
+    obs.ledger().reset()
+    obs.enable()
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=8)
+    t0 = time.perf_counter()
+    hist = tr.fit(loader, steps=20, log_every=5, checkpoint_manager=mgr)
+    wall = time.perf_counter() - t0
+    assert len(hist) == 4
+    # registry carries the TrainMetrics mirror
+    snap = {e["name"]: e for e in obs.collect()}
+    assert snap["pt_train_steps_total"]["value"] == 4
+    assert snap["pt_train_loss"]["value"] == pytest.approx(
+        hist[-1].loss, rel=1e-6)
+    assert snap["pt_train_step_seconds"]["count"] == 4
+    assert snap["pt_checkpoint_saves_total"]["value"] >= 2  # mid + final
+    # ledger: buckets sum to accounted wall-time (exact by construction),
+    # and the accounted window covers (almost all of) the external wall
+    t = obs.ledger().totals()
+    bucket_sum = sum(t[b] for b in obs.goodput.BUCKETS)
+    assert bucket_sum == pytest.approx(t["total_s"], rel=1e-9)
+    assert t["total_s"] <= wall
+    assert t["total_s"] >= 0.9 * wall
+    assert t["compile"] > 0                 # fresh trainer paid a compile
+    assert t["checkpoint_save"] > 0
+    assert t["productive_step"] > 0
+    assert snap["pt_goodput_fraction"]["value"] == pytest.approx(
+        t["goodput_fraction"], abs=0.05)
+
+
+def test_fit_superstep_metrics(tmp_path):
+    obs.ledger().reset()
+    obs.enable()
+    tr, loader = build()
+    hist = tr.fit(loader, steps=8, log_every=4, steps_per_dispatch=2)
+    assert len(hist) == 2
+    snap = {e["name"]: e for e in obs.collect()}
+    assert snap["pt_train_steps_total"]["value"] == 2
+    t = obs.ledger().totals()
+    assert t["productive_step"] > 0
+
+
+def test_resume_fills_restore_bucket(tmp_path):
+    obs.enable()
+    root = str(tmp_path / "ckpt")
+    tr, loader = build()
+    tr.fit(loader, steps=10, log_every=5,
+           checkpoint_manager=CheckpointManager(root,
+                                                save_interval_steps=5))
+    obs.ledger().reset()
+    tr2, loader2 = build()
+    tr2.fit(loader2, steps=12, log_every=5, resume="auto",
+            checkpoint_manager=CheckpointManager(root,
+                                                 save_interval_steps=5))
+    assert tr2._step == 12
+    t = obs.ledger().totals()
+    assert t["restore"] > 0
+    snap = {e["name"]: e for e in obs.collect()}
+    assert snap["pt_checkpoint_restores_total"]["value"] >= 1
+    assert snap["pt_checkpoint_restore_seconds"]["count"] >= 1
+
+
+def test_rollback_reclassifies_and_counts_verdicts(tmp_path):
+    obs.ledger().reset()
+    obs.enable()
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=4)
+    guard = AnomalyGuard(policy="rollback", max_rollbacks=3,
+                         warmup_steps=100)  # NaN-only trigger
+    data = chaos.nan_injector(list(loader), at=6, fields=["x"])
+    hist = tr.fit(data, steps=10, log_every=5, checkpoint_manager=mgr,
+                  anomaly_guard=guard)
+    assert tr._step == 10
+    assert guard.rollbacks == 1
+    t = obs.ledger().totals()
+    assert t["rollback_wasted"] > 0
+    assert t["restore"] > 0
+    assert obs.ledger().rollbacks == 1
+    snap = {e["name"]: e for e in obs.collect()}
+    c = {tuple(sorted(e["labels"].items())): e["value"]
+         for e in obs.collect() if e["name"] == "pt_anomaly_verdicts_total"}
+    assert c[(("verdict", "rollback"),)] == 1
+    assert c[(("verdict", "ok"),)] >= 9
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_anomaly_abort_dumps_flight_json(tmp_path):
+    obs.enable(flight_dir=str(tmp_path / "fallback"))
+    # a serving leg first, so the dump carries serving spans too
+    eng = tiny_engine()
+    eng.submit(np.arange(5, dtype=np.int32))
+    eng.run()
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=3)
+    guard = AnomalyGuard(policy="abort", warmup_steps=100)
+    # NaN injected AFTER a log boundary, so the dump's snapshot carries
+    # the last logged trainer metrics alongside the loss window
+    data = chaos.nan_injector(list(loader), at=6, fields=["x"])
+    with pytest.raises(DivergenceError):
+        tr.fit(data, steps=10, log_every=5, checkpoint_manager=mgr,
+               anomaly_guard=guard)
+    # dump lands NEXT TO the quarantine dir (inside the checkpoint root)
+    fdir = os.path.join(mgr.root, "_flight")
+    dumps = os.listdir(fdir)
+    assert len(dumps) == 1 and dumps[0].startswith("flight_")
+    text = open(os.path.join(fdir, dumps[0])).read()
+    # STRICT json: a NaN loss must not leak a bare NaN token
+    payload = json.loads(text, parse_constant=lambda s: pytest.fail(
+        f"non-strict JSON constant {s!r} in flight dump"))
+    assert payload["reason"] == "anomaly_abort"
+    win = payload["extra"]["loss_window"]
+    assert len(win) >= 4 and win[-1] == "nan"
+    assert all(isinstance(v, float) for v in win[:-1])
+    names = {s["name"] for s in payload["recent_spans"]}
+    assert "trainer::dispatch" in names
+    assert "serving::dispatch" in names
+    assert payload["goodput"]["total_s"] > 0
+    assert any(e["name"] == "pt_train_loss"
+               for e in payload["metrics_snapshot"])
+
+
+def test_preemption_dumps_and_counts(tmp_path):
+    obs.enable(flight_dir=str(tmp_path / "flight"))
+    tr, loader = build()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=50)
+    guard = PreemptionGuard()
+    guard.trigger()                      # latch without a signal
+    with pytest.raises(TrainingPreempted):
+        tr.fit(loader, steps=10, log_every=5, checkpoint_manager=mgr,
+               preemption_guard=guard)
+    snap = {e["name"]: e for e in obs.collect()}
+    assert snap["pt_preemptions_total"]["value"] == 1
+    fdir = os.path.join(mgr.root, "_flight")
+    payload = json.load(open(os.path.join(fdir, os.listdir(fdir)[0])))
+    assert payload["reason"] == "preemption"
+    t = obs.ledger().totals()
+    assert t["preemption_lost"] > 0 or t["checkpoint_save"] > 0
+
+
+def test_unhandled_exception_hook_chains(tmp_path):
+    rec = obs.flight_recorder.FlightRecorder(dir=str(tmp_path))
+    rec.start()
+    seen = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        rec.install(sigterm=False)
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        rec.uninstall()
+        rec.stop()
+        sys.excepthook = prev
+    assert len(seen) == 1                     # previous hook still ran
+    payload = json.load(open(rec.last_dump_path))
+    assert payload["reason"] == "unhandled_exception"
+    assert "boom" in payload["extra"]["exception"]
+
+
+# -- serving telemetry ------------------------------------------------------
+
+def test_serving_metrics_through_registry():
+    obs.enable()
+    eng = tiny_engine()
+    rs = np.random.RandomState(0)
+    for L in (6, 8, 5):
+        eng.submit(rs.randint(0, 32, (L,)).astype(np.int32))
+    out = eng.run()                      # publishes automatically
+    total = sum(len(v) for v in out.values())
+    snap = {e["name"]: e for e in obs.collect()}
+    assert snap["pt_serving_tokens_total"]["value"] == total
+    assert snap["pt_serving_requests_total"]["value"] == 3
+    assert snap["pt_serving_queue_depth"]["value"] == 0
+    assert snap["pt_serving_active_slots"]["value"] == 0
+    assert snap["pt_serving_page_pool_occupancy"]["value"] == 0
+    ttft = {tuple(sorted(e["labels"].items())): e["value"]
+            for e in obs.collect() if e["name"] == "pt_serving_ttft_seconds"}
+    assert ttft[(("q", "p50"),)] > 0
+    # counters stay monotonic across repeated publishes (delta logic)
+    eng.publish_metrics()
+    snap2 = {e["name"]: e for e in obs.collect()}
+    assert snap2["pt_serving_tokens_total"]["value"] == total
+
+
+# -- smoke tool -------------------------------------------------------------
+
+def test_obs_smoke_tool_in_process(tmp_path):
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import obs_smoke
+        out = obs_smoke.main(str(tmp_path / "smoke"))
+    finally:
+        sys.path.remove(tools)
+    assert out["errors"] == []
+    assert out["ok"]
+    assert out["jsonl_records"] > 0
+    assert out["prom_metrics"] > 0

@@ -2,6 +2,7 @@
 
 import json
 import os
+import re
 import time
 
 import jax
@@ -12,7 +13,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import profiler as prof_mod
 from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
-                                 export_chrome_tracing, make_scheduler, benchmark)
+                                 SortedKeys, export_chrome_tracing,
+                                 make_scheduler, benchmark)
 from paddle_tpu import device as dev
 
 
@@ -67,6 +69,87 @@ def test_profiler_summary_and_step_info():
     s = p.summary()
     assert "matmul" in s and "Calls" in s
     assert "steps/sec" in p.step_info()
+
+
+def test_back_to_back_rar_cycles_each_export_once_no_bleed():
+    """record=1 makes EVERY step RECORD_AND_RETURN: consecutive cycles
+    must each export exactly once, and the collector must drain between
+    cycles so no event bleeds into the next export."""
+    exports = []
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=1),
+                 on_trace_ready=lambda pr: exports.append(
+                     [e.name for e in pr.result.events]))
+    p.start()
+    for i in range(3):
+        with RecordEvent(f"ev{i}"):
+            pass
+        p.step()
+    p.stop()
+    # one export per cycle, each holding exactly its own cycle's event
+    # (stop() may flush one final empty cycle)
+    assert [e for e in exports if e] == [["ev0"], ["ev1"], ["ev2"]]
+    assert len(exports) <= 4
+
+
+def test_scheduler_repeat_closes_after_n_cycles():
+    exports = []
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=2),
+                 on_trace_ready=lambda pr: exports.append(
+                     [e.name for e in pr.result.events]))
+    p.start()
+    for i in range(8):
+        with RecordEvent(f"ev{i}"):
+            pass
+        p.step()
+    p.stop()
+    # cycles [0,1] and [2,3] export once each; steps >= 4 are CLOSED and
+    # their events are never collected
+    assert exports == [["ev0", "ev1"], ["ev2", "ev3"]]
+    assert prof_mod._collector.events == []
+
+
+def test_step_info_reports_true_samples_per_sec():
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(4):
+        time.sleep(0.002)
+        p.step(num_samples=32)
+    p.stop()
+    info = p.step_info()
+    assert "samples/sec" in info
+    rate = float(re.search(r"\(([\d.]+) samples/sec\)", info).group(1))
+    true_rate = 4 * 32 / sum(p._step_times)
+    assert rate == pytest.approx(true_rate, rel=0.01)
+    # a custom unit label is honored
+    assert "imgs/s" in p.step_info(unit="imgs/s")
+    # no sample counts -> falls back to steps/sec WITH the correct label
+    p2 = Profiler(timer_only=True)
+    p2.start()
+    p2.step()
+    p2.stop()
+    assert "steps/sec" in p2.step_info()
+    assert "samples/sec" not in p2.step_info()
+
+
+def test_summary_honors_sorted_by():
+    p = Profiler()
+    p.start()
+    for _ in range(6):
+        with RecordEvent("many_small"):
+            time.sleep(0.01)
+    with RecordEvent("one_big"):
+        time.sleep(0.03)
+    p.step()
+    p.stop()
+    first_row = lambda s: s.splitlines()[1].split()[0]
+    assert first_row(p.summary()) == "many_small"          # CPUTotal default
+    assert first_row(p.summary(sorted_by=SortedKeys.CPUTotal)) == "many_small"
+    assert first_row(p.summary(sorted_by=SortedKeys.CPUAvg)) == "one_big"
+    assert first_row(p.summary(sorted_by=SortedKeys.CPUMax)) == "one_big"
+    # int values (reference code passes enum members; ints must work too)
+    assert first_row(p.summary(sorted_by=SortedKeys.GPUAvg.value)) == "one_big"
+    assert "SortedKeys" in prof_mod.__all__
 
 
 def test_record_event_noop_when_not_recording():

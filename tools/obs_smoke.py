@@ -1,0 +1,162 @@
+"""Observability smoke: train + serve with exporters on, then validate.
+
+CI gate for the metrics plane (ISSUE 4 satellite): runs a short CPU
+training leg (Trainer.fit with checkpointing, so the goodput ledger sees
+compile/save buckets) and a short serving leg (ContinuousBatchingEngine),
+both with the JSONL + Prometheus exporters attached, then checks:
+
+* the JSONL time-series parses line-by-line (crash-safety contract);
+* the Prometheus text exposition round-trips the minimal parser and
+  carries the headline series (goodput buckets, compile cache, serving
+  telemetry);
+* the goodput buckets sum to the run's accounted wall-time;
+* a forced flight-recorder dump is strict JSON.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/obs_smoke.py [out_dir]
+
+Prints one JSON summary line; exit 0 = pass. ``main(out_dir)`` is
+importable — tests/test_observability.py runs it in-process.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _train_leg(steps: int = 12):
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.nn.layer import Layer
+    from paddle_tpu.optimizer import SGD
+    from paddle_tpu.trainer import Trainer
+
+    class TinyReg(Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 1)
+
+        def forward(self, x, y):
+            import jax.numpy as jnp
+            h = jnp.tanh(self.l1(x))
+            return jnp.mean((self.l2(h) - y) ** 2)
+
+    pt.seed(0)
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(16 * (steps + 2), 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    loader = DataLoader(
+        TensorDataset([xs, ys]), batch_size=16, shuffle=False,
+        drop_last=True,
+        collate_fn=lambda items: {"x": np.stack([i[0] for i in items]),
+                                  "y": np.stack([i[1] for i in items])})
+    model = TinyReg()
+    tr = Trainer(model, SGD(learning_rate=0.05, parameters=model),
+                 donate=False)
+    hist = tr.fit(loader, steps=steps, log_every=4)
+    return len(hist)
+
+
+def _serving_leg():
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=8, max_len=32,
+        generation_config=GenerationConfig(max_new_tokens=8,
+                                           do_sample=False),
+        decode_block=4)
+    rs = np.random.RandomState(0)
+    for L in (6, 8, 5):
+        eng.submit(rs.randint(0, 32, (L,)).astype(np.int32))
+    out = eng.run()
+    return sum(len(v) for v in out.values())
+
+
+def main(out_dir: str) -> dict:
+    os.makedirs(out_dir, exist_ok=True)
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability.exporters import (JSONLExporter,
+                                                    parse_prometheus)
+
+    jsonl_path = os.path.join(out_dir, "metrics.jsonl")
+    prom_path = os.path.join(out_dir, "metrics.prom")
+    flight_dir = os.path.join(out_dir, "flight")
+    obs.ledger().reset()
+    obs.enable(jsonl_path=jsonl_path, prom_path=prom_path,
+               flight_dir=flight_dir)
+    errors = []
+    try:
+        emissions = _train_leg()
+        served = _serving_leg()
+        obs.publish()
+
+        # goodput invariant: buckets sum to accounted wall-time
+        t = obs.ledger().totals()
+        bucket_sum = sum(t[b] for b in obs.goodput.BUCKETS)
+        if t["total_s"] > 0 and abs(bucket_sum - t["total_s"]) > \
+                0.01 * t["total_s"]:
+            errors.append(f"goodput buckets sum {bucket_sum} != "
+                          f"total {t['total_s']}")
+
+        # JSONL parses line-by-line
+        records = JSONLExporter.load_jsonl(jsonl_path)
+        if not records:
+            errors.append("JSONL exporter wrote no records")
+        names = {r["name"] for r in records}
+
+        # Prometheus text round-trips the minimal parser
+        with open(prom_path) as f:
+            text = f.read()
+        parsed = parse_prometheus(text)
+        for want in ("pt_goodput_seconds", "pt_goodput_fraction",
+                     "pt_train_loss", "pt_compile_cache",
+                     "pt_serving_tokens_total"):
+            if want not in names:
+                errors.append(f"{want} missing from JSONL series")
+            if not any(k.startswith(want) for k in parsed):
+                errors.append(f"{want} missing from Prometheus text")
+        buckets = {lb[0][1] for lb in parsed.get("pt_goodput_seconds", {})}
+        missing = set(obs.goodput.BUCKETS) - buckets
+        if missing:
+            errors.append(f"goodput buckets missing from exposition: "
+                          f"{sorted(missing)}")
+
+        # flight dump is strict JSON
+        path = obs.flight_recorder.recorder().dump("smoke")
+        with open(path) as f:
+            dump = json.load(f)          # json.load tolerates NaN...
+        json.loads(f'{{"x": {json.dumps(dump, allow_nan=False)}}}')
+        # ...so re-serialize with allow_nan=False to PROVE strictness
+        summary = {
+            "ok": not errors,
+            "train_metric_emissions": emissions,
+            "served_tokens": served,
+            "jsonl_records": len(records),
+            "prom_metrics": len(parsed),
+            "goodput_fraction": t["goodput_fraction"],
+            "flight_dump": os.path.basename(path),
+            "errors": errors,
+        }
+    finally:
+        obs.disable()
+    return summary
+
+
+if __name__ == "__main__":
+    out = main(sys.argv[1] if len(sys.argv) > 1 else "./obs_smoke_out")
+    print(json.dumps(out))
+    sys.exit(0 if out["ok"] else 1)
