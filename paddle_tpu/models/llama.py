@@ -61,6 +61,13 @@ class LlamaConfig:
     # "ulysses" all-to-alls heads for full-sequence local flash (cheaper
     # comm when heads divide the axis; parallel/ulysses.py)
     sp_mode: str = "ring"
+    # training loss head:
+    #   "fused" — blockwise lm_head-projection + CE, the [b, s, vocab]
+    #             logits never materialize (ops/pallas/fused_vocab_ce.py;
+    #             reference posture: c_softmax_with_cross_entropy_op.cu)
+    #   "naive" — materialize logits, then causal_lm_loss (the escape
+    #             hatch; also forced by env PT_NAIVE_LOSS_HEAD=1)
+    loss_impl: str = "fused"
 
     def __post_init__(self):
         if self.recompute not in ("none", "selective", "full"):
@@ -69,6 +76,9 @@ class LlamaConfig:
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"sp_mode must be 'ring'|'ulysses', "
                              f"got {self.sp_mode!r}")
+        if self.loss_impl not in ("fused", "naive"):
+            raise ValueError(f"loss_impl must be 'fused'|'naive', "
+                             f"got {self.loss_impl!r}")
         if self.hidden_size % self.num_attention_heads:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads:
@@ -106,6 +116,14 @@ def _normal(std):
     return I.Normal(0.0, std)
 
 
+def _token_mean(nll, labels, ignore_index: int = -100):
+    """Token-weighted mean over per-token nll (ignored rows already 0) —
+    the ONE reduction both loss heads share; a drifting copy here is a
+    silent fused-vs-naive divergence."""
+    cnt = jnp.sum(labels != ignore_index).astype(jnp.float32)
+    return jnp.sum(nll) / jnp.maximum(cnt, 1.0)
+
+
 def causal_lm_loss(logits, labels, ignore_index: int = -100):
     """Token-weighted mean CE for causal-LM heads.
 
@@ -124,10 +142,41 @@ def causal_lm_loss(logits, labels, ignore_index: int = -100):
         from ..parallel.mp_layers import parallel_cross_entropy
         nll = parallel_cross_entropy(logits, labels,
                                      ignore_index=ignore_index)
-        cnt = jnp.sum(labels != ignore_index).astype(jnp.float32)
-        return jnp.sum(nll) / jnp.maximum(cnt, 1.0)
+        return _token_mean(nll, labels, ignore_index)
     return F.cross_entropy(logits.astype(jnp.float32), labels,
                            ignore_index=ignore_index)
+
+
+def fused_loss_enabled(cfg) -> bool:
+    """The fused loss head is the default; ``cfg.loss_impl='naive'`` or env
+    ``PT_NAIVE_LOSS_HEAD=1`` (the bench A/B lever) fall back to the
+    materialized-logits path."""
+    import os
+    return (getattr(cfg, "loss_impl", "fused") == "fused"
+            and not os.environ.get("PT_NAIVE_LOSS_HEAD"))
+
+
+def fused_causal_lm_loss(hidden, w, labels, ignore_index: int = -100):
+    """Token-weighted mean CE(hidden @ w, labels) with the [b, s, vocab]
+    logits NEVER materialized — at Llama-3's 128K vocab that fp32 tensor
+    (b*s*128256*4 bytes) is the step's largest activation; the blockwise
+    kernel (ops/pallas/fused_vocab_ce.py) keeps peak loss-head memory at
+    O(b*s*block_v). When a mesh with an active "tp" axis is present and
+    ``w`` is vocab-sharded, each shard runs the fused blockwise pass over
+    its [H, V/tp] slice and the shards combine with pmax/psum
+    (parallel_fused_linear_cross_entropy) — the fused analogue of
+    parallel_cross_entropy, so TP never pays the projection-store either."""
+    from ..parallel.mesh import current_mesh
+    hm = current_mesh()
+    if (hm is not None and hm.axis_size("tp") > 1
+            and w.shape[-1] % hm.axis_size("tp") == 0):
+        from ..parallel.mp_layers import parallel_fused_linear_cross_entropy
+        nll = parallel_fused_linear_cross_entropy(
+            hidden, w, labels, ignore_index=ignore_index)
+        return _token_mean(nll, labels, ignore_index)
+    from ..ops.pallas.fused_vocab_ce import fused_linear_cross_entropy
+    return fused_linear_cross_entropy(hidden, w, labels,
+                                      ignore_index=ignore_index)
 
 
 class LlamaAttention(nn.Layer):
@@ -362,8 +411,12 @@ class LlamaAttention(nn.Layer):
     def decode_paged(self, x, cos, sin, pos, k_pool, v_pool, tables):
         """One-token step over the page pools: writes the new K/V into the
         page slot for position ``pos`` and attends via the Pallas paged
-        kernel (XLA gather fallback off-TPU)."""
-        from ..ops.pallas.paged_attention import (paged_decode_attention,
+        kernel (XLA gather fallback off-TPU). A ``force_decode_impl``
+        scope ("dense") routes the attention through the XLA gather path —
+        the serving engine's context-aware dense/paged dispatch uses it
+        below the measured crossover length."""
+        from ..ops.pallas.paged_attention import (forced_decode_impl,
+                                                 paged_decode_attention,
                                                  paged_decode_supported,
                                                  paged_decode_xla)
         from ..ops.registry import backend_kind
@@ -381,7 +434,8 @@ class LlamaAttention(nn.Layer):
         v_pool = v_pool.at[:, phys, off].set(
             jnp.swapaxes(v[:, 0], 0, 1).astype(v_pool.dtype))
         q2 = q[:, 0]                               # [b, n_h, hd]
-        if backend_kind() == "tpu" and paged_decode_supported(q2, k_pool):
+        if (forced_decode_impl() != "dense" and backend_kind() == "tpu"
+                and paged_decode_supported(q2, k_pool)):
             out = paged_decode_attention(q2, k_pool, v_pool, tables, pos)
         else:
             out = paged_decode_xla(q2, k_pool, v_pool, tables, pos)
@@ -591,18 +645,37 @@ class LlamaForCausalLM(nn.Layer):
         return jnp.matmul(hidden, w.astype(hidden.dtype))
 
     def forward(self, input_ids, labels=None, position_ids=None,
-                attn_mask=None, segment_ids=None):
+                attn_mask=None, segment_ids=None, return_logits=None):
         """``segment_ids`` [b, s] packs multiple documents per row: the
         flash kernel masks cross-segment attention in-kernel (reference
         varlen API: flash_attn_kernel.cu:91 cu_seqlens). Pass per-segment
         ``position_ids`` and -100 labels at segment boundaries for exact
-        packed-pretraining semantics."""
+        packed-pretraining semantics.
+
+        With labels, the loss runs the FUSED head by default
+        (cfg.loss_impl): CE computed blockwise from ``hidden`` without
+        materializing [b, s, vocab] logits. The returned logits then exist
+        only for API compatibility — the loss does not read them, so under
+        the Trainer's jit (which keeps only the loss) XLA dead-code-
+        eliminates the projection and no logits buffer is ever allocated
+        (pinned by the HLO guard in tests/test_fused_vocab_ce.py).
+        ``return_logits=False`` skips even the traced projection and
+        returns the scalar loss alone."""
         hidden = self.model(input_ids, position_ids, attn_mask, segment_ids)
-        logits = self.logits(hidden)
         if labels is None:
-            return logits
-        loss = causal_lm_loss(logits, labels)
-        return loss, logits
+            return self.logits(hidden)
+        logits = None
+        with jax.named_scope("loss_head"):
+            if fused_loss_enabled(self.cfg):
+                w = (jnp.swapaxes(self.model.embed_tokens, 0, 1)
+                     if self.cfg.tie_word_embeddings else self.lm_head)
+                loss = fused_causal_lm_loss(hidden, w, labels)
+            else:
+                logits = self.logits(hidden)
+                loss = causal_lm_loss(logits, labels)
+        if return_logits is False:
+            return loss
+        return loss, (logits if logits is not None else self.logits(hidden))
 
     # -- size accounting (MFU calculator input) -----------------------------
 
@@ -681,7 +754,7 @@ class LlamaForCausalLMPipe(nn.Layer):
         self.register_buffer("rope_cos", cos, persistable=False)
         self.register_buffer("rope_sin", sin, persistable=False)
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, return_logits=None):
         cfg = self.cfg
         s = input_ids.shape[1]
         x = jnp.take(self.embed_tokens, input_ids, axis=0)
@@ -690,10 +763,19 @@ class LlamaForCausalLMPipe(nn.Layer):
         hidden = self.norm(x)
         w = (jnp.swapaxes(self.embed_tokens, 0, 1)
              if cfg.tie_word_embeddings else self.lm_head)
-        logits = jnp.matmul(hidden, w.astype(hidden.dtype))
         if labels is None:
-            return logits
-        loss = causal_lm_loss(logits, labels)
+            return jnp.matmul(hidden, w.astype(hidden.dtype))
+        logits = None
+        with jax.named_scope("loss_head"):
+            if fused_loss_enabled(cfg):
+                loss = fused_causal_lm_loss(hidden, w, labels)
+            else:
+                logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+                loss = causal_lm_loss(logits, labels)
+        if return_logits is False:
+            return loss
+        if logits is None:  # compat tuple; dead (DCE'd) when unused
+            logits = jnp.matmul(hidden, w.astype(hidden.dtype))
         return loss, logits
 
     def loss_and_grads(self, params, input_ids, labels):
@@ -734,12 +816,18 @@ class LlamaForCausalLMPipe(nn.Layer):
         def loss_head_fn(hp, h, tgt):
             hidden = F.rms_norm(h, hp["norm_w"], cfg.rms_norm_eps)
             w = (jnp.swapaxes(hp["embed"], 0, 1) if tied else hp["lm_head"])
-            logits = jnp.matmul(hidden, w.astype(hidden.dtype))
             # (token-summed loss, valid count): pipeline_1f1b normalizes by
             # the GLOBAL count so unevenly-padded microbatches reproduce the
-            # unpipelined token-weighted mean exactly. causal_lm_loss keeps
-            # tp-sharded vocab un-gathered (parallel CE) when tp is active.
-            mean = causal_lm_loss(logits, tgt)
+            # unpipelined token-weighted mean exactly. The fused head keeps
+            # the per-microbatch [mb, s, vocab] logits from materializing
+            # (and the TP composition keeps the vocab un-gathered), same as
+            # the unpipelined loss path.
+            with jax.named_scope("loss_head"):
+                if fused_loss_enabled(cfg):
+                    mean = fused_causal_lm_loss(hidden, w, tgt)
+                else:
+                    logits = jnp.matmul(hidden, w.astype(hidden.dtype))
+                    mean = causal_lm_loss(logits, tgt)
             cnt = jnp.sum(tgt != -100).astype(jnp.float32)
             return mean * jnp.maximum(cnt, 1.0), cnt
 

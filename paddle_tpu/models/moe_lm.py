@@ -221,13 +221,23 @@ class MoEForCausalLM(nn.Layer):
                 x, aux = layer(x, cos, sin)
                 aux_total = aux_total + aux
         hidden = self.norm(x)
-        logits = jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
         if labels is None:
-            return logits
-        from .llama import causal_lm_loss
-        # vocab-parallel CE when tp is active (no gathered fp32 logits)
-        ce = causal_lm_loss(logits, labels)
+            return jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
+        from .llama import (causal_lm_loss, fused_causal_lm_loss,
+                            fused_loss_enabled)
+        logits = None
+        with jax.named_scope("loss_head"):
+            if fused_loss_enabled(cfg):
+                # fused blockwise head: no [b, s, vocab] logits (TP gets
+                # the per-shard fused path, same as Llama)
+                ce = fused_causal_lm_loss(hidden, self.lm_head, labels)
+            else:
+                logits = jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
+                # vocab-parallel CE when tp is active (no gathered logits)
+                ce = causal_lm_loss(logits, labels)
         loss = ce + cfg.aux_loss_weight * aux_total
+        if logits is None:  # compat tuple; dead (DCE'd) when unused
+            logits = jnp.matmul(hidden, self.lm_head.astype(hidden.dtype))
         return loss, logits
 
     def num_params(self) -> int:

@@ -17,5 +17,6 @@ from .registry import dispatch, register_kernel, backend_kind
 try:
     from .pallas import flash_attention as _pallas_flash_attention  # noqa: F401
     from .pallas import fused_norm as _pallas_fused_norm  # noqa: F401
+    from .pallas import fused_vocab_ce as _pallas_fused_vocab_ce  # noqa: F401
 except ImportError:  # pragma: no cover — jaxlib without pallas
     pass

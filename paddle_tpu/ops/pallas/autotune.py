@@ -129,12 +129,7 @@ def flash_attention_config(sq: int, sk: int, d: int,
     from ..registry import backend_kind
     if backend_kind() != "tpu":
         return 128, 128
-    try:
-        import jax
-        kind = getattr(jax.devices()[0], "device_kind", "tpu")
-    except Exception:
-        kind = "tpu"
-    key = TuneDB.key("flash_attention", kind, dtype,
+    key = TuneDB.key("flash_attention", _device_kind(default="tpu"), dtype,
                      sq=sq, sk=sk, d=d, causal=int(causal))
     hit = _DB.lookup(key)
     if hit and sq % int(hit["block_q"]) == 0 and sk % int(hit["block_k"]) == 0:
@@ -142,8 +137,66 @@ def flash_attention_config(sq: int, sk: int, d: int,
     return _default_blocks(sq, sk)
 
 
+def _device_kind(default: str = "cpu") -> str:
+    try:
+        import jax
+        return getattr(jax.devices()[0], "device_kind", default) or default
+    except Exception:
+        return default
+
+
+def fused_vocab_ce_config(n: int, h: int, v: int,
+                          dtype: str) -> Tuple[Optional[int], int]:
+    """(block_n, block_v) for a fused vocab-CE call (ops/pallas/
+    fused_vocab_ce.py): tuned if the DB has this (bucketed) shape on this
+    device, else VMEM-fitting defaults. ``block_n`` comes back None when no
+    candidate divides N — the caller falls through to the XLA path. The dW
+    backward kernel's fp32 [H, block_v] accumulator is the VMEM pacer, so
+    the default block_v shrinks as H grows."""
+    from ..registry import backend_kind
+    key = TuneDB.key("fused_vocab_ce", _device_kind(), dtype,
+                     h=h, v=v, sn=n)
+    hit = _DB.lookup(key)
+    if hit:
+        bn, bv = int(hit["block_n"]), int(hit["block_v"])
+        # a tuned entry the kernel gate would reject (stale DB after a
+        # VMEM_BUDGET change, hand-edited config) must fall through to the
+        # defaults, not silently downgrade every TPU call to the XLA path
+        if n % bn == 0:
+            if backend_kind() != "tpu":
+                return bn, bv
+            from .fused_vocab_ce import fused_ce_supported
+            if fused_ce_supported(n, h, v, dtype, bn, bv):
+                return bn, bv
+    # defaults come from the kernel module's OWN vmem formula — the same
+    # one fused_ce_supported gates on, so a default config is never chosen
+    # only to be rejected at dispatch (which would silently route every
+    # TPU call to the XLA fallback)
+    from .fused_vocab_ce import default_blocks
+    return default_blocks(n, h, dtype)
+
+
+def paged_decode_crossover(default: int = 4096) -> int:
+    """Context length (tokens) above which the Pallas paged-decode kernel
+    beats the dense XLA gather path for one decode step. Measured on v5e
+    (bench paged_decode_us_ctx* sweep): dense marginally ahead at ctx 2048,
+    paged 1.45x ahead at 8192 and 3.6x at 16K — so the default crossover
+    sits between them. A tuned value (op "paged_decode_crossover", config
+    key "ctx") in the TuneDB wins; the serving engine consults this per
+    dispatched decode block (inference/serving.py)."""
+    key = TuneDB.key("paged_decode_crossover", _device_kind(), "any")
+    hit = _DB.lookup(key)
+    if hit:
+        try:
+            return int(hit["ctx"])
+        except (KeyError, ValueError, TypeError):
+            pass
+    return default
+
+
 def get_db() -> TuneDB:
     return _DB
 
 
-__all__ = ["TuneDB", "get_db", "flash_attention_config"]
+__all__ = ["TuneDB", "get_db", "flash_attention_config",
+           "fused_vocab_ce_config", "paged_decode_crossover"]

@@ -1,0 +1,566 @@
+"""Fused vocab-projection + cross-entropy loss head (logits never exist).
+
+Reference analogue: c_softmax_with_cross_entropy_op.cu (the reference fuses
+the softmax+CE over model-parallel-sharded logits); prior art for the FULL
+fusion — projection INCLUDED — is Liger-kernel's fused_linear_cross_entropy
+and Apple's Cut Cross-Entropy. At Llama-3's 128K vocab the fp32 logits
+tensor ``[B, S, V]`` is the single largest activation of a training step
+(B*S*128256*4 bytes); even the tensor-parallel CE path only shards it. This
+module computes
+
+    loss = CE(hidden @ W, labels)
+
+blockwise over the vocab dimension so the logits tensor NEVER materializes:
+peak loss-head memory drops from O(N*V) to O(N*block_v) with N = B*S.
+
+Design:
+
+- The primitive is ``lse_and_target(hidden, w, labels) -> (lse, tgt)``:
+  per-row log-sum-exp of the logits and the logit at the label (0 when the
+  label is outside ``[0, V)`` — which encodes both ignore_index and a TP
+  shard's out-of-range labels with one rule). ``nll = lse - tgt``; any
+  reduction/weighting composes outside, and the TP composition in
+  parallel/mp_layers.py combines per-shard (lse, tgt) with pmax/psum.
+- Forward: online log-sum-exp over vocab blocks (running max m, running
+  denominator s — the flash-attention recurrence applied to the class dim)
+  plus a masked target-logit accumulation, fp32 throughout.
+- Backward (custom_vjp): RECOMPUTES each block's logits from the saved
+  per-row lse — softmax p = exp(logits - lse) — and accumulates
+  ``dhidden += dlog @ W_j^T`` and ``dW_j = hidden^T @ dlog`` with
+  ``dlog = g_lse * p + g_tgt * onehot``. One extra blockwise matmul versus
+  the naive backward buys O(block) memory.
+- Two interchangeable implementations behind one numerics contract:
+  a Pallas TPU kernel set (forward; dhidden; dW — each streaming vocab
+  blocks through VMEM with fp32 scratch accumulators) and a pure-XLA
+  ``lax.scan`` over vocab blocks that keeps the same O(block) memory on
+  CPU/GPU and is the test oracle. ``ops/pallas/autotune.py`` picks block
+  sizes (TuneDB-consulted like flash_attention).
+
+Vocab not divisible by the block size: W is padded to the block multiple
+and padded columns are masked to NEG_INF inside the kernels (their softmax
+weight is exactly 0 in the backward recompute).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports cleanly on TPU-enabled jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..registry import register_kernel
+
+NEG_INF = -1e30  # large-negative instead of -inf: avoids inf-inf=nan in exp
+LANES = 8        # lane width for per-row scalars (lse/tgt/labels tiles)
+
+
+def _tpu_params(*semantics):
+    if pltpu is None:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics))
+
+
+def _block_spec(shape, index_map):
+    return pl.BlockSpec(shape, index_map, memory_space=pltpu.VMEM)
+
+
+def _pad_vocab(w, block_v: int):
+    """Pad W's vocab (last) dim up to a block multiple; padded columns are
+    masked in-kernel so they contribute exactly 0."""
+    v = w.shape[-1]
+    vp = -(-v // block_v) * block_v
+    if vp == v:
+        return w
+    return jnp.pad(w, ((0, 0), (0, vp - v)))
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback: lax.scan over vocab blocks (same O(block_v) memory)
+# ---------------------------------------------------------------------------
+
+def _fwd_xla(h, w, labels, block_v, unroll=False):
+    n, hd = h.shape
+    v = w.shape[1]
+    wp = _pad_vocab(w, block_v)
+    nb = wp.shape[1] // block_v
+
+    def body(carry, j):
+        m, s, t = carry
+        wj = jax.lax.dynamic_slice(wp, (0, j * block_v), (hd, block_v))
+        logits = jax.lax.dot_general(
+            h, wj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [n, block_v]
+        cols = j * block_v + jnp.arange(block_v, dtype=jnp.int32)[None, :]
+        logits = jnp.where(cols < v, logits, NEG_INF)
+        t = t + jnp.sum(jnp.where(cols == labels[:, None], logits, 0.0), -1)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        p = jnp.where(logits <= NEG_INF * 0.5, 0.0,
+                      jnp.exp(logits - m_new[:, None]))
+        s = s * jnp.exp(m - m_new) + jnp.sum(p, axis=-1)
+        return (m_new, s, t), None
+
+    carry = (jnp.full((n,), NEG_INF, jnp.float32),
+             jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    if unroll:
+        # Python loop (no while op): required inside partial-auto
+        # shard_map regions, whose SPMD partitioning rejects scan
+        for j in range(nb):
+            carry, _ = body(carry, jnp.int32(j))
+    else:
+        carry, _ = jax.lax.scan(body, carry,
+                                jnp.arange(nb, dtype=jnp.int32))
+    m, s, t = carry
+    safe = jnp.where(s == 0.0, 1.0, s)
+    return m + jnp.log(safe), t
+
+
+def _bwd_xla(h, w, labels, lse, g_lse, g_tgt, block_v, unroll=False):
+    n, hd = h.shape
+    v = w.shape[1]
+    wp = _pad_vocab(w, block_v)
+    nb = wp.shape[1] // block_v
+
+    def body(dh, j):
+        wj = jax.lax.dynamic_slice(wp, (0, j * block_v), (hd, block_v))
+        logits = jax.lax.dot_general(
+            h, wj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cols = j * block_v + jnp.arange(block_v, dtype=jnp.int32)[None, :]
+        logits = jnp.where(cols < v, logits, NEG_INF)
+        p = jnp.where(logits <= NEG_INF * 0.5, 0.0,
+                      jnp.exp(logits - lse[:, None]))
+        dlog = g_lse[:, None] * p \
+            + jnp.where(cols == labels[:, None], g_tgt[:, None], 0.0)
+        dh = dh + jax.lax.dot_general(
+            dlog, wj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwj = jax.lax.dot_general(
+            h, dlog, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [hd, block_v]
+        return dh, dwj.astype(w.dtype)
+
+    dh0 = jnp.zeros((n, hd), jnp.float32)
+    if unroll:
+        dh, blocks = dh0, []
+        for j in range(nb):
+            dh, dwj = body(dh, jnp.int32(j))
+            blocks.append(dwj)
+        dw = jnp.concatenate(blocks, axis=1)[:, :v]
+    else:
+        dh, dw_blocks = jax.lax.scan(body, dh0,
+                                     jnp.arange(nb, dtype=jnp.int32))
+        dw = jnp.moveaxis(dw_blocks, 0, 1).reshape(hd, nb * block_v)[:, :v]
+    return dh.astype(h.dtype), dw
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels
+# ---------------------------------------------------------------------------
+
+def _lift_rows(x, dtype):
+    """[n] per-row scalars -> lane-broadcast [n, LANES] tiles (Mosaic wants
+    the last block dim aligned or equal to the array dim)."""
+    return jnp.broadcast_to(jnp.asarray(x, dtype)[:, None],
+                            (x.shape[0], LANES))
+
+
+def _fwd_kernel(h_ref, w_ref, lab_ref, lse_ref, tgt_ref, m_scr, s_scr, t_scr,
+                *, vocab, block_v):
+    """Grid (nN, nV) — nV innermost/sequential; scratch carries the online
+    log-sum-exp state (m, s) and the target-logit accumulator across it."""
+    vi = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        s_scr[:] = jnp.zeros_like(s_scr)
+        t_scr[:] = jnp.zeros_like(t_scr)
+
+    h = h_ref[...]
+    wb = w_ref[...]
+    logits = jax.lax.dot_general(
+        h, wb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bn, bv]
+    cols = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(cols < vocab, logits, NEG_INF)
+    lab = lab_ref[:, :1]                                 # [bn, 1]
+    t_new = t_scr[:, :1] + jnp.sum(
+        jnp.where(cols == lab, logits, 0.0), axis=-1, keepdims=True)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    p = jnp.where(logits <= NEG_INF * 0.5, 0.0, jnp.exp(logits - m_new))
+    s_new = jnp.exp(m_prev - m_new) * s_scr[:, :1] \
+        + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    s_scr[:] = jnp.broadcast_to(s_new, s_scr.shape)
+    t_scr[:] = jnp.broadcast_to(t_new, t_scr.shape)
+
+    @pl.when(vi == nv - 1)
+    def _finalize():
+        s = s_scr[:, :1]
+        safe = jnp.where(s == 0.0, 1.0, s)
+        lse_ref[...] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(safe),
+                                        lse_ref.shape)
+        tgt_ref[...] = jnp.broadcast_to(t_scr[:, :1], tgt_ref.shape)
+
+
+def _fwd_pallas(h, w, labels, block_n, block_v, interpret):
+    n, hd = h.shape
+    v = w.shape[1]
+    wp = _pad_vocab(w, block_v)
+    nb = wp.shape[1] // block_v
+    nn = n // block_n
+    lab2 = _lift_rows(labels, jnp.int32)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, vocab=v, block_v=block_v),
+        grid=(nn, nb),
+        in_specs=[
+            _block_spec((block_n, hd), lambda ni, vi: (ni, 0)),
+            _block_spec((hd, block_v), lambda ni, vi: (0, vi)),
+            _block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+        ],
+        out_specs=[_block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+                   _block_spec((block_n, LANES), lambda ni, vi: (ni, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((n, LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_n, 128), jnp.float32),
+                        pltpu.VMEM((block_n, 128), jnp.float32),
+                        pltpu.VMEM((block_n, 128), jnp.float32)],
+        compiler_params=_tpu_params("parallel", "arbitrary"),
+        interpret=interpret,
+    )(h, wp, lab2)
+    return out[0][:, 0], out[1][:, 0]
+
+
+def _dlog_block(h, wb, lab_ref, lse_ref, glse_ref, gtgt_ref, vi, vocab,
+                block_v):
+    """Recompute one [bn, bv] softmax block from the saved lse and form the
+    logits cotangent dlog = g_lse * p + g_tgt * onehot (shared by the
+    dhidden and dW backward kernels)."""
+    logits = jax.lax.dot_general(
+        h, wb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    cols = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(cols < vocab, logits, NEG_INF)
+    p = jnp.where(logits <= NEG_INF * 0.5, 0.0,
+                  jnp.exp(logits - lse_ref[:, :1]))
+    return glse_ref[:, :1] * p + jnp.where(cols == lab_ref[:, :1],
+                                           gtgt_ref[:, :1], 0.0)
+
+
+def _bwd_dh_kernel(h_ref, w_ref, lab_ref, lse_ref, glse_ref, gtgt_ref,
+                   dh_ref, acc_scr, *, vocab, block_v):
+    """Grid (nN, nV): accumulate dhidden over vocab blocks."""
+    vi = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    wb = w_ref[...]
+    dlog = _dlog_block(h_ref[...], wb, lab_ref, lse_ref, glse_ref, gtgt_ref,
+                       vi, vocab, block_v)
+    acc_scr[:] += jax.lax.dot_general(
+        dlog.astype(wb.dtype), wb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(vi == nv - 1)
+    def _finalize():
+        dh_ref[...] = acc_scr[:].astype(dh_ref.dtype)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, lab_ref, lse_ref, glse_ref, gtgt_ref,
+                   dw_ref, acc_scr, *, vocab, block_v):
+    """Grid (nV, nN): accumulate dW at vocab-block resolution over rows."""
+    vi = pl.program_id(0)
+    ni = pl.program_id(1)
+    nn = pl.num_programs(1)
+
+    @pl.when(ni == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    h = h_ref[...]
+    dlog = _dlog_block(h, w_ref[...], lab_ref, lse_ref, glse_ref, gtgt_ref,
+                       vi, vocab, block_v)
+    acc_scr[:] += jax.lax.dot_general(
+        h, dlog.astype(h.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ni == nn - 1)
+    def _finalize():
+        dw_ref[...] = acc_scr[:].astype(dw_ref.dtype)
+
+
+def _bwd_pallas(h, w, labels, lse, g_lse, g_tgt, block_n, block_v, interpret):
+    n, hd = h.shape
+    v = w.shape[1]
+    wp = _pad_vocab(w, block_v)
+    vp = wp.shape[1]
+    nb = vp // block_v
+    nn = n // block_n
+    lab2 = _lift_rows(labels, jnp.int32)
+    lse2 = _lift_rows(lse, jnp.float32)
+    glse2 = _lift_rows(g_lse, jnp.float32)
+    gtgt2 = _lift_rows(g_tgt, jnp.float32)
+
+    row_specs = [
+        _block_spec((block_n, hd), lambda ni, vi: (ni, 0)),
+        _block_spec((hd, block_v), lambda ni, vi: (0, vi)),
+        _block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+        _block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+        _block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+        _block_spec((block_n, LANES), lambda ni, vi: (ni, 0)),
+    ]
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, vocab=v, block_v=block_v),
+        grid=(nn, nb),
+        in_specs=row_specs,
+        out_specs=[_block_spec((block_n, hd), lambda ni, vi: (ni, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, hd), h.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_n, hd), jnp.float32)],
+        compiler_params=_tpu_params("parallel", "arbitrary"),
+        interpret=interpret,
+    )(h, wp, lab2, lse2, glse2, gtgt2)[0]
+
+    # dW: grid transposed (vocab blocks parallel, rows sequential) so the
+    # [hd, block_v] fp32 accumulator lives in VMEM across the row sweep
+    col_specs = [
+        _block_spec((block_n, hd), lambda vi, ni: (ni, 0)),
+        _block_spec((hd, block_v), lambda vi, ni: (0, vi)),
+        _block_spec((block_n, LANES), lambda vi, ni: (ni, 0)),
+        _block_spec((block_n, LANES), lambda vi, ni: (ni, 0)),
+        _block_spec((block_n, LANES), lambda vi, ni: (ni, 0)),
+        _block_spec((block_n, LANES), lambda vi, ni: (ni, 0)),
+    ]
+    dwp = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, vocab=v, block_v=block_v),
+        grid=(nb, nn),
+        in_specs=col_specs,
+        out_specs=[_block_spec((hd, block_v), lambda vi, ni: (0, vi))],
+        out_shape=[jax.ShapeDtypeStruct((hd, vp), w.dtype)],
+        scratch_shapes=[pltpu.VMEM((hd, block_v), jnp.float32)],
+        compiler_params=_tpu_params("parallel", "arbitrary"),
+        interpret=interpret,
+    )(h, wp, lab2, lse2, glse2, gtgt2)[0]
+    return dh, dwp[:, :v]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp primitive
+# ---------------------------------------------------------------------------
+
+def _fwd_impl(h, w, labels, block_n, block_v, impl, interpret):
+    if impl == "pallas":
+        return _fwd_pallas(h, w, labels, block_n, block_v, interpret)
+    return _fwd_xla(h, w, labels, block_v, unroll=(impl == "xla_unroll"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def lse_and_target(h, w, labels, block_n=128, block_v=512, impl="xla",
+                   interpret=False):
+    """Per-row (logsumexp(h @ w), logit-at-label) over vocab blocks.
+
+    h: [N, H]; w: [H, V]; labels: [N] int32 — a label outside ``[0, V)``
+    contributes 0 to ``tgt`` (encodes ignore_index and TP-shard-local
+    out-of-range labels). Returns (lse [N] f32, tgt [N] f32); the logits
+    tensor is never materialized, in either the forward or the recompute
+    backward."""
+    return _fwd_impl(h, w, labels, block_n, block_v, impl, interpret)
+
+
+def _lse_fwd_rule(h, w, labels, block_n, block_v, impl, interpret):
+    lse, tgt = _fwd_impl(h, w, labels, block_n, block_v, impl, interpret)
+    return (lse, tgt), (h, w, labels, lse)
+
+
+def _lse_bwd_rule(block_n, block_v, impl, interpret, res, g):
+    h, w, labels, lse = res
+    g_lse, g_tgt = g
+    if impl == "pallas":
+        dh, dw = _bwd_pallas(h, w, labels, lse, g_lse, g_tgt,
+                             block_n, block_v, interpret)
+    else:
+        dh, dw = _bwd_xla(h, w, labels, lse, g_lse, g_tgt, block_v,
+                          unroll=(impl == "xla_unroll"))
+    # int labels: symbolically-zero (float0) cotangent
+    dlab = np.zeros(labels.shape, jax.dtypes.float0)
+    return dh, dw, dlab
+
+
+lse_and_target.defvjp(_lse_fwd_rule, _lse_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# support gates + public entry
+# ---------------------------------------------------------------------------
+
+VMEM_BUDGET = 14 * 2 ** 20
+
+
+def kernel_vmem_bytes(block_n, block_v, hd, itemsize) -> int:
+    """Worst-case per-kernel VMEM for one (block_n, block_v) config — the
+    dW backward kernel is the pacer. The ONE formula shared by the support
+    gate and the default block chooser (autotune.fused_vocab_ce_config):
+    two inconsistent estimates would let the chooser pick configs the gate
+    then rejects, silently routing every TPU call to the XLA fallback."""
+    return (hd * block_v * 4                  # dW accumulator (fp32)
+            + hd * block_v * itemsize         # W block
+            + block_n * hd * (itemsize + 4)   # h block + dh accumulator
+            + block_n * block_v * 4)          # dlog block
+
+
+def default_blocks(n, hd, dtype_str) -> Tuple[Optional[int], int]:
+    """VMEM-fitting (block_n, block_v) defaults: the largest row block
+    dividing N (None → no Pallas), then the largest 128-multiple vocab
+    block that keeps the shared estimate under budget, shrinking the row
+    block if even bv=128 won't fit."""
+    itemsize = {"float32": 4}.get(dtype_str, 2)
+    for bn in (256, 128, 64, 32, 16, 8):
+        if n % bn:
+            continue
+        bv = next((c for c in (2048, 1024, 512, 256, 128)
+                   if kernel_vmem_bytes(bn, c, hd, itemsize)
+                   <= VMEM_BUDGET), None)
+        if bv is not None:
+            return bn, bv
+    return None, 512
+
+
+def fused_ce_supported(n, hd, v, dtype, block_n, block_v,
+                       interpret=False) -> bool:
+    """Static gate encoding the Mosaic lowering rules for this block
+    layout: row blocks are [block_n, H] (H is the full lane dim), vocab
+    blocks [H, block_v]; the dW kernel's fp32 [H, block_v] accumulator is
+    the VMEM pacer. ``interpret`` relaxes alignment so CPU tests can run
+    tiny blocks."""
+    from ..registry import pallas_disabled
+    if not _HAS_PLTPU or pallas_disabled():
+        return False
+    if block_n is None or block_v is None:
+        return False
+    if n % block_n:
+        return False
+    if interpret:
+        return True
+    itemsize = jnp.dtype(dtype).itemsize
+    return (block_n % 8 == 0 and block_v % 128 == 0 and hd % 128 == 0
+            and kernel_vmem_bytes(block_n, block_v, hd, itemsize)
+            <= VMEM_BUDGET)
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_lowering_ok() -> bool:
+    """One-shot compile probe on the real backend (same rationale as
+    flash_attention: degrade to the XLA path on env drift instead of
+    poisoning every downstream jit)."""
+    from ..registry import backend_kind
+    if backend_kind() != "tpu":
+        return False
+    try:
+        h = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+        w = jax.ShapeDtypeStruct((128, 256), jnp.bfloat16)
+        lab = jax.ShapeDtypeStruct((128,), jnp.int32)
+
+        def probe(h, w, lab):
+            # grad probes BOTH directions: the backward dh/dW kernels use
+            # different grids (the dW grid is transposed) and larger
+            # scratch, so a forward-only probe could pass while the first
+            # train step still fails to lower
+            lse, tgt = lse_and_target(h, w, lab, block_n=128, block_v=128,
+                                      impl="pallas", interpret=False)
+            return jnp.sum(lse) + jnp.sum(tgt)
+
+        jax.jit(jax.grad(probe, argnums=(0, 1))).lower(h, w, lab).compile()
+        return True
+    except Exception as e:  # pragma: no cover - only on env drift
+        import warnings
+        warnings.warn(f"Pallas fused vocab-CE failed TPU lowering; "
+                      f"falling back to the XLA blockwise path: {e}")
+        return False
+
+
+def resolve_impl(n, hd, v, dtype, block_n, block_v,
+                 interpret=False) -> str:
+    """'pallas' when the TPU kernel path is usable for these shapes (or
+    interpret mode is forced), else 'xla'."""
+    from ..registry import backend_kind
+    if not fused_ce_supported(n, hd, v, dtype, block_n, block_v, interpret):
+        return "xla"
+    if interpret:
+        return "pallas"
+    if backend_kind() == "tpu" and _tpu_lowering_ok():
+        return "pallas"
+    return "xla"
+
+
+def fused_linear_cross_entropy(hidden, w, labels, ignore_index: int = -100,
+                               reduction: str = "mean",
+                               block_n: Optional[int] = None,
+                               block_v: Optional[int] = None,
+                               impl: Optional[str] = None,
+                               interpret: bool = False):
+    """CE(hidden @ w, labels) without materializing the logits.
+
+    hidden: [..., H]; w: [H, V]; labels: [...] int ids (``ignore_index``
+    rows contribute 0 loss and don't count toward the mean). ``reduction``:
+    'mean' (token-weighted, fp32 — the causal-LM head convention), 'sum',
+    or 'none' (per-token nll, shaped like ``labels``).
+
+    Numerically interchangeable with
+    ``F.cross_entropy((hidden @ w).astype(f32), labels)`` to fp32
+    tolerance; peak memory is O(N * block_v) instead of O(N * V)."""
+    lead = hidden.shape[:-1]
+    hd = hidden.shape[-1]
+    v = w.shape[-1]
+    n = int(np.prod(lead)) if lead else 1
+    h2 = hidden.reshape(n, hd)
+    lab = labels.reshape(n).astype(jnp.int32)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, -1)          # out of range -> tgt = 0
+    if block_n is None or block_v is None:
+        from .autotune import fused_vocab_ce_config
+        tn, tv = fused_vocab_ce_config(n, hd, v, str(hidden.dtype))
+        block_n = block_n if block_n is not None else tn
+        block_v = block_v if block_v is not None else tv
+    if impl is None:
+        impl = resolve_impl(n, hd, v, hidden.dtype, block_n, block_v,
+                            interpret)
+    lse, tgt = lse_and_target(h2, w, safe, block_n, block_v, impl, interpret)
+    nll = jnp.where(valid, lse - tgt, 0.0)
+    if reduction == "none":
+        return nll.reshape(lead)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    cnt = jnp.sum(valid.astype(jnp.float32))
+    return jnp.sum(nll) / jnp.maximum(cnt, 1.0)
+
+
+@register_kernel("fused_vocab_ce", "tpu")
+def _fused_ce_tpu(hidden, w, labels, **kw):
+    return fused_linear_cross_entropy(hidden, w, labels, **kw)
+
+
+@register_kernel("fused_vocab_ce", "any")
+def _fused_ce_any(hidden, w, labels, **kw):
+    kw.setdefault("impl", "xla")
+    return fused_linear_cross_entropy(hidden, w, labels, **kw)
+
+
+__all__ = ["fused_linear_cross_entropy", "lse_and_target",
+           "fused_ce_supported", "resolve_impl"]

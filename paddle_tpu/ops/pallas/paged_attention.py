@@ -186,6 +186,41 @@ def paged_decode_xla(q, k_pages, v_pages, block_tables, seq_lens,
     return out.astype(q.dtype)
 
 
+_FORCED_IMPL = [None]  # None = auto; "dense" | "paged" (context-aware dispatch)
+
+
+class force_decode_impl:
+    """Trace-time override of the paged-decode attention path.
+
+    ``"dense"`` routes decode through the XLA gather composition
+    (``paged_decode_xla`` — the dense contiguous-attention cost profile),
+    ``"paged"``/None keeps the auto choice (Pallas kernel on TPU when
+    supported). The serving engine wraps each decode-block TRACE in this
+    scope to bake the measured dense/paged crossover into the executable
+    (inference/serving.py; crossover from autotune.paged_decode_crossover):
+    the bench sweep shows dense ahead at short contexts and the paged
+    kernel 1.45-3.6x ahead at 8K-16K, so one static choice per compiled
+    block is exactly the right granularity."""
+
+    def __init__(self, impl):
+        if impl not in (None, "dense", "paged"):
+            raise ValueError(f"impl must be None|'dense'|'paged', "
+                             f"got {impl!r}")
+        self.impl = impl
+
+    def __enter__(self):
+        _FORCED_IMPL.append(self.impl)
+        return self
+
+    def __exit__(self, *exc):
+        _FORCED_IMPL.pop()
+        return False
+
+
+def forced_decode_impl():
+    return _FORCED_IMPL[-1]
+
+
 def paged_decode_supported(q, k_pages) -> bool:
     """Mosaic-rule gate for the head-major pool layout: page blocks are
     (1, 1, page_size, D) == the trailing array dims, and the q/out blocks
@@ -202,4 +237,4 @@ def paged_decode_supported(q, k_pages) -> bool:
 
 
 __all__ = ["paged_decode_attention", "paged_decode_supported",
-           "paged_decode_xla"]
+           "paged_decode_xla", "force_decode_impl", "forced_decode_impl"]

@@ -7,7 +7,9 @@ from .api import (shard_tensor, reshard, shard_layer, shard_optimizer_state,
                   param_spec_tree, Shard, Replicate, Partial, Placement)
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         RowParallelLinear, ParallelCrossEntropy,
-                        parallel_cross_entropy, scatter_seq, gather_seq,
+                        parallel_cross_entropy,
+                        parallel_fused_linear_cross_entropy,
+                        scatter_seq, gather_seq,
                         ColumnSequenceParallelLinear, RowSequenceParallelLinear)
 from .moe import MoELayer, MoEMLP, top_k_gating
 from .ring_attention import ring_attention

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -203,6 +204,101 @@ def parallel_cross_entropy(logits, labels, mesh=None, axis: str = "tp",
         in_specs=(P(*([None] * (logits.ndim - 1)), axis), batch_spec),
         out_specs=batch_spec)
     return fn(logits, labels)
+
+
+def parallel_fused_linear_cross_entropy(hidden, w, labels, mesh=None,
+                                        axis: str = "tp",
+                                        ignore_index: int = -100,
+                                        block_n=None, block_v=None,
+                                        impl=None, interpret: bool = False):
+    """Fused CE(hidden @ w, labels) over a VOCAB-SHARDED w — the fused
+    loss head's tensor-parallel composition: neither the full logits NOR a
+    full vocab shard of them ever materializes.
+
+    parallel_cross_entropy (above) still receives [..., vocab]-sharded
+    logits, i.e. the projection has already been paid and stored. Here each
+    tp shard runs the blockwise fused kernel (ops/pallas/fused_vocab_ce.py)
+    over ITS [H, V/tp] weight shard — per-shard online log-sum-exp + local
+    target gather in O(block_v) memory — and the shards combine with the
+    same pmax/psum pattern the reference's c_softmax_with_cross_entropy
+    uses: global lse via max-shifted psum of exp(local_lse), target logit
+    via psum (only the owning shard contributes a nonzero tgt).
+
+    hidden: [..., H] replicated over ``axis``; w: [H, V] sharded on its
+    LAST dim over ``axis``; labels: [...] global ids. Returns per-token
+    nll [...] (f32). Differentiable in hidden and w (the fused primitive's
+    custom_vjp recomputes per-block logits; psum/pmax combine via jax AD —
+    the pmax stability shift is stop_gradient'd, as in
+    parallel_cross_entropy)."""
+    from ..ops.pallas.fused_vocab_ce import (fused_linear_cross_entropy,
+                                             lse_and_target, resolve_impl)
+    hm = current_mesh() if mesh is None else mesh
+    if hm is None or hm.axis_size(axis) <= 1:
+        return fused_linear_cross_entropy(
+            hidden, w, labels, ignore_index=ignore_index, reduction="none",
+            block_n=block_n, block_v=block_v, impl=impl, interpret=interpret)
+
+    n_shards = hm.axis_size(axis)
+    vocab = w.shape[-1]
+    if vocab % n_shards:
+        raise ValueError(f"vocab {vocab} not divisible by {axis} degree "
+                         f"{n_shards}")
+    shard_size = vocab // n_shards
+    hd = hidden.shape[-1]
+    n_tok = int(np.prod(labels.shape))
+    if block_n is None or block_v is None:
+        from ..ops.pallas.autotune import fused_vocab_ce_config
+        tn, tv = fused_vocab_ce_config(n_tok, hd, shard_size,
+                                       str(hidden.dtype))
+        block_n = block_n if block_n is not None else tn
+        block_v = block_v if block_v is not None else tv
+    # the block size must DIVIDE the per-shard vocab: the non-TP path pads
+    # W up to a block multiple, but a pad op inside this partial-auto
+    # manual region crashes the SPMD partitioner (IsManualSubgroup check).
+    # Fall back to one shard-sized block (== parallel_cross_entropy's
+    # per-shard working set) when nothing divides.
+    if shard_size % block_v:
+        block_v = next((c for c in (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+                        if c <= shard_size and shard_size % c == 0),
+                       shard_size)
+    if impl is None:
+        impl = resolve_impl(n_tok, hd, shard_size, hidden.dtype,
+                            block_n, block_v, interpret)
+    if impl == "xla":
+        # the scan-based fallback lowers to a while loop, which the SPMD
+        # partitioner rejects inside this partial-auto manual region —
+        # unroll the (V/tp)/block_v vocab-block loop instead
+        impl = "xla_unroll"
+    batch_spec = P(*([None] * labels.ndim))
+    # each shard's vocab offset arrives as DATA (an axis-sharded [n_shards]
+    # array -> [1] per shard) instead of via lax.axis_index: the PartitionId
+    # lowering of axis_index is rejected by the SPMD partitioner when the
+    # manual region also contains the vocab-block scan
+    offsets = jnp.arange(n_shards, dtype=jnp.int32) * shard_size
+
+    def local_fn(h_l, w_l, labels_l, off_l):
+        lo = off_l[0]
+        lab = labels_l.reshape(-1).astype(jnp.int32)
+        valid = lab != ignore_index
+        # ignored rows map below every shard's range (-1 - lo <= -1)
+        local = jnp.where(valid, lab, -1) - lo
+        h2 = h_l.reshape(-1, hd)
+        lse_l, tgt_l = lse_and_target(h2, w_l, local, block_n, block_v,
+                                      impl, interpret)
+        gmax = jax.lax.stop_gradient(
+            jax.lax.pmax(jax.lax.stop_gradient(lse_l), axis))
+        gse = jax.lax.psum(jnp.exp(lse_l - gmax), axis)
+        lse = gmax + jnp.log(gse)
+        tgt = jax.lax.psum(tgt_l, axis)
+        nll = jnp.where(valid, lse - tgt, 0.0)
+        return nll.reshape(labels_l.shape)
+
+    fn = shard_map(
+        local_fn, mesh=hm.mesh, axis_names=frozenset({axis}),
+        in_specs=(P(*([None] * hidden.ndim)), P(None, axis), batch_spec,
+                  P(axis)),
+        out_specs=batch_spec)
+    return fn(hidden, w, labels, offsets)
 
 
 class ParallelCrossEntropy(Layer):

@@ -316,11 +316,18 @@ class Trainer:
         sched_part = ()
         if sched is not None and self._lr_fn is not None:
             sched_part = sched_constants(sched)
+        import os
         self._static_fp = (
             jax.__version__, jax.default_backend(),
             type(model).__qualname__, scalars(model),
             scalars(cfg) if cfg is not None and hasattr(cfg, "__dict__")
             else (),
+            # trace-affecting env escapes: the loss-head override flips
+            # which program gets traced with identical avals and cfg —
+            # without this key a restart under PT_NAIVE_LOSS_HEAD=1 would
+            # aot-hit the stale FUSED executable (and vice versa)
+            bool(os.environ.get("PT_NAIVE_LOSS_HEAD")),
+            bool(os.environ.get("PT_DISABLE_PALLAS")),
             structure,
             type(opt).__qualname__, scalars(opt),
             type(sched).__qualname__ if sched is not None else None,

@@ -1,0 +1,82 @@
+"""Tunnel-recovery hardening in utils/hw_probe.probe_tpu (VERDICT r05
+item 1b): a wedged probe must run the reset hook and back off
+EXPONENTIALLY between attempts — recover-over-the-round, not a fixed
+30s-gap schedule — and a probe straight after a reset runs short so a
+successful reset is discovered fast."""
+
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.utils import hw_probe
+
+
+@pytest.fixture
+def no_cpu_force(monkeypatch):
+    monkeypatch.delenv("PT_BENCH_FORCE_CPU", raising=False)
+
+
+def _patch(monkeypatch, responses, calls, sleeps):
+    def fake_probe(timeout, cwd, env=None):
+        calls.append(timeout)
+        return responses[min(len(calls) - 1, len(responses) - 1)]
+    monkeypatch.setattr(hw_probe, "_one_probe", fake_probe)
+    monkeypatch.setattr(hw_probe.time, "sleep", lambda s: sleeps.append(s))
+
+
+def test_reset_hook_runs_between_every_attempt(monkeypatch, tmp_path,
+                                               no_cpu_force):
+    """The reset hook fires in EVERY retry gap (not once at the end), and
+    the gaps grow exponentially from the base sleep."""
+    marker = tmp_path / "resets.log"
+    monkeypatch.setenv("PT_TUNNEL_RESET_CMD",
+                       f"{sys.executable} -c \"open(r'{marker}','a')"
+                       f".write('r')\"")
+    calls, sleeps = [], []
+    _patch(monkeypatch, [(False, "hung >240s (TPU tunnel wedged?)")],
+           calls, sleeps)
+    ok, note = hw_probe.probe_tpu(attempts=4, timeout=240, sleep=2,
+                                  window=900)
+    assert not ok
+    assert len(calls) == 4
+    assert marker.read_text() == "rrr"        # one reset per retry gap
+    assert sleeps == [2, 4, 8]                # exponential backoff
+    assert "ran PT_TUNNEL_RESET_CMD" in note
+
+
+def test_post_reset_probe_is_short(monkeypatch, no_cpu_force, tmp_path):
+    """After a reset ran OK, the next attempt uses the short (90s) timeout
+    — a recovered tunnel answers fast; a still-wedged one must not re-burn
+    the full 240s."""
+    monkeypatch.setenv("PT_TUNNEL_RESET_CMD", f"{sys.executable} -c pass")
+    calls, sleeps = [], []
+    _patch(monkeypatch, [(False, "hung >60s"), (True, "TPU_OK")],
+           calls, sleeps)
+    ok, note = hw_probe.probe_tpu(attempts=3, timeout=240, sleep=1,
+                                  window=900)
+    assert ok and note is None
+    assert calls[0] == 60.0                   # fast first probe (unchanged)
+    assert calls[1] == 90.0                   # short post-reset probe
+
+
+def test_no_reset_cmd_still_backs_off(monkeypatch, no_cpu_force):
+    monkeypatch.delenv("PT_TUNNEL_RESET_CMD", raising=False)
+    calls, sleeps = [], []
+    _patch(monkeypatch, [(False, "rc=1 platform=cpu:")], calls, sleeps)
+    ok, _ = hw_probe.probe_tpu(attempts=3, timeout=240, sleep=5, window=900)
+    assert not ok
+    assert sleeps == [5, 10]
+    assert calls[1] == 240.0                  # no reset -> full timeout
+
+
+def test_backoff_capped_by_window(monkeypatch, no_cpu_force):
+    """The gap never overruns the probe window (the round budget)."""
+    calls, sleeps = [], []
+    _patch(monkeypatch, [(False, "hung >240s")], calls, sleeps)
+    t = {"now": 0.0}
+    monkeypatch.setattr(hw_probe.time, "monotonic", lambda: t["now"])
+    ok, _ = hw_probe.probe_tpu(attempts=6, timeout=240, sleep=60,
+                               window=900)
+    assert not ok
+    assert all(s <= 120.0 for s in sleeps)    # hard cap
