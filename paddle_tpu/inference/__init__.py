@@ -15,6 +15,8 @@ from .predictor import Config, Predictor, create_predictor
 from . import generation
 from .generation import GenerationConfig, generate
 from .serving import ContinuousBatchingEngine
+from .speculative import DraftProvider, NgramDraftProvider
 
 __all__ = ["Config", "Predictor", "create_predictor", "generation",
-           "GenerationConfig", "generate", "ContinuousBatchingEngine"]
+           "GenerationConfig", "generate", "ContinuousBatchingEngine",
+           "DraftProvider", "NgramDraftProvider"]

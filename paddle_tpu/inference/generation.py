@@ -124,6 +124,20 @@ def sample_logits_per_slot(logits, temperature, top_k, top_p, do_sample,
     return jnp.where(do_sample, sampled, greedy)
 
 
+def fold_sampling_keys(base_key, rseed, token_index):
+    """Per-row replay-exact sampling keys: ``fold_in(fold_in(base, rid),
+    token_index)`` for each row. This derivation IS the serving engine's
+    determinism contract — the non-speculative decode scan and the
+    speculative verify tick must fold IDENTICALLY so a draft is accepted
+    iff it equals the token the plain scan would have emitted (spec-on ≡
+    spec-off), and so sampled streams are independent of batching,
+    pipelining depth, and preemption/replay. One definition, two call
+    sites (``serving._build_decode`` / ``serving._build_spec_decode``)."""
+    return jax.vmap(
+        lambda r, n: jax.random.fold_in(jax.random.fold_in(base_key, r), n)
+    )(rseed, token_index)
+
+
 def decode_stop_update(tok, active, budget, eos_id):
     """On-device stop detection for one decode step (the sampling body's
     ``done`` bookkeeping). ``tok`` [b] is the token just emitted for rows

@@ -1,0 +1,105 @@
+"""Draft proposers for token-level speculative decoding (ISSUE 6).
+
+Speculative decoding (Leviathan et al., 2023) closes decode's
+memory-bandwidth gap: instead of one weight pass per token, a cheap
+draft proposes k tokens and the target model VERIFIES all k positions in
+one batched forward. The serving engine
+(``serving.ContinuousBatchingEngine(spec_k=k)``) owns the verify loop;
+this module owns the drafting side behind one small contract.
+
+``DraftProvider`` is the extension point: ``propose`` runs INSIDE the
+engine's compiled decode tick (it must be pure jax, traced arrays in →
+traced arrays out, no host state). The first provider is draft-FREE
+prompt-lookup / n-gram drafting (Saxena, 2023): match the stream's
+trailing n-gram against its own prompt+generated history and propose the
+tokens that followed the previous occurrence — zero extra model cost,
+and on repetitive or quoting workloads acceptance is high. A small draft
+MODEL sharing the paged KV pool is the planned second implementation
+(same signature; it would close over its own params/pools the way the
+engine's decode fn closes over the target's).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class DraftProvider:
+    """Contract for speculative-draft proposers.
+
+    ``propose(history, hist_len, k)`` → ``[B, k]`` int32 draft tokens.
+
+    * ``history`` ``[B, H]`` int32 — per-slot token history (prompt +
+      committed generations, device-resident, maintained by the engine);
+      entries at index >= ``hist_len`` are stale and must be ignored.
+    * ``hist_len`` ``[B]`` int32 — valid prefix length per row. The
+      engine calls ``propose`` AFTER appending the tick's first
+      (unconditionally committed) token, so drafts condition on it.
+    * ``k`` — static draft length (compiled into the engine's tick).
+
+    The call is traced into the engine's compiled decode block, so it
+    must be jit-pure: no python branching on array values, no host I/O.
+    Rows the engine has deactivated are proposed for anyway and masked by
+    the engine — providers need no liveness logic. Proposals are SAFE by
+    construction: a wrong draft costs only wasted verify width (the
+    engine's acceptance step masks the rejected suffix to pad and routes
+    its KV to the garbage page), never a wrong output token.
+    """
+
+    def propose(self, history, hist_len, k: int):
+        raise NotImplementedError
+
+
+class NgramDraftProvider(DraftProvider):
+    """Prompt-lookup / n-gram drafting over the slot's own history.
+
+    For each row, find the most recent PRIOR occurrence of the trailing
+    ``n``-gram (longest ``n`` first, ``max_ngram`` down to ``min_ngram``)
+    and propose the ``k`` tokens that followed it. Rows with no match —
+    or matches whose continuation runs off the end of history — fall back
+    to repeating the last token (still occasionally right on repetitive
+    text, and wrong drafts are free).
+
+    Everything is vectorized over ``[B, H]``: the match scan is a handful
+    of rolled equality ANDs + one argmax-style reduction, a few microsec
+    of VPU work next to the verify forward it feeds.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not (1 <= min_ngram <= max_ngram):
+            raise ValueError(f"need 1 <= min_ngram <= max_ngram, got "
+                             f"({min_ngram}, {max_ngram})")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history, hist_len, k: int):
+        B, H = history.shape
+        pos_i = jnp.arange(H, dtype=jnp.int32)[None, :]          # [1, H]
+        last_tok = jnp.take_along_axis(
+            history, jnp.clip(hist_len - 1, 0, H - 1)[:, None], axis=1)
+        best = jnp.full((B,), -1, jnp.int32)   # continuation start, -1=none
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            suf_idx = jnp.clip(hist_len[:, None] - n
+                               + jnp.arange(n, dtype=jnp.int32)[None, :],
+                               0, H - 1)
+            suffix = jnp.take_along_axis(history, suf_idx, axis=1)  # [B,n]
+            m = jnp.ones((B, H), bool)
+            for j in range(n):
+                # roll wraps, but validity below forces i+n < hist_len
+                # <= H so wrapped tail positions never survive the mask
+                m &= jnp.roll(history, -j, axis=1) == suffix[:, j:j + 1]
+            # strictly PRIOR occurrence with at least one continuation
+            # token (the trailing n-gram itself sits at i = hist_len - n
+            # and is excluded by i + n < hist_len)
+            m &= (pos_i + n) < hist_len[:, None]
+            m &= (hist_len >= n + 1)[:, None]
+            cand = jnp.max(jnp.where(m, pos_i + n, -1), axis=1)
+            best = jnp.where(best < 0, cand, best)   # longest n wins
+        d_idx = best[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+        in_hist = (best[:, None] >= 0) & (d_idx < hist_len[:, None])
+        toks = jnp.take_along_axis(history, jnp.clip(d_idx, 0, H - 1),
+                                   axis=1)
+        return jnp.where(in_hist, toks, last_tok).astype(jnp.int32)
+
+
+__all__ = ["DraftProvider", "NgramDraftProvider"]

@@ -342,6 +342,39 @@ class LlamaAttention(nn.Layer):
                 tiles.astype(pool.dtype))
         return out, scatter(k_pool, k), scatter(v_pool, v)
 
+    def _paged_ctx_attention(self, q, positions, k_pool, v_pool, tables):
+        """Full-table-span paged attention read: queries ``q``
+        [b, C, n_h, hd] at absolute ``positions`` [b, C] gather the whole
+        table (static shape: max_pages * page), GQA-expand, and attend
+        causally by j_global <= position — O(C * max_len), the same total
+        work order as one full-prompt pass. Shared by the chunked-prefill
+        extend (shared page-aligned offset per row) and the speculative
+        verify step (per-row positions); the causal mask is per row, which
+        reduces to the shared-offset mask when rows agree."""
+        cfg = self.cfg
+        n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                         cfg.head_dim)
+        b, C = positions.shape
+        page = k_pool.shape[2]
+        S = tables.shape[1] * page
+
+        def gather(pool):
+            ctx = pool[:, tables.reshape(-1)]        # [n_kv, b*mp, pg, hd]
+            ctx = ctx.reshape(n_kv, b, S, hd)
+            return jnp.transpose(ctx, (1, 0, 2, 3))  # [b, n_kv, S, hd]
+        k_ctx = gather(k_pool).astype(jnp.float32)
+        v_ctx = gather(v_pool).astype(jnp.float32)
+        rep = n_h // n_kv
+        k_ctx = jnp.repeat(k_ctx, rep, axis=1)       # [b, n_h, S, hd]
+        v_ctx = jnp.repeat(v_ctx, rep, axis=1)
+        qf = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)
+        scores = jnp.einsum("bhcd,bhsd->bhcs", qf, k_ctx) / (hd ** 0.5)
+        j = jnp.arange(S, dtype=jnp.int32)[None, None, None, :]
+        scores = jnp.where(j <= positions[:, None, :, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhcs,bhsd->bhcd", probs, v_ctx)
+        return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, C, n_h * hd)
+
     def prefill_chunk_paged(self, x, cos, sin, offset, k_pool, v_pool,
                             tables):
         """Chunked-prefill step (Sarathi/vLLM-style prefill-extend): a
@@ -382,29 +415,9 @@ class LlamaAttention(nn.Layer):
         k_pool = scatter(k_pool, k)
         v_pool = scatter(v_pool, v)
 
-        # gather the whole table (static shape: max_pages * page) and
-        # mask by j_global <= offset + i — O(C * max_len) per chunk, the
-        # same total work order as one full-prompt pass
-        S = max_pages * page
-
-        def gather(pool):
-            ctx = pool[:, tables.reshape(-1)]        # [n_kv, b*mp, pg, hd]
-            ctx = ctx.reshape(n_kv, b, S, hd)
-            return jnp.transpose(ctx, (1, 0, 2, 3))  # [b, n_kv, S, hd]
-        k_ctx = gather(k_pool).astype(jnp.float32)
-        v_ctx = gather(v_pool).astype(jnp.float32)
-        rep = n_h // n_kv
-        k_ctx = jnp.repeat(k_ctx, rep, axis=1)       # [b, n_h, S, hd]
-        v_ctx = jnp.repeat(v_ctx, rep, axis=1)
-        qf = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)
-        scores = jnp.einsum("bhcd,bhsd->bhcs", qf, k_ctx) / (hd ** 0.5)
-        j = jnp.arange(S, dtype=jnp.int32)[None, :]
-        i = positions[0][:, None]
-        scores = jnp.where((j <= i)[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhcs,bhsd->bhcd", probs, v_ctx)
-        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, C, n_h * hd)
-        out = out.astype(x.dtype)
+        out = self._paged_ctx_attention(
+            q, jnp.broadcast_to(positions, (b, C)), k_pool, v_pool,
+            tables).astype(x.dtype)
         return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
                 k_pool, v_pool)
 
@@ -440,6 +453,46 @@ class LlamaAttention(nn.Layer):
         else:
             out = paged_decode_xla(q2, k_pool, v_pool, tables, pos)
         out = out.reshape(b, 1, n_h * hd).astype(x.dtype)
+        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
+                k_pool, v_pool)
+
+    def decode_verify_paged(self, x, cos, sin, pos, k_pool, v_pool,
+                            tables):
+        """Speculative-verify step: T tokens per row at PER-ROW positions
+        ``pos[b] .. pos[b]+T-1`` (unlike ``prefill_chunk_paged``'s shared,
+        page-aligned offset) — writes all T K/V slots, then attends
+        causally over the full paged history plus the in-chunk prefix.
+        One weight pass scores every draft position (the point of
+        speculative decoding: decode is bandwidth-bound, so T positions
+        cost ~one token's weight traffic).
+
+        Writes past a row's table span route to the reserved garbage page
+        EXPLICITLY (draft positions may legitimately poke past the
+        claimed/claimable region near max_len; the engine only ever
+        COMMITS tokens whose pages it claimed). Stale draft K/V left in
+        real pages by a rejected suffix is overwritten by the next verify
+        chunk before anything attends to it — positions only advance by
+        the committed prefix, and every chunk rewrites its own T slots."""
+        page = k_pool.shape[2]
+        T = x.shape[1]
+        positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        q, k, v = self._qkv_rope(x, cos, sin, positions)
+        max_pages = tables.shape[1]
+        pidx = positions // page                         # [b, T]
+        valid = pidx < max_pages
+        phys = jnp.take_along_axis(tables,
+                                   jnp.minimum(pidx, max_pages - 1), axis=1)
+        phys = jnp.where(valid, phys, 0)                 # garbage page
+        off = positions % page
+
+        def scatter(pool, new):                          # new [b, T, kv, hd]
+            return pool.at[:, phys, off].set(
+                jnp.transpose(new, (2, 0, 1, 3)).astype(pool.dtype))
+        k_pool = scatter(k_pool, k)
+        v_pool = scatter(v_pool, v)
+
+        out = self._paged_ctx_attention(q, positions, k_pool, v_pool,
+                                        tables).astype(x.dtype)
         return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
                 k_pool, v_pool)
 
@@ -618,6 +671,22 @@ class LlamaModel(nn.Layer):
         new_pools = []
         for layer, (kp, vp) in zip(self.layers, pools):
             a, kp, vp = layer.self_attn.decode_paged(
+                layer.input_layernorm(x), self.rope_cos, self.rope_sin,
+                pos, kp, vp, tables)
+            h = x + a
+            x = h + layer.mlp(layer.post_attention_layernorm(h))
+            new_pools.append((kp, vp))
+        return self.norm(x), new_pools
+
+    def decode_verify_paged(self, token_ids, pos, pools, tables):
+        """Speculative verify: ``token_ids`` [b, T] at per-row positions
+        ``pos[b]..pos[b]+T-1`` → (hidden [b, T, d], pools). Hidden at
+        in-chunk index j scores the token AFTER input j — the engine
+        samples targets from every row to accept/reject drafts."""
+        x = jnp.take(self.embed_tokens, token_ids, axis=0)
+        new_pools = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            a, kp, vp = layer.self_attn.decode_verify_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
                 pos, kp, vp, tables)
             h = x + a

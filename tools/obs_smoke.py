@@ -83,7 +83,45 @@ def _serving_leg():
     for L in (6, 8, 5):
         eng.submit(rs.randint(0, 32, (L,)).astype(np.int32))
     out = eng.run()
-    return sum(len(v) for v in out.values())
+    served = sum(len(v) for v in out.values())
+
+    # speculative batch (ISSUE 6 satellite): the acceptance counters
+    # must MOVE deterministically, so the drafts come from an ORACLE
+    # provider that replays the precomputed greedy continuation — the
+    # engine's parity contract (spec stream == generate_scan stream)
+    # guarantees every draft matches its target, independent of what the
+    # random-weight model happens to generate on any jax/platform
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference import DraftProvider
+    from paddle_tpu.inference.generation import generate_scan
+
+    prompt = rs.randint(0, 32, (8,)).astype(np.int32)
+    full = np.asarray(generate_scan(
+        model, jnp.asarray(prompt)[None, :],
+        GenerationConfig(max_new_tokens=10, do_sample=False)))[0]
+
+    class Oracle(DraftProvider):
+        """history[:hist_len] == full[:hist_len] by the parity contract,
+        so the stream's next tokens are full[hist_len:]."""
+
+        def propose(self, history, hist_len, k):
+            ref = jnp.asarray(full, jnp.int32)
+            idx = hist_len[:, None] + jnp.arange(k, dtype=jnp.int32)
+            return ref[jnp.clip(idx, 0, ref.shape[0] - 1)]
+
+    spec = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=8, max_len=48,
+        generation_config=GenerationConfig(max_new_tokens=10,
+                                           do_sample=False),
+        spec_k=3, draft_provider=Oracle())
+    spec.submit(prompt)
+    out = spec.run()
+    served += sum(len(v) for v in out.values())
+    assert spec.spec_tokens_proposed > 0, "spec verify never ran"
+    assert spec.spec_tokens_accepted > 0, \
+        "oracle drafts not accepted: spec parity contract broken"
+    return served, spec.spec_stats()
 
 
 def main(out_dir: str) -> dict:
@@ -101,7 +139,7 @@ def main(out_dir: str) -> dict:
     errors = []
     try:
         emissions = _train_leg()
-        served = _serving_leg()
+        served, spec_stats = _serving_leg()
         obs.publish()
 
         # goodput invariant: buckets sum to accounted wall-time
@@ -124,11 +162,16 @@ def main(out_dir: str) -> dict:
         parsed = parse_prometheus(text)
         for want in ("pt_goodput_seconds", "pt_goodput_fraction",
                      "pt_train_loss", "pt_compile_cache",
-                     "pt_serving_tokens_total"):
+                     "pt_serving_tokens_total",
+                     "pt_spec_tokens_proposed_total",
+                     "pt_spec_tokens_accepted_total"):
             if want not in names:
                 errors.append(f"{want} missing from JSONL series")
             if not any(k.startswith(want) for k in parsed):
                 errors.append(f"{want} missing from Prometheus text")
+        # (counter records only exist once they increment, so the
+        # missing-name check above already proves the spec counters
+        # moved)
         buckets = {lb[0][1] for lb in parsed.get("pt_goodput_seconds", {})}
         missing = set(obs.goodput.BUCKETS) - buckets
         if missing:
@@ -145,6 +188,8 @@ def main(out_dir: str) -> dict:
             "ok": not errors,
             "train_metric_emissions": emissions,
             "served_tokens": served,
+            "spec_accept_rate": round(
+                spec_stats.get("spec_accept_rate", 0.0), 3),
             "jsonl_records": len(records),
             "prom_metrics": len(parsed),
             "goodput_fraction": t["goodput_fraction"],
