@@ -8,7 +8,9 @@ or a live Layer jitted on first run. The name/handle API
 (get_input_names/get_input_handle/run) is preserved so serving code ports,
 but handles are zero-copy device arrays rather than LoDTensors. LLM serving
 (KV-cache generation loops, greedy/top-k/top-p) lives in
-paddle_tpu.inference.generation.
+paddle_tpu.inference.generation; the production serving control plane —
+continuous batching, radix prefix-shared KV, SLO-aware admission — in
+paddle_tpu.inference.{serving,prefix_cache,admission}.
 """
 
 from .predictor import Config, Predictor, create_predictor
@@ -16,7 +18,10 @@ from . import generation
 from .generation import GenerationConfig, generate
 from .serving import ContinuousBatchingEngine
 from .speculative import DraftProvider, NgramDraftProvider
+from .prefix_cache import RadixPrefixCache
+from .admission import AdmissionPolicy, SLOAdmissionPolicy, VictimInfo
 
 __all__ = ["Config", "Predictor", "create_predictor", "generation",
            "GenerationConfig", "generate", "ContinuousBatchingEngine",
-           "DraftProvider", "NgramDraftProvider"]
+           "DraftProvider", "NgramDraftProvider", "RadixPrefixCache",
+           "AdmissionPolicy", "SLOAdmissionPolicy", "VictimInfo"]

@@ -69,10 +69,46 @@ Saxena'23, TPU-shaped):
   page ever claimed, so claim coverage is monotone and always ahead of
   what the device can commit.
 
+RADIX PREFIX SHARING (``prefix_cache=True``, PagedAttention Kwon'23 /
+RadixAttention Zheng'24, TPU-shaped):
+
+- A radix tree over token sequences (``prefix_cache.RadixPrefixCache``)
+  owns REFCOUNTED pages in the same pool the engine allocates from.
+  Admission walks the tree, maps the matched pages straight into the new
+  slot's page table (one lock per slot; node splits are page-aligned)
+  and prefills ONLY the unmatched suffix through the existing
+  chunked-prefill path from a page-aligned offset — shared system
+  prompts cost one table write instead of a full prefill.
+- A FULL-prompt match takes the COW fast path: the page holding the
+  last prompt token is copy-on-written into a private page (decode is
+  about to diverge into it) and exactly ONE token is re-forwarded
+  (``decode_verify_paged`` at L-1) to produce the first-token logits —
+  TTFT collapses to one decode-step's work.
+- Retiring/preempted slots DONATE their completed full pages to the
+  tree before their lock releases, so conversation-style reuse and
+  preemption replay both hit. Refcount-0 tree pages stay cached and are
+  LRU-evicted (tail-first) only under pool pressure, inside
+  ``_alloc_pages`` — the ``pool_dry_drains``/recompute-preemption
+  machinery downstream is untouched, it just sees a deeper pool.
+- The refcount invariant (fuzz-tested): every pool page is free, OR
+  privately owned by exactly one table, OR tree-owned with
+  ``node.ref == number of tables mapping it``. Decode never writes a
+  shared page: the mapped prefix always ends below the first decode
+  position (the COW fast path privatizes the boundary page at admit).
+- ``prefix_cache=False`` (default) leaves every path above unbuilt —
+  the engine is characterization-identical to the pre-prefix code.
+
+SLO-AWARE ADMISSION (``admission=SLOAdmissionPolicy(...)``): queued
+requests are admitted shortest-uncached-suffix first (prefix-aware
+ordering — the SGLang insight), a long cold prefill is DEFERRED while
+the ITL p99 gauge breaches its target (unless TTFT is also breaching),
+and recompute-preemption prefers low-progress / low-shared-refcount
+victims. ``admission=None`` (default) keeps FIFO + newest-rid victims.
+
 The engine is exact: greedy outputs match ``generate_scan`` per request
-regardless of batching/preemption/pipelining/speculation interleaving
-(tests/test_serving.py, tests/test_serving_async.py,
-tests/test_serving_spec.py).
+regardless of batching/preemption/pipelining/speculation/prefix-sharing
+interleaving (tests/test_serving.py, tests/test_serving_async.py,
+tests/test_serving_spec.py, tests/test_serving_prefix.py).
 """
 
 from __future__ import annotations
@@ -89,8 +125,10 @@ import numpy as np
 
 from ..observability.metrics import REGISTRY as _REG
 from ..profiler import RecordEvent
+from .admission import AdmissionPolicy, VictimInfo
 from .generation import (GenerationConfig, decode_stop_update,
                          fold_sampling_keys, sample_logits_per_slot)
+from .prefix_cache import RadixPrefixCache
 from .speculative import DraftProvider, NgramDraftProvider
 
 
@@ -163,7 +201,9 @@ class ContinuousBatchingEngine:
                  decode_block: int = 1, chunked_prefill: bool = False,
                  prefill_chunk: Optional[int] = None, async_depth: int = 2,
                  attn_crossover: Optional[int] = None, spec_k: int = 0,
-                 draft_provider: Optional[DraftProvider] = None):
+                 draft_provider: Optional[DraftProvider] = None,
+                 prefix_cache: bool = False,
+                 admission: Optional[AdmissionPolicy] = None):
         self.model = model
         self.core = getattr(model, "model", model)
         if spec_k and not hasattr(self.core, "decode_verify_paged"):
@@ -248,6 +288,20 @@ class ContinuousBatchingEngine:
         self.attn_crossover = int(attn_crossover)
         self.attn_path_ticks = {"dense": 0, "paged": 0}
         self._inflight: Deque[_InflightBlock] = deque()
+        # radix prefix-shared KV (ISSUE 7): tree nodes own refcounted
+        # pages in THIS pool; one PrefixLock per occupied slot records
+        # exactly which nodes its table maps. prefix_cache=False builds
+        # none of it — every sharing branch below gates on _prefix.
+        self._prefix = (RadixPrefixCache(page_size) if prefix_cache
+                        else None)
+        self._tree_locks: List[Optional[object]] = [None] * max_batch
+        self._admission = admission
+        self.prefix_hit_tokens = 0          # prompt tokens NOT recomputed
+        self.prefix_cow_copies = 0          # shared pages copy-on-written
+        self._prefix_prompt_tokens = 0      # denominator for the hit rate
+        self._price_cache: Dict[int, tuple] = {}   # rid -> (key, price)
+        self._cow_fn = None                 # jitted page copy (COW)
+        self._tail_fn = None                # 1-token re-forward for logits
         # chunked prefill (Sarathi/vLLM prefill-extend): admission claims
         # pages but prefill proceeds one chunk per scheduler tick,
         # interleaved with decode of running slots — bounds the per-tick
@@ -297,6 +351,12 @@ class ContinuousBatchingEngine:
         self._g_occupancy = _REG.gauge(
             "pt_serving_page_pool_occupancy",
             "fraction of the KV page pool claimed")
+        self._g_prefix_pages = _REG.gauge(
+            "pt_serving_prefix_shared_pages",
+            "pool pages owned by the radix prefix cache")
+        self._g_prefix_hit = _REG.gauge(
+            "pt_serving_prefix_hit_rate",
+            "prefix-cache hit tokens / admitted prompt tokens")
 
     # -- public API ---------------------------------------------------------
 
@@ -398,6 +458,28 @@ class ContinuousBatchingEngine:
         if self.spec_k:
             out["spec_tokens_proposed"] = self.spec_tokens_proposed
             out["spec_tokens_accepted"] = self.spec_tokens_accepted
+        if self._prefix is not None:
+            out["prefix_hit_tokens"] = self.prefix_hit_tokens
+            out["prefix_cow_copies"] = self.prefix_cow_copies
+            out["prefix_shared_pages"] = self._prefix.num_pages
+        return out
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Prefix-cache effectiveness over the engine's lifetime: hit
+        tokens (prompt tokens served from shared pages instead of being
+        re-prefilled), hit rate against all admitted prompt tokens,
+        copy-on-write count and current tree size. Empty when
+        ``prefix_cache=False``."""
+        if self._prefix is None:
+            return {}
+        out = {"prefix_hit_tokens": float(self.prefix_hit_tokens),
+               "prefix_prompt_tokens": float(self._prefix_prompt_tokens),
+               "prefix_cow_copies": float(self.prefix_cow_copies),
+               "prefix_shared_pages": float(self._prefix.num_pages),
+               "prefix_nodes": float(self._prefix.num_nodes())}
+        if self._prefix_prompt_tokens:
+            out["prefix_hit_rate"] = (self.prefix_hit_tokens
+                                      / self._prefix_prompt_tokens)
         return out
 
     def spec_stats(self) -> Dict[str, float]:
@@ -429,6 +511,8 @@ class ContinuousBatchingEngine:
         self._g_free.set(len(self._free))
         self._g_occupancy.set(
             1.0 - len(self._free) / max(self._total_pages, 1))
+        if self._prefix is not None:
+            self._g_prefix_pages.set(self._prefix.num_pages)
 
     def publish_metrics(self) -> Dict[str, float]:
         """Mirror the engine's telemetry into the process metrics registry
@@ -455,7 +539,12 @@ class ContinuousBatchingEngine:
                  "draft tokens scored by speculative verify passes"),
                 ("pt_spec_tokens_accepted_total",
                  self.spec_tokens_accepted,
-                 "draft tokens committed by speculative verify passes")):
+                 "draft tokens committed by speculative verify passes"),
+                ("pt_serving_prefix_hit_tokens_total",
+                 self.prefix_hit_tokens,
+                 "prompt tokens served from shared prefix pages"),
+                ("pt_serving_cow_copies_total", self.prefix_cow_copies,
+                 "shared pages copy-on-written at divergence")):
             prev = self._published.get(name, 0)
             if val > prev:
                 _REG.counter(name, help).inc(val - prev)
@@ -469,6 +558,9 @@ class ContinuousBatchingEngine:
             _REG.gauge("pt_spec_mean_accepted_len",
                        "mean committed tokens per speculative drain").set(
                 sp["spec_mean_accepted_len"])
+        if self._prefix is not None and self._prefix_prompt_tokens:
+            self._g_prefix_hit.set(self.prefix_hit_tokens
+                                   / self._prefix_prompt_tokens)
         for key, metric in (("ttft", "pt_serving_ttft_seconds"),
                             ("latency", "pt_serving_latency_seconds"),
                             ("itl", "pt_serving_itl_seconds")):
@@ -485,16 +577,45 @@ class ContinuousBatchingEngine:
 
     # -- page allocator -----------------------------------------------------
 
-    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+    def _alloc_pages(self, n: int,
+                     protect=None) -> Optional[List[int]]:
+        """Pop ``n`` pages; under pressure, refcount-0 prefix-tree pages
+        are LRU-evicted back into the free list first (``protect`` pins
+        the match path of the request being admitted so admission can't
+        evict the prefix it is about to map). Only once the tree has
+        nothing evictable does the caller see None — the dry-pool
+        drain/preemption machinery downstream is unchanged."""
+        if len(self._free) < n and self._prefix is not None:
+            self._free.extend(
+                self._prefix.evict(n - len(self._free), protect))
         if len(self._free) < n:
             return None
         return [self._free.pop() for _ in range(n)]
 
-    def _free_slot(self, slot: int):
+    def _free_slot(self, slot: int, cache: bool = False):
         req = self._slots[slot]
         # free every held page (page 0 == unset): counting from pos would
         # leak a boundary page granted earlier in the same scheduling pass
-        self._free.extend(int(p) for p in self.tables[slot] if p != 0)
+        if self._prefix is not None:
+            if cache and req is not None and self._decode_ready(req):
+                # donate completed full pages before the lock releases:
+                # retirement caches the whole conversation, preemption
+                # caches the replay's own prefix (the re-prefill hits)
+                self._insert_prefix(slot, req)
+            lock = self._tree_locks[slot]
+            if lock is not None:
+                # released exactly ONCE, whether the slot retired,
+                # was preempted mid-decode, or was evicted mid-prefill
+                # before ever activating — a mid-prefill slot's table
+                # holds admission-claimed private pages PLUS the mapped
+                # shared prefix, and only the former go back to the
+                # free list (the tree still owns the latter)
+                self._prefix.release(lock)
+                self._tree_locks[slot] = None
+            self._free.extend(int(p) for p in self.tables[slot]
+                              if p != 0 and not self._prefix.owns(int(p)))
+        else:
+            self._free.extend(int(p) for p in self.tables[slot] if p != 0)
         self.tables[slot] = 0
         self._tables_dirty = True
         self.pos[slot] = 0
@@ -614,52 +735,242 @@ class ContinuousBatchingEngine:
         self._prefill_cache[bucket] = fn
         return fn
 
+    @staticmethod
+    def _req_tokens(req: _Request) -> np.ndarray:
+        """The request's replay token sequence: prompt + anything
+        generated before a preemption — the ONE definition the prefix
+        match, donation, admission-pricing and chunk-prefill paths all
+        key on."""
+        return np.concatenate([req.prompt,
+                               np.asarray(req.generated, np.int32)])
+
+    def _uncached_tokens(self, req: _Request) -> int:
+        """Predicted prefill cost of admitting ``req`` now: the tokens
+        its admission would actually recompute (1 for a full-prompt hit
+        — just the logits re-forward). The admission policy prices
+        admits with this. Prices are cached per (rid, replay length)
+        against the tree's mutation epoch, so a deep deferred queue
+        costs one tree walk per request per tree CHANGE, not per tick."""
+        L = len(req.prompt) + len(req.generated)
+        if self._prefix is None:
+            return L
+        key = (self._prefix.epoch, L)
+        hit = self._price_cache.get(req.rid)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        # touch=False: a pricing read must not bump the match path's LRU
+        # rank — a request deferred every tick would otherwise keep its
+        # prefix artificially hot and starve eviction of real traffic
+        m = self._prefix.match(self._req_tokens(req), touch=False)
+        if m >= L and hasattr(self.core, "decode_verify_paged"):
+            price = 1
+        else:
+            price = L - (min(m, L - 1) // self.page_size) * self.page_size
+        if len(self._price_cache) > 4 * self.max_batch + 1024:
+            self._price_cache.clear()          # bound stale-rid growth
+        self._price_cache[req.rid] = (key, price)
+        return price
+
+    def _insert_prefix(self, slot: int, req: _Request) -> None:
+        """Donate the slot's completed full pages (prompt + committed
+        generations) to the radix tree. Ranges the tree already covers
+        stay the slot's private duplicates; new nodes join the slot's
+        lock at ref 1 so the uniform release path owns them."""
+        toks = self._req_tokens(req)
+        n_ins = len(toks) // self.page_size
+        if n_ins == 0:
+            return
+        lock = self._tree_locks[slot]
+        if lock is None:
+            lock = self._tree_locks[slot] = self._prefix.new_lock()
+        self._prefix.insert(toks[:n_ins * self.page_size],
+                            [int(p) for p in self.tables[slot, :n_ins]],
+                            lock)
+
+    def _cow_page(self, src: int, dst: int) -> None:
+        """Copy page ``src`` → ``dst`` across every layer's K/V pool (one
+        jitted dispatch, page ids traced): the COW primitive for decode
+        diverging into a shared page."""
+        if self._cow_fn is None:
+            def run(pools, src, dst):
+                return [(kp.at[:, dst].set(kp[:, src]),
+                         vp.at[:, dst].set(vp[:, src]))
+                        for kp, vp in pools]
+            self._cow_fn = jax.jit(run, donate_argnums=(0,))
+        self.pools = self._cow_fn(self.pools, jnp.int32(src),
+                                  jnp.int32(dst))
+        self.prefix_cow_copies += 1
+
+    def _tail_logits_fn(self):
+        """The full-prompt-hit fast path's entire compute, ONE dispatch:
+        copy-on-write the shared boundary page (``src`` → ``dst``, every
+        layer), then re-forward the single last prompt token — its K/V
+        write lands in the private copy and the returned logits row is
+        what a full prefill would have produced."""
+        if self._tail_fn is None:
+            core, model = self.core, self.model
+            head = model.logits if hasattr(model, "logits") else \
+                (lambda h: h)
+
+            def run(params, tok, pos, pools, tables1, src, dst):
+                pools = [(kp.at[:, dst].set(kp[:, src]),
+                          vp.at[:, dst].set(vp[:, src]))
+                         for kp, vp in pools]
+                ctx = model._bind(params) if hasattr(model, "_bind") \
+                    else None
+                with ctx if ctx is not None else _null():
+                    h, pools = core.decode_verify_paged(tok, pos, pools,
+                                                        tables1)
+                    logits = head(h[0, 0, :])
+                return logits, pools
+
+            self._tail_fn = jax.jit(run, donate_argnums=(3,))
+        return self._tail_fn
+
     def _admit(self):
+        lat, prices, q_snap = None, {}, None
         while self._queue:
             slot = next((i for i, s in enumerate(self._slots) if s is None),
                         None)
             if slot is None:
                 return
-            req = self._queue[0]
+            if self._admission is not None:
+                if lat is None:
+                    lat = self.latency_stats()
+
+                # price each queued request at most once per _admit call
+                # (select() re-runs per admitted slot; without the memo a
+                # deep queue costs admits x queue tree walks per tick).
+                # Prices can go stale within the call — an earlier
+                # admit's insertion may raise a later request's hit —
+                # which only costs ordering accuracy, never correctness.
+                def _price(r):
+                    v = prices.get(r.rid)
+                    if v is None:
+                        v = prices[r.rid] = self._uncached_tokens(r)
+                    return v
+                q_snap = list(self._queue)
+                qi = self._admission.select(q_snap, _price, lat)
+                if qi is None:
+                    return                   # SLO defer: none this tick
+                req = q_snap[qi]
+            else:
+                qi, req = 0, self._queue[0]
             L = len(req.prompt) + len(req.generated)
             need = -(-self._bucket(L) // self.page_size)
-            pages = self._alloc_pages(need)
+            toks = self._req_tokens(req)
+            # prefix sharing: map every FULLY matched page; a full-prompt
+            # match keeps the boundary page shared too and COWs it (the
+            # last token is re-forwarded for its logits), otherwise the
+            # page holding the first unmatched token is recomputed by the
+            # suffix prefill. n_lock*page_size is always < L, so decode
+            # positions land strictly beyond the shared region.
+            n_lock, fast, m = 0, False, 0
+            if self._prefix is not None:
+                m = self._prefix.match(toks)
+                fast = (m >= L
+                        and hasattr(self.core, "decode_verify_paged"))
+                n_lock = (L - 1) // self.page_size if m >= L \
+                    else m // self.page_size
+            pages = self._alloc_pages(need - n_lock,
+                                      protect=toks if m else None)
             if pages is None:
-                if not any(s is not None for s in self._slots):
-                    # nothing running that could ever free pages: a replay
-                    # grew past the pool (the submit-time check covers only
-                    # the original prompt)
+                if any(s is not None for s in self._slots):
+                    return                   # wait for pages to free up
+                if m:
+                    # nothing running, and the free pool + evictable
+                    # tree can't cover the private remainder because
+                    # the protected match path holds the pages: admit
+                    # COLD instead (evict everything, full prefill)
+                    n_lock, fast = 0, False
+                    pages = self._alloc_pages(need)
+                if pages is None:
+                    # nothing running that could ever free pages: a
+                    # replay grew past the pool (the submit-time check
+                    # covers only the original prompt)
                     raise RuntimeError(
-                        f"request {req.rid} needs {need} pages but the pool "
-                        f"holds {self._total_pages}; raise num_pages")
-                return                       # wait for pages to free up
-            self._queue.popleft()
-            # replay = prompt + anything generated before a preemption
-            toks = np.concatenate([req.prompt,
-                                   np.asarray(req.generated, np.int32)])
-            self.tables[slot, :len(pages)] = pages
+                        f"request {req.rid} needs {need} pages but the "
+                        f"pool holds {self._total_pages}; raise num_pages")
+            if self._admission is not None:
+                # pages really claimed: NOW the passed-over requests are
+                # charged a starvation skip (a pool-blocked tick above
+                # returned without charging anyone)
+                self._admission.note_admitted(q_snap, qi)
+                del self._queue[qi]
+            else:
+                self._queue.popleft()
+            if self._prefix is not None:
+                lock = (self._prefix.lock_prefix(toks, n_lock) if n_lock
+                        else self._prefix.new_lock())
+                self._tree_locks[slot] = lock
+                self.tables[slot, :n_lock] = lock.pages()
+                self._prefix_prompt_tokens += L
+                self.prefix_hit_tokens += (L - 1) if fast \
+                    else n_lock * self.page_size
+            self.tables[slot, n_lock:n_lock + len(pages)] = pages
             self._tables_dirty = True
             self._slots[slot] = req
             req.slot = slot
             self._dosample[slot] = req.do_sample
             req.prefill_target = L
+            if fast:
+                # COW the shared page holding token L-1 (positions >= L-1
+                # in the copy are ours to overwrite; positions < L-1 in
+                # it matched, so their KV is exactly what we'd compute),
+                # then re-forward ONLY that token for the logits row.
+                src = self._prefix.page_at(toks, n_lock)
+                assert src is not None, "matched tail page vanished"
+                self.prefix_cow_copies += 1
+                with RecordEvent("serving::prefill"):
+                    logits, self.pools = self._tail_logits_fn()(
+                        self._params,
+                        jnp.asarray(toks[L - 1:L].reshape(1, 1)),
+                        jnp.full((1,), L - 1, jnp.int32), self.pools,
+                        jnp.asarray(self.tables[slot:slot + 1]),
+                        jnp.int32(src), jnp.int32(pages[0]))
+                req.prefilled = L
+                self._activate(slot, req, logits)
+                self._insert_prefix(slot, req)
+                continue
             if self.chunked_prefill:
-                # pages claimed now; KV written one chunk per tick
-                req.prefilled = 0
+                # pages claimed now; KV written one chunk per tick,
+                # starting AFTER the shared prefix (page-aligned offset)
+                req.prefilled = n_lock * self.page_size
                 self.pos[slot] = 0
                 self._proj_pos[slot] = 0
                 self._proj_gen[slot] = 0
                 continue
             bucket = self._bucket(L)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :L] = toks
+            off = n_lock * self.page_size
             req.prefilled = L
             with RecordEvent("serving::prefill"):
-                logits, self.pools = self._prefill_fn(bucket)(
-                    self._params, jnp.asarray(ids), self.pools,
-                    jnp.asarray(self.tables[slot:slot + 1]),
-                    jnp.int32(L - 1))
+                if off:
+                    # suffix-only prefill from the page-aligned offset:
+                    # the existing chunked-prefill extend attends over
+                    # the mapped shared history plus itself. The ids
+                    # width (bucket - off) is a page multiple, so the
+                    # executable set this jit retraces over is bounded
+                    # by pages_per_seq — the same bound the per-bucket
+                    # cold-prefill cache already lives with.
+                    ids = np.zeros((1, bucket - off), np.int32)
+                    ids[0, :L - off] = toks[off:]
+                    if self._chunk_fn is None:
+                        self._chunk_fn = self._build_chunk_fn()
+                    logits, self.pools = self._chunk_fn(
+                        self._params, jnp.asarray(ids), jnp.int32(off),
+                        self.pools,
+                        jnp.asarray(self.tables[slot:slot + 1]),
+                        jnp.int32(L - 1))
+                else:
+                    ids = np.zeros((1, bucket), np.int32)
+                    ids[0, :L] = toks
+                    logits, self.pools = self._prefill_fn(bucket)(
+                        self._params, jnp.asarray(ids), self.pools,
+                        jnp.asarray(self.tables[slot:slot + 1]),
+                        jnp.int32(L - 1))
             self._activate(slot, req, logits)
+            if self._prefix is not None:
+                self._insert_prefix(slot, req)
 
     def _decode_ready(self, req) -> bool:
         return req is not None and req.prefilled >= req.prefill_target
@@ -692,8 +1003,7 @@ class ContinuousBatchingEngine:
         req = self._slots[slot]
         C = self.prefill_chunk
         off = req.prefilled
-        toks = np.concatenate([req.prompt,
-                               np.asarray(req.generated, np.int32)])
+        toks = self._req_tokens(req)
         ids = np.zeros((1, C), np.int32)
         chunk = toks[off:off + C]
         ids[0, :len(chunk)] = chunk
@@ -708,6 +1018,8 @@ class ContinuousBatchingEngine:
         req.prefilled = min(off + C, self._bucket(req.prefill_target))
         if req.prefilled >= req.prefill_target:
             self._activate(slot, req, logits)
+            if self._prefix is not None:
+                self._insert_prefix(slot, req)
 
     # -- decode -------------------------------------------------------------
 
@@ -937,28 +1249,72 @@ class ContinuousBatchingEngine:
             for pidx in range(first, last + 1):
                 if pidx >= self.pages_per_seq:
                     raise RuntimeError("sequence exceeded engine max_len")
-                if self.tables[slot, pidx] != 0:
+                existing = int(self.tables[slot, pidx])
+                if existing != 0:
+                    if self._prefix is not None \
+                            and self._prefix.owns(existing):
+                        # decode is about to write into a tree-owned
+                        # page: copy-on-write it into a private page.
+                        # (Admission keeps the mapped prefix strictly
+                        # below the first decode position, so today
+                        # this only guards future mapping policies —
+                        # but the write-a-shared-page hazard is fatal
+                        # enough to keep the net under it.)
+                        assert self._tree_locks[slot] is None or all(
+                            existing not in n.pages
+                            for n in self._tree_locks[slot].nodes), \
+                            "decode diverged inside its own locked prefix"
+                        self.tables[slot, pidx] = self._claim_one(slot)
+                        self._cow_page(existing, int(self.tables[slot,
+                                                                 pidx]))
+                        self._tables_dirty = True
                     continue                  # already holds this page
-                page = self._alloc_pages(1)
-                while page is None:
-                    if self._inflight:
-                        raise _PoolDry()
-                    victim = max((i for i in range(self.max_batch)
-                                  if self._slots[i] is not None
-                                  and i != slot),
-                                 key=lambda i: self._slots[i].rid,
-                                 default=None)
-                    if victim is None:
-                        raise RuntimeError(
-                            "page pool too small for one request")
-                    self.preemptions += 1
-                    vreq = self._slots[victim]
-                    self._deactivate(victim)
-                    self._free_slot(victim)
-                    self._queue.appendleft(vreq)
-                    page = self._alloc_pages(1)
-                self.tables[slot, pidx] = page[0]
+                self.tables[slot, pidx] = self._claim_one(slot)
                 self._tables_dirty = True
+
+    def _claim_one(self, exclude_slot: int) -> int:
+        """One page for a decode-time claim; recompute-preempts (policy
+        victim when configured, newest-rid otherwise) once the pool AND
+        the evictable prefix tree are dry, raising _PoolDry first while
+        speculative blocks are still in flight."""
+        page = self._alloc_pages(1)
+        while page is None:
+            if self._inflight:
+                raise _PoolDry()
+            cands = [i for i in range(self.max_batch)
+                     if self._slots[i] is not None and i != exclude_slot]
+            if not cands:
+                raise RuntimeError("page pool too small for one request")
+            if self._admission is not None:
+                infos = []
+                for i in cands:
+                    r = self._slots[i]
+                    priv = shared = 0
+                    for p in self.tables[i]:
+                        if p == 0:
+                            continue
+                        if self._prefix is not None \
+                                and self._prefix.owns(int(p)):
+                            shared += 1
+                        else:
+                            priv += 1
+                    infos.append(VictimInfo(slot=i, rid=r.rid,
+                                            progress=len(r.generated),
+                                            private_pages=priv,
+                                            shared_pages=shared))
+                victim = self._admission.choose_victim(infos)
+            else:
+                victim = max(cands, key=lambda i: self._slots[i].rid)
+            self.preemptions += 1
+            vreq = self._slots[victim]
+            self._deactivate(victim)
+            # donate the victim's completed pages (prefix mode): its
+            # replay re-maps them instead of re-prefilling, and at ref 0
+            # they stay first in line for LRU eviction if pressure holds
+            self._free_slot(victim, cache=True)
+            self._queue.appendleft(vreq)
+            page = self._alloc_pages(1)
+        return page[0]
 
     def _dispatch_block(self, emitted: List[tuple]) -> bool:
         """Issue the next decode block WITHOUT waiting for in-flight
@@ -1125,7 +1481,10 @@ class ContinuousBatchingEngine:
                      req.done_t - req.submit_t,
                      len(req.generated)))
                 self._itl_gaps.extend(req.itl_gaps)
-                self._free_slot(slot)
+                # cache=True: donate the whole conversation's completed
+                # pages to the prefix tree before the slot's lock
+                # releases (prefix mode; no-op otherwise)
+                self._free_slot(slot, cache=True)
             else:
                 self.pos[slot] = int(pos_after[slot])
         if self.spec_k:
@@ -1145,6 +1504,34 @@ class ContinuousBatchingEngine:
                 self._proj_gen[slot] = len(req.generated) + extra
                 self._proj_pos[slot] = int(pos_after[slot]) + extra
         return emitted
+
+    def _check_page_invariants(self) -> None:
+        """Test hook (fuzz-asserted): every pool page is exactly one of
+        free / privately owned by ONE table / tree-owned with
+        ``node.ref == number of tables mapping it`` — the refcount
+        invariant prefix sharing lives or dies by."""
+        from collections import Counter as _Counter
+        free = [int(p) for p in self._free]
+        assert len(set(free)) == len(free), "duplicate pages in free list"
+        assert 0 not in free, "garbage page leaked into the free list"
+        mapped = _Counter(int(p) for row in self.tables for p in row if p)
+        tree = dict(self._prefix._pages) if self._prefix is not None \
+            else {}
+        assert not set(free) & set(mapped), "page both free and mapped"
+        assert not set(free) & set(tree), "page both free and tree-owned"
+        for p, node in tree.items():
+            assert mapped.get(p, 0) == node.ref, (
+                f"tree page {p}: refcount {node.ref} != "
+                f"{mapped.get(p, 0)} mapping tables")
+        for p, c in mapped.items():
+            if p not in tree:
+                assert c == 1, f"private page {p} mapped by {c} tables"
+        accounted = (len(free) + len(tree)
+                     + sum(1 for p in mapped if p not in tree))
+        assert accounted == self._total_pages, (
+            f"page leak: {self._total_pages - accounted} unaccounted")
+        if self._prefix is not None:
+            self._prefix.check()
 
     def reset_latency_stats(self) -> None:
         """Drop the retired-request latency window (e.g. after a warmup

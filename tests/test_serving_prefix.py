@@ -1,0 +1,509 @@
+"""Radix prefix-shared KV cache + SLO-aware admission (ISSUE 7).
+
+The serving engine (``prefix_cache=True``) indexes token sequences in a
+radix tree whose nodes own REFCOUNTED pages of the engine's paged pool:
+admission maps matched pages into the new slot's table and prefills
+only the unmatched suffix (full-prompt hits COW the boundary page and
+re-forward ONE token for logits). These tests pin the safety story:
+
+* prefix-sharing ON ≡ OFF token-for-token — greedy and sampled, spec_k
+  on and off, async depth 1 and 2 (sharing changes WHAT is computed at
+  admit, never WHICH tokens a request gets);
+* the refcount invariant: after arbitrary admit/evict/divergence
+  schedules every pool page is free, privately owned by exactly one
+  table, or tree-owned with refcount == number of mapping tables
+  (fuzz-asserted at every scheduler tick);
+* a chunked-prefill slot evicted BEFORE activation releases its
+  admission-claimed private pages without touching tree refcounts it
+  never took (the mid-prefill eviction regression);
+* LRU eviction of refcount-0 tree pages only under pool pressure, with
+  the preemption/pool_dry semantics of the non-sharing engine intact;
+* the SLO admission policy defers a long cold prefill when the ITL p99
+  gauge breaches its target (synthetic gauge), orders the queue
+  prefix-aware, never starves, and prefers low-progress/low-refcount
+  preemption victims.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.inference import (AdmissionPolicy, ContinuousBatchingEngine,
+                                  GenerationConfig, RadixPrefixCache,
+                                  SLOAdmissionPolicy, VictimInfo)
+from paddle_tpu.inference.generation import generate_scan
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _ref_greedy(model, prompt, new_tokens):
+    gc = GenerationConfig(max_new_tokens=new_tokens, do_sample=False)
+    out = generate_scan(model, jnp.asarray(prompt)[None, :], gc)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _mk_prompt(rs, n, vocab):
+    return rs.randint(0, vocab, (n,)).astype(np.int32)
+
+
+def _shared_family(rs, vocab, shared_len=10, tails=(3, 5, 2, 7)):
+    """Prompts sharing a common prefix (the system-prompt workload)."""
+    shared = _mk_prompt(rs, shared_len, vocab)
+    return [np.concatenate([shared, _mk_prompt(rs, t, vocab)])
+            for t in tails]
+
+
+def _family_run(model, prefix, *, spec_k=0, depth=2, num_pages=None,
+                chunked=False, decode_block=1, admission=None, seed=31,
+                new_tokens=9, repeat=1):
+    """Mixed greedy/sampled shared-prefix requests through 3 slots; the
+    family is submitted ``repeat`` times (round 2+ exercises full-prompt
+    fast-path hits against round 1's insertions)."""
+    rs = np.random.RandomState(seed)
+    vocab = model.cfg.vocab_size
+    prompts = _shared_family(rs, vocab)
+    eng = ContinuousBatchingEngine(
+        model, max_batch=3, page_size=PAGE, max_len=64,
+        num_pages=num_pages,
+        generation_config=GenerationConfig(max_new_tokens=new_tokens,
+                                           do_sample=False),
+        async_depth=depth, spec_k=spec_k, chunked_prefill=chunked,
+        decode_block=decode_block, prefix_cache=prefix,
+        admission=admission)
+    sgc = GenerationConfig(max_new_tokens=new_tokens, do_sample=True,
+                           temperature=0.9, top_k=20)
+    out = {}
+    for r in range(repeat):
+        rids = [eng.submit(p, generation_config=sgc if i % 2 else None)
+                for i, p in enumerate(prompts)]
+        got = eng.run()
+        if prefix:
+            eng._check_page_invariants()
+        out[r] = {i: got[rid].tolist() for i, rid in enumerate(rids)}
+    return out, eng, prompts
+
+
+# --- parity: prefix ON ≡ OFF ------------------------------------------------
+
+def test_prefix_on_off_identical_mixed_spec_depth_matrix(model):
+    """Greedy AND sampled shared-prefix requests: sharing must be
+    token-invisible across spec_k {0, 3} × depth {1, 2}, including the
+    round-2 full-prompt COW fast path."""
+    ref, _, prompts = _family_run(model, False, repeat=2)
+    for spec_k in (0, 3):
+        for depth in (1, 2):
+            got, eng, _ = _family_run(model, True, spec_k=spec_k,
+                                      depth=depth, repeat=2)
+            assert got == ref, (spec_k, depth)
+            assert eng.prefix_hit_tokens > 0     # sharing actually engaged
+    # greedy rows against the model-level reference
+    for i in (0, 2):
+        np.testing.assert_array_equal(
+            np.asarray(ref[0][i]), _ref_greedy(model, prompts[i], 9))
+
+
+def test_prefix_chunked_and_block_parity(model):
+    """Chunked prefill resumes AFTER the shared offset; decode_block>1
+    composes with mapped prefixes."""
+    ref, _, _ = _family_run(model, False, repeat=2)
+    for kw in (dict(chunked=True), dict(decode_block=4),
+               dict(chunked=True, decode_block=4)):
+        got, eng, _ = _family_run(model, True, repeat=2, **kw)
+        assert got == ref, kw
+        assert eng.prefix_hit_tokens > 0
+
+
+def test_prefix_off_characterization(model):
+    """prefix_cache=False builds none of the sharing machinery and the
+    stats surface stays exactly the PR 6 one."""
+    _, eng, _ = _family_run(model, False)
+    assert eng._prefix is None and eng._cow_fn is None
+    assert eng._tail_fn is None
+    assert eng.prefix_stats() == {}
+    assert "prefix_hit_tokens" not in eng.stats()
+
+
+def test_full_prompt_hit_takes_cow_fast_path(model):
+    """An identical resubmitted prompt re-forwards exactly ONE token:
+    the boundary page is COW'd, hit tokens == L-1, output exact."""
+    rs = np.random.RandomState(3)
+    prompt = _mk_prompt(rs, 21, model.cfg.vocab_size)      # mid-page L
+    ref = _ref_greedy(model, prompt, 8)
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=64,
+        generation_config=GenerationConfig(max_new_tokens=8,
+                                           do_sample=False),
+        prefix_cache=True)
+    r1 = eng.submit(prompt)
+    out1 = eng.run()
+    assert eng.prefix_cow_copies == 0
+    r2 = eng.submit(prompt)
+    out2 = eng.run()
+    eng._check_page_invariants()
+    np.testing.assert_array_equal(out1[r1], ref)
+    np.testing.assert_array_equal(out2[r2], ref)
+    assert eng.prefix_cow_copies == 1
+    assert eng.prefix_hit_tokens == len(prompt) - 1
+
+
+def test_shared_pages_really_shared_and_freed(model):
+    """Two live requests over one long shared prefix occupy the prefix
+    pages ONCE (the capacity win), and after both retire the tree keeps
+    them cached at refcount 0 — pool accounting exact throughout."""
+    rs = np.random.RandomState(5)
+    vocab = model.cfg.vocab_size
+    shared = _mk_prompt(rs, 2 * PAGE, vocab)               # 2 full pages
+    p1 = np.concatenate([shared, _mk_prompt(rs, 3, vocab)])
+    p2 = np.concatenate([shared, _mk_prompt(rs, 4, vocab)])
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=PAGE, max_len=64,
+        generation_config=GenerationConfig(max_new_tokens=4,
+                                           do_sample=False),
+        prefix_cache=True)
+    total = eng._total_pages
+    r1 = eng.submit(p1)
+    eng.step()                                 # p1 admits + inserts
+    r2 = eng.submit(p2)
+    eng.step()                                 # p2 admits, maps 2 pages
+    eng._check_page_invariants()
+    tree = eng._prefix
+    slot1, slot2 = eng._requests[r1].slot, eng._requests[r2].slot
+    assert slot1 >= 0 and slot2 >= 0
+    shared_ids = {int(p) for p in eng.tables[slot1, :2]}
+    assert shared_ids == {int(p) for p in eng.tables[slot2, :2]}
+    assert all(tree.owns(p) for p in shared_ids)
+    out = eng.run()
+    eng._check_page_invariants()
+    np.testing.assert_array_equal(out[r1], _ref_greedy(model, p1, 4))
+    np.testing.assert_array_equal(out[r2], _ref_greedy(model, p2, 4))
+    # retired: pages split between free list and refcount-0 tree cache
+    st = eng.stats()
+    assert st["free_pages"] + st["prefix_shared_pages"] == total
+    assert not any(n.ref for n in tree._iter_nodes())
+
+
+# --- eviction ---------------------------------------------------------------
+
+def test_lru_eviction_under_pool_pressure(model):
+    """Cached (refcount-0) tree pages yield to pool pressure WITHOUT
+    preemptions the non-sharing engine wouldn't have had; coldest prefix
+    evicts first."""
+    rs = np.random.RandomState(11)
+    vocab = model.cfg.vocab_size
+    pa = _mk_prompt(rs, 2 * PAGE, vocab)
+    pb = _mk_prompt(rs, 2 * PAGE, vocab)
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=64, num_pages=3,
+        generation_config=GenerationConfig(max_new_tokens=4,
+                                           do_sample=False),
+        prefix_cache=True)
+    ra = eng.submit(pa)
+    out = eng.run()
+    np.testing.assert_array_equal(out[ra], _ref_greedy(model, pa, 4))
+    assert eng.stats()["prefix_shared_pages"] == 2      # pa cached
+    rb = eng.submit(pb)                                 # needs 3 pages
+    out = eng.run()
+    eng._check_page_invariants()
+    np.testing.assert_array_equal(out[rb], _ref_greedy(model, pb, 4))
+    # pb's admission had to evict pa's cold pages — and pb is now the
+    # cached resident; no preemption was ever needed
+    assert eng.preemptions == 0
+    assert eng._prefix.match(pa) < 2 * PAGE             # pa (partly) gone
+    assert eng._prefix.match(pb) >= PAGE                # pb cached
+    st = eng.stats()
+    assert st["free_pages"] + st["prefix_shared_pages"] == 3
+
+
+def test_preemption_replay_hits_its_own_donation(model):
+    """A preempted slot donates its completed pages; the replay maps
+    them back instead of re-prefilling — and stays exact."""
+    rs = np.random.RandomState(4)
+    vocab = model.cfg.vocab_size
+    p1, p2 = _mk_prompt(rs, PAGE - 2, vocab), _mk_prompt(rs, PAGE - 2, vocab)
+    new = PAGE + 6
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=PAGE, max_len=8 * PAGE, num_pages=4,
+        generation_config=GenerationConfig(max_new_tokens=new,
+                                           do_sample=False),
+        prefix_cache=True)
+    r1, r2 = eng.submit(p1), eng.submit(p2)
+    out = eng.run()
+    eng._check_page_invariants()
+    assert eng.preemptions >= 1
+    assert eng.prefix_hit_tokens > 0        # the replay reused pages
+    np.testing.assert_array_equal(out[r1], _ref_greedy(model, p1, new))
+    np.testing.assert_array_equal(out[r2], _ref_greedy(model, p2, new))
+    st = eng.stats()
+    assert st["free_pages"] + st["prefix_shared_pages"] == 4
+
+
+# --- mid-prefill eviction regression (satellite) ----------------------------
+
+def test_mid_prefill_eviction_releases_claims_not_tree_refs(model):
+    """A chunked-prefill slot evicted BEFORE activation holds
+    admission-claimed private pages plus a mapped shared prefix. Its
+    eviction must free ONLY the private pages and decrement ONLY the
+    refcounts its admission took — exactly once. (Regression: the
+    pre-prefix ``_free_slot`` freed every table page uncondition-
+    ally, which would hand tree-owned pages to the allocator while the
+    tree still indexed them — double ownership.)"""
+    rs = np.random.RandomState(21)
+    vocab = model.cfg.vocab_size
+    shared = _mk_prompt(rs, 2 * PAGE, vocab)
+    pa = np.concatenate([shared, _mk_prompt(rs, 3, vocab)])
+    pb = np.concatenate([shared, _mk_prompt(rs, 4 * PAGE, vocab)])
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=PAGE, max_len=12 * PAGE,
+        num_pages=8,
+        generation_config=GenerationConfig(max_new_tokens=2 * PAGE,
+                                           do_sample=False),
+        chunked_prefill=True, prefill_chunk=PAGE, prefix_cache=True)
+    ra = eng.submit(pa)
+    for _ in range(6):
+        eng.step()                    # pa prefilled + decoding + donated
+    rb = eng.submit(pb)
+    eng.step()                        # pb admits: maps 2 shared, claims 5
+    reqb = eng._requests[rb]
+    assert reqb.slot >= 0 and not eng._decode_ready(reqb)  # mid-prefill
+    slot_b = reqb.slot
+    shared_node_pages = {int(p) for p in eng.tables[slot_b, :2]}
+    assert all(eng._prefix.owns(p) for p in shared_node_pages)
+    refs_before = {p: eng._prefix._pages[p].ref for p in shared_node_pages}
+    # drive pa's decode until its lazy page claims exhaust the pool and
+    # evict pb mid-prefill (pb is the newest rid — the default victim)
+    evicted = False
+    while eng.has_work():
+        eng.step()
+        eng._check_page_invariants()   # the invariant at EVERY tick
+        if eng.preemptions > 0 and not evicted:
+            evicted = True
+            # the moment after eviction: pb's one refcount came back off
+            # each shared node, the tree still owns those pages, and none
+            # of them leaked into the free list
+            for p in shared_node_pages:
+                assert eng._prefix.owns(p)
+                assert eng._prefix._pages[p].ref <= refs_before[p]
+            assert not shared_node_pages & {int(x) for x in eng._free}
+    assert evicted, "pool was not tight enough to force the eviction"
+    out = eng.run()
+    eng._check_page_invariants()
+    np.testing.assert_array_equal(out[ra],
+                                  _ref_greedy(model, pa, 2 * PAGE))
+    np.testing.assert_array_equal(out[rb],
+                                  _ref_greedy(model, pb, 2 * PAGE))
+
+
+# --- refcount-invariant fuzz (satellite) ------------------------------------
+
+def test_refcount_invariant_fuzz(model):
+    """Random admit/evict/divergence schedules over a tight pool with a
+    shared-prefix prompt family: the page-ownership invariant (free ∪
+    one-table-private ∪ tree-owned-with-ref==mappers) holds at every
+    scheduler tick, outputs stay exact, and the engine drains clean."""
+    vocab = model.cfg.vocab_size
+    for seed in (0, 1, 2):
+        rs = np.random.RandomState(100 + seed)
+        shared = _mk_prompt(rs, 2 * PAGE, vocab)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=3, page_size=PAGE, max_len=8 * PAGE,
+            num_pages=9,
+            generation_config=GenerationConfig(max_new_tokens=PAGE + 3,
+                                               do_sample=False),
+            chunked_prefill=bool(seed % 2), prefix_cache=True)
+        expected, outputs = {}, {}
+        pending = 7
+        while pending or eng.has_work():
+            if pending and (rs.rand() < 0.4 or not eng.has_work()):
+                # half the traffic shares the prefix (divergent tails),
+                # half is cold — both shapes collide with eviction
+                if rs.rand() < 0.5:
+                    p = np.concatenate(
+                        [shared[:PAGE * int(rs.randint(1, 3))],
+                         _mk_prompt(rs, int(rs.randint(1, PAGE)), vocab)])
+                else:
+                    p = _mk_prompt(rs, int(rs.randint(2, 3 * PAGE)), vocab)
+                rid = eng.submit(p)
+                expected[rid] = p
+                pending -= 1
+            else:
+                for rid, tok in eng.step():
+                    outputs.setdefault(rid, []).append(tok)
+            eng._check_page_invariants()
+        while eng._inflight:
+            eng._reconcile_one()
+        eng._check_page_invariants()
+        for rid, p in expected.items():
+            np.testing.assert_array_equal(
+                np.asarray(outputs[rid], np.int32),
+                _ref_greedy(model, p, PAGE + 3),
+                err_msg=f"seed={seed} rid={rid} preempt={eng.preemptions}")
+
+
+# --- SLO admission policy (satellite + acceptance) --------------------------
+
+class TestSLOAdmissionPolicy:
+    def test_defers_long_cold_prefill_on_itl_breach(self):
+        """Synthetic gauge: ITL p99 over target → a long cold prefill is
+        deferred while a cheap high-hit admit still flows (and with no
+        cheap candidate, EVERYTHING defers)."""
+        pol = SLOAdmissionPolicy(itl_p99_target_s=0.05,
+                                 defer_uncached_tokens=64)
+        cold, warm = object(), object()
+        costs = {id(cold): 512, id(warm): 8}
+        uncached = lambda r: costs[id(r)]
+        breach = {"itl_p99_s": 0.5}
+        # warm admit wins (cache-aware order), cold defers
+        assert pol.select([cold, warm], uncached, breach) == 1
+        assert pol.select([cold], uncached, breach) is None
+        assert pol.deferrals == 1
+        # gauge back under target: the cold prefill admits
+        assert pol.select([cold], uncached, {"itl_p99_s": 0.01}) == 0
+        # no gauge data at all (fresh engine): admit
+        assert pol.select([cold], uncached, {}) == 0
+
+    def test_ttft_breach_suspends_deferral(self):
+        pol = SLOAdmissionPolicy(itl_p99_target_s=0.05,
+                                 ttft_p99_target_s=1.0,
+                                 defer_uncached_tokens=64)
+        cold = object()
+        both = {"itl_p99_s": 0.5, "ttft_p99_s": 5.0}
+        assert pol.select([cold], lambda r: 512, both) == 0
+
+    def test_cache_aware_ordering_and_fifo_tiebreak(self):
+        pol = SLOAdmissionPolicy()
+        a, b, c = object(), object(), object()
+        costs = {id(a): 100, id(b): 4, id(c): 4}
+        sel = pol.select([a, b, c], lambda r: costs[id(r)], {})
+        assert sel == 1                      # cheapest, FIFO tiebreak
+
+    def test_starvation_override(self):
+        """A request passed over by ``starvation_ticks`` SUCCESSFUL
+        admits is forced through even while the SLO gauge is breached —
+        and pool-blocked ticks (select without note_admitted) charge
+        nobody."""
+        pol = SLOAdmissionPolicy(itl_p99_target_s=0.05,
+                                 defer_uncached_tokens=64,
+                                 starvation_ticks=3)
+        cold, warm = object(), object()
+        costs = {id(cold): 512, id(warm): 8}
+        uncached = lambda r: costs[id(r)]
+        breach = {"itl_p99_s": 0.5}
+        q = [cold, warm]
+        # pool-blocked ticks: chosen but never admitted — no charges
+        for _ in range(5):
+            assert pol.select(q, uncached, breach) == 1
+        for _ in range(3):
+            assert pol.select(q, uncached, breach) == 1
+            pol.note_admitted(q, 1)          # the admit really happened
+        assert pol.select(q, uncached, breach) == 0     # forced
+
+    def test_victim_chooser_prefers_low_progress_low_refcount(self):
+        pol = SLOAdmissionPolicy()
+        cands = [VictimInfo(slot=0, rid=1, progress=30, private_pages=6,
+                            shared_pages=0),
+                 VictimInfo(slot=1, rid=2, progress=2, private_pages=1,
+                            shared_pages=4),
+                 VictimInfo(slot=2, rid=3, progress=2, private_pages=5,
+                            shared_pages=0)]
+        # lowest progress wins; among those, most freeable private pages
+        assert pol.choose_victim(cands) == 2
+
+    def test_default_policy_reproduces_builtin_rules(self):
+        pol = AdmissionPolicy()
+        assert pol.select([object(), object()], lambda r: 1, {}) == 0
+        cands = [VictimInfo(0, 5, 1, 1, 0), VictimInfo(1, 9, 1, 1, 0)]
+        assert pol.choose_victim(cands) == 1          # newest rid
+
+    def test_engine_end_to_end_with_policy(self, model):
+        """Policy-driven engine on a tight pool: outputs stay exact and
+        the cache-aware ordering admits the high-hit request first."""
+        rs = np.random.RandomState(9)
+        vocab = model.cfg.vocab_size
+        shared = _mk_prompt(rs, 2 * PAGE, vocab)
+        warm = np.concatenate([shared, _mk_prompt(rs, 2, vocab)])
+        cold = _mk_prompt(rs, 3 * PAGE, vocab)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, page_size=PAGE, max_len=8 * PAGE,
+            generation_config=GenerationConfig(max_new_tokens=4,
+                                               do_sample=False),
+            prefix_cache=True,
+            admission=SLOAdmissionPolicy(itl_p99_target_s=1e9))
+        r0 = eng.submit(np.concatenate([shared,
+                                        _mk_prompt(rs, 1, vocab)]))
+        eng.run()                            # seed the tree
+        rc, rw = eng.submit(cold), eng.submit(warm)
+        eng.step()
+        assert eng._requests[rw].slot >= 0   # warm admitted FIRST
+        assert eng._requests[rc].slot == -1
+        out = eng.run()
+        eng._check_page_invariants()
+        np.testing.assert_array_equal(out[rw], _ref_greedy(model, warm, 4))
+        np.testing.assert_array_equal(out[rc], _ref_greedy(model, cold, 4))
+
+
+# --- radix tree unit tests --------------------------------------------------
+
+class TestRadixPrefixCache:
+    def test_match_insert_split_lock_release(self):
+        t = RadixPrefixCache(4)
+        seq = np.arange(20, dtype=np.int32)
+        la = t.new_lock()
+        assert t.insert(seq[:16], [10, 11, 12, 13], la) == [10, 11, 12, 13]
+        t.check()
+        assert t.match(seq) == 16
+        lb = t.lock_prefix(seq, 2)           # page-aligned split
+        assert lb.pages() == [10, 11]
+        t.check()
+        # la was spliced across the split: still maps all four pages
+        assert sorted(la.pages()) == [10, 11, 12, 13]
+        assert t.page_at(seq, 3) == 13
+        t.release(lb)
+        t.release(la)
+        t.check()
+        with pytest.raises(RuntimeError):
+            t.release(lb)                    # double release is fatal
+
+    def test_partial_page_divergence_not_insertable(self):
+        t = RadixPrefixCache(4)
+        t.insert(np.arange(8, dtype=np.int32), [1, 2])
+        div = np.asarray([0, 1, 2, 3, 4, 5, 99, 98], np.int32)
+        assert t.match(div) == 6
+        donated = t.insert(div, [3, 4])
+        assert donated == []                 # mid-page divergence drops
+        t.check()
+        # page-BOUNDARY divergence inserts as a sibling
+        div2 = np.asarray([0, 1, 2, 3, 50, 51, 52, 53], np.int32)
+        assert t.insert(div2, [5, 6]) == [6]
+        t.check()
+        assert t.match(div2) == 8
+
+    def test_evict_lru_tail_first_with_protect(self):
+        t = RadixPrefixCache(4)
+        t.insert(np.arange(16, dtype=np.int32), [1, 2, 3, 4])
+        t.match(np.arange(8, dtype=np.int32))     # touch the head
+        assert t.evict(1) == [4]                  # tail page goes first
+        t.check()
+        assert t.match(np.arange(16, dtype=np.int32)) == 12
+        # protect pins the whole path it matches
+        assert t.evict(10, protect=np.arange(12, dtype=np.int32)) == []
+        lock = t.lock_prefix(np.arange(12, dtype=np.int32), 3)
+        assert t.evict(10) == []                  # ref'd: nothing to take
+        t.release(lock)
+        assert sorted(t.evict(10)) == [1, 2, 3]
+        t.check()
+        assert t.num_pages == 0
+
+    def test_lock_prefix_beyond_match_raises(self):
+        t = RadixPrefixCache(4)
+        t.insert(np.arange(8, dtype=np.int32), [1, 2])
+        with pytest.raises(ValueError):
+            t.lock_prefix(np.arange(16, dtype=np.int32), 3)

@@ -121,7 +121,33 @@ def _serving_leg():
     assert spec.spec_tokens_proposed > 0, "spec verify never ran"
     assert spec.spec_tokens_accepted > 0, \
         "oracle drafts not accepted: spec parity contract broken"
-    return served, spec.spec_stats()
+
+    # prefix-sharing leg (ISSUE 7 satellite): two requests over one
+    # shared prompt through a prefix-enabled engine — the second admit
+    # must HIT (two full shared pages + the COW fast path on the exact
+    # repeat), moving the shared-page gauge and the hit/COW counters
+    # the exporters round-trip below
+    from paddle_tpu.observability.metrics import REGISTRY
+    shared = rs.randint(0, 32, (17,)).astype(np.int32)
+    px = ContinuousBatchingEngine(
+        model, max_batch=2, page_size=8, max_len=48,
+        generation_config=GenerationConfig(max_new_tokens=6,
+                                           do_sample=False),
+        prefix_cache=True)
+    px.submit(shared)
+    px.submit(np.concatenate([shared,
+                              rs.randint(0, 32, (4,)).astype(np.int32)]))
+    out = px.run()                        # seeds the tree
+    px.submit(shared)                     # exact repeat: COW fast path
+    out2 = px.run()
+    served += sum(len(v) for v in out.values())
+    served += sum(len(v) for v in out2.values())
+    px._check_page_invariants()
+    assert px.prefix_hit_tokens > 0, "prefix admit never hit"
+    assert px.prefix_cow_copies > 0, "full-prompt hit skipped COW path"
+    gauge = REGISTRY.gauge("pt_serving_prefix_shared_pages").value()
+    assert gauge > 0, "shared-page gauge never moved"
+    return served, spec.spec_stats(), px.prefix_stats()
 
 
 def main(out_dir: str) -> dict:
@@ -139,7 +165,7 @@ def main(out_dir: str) -> dict:
     errors = []
     try:
         emissions = _train_leg()
-        served, spec_stats = _serving_leg()
+        served, spec_stats, prefix_stats = _serving_leg()
         obs.publish()
 
         # goodput invariant: buckets sum to accounted wall-time
@@ -164,7 +190,11 @@ def main(out_dir: str) -> dict:
                      "pt_train_loss", "pt_compile_cache",
                      "pt_serving_tokens_total",
                      "pt_spec_tokens_proposed_total",
-                     "pt_spec_tokens_accepted_total"):
+                     "pt_spec_tokens_accepted_total",
+                     "pt_serving_prefix_hit_tokens_total",
+                     "pt_serving_cow_copies_total",
+                     "pt_serving_prefix_shared_pages",
+                     "pt_serving_prefix_hit_rate"):
             if want not in names:
                 errors.append(f"{want} missing from JSONL series")
             if not any(k.startswith(want) for k in parsed):
@@ -190,6 +220,10 @@ def main(out_dir: str) -> dict:
             "served_tokens": served,
             "spec_accept_rate": round(
                 spec_stats.get("spec_accept_rate", 0.0), 3),
+            "prefix_hit_rate": round(
+                prefix_stats.get("prefix_hit_rate", 0.0), 3),
+            "prefix_cow_copies": int(
+                prefix_stats.get("prefix_cow_copies", 0)),
             "jsonl_records": len(records),
             "prom_metrics": len(parsed),
             "goodput_fraction": t["goodput_fraction"],
