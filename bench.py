@@ -1118,6 +1118,35 @@ def _obs_probe(on_tpu):
     return out
 
 
+def _graph_contracts_probe(on_tpu):
+    """Graph-contract rows (ISSUE 8): run the static analyzers over the
+    canonical compiled entrypoints and report count/byte metrics — per the
+    bench-variance policy these are structural (deterministic per build),
+    not wall-time. ``train_step_collective_count`` counts collectives in
+    the canonical train-step graph (0 single-chip; a sharded trainer on a
+    pod shows its real comm load), ``serving_tick_donated_bytes`` is the
+    aliased (donated) input bytes of the serving decode tick — the number
+    that drops when a refactor silently loses a donation."""
+    out = {}
+    try:
+        import paddle_tpu.analysis as A
+        _log("graph contracts: analyzing canonical train/serving graphs")
+        g = A.build_graph("train_step_k1")
+        rep = A.analyze(g.compiled, g.name, g.contract)
+        out["train_step_collective_count"] = \
+            rep.collectives["total_collectives"]
+        out["train_step_largest_intermediate_mb"] = round(
+            rep.materialization["largest_intermediate_bytes"] / 2 ** 20, 3)
+        g = A.build_graph("serving_tick")
+        rep = A.analyze(g.compiled, g.name, g.contract)
+        out["serving_tick_donated_bytes"] = rep.donation["donated_bytes"]
+        out["serving_tick_host_transfers"] = \
+            rep.transfers["host_transfer_count"]
+    except Exception as e:
+        out["graph_contracts_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    return out
+
+
 _ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_artifacts")
 
@@ -1347,6 +1376,7 @@ def _run(error_note):
     detail.update(_decode_bench(cfg, on_tpu))
     detail.update(_loss_head_probe(cfg, on_tpu, step_s))
     detail.update(_obs_probe(on_tpu))
+    detail.update(_graph_contracts_probe(on_tpu))
     if error_note:
         payload["error"] = error_note
     if on_tpu:

@@ -1,0 +1,48 @@
+"""Graph contracts: static analysis over lowered/compiled XLA artifacts.
+
+The repo's perf wins are graph-SHAPE properties — no materialized logits
+(PR 5), no per-step host sync (PR 2/3), donated carries (PR 2/6), a
+designed collective pattern (TP fused CE) — and graph shape is invisible
+to numerics tests. This subsystem makes it checkable:
+
+* :mod:`hlo`             — the one parser over optimized-HLO text;
+* :mod:`materialization` — buffer bans + largest-intermediate budgets;
+* :mod:`donation`        — input/output aliasing audit (donated bytes,
+                           donat-able-but-undonated candidates);
+* :mod:`transfers`       — host callbacks / infeed / outfeed / host
+                           copies inside hot graphs;
+* :mod:`collectives`     — per-mesh-axis collective census (the comm
+                           table ROADMAP item 3's planner will price);
+* :mod:`contracts`       — declarative ``GraphContract`` + JSON budget
+                           snapshots with diff-style failures;
+* :mod:`graphs`          — canonical compiled entrypoints (train step
+                           K=1/K=4, serving tick spec on/off, prefix
+                           admit, fused CE) the budgets pin;
+* :mod:`trace_lint`      — AST linter for retrace/host-sync hazards in
+                           jit-reachable python (waivable inline).
+
+CLI: ``python tools/graph_lint.py`` (tier-1 gated);
+``--update-budgets`` re-pins tools/graph_budgets.json preserving waivers.
+"""
+
+from .collectives import collective_census, mesh_axis_groups
+from .contracts import (BanRule, GraphContract, GraphReport, Violation,
+                        analyze, check_budget, check_contract,
+                        load_budgets, render_violations, save_budgets,
+                        snapshot_report)
+from .donation import donation_report
+from .graphs import (REGISTRY, BuiltGraph, GraphSkipped, build_graph,
+                     graph_names)
+from .hlo import HloModule, parse_hlo
+from .materialization import banned_buffers, materialization_report
+from .transfers import host_transfer_report
+
+__all__ = [
+    "analyze", "parse_hlo", "HloModule",
+    "BanRule", "GraphContract", "GraphReport", "Violation",
+    "check_budget", "check_contract", "snapshot_report",
+    "load_budgets", "save_budgets", "render_violations",
+    "materialization_report", "banned_buffers", "donation_report",
+    "host_transfer_report", "collective_census", "mesh_axis_groups",
+    "REGISTRY", "BuiltGraph", "GraphSkipped", "build_graph", "graph_names",
+]

@@ -1,0 +1,130 @@
+"""Collective census: every cross-device operation in a compiled graph,
+classified per mesh axis.
+
+The TP/shard_map paths are one sharding-annotation typo away from GSPMD
+inserting an implicit all-gather that re-materializes exactly the tensor
+a kernel was built to keep sharded (the fused CE head's vocab shards, the
+ring-attention KV blocks) — and the step still produces the right
+numbers, just slower and fatter. The census makes the communication
+pattern an ASSERTABLE artifact: for each collective instruction it
+records opcode, payload bytes, ``replica_groups``, ``channel_id`` and the
+jax-level op that introduced it (pmax/psum/... via op_name metadata), and
+classifies which mesh axis the groups span by matching them against the
+axis groupings a ``jax.sharding.Mesh`` implies.
+
+The summary (counts per opcode+axis, bytes per opcode) is what budget
+snapshots pin, and the per-graph comm table is the input the ROADMAP
+item 3 sharding planner's cost model will price (bytes over an axis ×
+per-axis link bandwidth = predicted comm time).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .hlo import HloModule
+
+__all__ = ["CollectiveInstr", "collective_census", "mesh_axis_groups"]
+
+COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+# async variants lower as <op>-start/<op>-done; count the -start only
+_START_SUFFIX = "-start"
+_DONE_SUFFIX = "-done"
+
+
+@dataclass
+class CollectiveInstr:
+    opcode: str
+    bytes: int
+    replica_groups: Optional[str]
+    channel_id: Optional[str]
+    axis: str                   # mesh axis name, "?" when unclassified
+    op_name: str
+    source: str
+
+    def describe(self) -> str:
+        src = f" ({self.source})" if self.source else ""
+        return (f"{self.opcode}[{self.axis}] {self.bytes:,} B "
+                f"groups={self.replica_groups or '-'}"
+                f" <- {self.op_name or '?'}{src}")
+
+
+def mesh_axis_groups(mesh) -> Dict[str, frozenset]:
+    """axis name -> canonical replica grouping (frozenset of sorted
+    device-id tuples) for a ``jax.sharding.Mesh`` (or an object exposing
+    ``.mesh``, e.g. HybridMesh). A collective whose replica_groups equals
+    an axis's grouping communicates over exactly that axis."""
+    mesh = getattr(mesh, "mesh", mesh)
+    ids = mesh.devices  # ndarray of Device objects
+    import numpy as np
+    id_arr = np.vectorize(lambda d: d.id)(ids)
+    out: Dict[str, frozenset] = {}
+    names = list(mesh.axis_names)
+    for i, name in enumerate(names):
+        # move this axis last; every other index tuple is one group
+        moved = np.moveaxis(id_arr, i, -1).reshape(-1, id_arr.shape[i])
+        out[name] = frozenset(tuple(sorted(int(x) for x in row))
+                              for row in moved)
+    return out
+
+
+def _parse_groups(text: str) -> Optional[frozenset]:
+    if not text:
+        return None
+    rows = re.findall(r"\{([0-9, ]+)\}", text)
+    if not rows:
+        return None
+    return frozenset(tuple(sorted(int(x) for x in row.replace(" ", "")
+                                  .split(",") if x != ""))
+                     for row in rows)
+
+
+def collective_census(mod: HloModule, mesh=None) -> Dict:
+    """Per-instruction table + summary. ``mesh`` (optional) enables axis
+    classification; without it every collective reports axis "?"."""
+    axis_groups: Dict[str, frozenset] = {}
+    if mesh is not None:
+        try:
+            axis_groups = mesh_axis_groups(mesh)
+        except Exception:
+            axis_groups = {}
+
+    table: List[CollectiveInstr] = []
+    for ins in mod.instructions:
+        op = ins.opcode
+        if op.endswith(_DONE_SUFFIX):
+            continue
+        base = op[:-len(_START_SUFFIX)] if op.endswith(_START_SUFFIX) else op
+        if base not in COLLECTIVE_OPS:
+            continue
+        groups_txt = ins.attr("replica_groups")
+        groups = _parse_groups(groups_txt or "")
+        axis = "?"
+        if groups is not None:
+            for name, ag in axis_groups.items():
+                if groups == ag:
+                    axis = name
+                    break
+        table.append(CollectiveInstr(
+            opcode=base, bytes=ins.bytes, replica_groups=groups_txt,
+            channel_id=ins.attr("channel_id"), axis=axis,
+            op_name=ins.op_name, source=ins.source))
+
+    counts: Dict[str, int] = {}
+    bytes_by_op: Dict[str, int] = {}
+    for c in table:
+        key = f"{c.opcode}[{c.axis}]" if c.axis != "?" else c.opcode
+        counts[key] = counts.get(key, 0) + 1
+        bytes_by_op[c.opcode] = bytes_by_op.get(c.opcode, 0) + c.bytes
+    return {
+        "table": table,
+        "counts": dict(sorted(counts.items())),
+        "bytes_by_op": dict(sorted(bytes_by_op.items())),
+        "total_collectives": len(table),
+        "total_collective_bytes": sum(c.bytes for c in table),
+    }

@@ -1,0 +1,263 @@
+"""Declarative graph contracts + JSON budget snapshots.
+
+A ``GraphContract`` states the INVARIANTS a compiled graph must hold —
+the properties a PR review can't see and a numerics test can't catch:
+
+* ``ban_rules``       — buffers that must not exist (the [B,S,V] logits);
+* ``require_aliased`` — label prefixes of entry parameters that MUST be
+  donated (params/opt_state in the train step, pools/hist in serving);
+* ``max_host_transfers`` — callbacks/infeed/outfeed ceiling (0 for every
+  hot graph: PR 2/3's no-per-step-host-sync property);
+* ``expect_collectives`` — exact per-axis collective counts where the
+  comm pattern is part of the design (the TP fused-CE pmax/psum trio),
+  ``None`` where the budget snapshot pins it instead.
+
+The checked-in budget file (tools/graph_budgets.json) pins the MEASURED
+side: largest intermediate bytes (ceiling), donated bytes and aliased
+param count (floors), host transfer count (ceiling), collective counts
+(exact) and the set of known donat-able-but-undonated inputs, each
+covered by a hand-written waiver with a rationale. A failing check prints
+a diff — budget vs actual, plus the producing instruction — and says how
+to accept an intentional change (``tools/graph_lint.py --update-budgets``,
+which preserves waivers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .collectives import collective_census
+from .donation import donation_report
+from .hlo import HloModule, parse_hlo
+from .materialization import BanRule, materialization_report
+from .transfers import host_transfer_report
+
+__all__ = [
+    "GraphContract", "GraphReport", "Violation", "analyze",
+    "snapshot_report", "check_contract", "check_budget",
+    "render_violations", "load_budgets", "save_budgets", "BanRule",
+]
+
+
+@dataclass
+class GraphContract:
+    name: str
+    ban_rules: Tuple[BanRule, ...] = ()
+    require_aliased: Tuple[str, ...] = ()     # param-label prefixes
+    max_host_transfers: int = 0
+    expect_collectives: Optional[Dict[str, int]] = None
+    notes: str = ""
+
+
+@dataclass
+class GraphReport:
+    name: str
+    module: HloModule
+    materialization: Dict
+    donation: Dict
+    transfers: Dict
+    collectives: Dict
+
+
+@dataclass
+class Violation:
+    graph: str
+    rule: str
+    message: str
+    lines: List[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        out = [f"FAIL {self.graph} :: {self.rule}", f"  {self.message}"]
+        out += [f"    {l}" for l in self.lines]
+        return "\n".join(out)
+
+
+def analyze(compiled_or_text, name: str = "graph",
+            contract: Optional[GraphContract] = None,
+            mesh=None) -> GraphReport:
+    """Run every analyzer over one compiled graph (a
+    ``jax.stages.Compiled``, or raw optimized-HLO text)."""
+    if isinstance(compiled_or_text, str):
+        text = compiled_or_text
+    else:
+        text = compiled_or_text.as_text()
+    mod = parse_hlo(text)
+    rules = contract.ban_rules if contract is not None else ()
+    return GraphReport(
+        name=name, module=mod,
+        materialization=materialization_report(mod, rules),
+        donation=donation_report(mod),
+        transfers=host_transfer_report(mod),
+        collectives=collective_census(mod, mesh=mesh),
+    )
+
+
+# -- contract invariants -----------------------------------------------------
+
+def check_contract(contract: GraphContract,
+                   report: GraphReport) -> List[Violation]:
+    v: List[Violation] = []
+    banned = report.materialization["banned"]
+    if banned:
+        v.append(Violation(
+            report.name, "materialization.ban",
+            f"{len(banned)} banned buffer(s) materialized "
+            f"(rule: {', '.join(r.label for r in contract.ban_rules)})",
+            [h.describe() for h in banned[:8]]))
+
+    if contract.require_aliased:
+        mod = report.module
+        aliased = set(mod.aliased_param_numbers())
+        labels = {n: mod.param_label(n)
+                  for n in range(len(mod.entry_param_shapes))}
+        for prefix in contract.require_aliased:
+            matching = [n for n, l in labels.items()
+                        if l.startswith(prefix)]
+            if not matching:
+                v.append(Violation(
+                    report.name, f"donation.require_aliased[{prefix}]",
+                    f"no entry parameter labeled '{prefix}*' exists — "
+                    f"the contract references a renamed/removed argument"))
+                continue
+            missing = [n for n in matching if n not in aliased]
+            if missing:
+                v.append(Violation(
+                    report.name, f"donation.require_aliased[{prefix}]",
+                    f"{len(missing)}/{len(matching)} '{prefix}*' "
+                    f"parameter(s) are NOT donated "
+                    f"(input_output_alias has no entry); fix the jit's "
+                    f"donate_argnums or waive with a rationale",
+                    [f"{labels[n]} "
+                     f"({mod.entry_param_shapes[n]})" for n in missing[:8]]))
+
+    ht = report.transfers["host_transfer_count"]
+    if ht > contract.max_host_transfers:
+        details = (report.transfers["host_callbacks"]
+                   + report.transfers["infeed"]
+                   + report.transfers["outfeed"]
+                   + report.transfers["host_sendrecv"]
+                   + report.transfers["host_copies"])
+        v.append(Violation(
+            report.name, "transfers.max_host_transfers",
+            f"{ht} host transfer(s) in a hot graph "
+            f"(budget {contract.max_host_transfers}) — a per-step host "
+            f"sync re-entered the compiled path", details[:8]))
+
+    if contract.expect_collectives is not None:
+        actual = report.collectives["counts"]
+        if actual != contract.expect_collectives:
+            v.append(Violation(
+                report.name, "collectives.expect",
+                "collective census diverged from the contract",
+                _dict_diff(contract.expect_collectives, actual)))
+    return v
+
+
+# -- budget snapshots --------------------------------------------------------
+
+def snapshot_report(report: GraphReport) -> Dict:
+    """The JSON-able measured quantities a budget pins."""
+    return {
+        "largest_intermediate_bytes":
+            report.materialization["largest_intermediate_bytes"],
+        "donated_bytes": report.donation["donated_bytes"],
+        "aliased_param_count": report.donation["aliased_param_count"],
+        "undonated_candidates": sorted(
+            c.label for c in report.donation["undonated_candidates"]),
+        "host_transfer_count": report.transfers["host_transfer_count"],
+        "collective_counts": report.collectives["counts"],
+        "collective_bytes": report.collectives["total_collective_bytes"],
+    }
+
+
+def _dict_diff(budget: Dict, actual: Dict) -> List[str]:
+    lines = []
+    for k in sorted(set(budget) | set(actual)):
+        b, a = budget.get(k, 0), actual.get(k, 0)
+        if b != a:
+            lines.append(f"{k}: budget {b} -> actual {a}")
+    return lines
+
+
+def check_budget(report: GraphReport, entry: Dict) -> List[Violation]:
+    """Compare a report against one budget-file entry
+    (``{"budget": {...}, "waivers": {label: rationale}}``)."""
+    budget = entry.get("budget", {})
+    waivers = entry.get("waivers", {})
+    snap = snapshot_report(report)
+    v: List[Violation] = []
+
+    def ceiling(key, why):
+        if key in budget and snap[key] > budget[key]:
+            v.append(Violation(
+                report.name, f"budget.{key}",
+                f"{why}: budget {budget[key]:,} -> actual {snap[key]:,} "
+                f"(+{snap[key] - budget[key]:,}); intentional? re-pin with "
+                f"--update-budgets",
+                (report.materialization["largest_buffers"][:4]
+                 if key == "largest_intermediate_bytes" else [])))
+
+    def floor(key, why):
+        if key in budget and snap[key] < budget[key]:
+            v.append(Violation(
+                report.name, f"budget.{key}",
+                f"{why}: budget {budget[key]:,} -> actual {snap[key]:,} "
+                f"({snap[key] - budget[key]:,})",
+                [a["label"] for a in report.donation["aliased"][:8]]))
+
+    ceiling("largest_intermediate_bytes",
+            "largest live buffer grew past its budget")
+    ceiling("host_transfer_count", "host transfers appeared in a hot graph")
+    ceiling("collective_bytes", "collective payload bytes grew")
+    floor("donated_bytes",
+          "donated bytes dropped — a buffer donation was lost")
+    floor("aliased_param_count",
+          "fewer parameters are donated than the budget pins")
+
+    if "collective_counts" in budget:
+        if snap["collective_counts"] != budget["collective_counts"]:
+            v.append(Violation(
+                report.name, "budget.collective_counts",
+                "collective census changed (an implicit GSPMD "
+                "reshard/all-gather, or an intentional graph change — "
+                "re-pin with --update-budgets)",
+                _dict_diff(budget["collective_counts"],
+                           snap["collective_counts"])))
+
+    if "undonated_candidates" in budget:
+        known = set(budget["undonated_candidates"]) | set(waivers)
+        new = [c for c in report.donation["undonated_candidates"]
+               if c.label not in known]
+        if new:
+            v.append(Violation(
+                report.name, "budget.undonated_candidates",
+                f"{len(new)} NEW donat-able-but-undonated input(s): donate "
+                f"them at the jit site or add a waiver with a rationale",
+                [c.describe() for c in new[:8]]))
+    return v
+
+
+# -- budget file I/O ---------------------------------------------------------
+
+def load_budgets(path: str) -> Dict:
+    if not os.path.exists(path):
+        return {"_meta": {}, "graphs": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_budgets(path: str, budgets: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(budgets, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def render_violations(violations: Sequence[Violation]) -> str:
+    if not violations:
+        return "OK: all graph contracts hold"
+    return "\n".join(x.render() for x in violations)

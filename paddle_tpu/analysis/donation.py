@@ -1,0 +1,96 @@
+"""Donation audit over the compiled module's input/output aliasing table.
+
+XLA records buffer donation as ``input_output_alias`` in the module header;
+jax's ``donate_argnums`` is only a *request* — a silently dropped donation
+(an arg reordered, a wrapper rebuilt without the argnums, jax.export's
+call wrapper which forgets them entirely) doubles the HBM footprint of
+whatever was being threaded (params+opt_state in training, KV pools and
+the speculation history in serving) without failing a single numerics
+test. This pass turns the aliasing table into facts a contract can pin:
+
+* ``aliased`` — which entry parameters ARE donated (label, bytes, kind),
+  with ``donated_bytes`` as the budget-floor metric (a refactor that
+  drops a donation shrinks it and fails the snapshot);
+* ``undonated_candidates`` — parameters that are NOT aliased but whose
+  (shape, dtype) matches a not-yet-aliased output leaf, i.e. buffers XLA
+  *could* have reused in place. Matching is structural, so persistent
+  inputs (sampling knobs read every tick) show up too — that is what the
+  budget file's per-graph ``waivers`` are for: each candidate is either
+  fixed at the jit site or waived WITH A RATIONALE, and a new candidate
+  appearing (someone added a threaded buffer without donating it) fails
+  the check until triaged.
+
+Parameter labels come from the parameter instructions' op_name metadata
+(``pools[0][0]``, ``opt_state['...']``), so reports name the python-level
+argument, not an XLA parameter number.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from .hlo import HloModule
+
+__all__ = ["DonationCandidate", "donation_report"]
+
+
+@dataclass
+class DonationCandidate:
+    param_number: int
+    label: str
+    shape: str
+    bytes: int
+
+    def describe(self) -> str:
+        return f"{self.label} ({self.shape}, {self.bytes:,} B)"
+
+
+def donation_report(mod: HloModule) -> Dict:
+    """Aliasing facts + donat-able-but-undonated candidates."""
+    aliased_params = set(mod.aliased_param_numbers())
+    aliased_out_leaves = {a.output_index for a in mod.aliases}
+
+    aliased = []
+    donated_bytes = 0
+    for a in mod.aliases:
+        shape = (mod.entry_param_shapes[a.param_number]
+                 if a.param_number < len(mod.entry_param_shapes) else None)
+        nbytes = shape.bytes if shape is not None else 0
+        donated_bytes += nbytes
+        aliased.append({
+            "param": a.param_number,
+            "label": mod.param_label(a.param_number),
+            "shape": str(shape) if shape is not None else "?",
+            "bytes": nbytes,
+            "kind": a.kind,
+            "output_index": list(a.output_index),
+        })
+
+    # output leaves not already backed by a donated input, keyed by
+    # (dtype, dims) — the pool a donat-able input could have aliased into
+    free_outputs: Dict[tuple, int] = {}
+    for i, leaf in enumerate(mod.entry_output_shapes):
+        if (i,) in aliased_out_leaves or leaf.dims == ():
+            continue
+        key = (leaf.dtype, leaf.dims)
+        free_outputs[key] = free_outputs.get(key, 0) + 1
+
+    candidates: List[DonationCandidate] = []
+    for num, shape in enumerate(mod.entry_param_shapes):
+        if num in aliased_params or shape.dims == ():
+            continue            # scalars are not worth a donation slot
+        key = (shape.dtype, shape.dims)
+        if free_outputs.get(key, 0) > 0:
+            free_outputs[key] -= 1
+            candidates.append(DonationCandidate(
+                num, mod.param_label(num), str(shape), shape.bytes))
+    candidates.sort(key=lambda c: -c.bytes)
+
+    return {
+        "aliased": aliased,
+        "aliased_param_count": len(aliased_params),
+        "donated_bytes": donated_bytes,
+        "undonated_candidates": candidates,
+        "undonated_candidate_bytes": sum(c.bytes for c in candidates),
+    }

@@ -1,0 +1,288 @@
+"""Structured view over XLA's optimized-HLO text dump.
+
+Every graph-contract analyzer (materialization, donation, host-sync,
+collective census) consumes ``jax.jit(...).lower(...).compile().as_text()``
+through this ONE parser, so the regexes that understand HLO live in exactly
+one place. The parser is deliberately text-based: the HLO proto bindings
+differ across jaxlib versions, while the text format (instruction lines,
+``input_output_alias`` header, ``replica_groups`` attributes) has been
+stable for years and is what the repo's hand-rolled guards (PR 5's
+``_bsv_buffers``) already matched against.
+
+Parsed facts:
+
+* **instructions** — every ``%name = shape opcode(...)`` line across every
+  computation, with opcode, output shape leaves (dtype, dims, bytes),
+  ``metadata={op_name=...}`` attribution and the raw attribute tail
+  (``replica_groups``, ``channel_id``, ``custom_call_target`` live there);
+* **input_output_alias** — the donation table from the module header:
+  which output buffer aliases which entry parameter (``may-alias`` /
+  ``must-alias``);
+* **entry parameters** — number → (shape, jax-level name from the
+  parameter instruction's op_name metadata), the names donation reports
+  are keyed on (``pools[0][0]``, ``opt_state['m']['...']``).
+
+Nothing here imports jax: the analyzers stay usable on a saved ``.hlo``
+dump (e.g. one captured from a real pod) without a device in sight.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "ShapeLeaf", "HloInstruction", "HloComputation", "HloModule",
+    "parse_hlo", "parse_shape", "dtype_bytes",
+]
+
+# XLA primitive-type byte widths (token/opaque/tuple carry no payload)
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "f8e3m4": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "token": 0, "opaque": 0,
+}
+
+
+def dtype_bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(dtype, 4)
+
+
+@dataclass(frozen=True)
+class ShapeLeaf:
+    """One array shape inside an instruction's (possibly tuple) result."""
+    dtype: str
+    dims: Tuple[int, ...]
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def bytes(self) -> int:
+        return self.num_elements * dtype_bytes(self.dtype)
+
+    def __str__(self) -> str:
+        return f"{self.dtype}[{','.join(str(d) for d in self.dims)}]"
+
+
+@dataclass
+class HloInstruction:
+    name: str                       # %foo.12 (sans %)
+    opcode: str                     # add / all-reduce / custom-call / ...
+    shape_leaves: List[ShapeLeaf]
+    computation: str                # owning computation's name
+    is_entry: bool                  # defined in the ENTRY computation
+    raw: str                        # full source line (attrs live here)
+    op_name: str = ""               # metadata={op_name="..."} if present
+    source: str = ""                # source_file:source_line if present
+
+    @property
+    def bytes(self) -> int:
+        return sum(l.bytes for l in self.shape_leaves)
+
+    def attr(self, key: str) -> Optional[str]:
+        """Raw attribute text, e.g. attr("replica_groups") ->
+        "{{0,1},{2,3}}", attr("custom_call_target") -> 'xla_..._callback'."""
+        m = re.search(re.escape(key) + r"=", self.raw)
+        if not m:
+            return None
+        rest = self.raw[m.end():]
+        if rest.startswith("{"):
+            depth = 0
+            for i, ch in enumerate(rest):
+                if ch == "{":
+                    depth += 1
+                elif ch == "}":
+                    depth -= 1
+                    if depth == 0:
+                        return rest[:i + 1]
+            return rest
+        if rest.startswith('"'):
+            end = rest.find('"', 1)
+            return rest[1:end] if end > 0 else rest[1:]
+        vm = re.match(r"[\w.\-]+", rest)
+        return vm.group(0) if vm else None
+
+
+@dataclass
+class HloComputation:
+    name: str
+    is_entry: bool
+    instructions: List[HloInstruction] = field(default_factory=list)
+
+
+@dataclass
+class AliasEntry:
+    """One ``input_output_alias`` record: entry-output leaf ``output_index``
+    is backed by entry-parameter ``param_number`` (leaf ``param_index``
+    within that parameter, almost always () under jax's flat calling
+    convention)."""
+    output_index: Tuple[int, ...]
+    param_number: int
+    param_index: Tuple[int, ...]
+    kind: str                       # may-alias | must-alias
+
+
+@dataclass
+class HloModule:
+    name: str
+    text: str
+    computations: List[HloComputation]
+    aliases: List[AliasEntry]
+    entry_param_shapes: List[ShapeLeaf]
+    entry_param_names: Dict[int, str]       # number -> jax op_name label
+    entry_output_shapes: List[ShapeLeaf]
+
+    # -- convenience views ---------------------------------------------------
+
+    @property
+    def instructions(self) -> Iterable[HloInstruction]:
+        for c in self.computations:
+            for ins in c.instructions:
+                yield ins
+
+    def find(self, opcode: str) -> List[HloInstruction]:
+        return [i for i in self.instructions if i.opcode == opcode]
+
+    def aliased_param_numbers(self) -> List[int]:
+        return sorted({a.param_number for a in self.aliases})
+
+    def param_label(self, number: int) -> str:
+        return self.entry_param_names.get(number, f"param#{number}")
+
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# `  ROOT %name = <shape+layout> opcode(...)`; shape may be a tuple
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^=]*?\)|[a-z][a-z0-9]*\[[0-9,]*\]"
+    r"(?:\{[^}]*\})?)\s+([\w\-]+)\(")
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\((.*)$")
+_META_RE = re.compile(
+    r"metadata=\{[^}]*?op_name=\"([^\"]*)\"[^}]*?"
+    r"(?:source_file=\"([^\"]*)\"[^}]*?source_line=(\d+))?[^}]*\}")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\((\d+),\s*\{([0-9,\s]*)\},\s*([\w\-]+)\)")
+
+
+def parse_shape(text: str) -> List[ShapeLeaf]:
+    """Every array leaf mentioned in a shape string — handles scalars
+    (``f32[]``), arrays and (nested) tuples."""
+    leaves = []
+    for dtype, dims in _SHAPE_RE.findall(text):
+        if dtype in ("token", "opaque"):
+            leaves.append(ShapeLeaf(dtype, ()))
+            continue
+        t = tuple(int(d) for d in dims.split(",")) if dims else ()
+        leaves.append(ShapeLeaf(dtype, t))
+    return leaves
+
+
+def _parse_index(text: str) -> Tuple[int, ...]:
+    text = text.strip()
+    if not text:
+        return ()
+    return tuple(int(x) for x in text.split(","))
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse one HLO module dump (``Compiled.as_text()``)."""
+    mod_name = ""
+    m = re.search(r"HloModule\s+([\w.\-]+)", text)
+    if m:
+        mod_name = m.group(1)
+
+    aliases: List[AliasEntry] = []
+    start = text.find("input_output_alias={")
+    if start >= 0:
+        # brace-counted block: the table nests {output_index} inside the
+        # outer braces, so a regex-to-first-close silently drops it all
+        i = start + len("input_output_alias=")
+        depth, end = 0, i
+        for j in range(i, min(len(text), i + 200_000)):
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    end = j + 1
+                    break
+        block = text[i:end]
+        for out_idx, pnum, pidx, kind in _ALIAS_ENTRY_RE.findall(block):
+            aliases.append(AliasEntry(_parse_index(out_idx), int(pnum),
+                                      _parse_index(pidx), kind))
+
+    computations: List[HloComputation] = []
+    current: Optional[HloComputation] = None
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped:
+            continue
+        # computation header: `%comp (args) -> shape {` or `ENTRY %main ...`
+        if not stripped.startswith("%") or " = " not in stripped:
+            cm = _COMP_RE.match(stripped)
+            if cm and stripped.rstrip().endswith("{"):
+                current = HloComputation(name=cm.group(2),
+                                         is_entry=bool(cm.group(1)))
+                computations.append(current)
+                continue
+        # long tuple shapes/operand lists carry /*index=N*/ comments whose
+        # '=' breaks the shape match — strip them before parsing
+        clean = re.sub(r"/\*.*?\*/", "", line)
+        im = _INSTR_RE.match(clean)
+        if im and current is not None:
+            name, shape_txt, opcode = im.groups()
+            ins = HloInstruction(
+                name=name, opcode=opcode,
+                shape_leaves=parse_shape(shape_txt),
+                computation=current.name, is_entry=current.is_entry,
+                raw=line)
+            mm = _META_RE.search(line)
+            if mm:
+                # the dump escapes quotes inside op_name (params[\'w\'])
+                ins.op_name = mm.group(1).replace("\\'", "'").replace(
+                    '\\"', '"')
+                if mm.group(2):
+                    ins.source = f"{mm.group(2)}:{mm.group(3)}"
+            current.instructions.append(ins)
+
+    entry = next((c for c in computations if c.is_entry), None)
+    param_shapes: List[ShapeLeaf] = []
+    param_names: Dict[int, str] = {}
+    out_shapes: List[ShapeLeaf] = []
+    if entry is not None:
+        params = {}
+        for ins in entry.instructions:
+            if ins.opcode != "parameter":
+                continue
+            pm = re.search(r"parameter\((\d+)\)", ins.raw)
+            if not pm:
+                continue
+            num = int(pm.group(1))
+            params[num] = ins
+            if ins.op_name:
+                param_names[num] = ins.op_name
+        for num in sorted(params):
+            leaves = params[num].shape_leaves
+            param_shapes.append(leaves[0] if leaves else ShapeLeaf("token",
+                                                                  ()))
+        root = entry.instructions[-1] if entry.instructions else None
+        for ins in entry.instructions:
+            if "ROOT" in ins.raw.split("=")[0]:
+                root = ins
+        if root is not None:
+            out_shapes = list(root.shape_leaves)
+
+    return HloModule(name=mod_name, text=text, computations=computations,
+                     aliases=aliases, entry_param_shapes=param_shapes,
+                     entry_param_names=param_names,
+                     entry_output_shapes=out_shapes)

@@ -1,0 +1,118 @@
+"""Materialization audit: which buffers the compiled graph actually holds.
+
+The repo's flagship perf wins are *absence* properties — the fused CE head
+means no [B,S,V] logits buffer exists anywhere in the optimized HLO (PR 5),
+blockwise kernels mean working sets stay O(block) — and absences are what
+refactors silently destroy. This pass generalizes the hand-rolled
+``_bsv_buffers`` guard from tests/test_fused_vocab_ce.py into the reusable
+check every graph contract calls:
+
+* ``banned_buffers`` — shapes matching a declarative rule (last dim == V,
+  remaining dims multiply to N: the logits-materialization signature —
+  exactly the predicate the PR 5 test hard-coded), reported with the
+  producing instruction so the failure says WHO re-materialized it;
+* ``largest_buffers`` — the top-k biggest instruction results, the number
+  a byte *budget* pins so a refactor that balloons an intermediate (a
+  dropped rematerialization, an accidental fp32 upcast of a bf16 buffer)
+  fails the snapshot diff even when no ban rule names its shape.
+
+Buffer enumeration walks instruction DEF sites in every computation
+(fusion-internal defs included — conservative, same coverage the original
+text-scan guard had) and skips opcodes that never own a distinct buffer
+(parameter/tuple plumbing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from .hlo import HloInstruction, HloModule
+
+__all__ = ["BanRule", "BufferHit", "materialization_report",
+           "banned_buffers"]
+
+# plumbing opcodes whose "result" is an existing buffer, not a new one
+_NO_BUFFER = {"parameter", "tuple", "get-tuple-element", "bitcast"}
+
+
+@dataclass(frozen=True)
+class BanRule:
+    """Declarative buffer ban: an array whose LAST dim equals ``last_dim``
+    and whose remaining dims multiply to ``leading_product`` (dtype-blind)
+    — with ``last_dim=V`` and ``leading_product=B*S`` this is precisely
+    "a logits tensor materialized"."""
+    last_dim: int
+    leading_product: int
+    label: str = "banned"
+
+    def matches(self, dims: Sequence[int]) -> bool:
+        if len(dims) < 2 or dims[-1] != self.last_dim:
+            return False
+        prod = 1
+        for d in dims[:-1]:
+            prod *= d
+        return prod == self.leading_product
+
+
+@dataclass
+class BufferHit:
+    shape: str
+    bytes: int
+    instruction: str
+    opcode: str
+    op_name: str
+    source: str
+
+    def describe(self) -> str:
+        where = f" [{self.op_name}]" if self.op_name else ""
+        src = f" ({self.source})" if self.source else ""
+        return (f"{self.shape} ({self.bytes:,} B) <- %{self.instruction} "
+                f"{self.opcode}{where}{src}")
+
+
+def _buffers(mod: HloModule):
+    for ins in mod.instructions:
+        if ins.opcode in _NO_BUFFER:
+            continue
+        for leaf in ins.shape_leaves:
+            if leaf.dims or leaf.dtype not in ("token", "opaque"):
+                yield ins, leaf
+
+
+def banned_buffers(mod: HloModule, rules: Sequence[BanRule]
+                   ) -> List[BufferHit]:
+    """All buffers matching any ban rule — the one definition of the
+    "did the logits materialize?" check (test_fused_vocab_ce's HLO guard
+    and the train-step contract both call this)."""
+    hits: List[BufferHit] = []
+    seen = set()
+    for ins, leaf in _buffers(mod):
+        for rule in rules:
+            if rule.matches(leaf.dims):
+                key = (str(leaf), ins.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                hits.append(BufferHit(str(leaf), leaf.bytes, ins.name,
+                                      ins.opcode, ins.op_name, ins.source))
+    hits.sort(key=lambda h: -h.bytes)
+    return hits
+
+
+def materialization_report(mod: HloModule,
+                           rules: Sequence[BanRule] = (),
+                           top_k: int = 5) -> Dict:
+    """Summary the contract checker and budget snapshots consume."""
+    largest: List[BufferHit] = []
+    biggest = 0
+    for ins, leaf in _buffers(mod):
+        biggest = max(biggest, leaf.bytes)
+        largest.append(BufferHit(str(leaf), leaf.bytes, ins.name,
+                                 ins.opcode, ins.op_name, ins.source))
+    largest.sort(key=lambda h: -h.bytes)
+    return {
+        "largest_intermediate_bytes": biggest,
+        "largest_buffers": [h.describe() for h in largest[:top_k]],
+        "banned": banned_buffers(mod, rules),
+    }

@@ -1,0 +1,362 @@
+"""AST linter for retrace / host-sync hazards in jit-reachable python.
+
+The HLO analyzers catch what a bad pattern COMPILED INTO; this linter
+catches the pattern at the source line, before anyone pays a trace. It
+walks a module's AST, marks the functions that get traced — arguments to
+``jax.jit`` / ``pmap`` / ``shard_map`` / ``lax.scan|cond|while_loop|map``
+/ ``custom_vjp`` / ``pallas_call`` / ``checkpoint``, jit-decorated
+defs, and every ``def`` nested inside one (scan bodies) — and flags,
+INSIDE traced code only:
+
+* ``host-sync``    — ``float()/int()/bool()`` on computed values,
+  ``.item()``/``.tolist()``, ``np.asarray``/``np.array``: a device fence
+  (or a ConcretizationError) inside the compiled region;
+* ``host-time``    — ``time.time()/perf_counter()``, ``datetime.now()``:
+  traces bake the trace-time clock in as a constant;
+* ``host-rng``     — ``np.random.*``, ``jax.random.key/PRNGKey``: host
+  randomness is a per-trace constant (replay-breaking) — keys must enter
+  as arguments and derive via ``fold_in``/``split`` on device;
+* ``nonstatic-branch`` — ``if``/``while`` on a bare traced-function
+  parameter: python control flow on a traced value.
+
+Plus one host-side rule, applied everywhere:
+
+* ``jit-in-loop``  — ``jax.jit(...)`` constructed inside a ``for``/
+  ``while`` body: a fresh jit wrapper per iteration retraces every time
+  (cache it outside the loop, like the engine's ``_decode_fns``).
+
+False positives are expected at the margins (the linter has no dataflow)
+— that is what inline waivers are for::
+
+    x = float(n_static)   # trace-lint: waive(host-sync) static python int
+
+A waiver comment on the flagged line (or the line directly above) names
+the rule it waives and MUST carry a reason; unwaived violations fail
+``tools/graph_lint.py`` and the tier-1 contract test.
+
+CLI: ``python -m paddle_tpu.analysis.trace_lint <paths...>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Violation", "lint_source", "lint_file", "lint_paths",
+           "RULES", "main"]
+
+RULES = ("host-sync", "host-time", "host-rng", "nonstatic-branch",
+         "jit-in-loop")
+
+# callables whose function-typed arguments get traced
+_TRACERS = {
+    "jit", "pmap", "vmap_with_jit",  # jax.jit / jax.pmap
+    "scan", "cond", "while_loop", "map", "switch", "fori_loop",
+    "shard_map", "pallas_call", "custom_vjp", "custom_jvp", "checkpoint",
+    "remat", "named_call", "export",
+}
+_HOST_SYNC_CASTS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_NP_ARRAYIFY = {"asarray", "array", "copy"}
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
+             "perf_counter_ns", "time_ns"}
+_HOST_KEY_FNS = {"key", "PRNGKey"}
+
+_WAIVE_RE = re.compile(r"trace-lint:\s*waive\(([\w\-, ]+)\)\s*(.*)")
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def render(self) -> str:
+        tag = " [waived]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule}{tag}: {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for an Attribute/Name chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_static_arg(node: ast.AST) -> bool:
+    """Arguments that are obviously NOT traced values: literals, shape
+    tuples/attribute chains ending in .shape/.ndim/.size/.dtype, len(),
+    and arithmetic over those — enough to keep static shape math quiet."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "size", "dtype")
+    if isinstance(node, ast.Subscript):
+        return _is_static_arg(node.value)
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f == "len" or f.endswith(".prod") or f.endswith(".ceil") \
+                or f.endswith(".floor"):
+            return all(_is_static_arg(a) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_static_arg(node.left) and _is_static_arg(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_arg(node.operand)
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.violations: List[Violation] = []
+        # lexical state
+        self._traced_depth = 0          # >0: inside a traced function body
+        self._loop_depth = 0
+        self._params: List[Set[str]] = []   # traced fn param-name stack
+        self._traced_defs: Set[ast.AST] = set()
+
+    # -- waiver lookup -------------------------------------------------------
+
+    def _waiver(self, line: int, rule: str) -> Optional[str]:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _WAIVE_RE.search(self.lines[ln - 1])
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    if rule in rules or "all" in rules:
+                        return m.group(2).strip() or "(no reason given)"
+        return None
+
+    def _flag(self, node: ast.AST, rule: str, message: str) -> None:
+        reason = self._waiver(node.lineno, rule)
+        self.violations.append(Violation(
+            self.path, node.lineno, rule, message,
+            waived=reason is not None, waiver_reason=reason or ""))
+
+    # -- traced-function discovery ------------------------------------------
+
+    def _mark_traced_args(self, call: ast.Call) -> None:
+        """jax.jit(fn) / lax.scan(body, ...) / pallas_call(kernel):
+        function-typed arguments (Name refs and lambdas) become traced."""
+        fn_name = _dotted(call.func)
+        last = fn_name.rsplit(".", 1)[-1]
+        if last not in _TRACERS:
+            return
+        if last == "map" and "lax" not in fn_name:
+            return          # jax.tree.map / builtins map: NOT a tracer
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Lambda):
+                self._traced_defs.add(arg)
+            elif isinstance(arg, ast.Name):
+                self._names_traced.add(arg.id)
+
+    def visit_Module(self, node: ast.Module):
+        # pass 1: collect names referenced as tracer arguments anywhere in
+        # the module (jit sites routinely appear AFTER or BEFORE the def)
+        self._names_traced: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._mark_traced_args(n)
+        self.generic_visit(node)
+
+    def _is_traced_def(self, node) -> bool:
+        if node in self._traced_defs:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # name matching is module-wide, so a `def run(self)` METHOD
+            # must not inherit traced-ness from a jitted local `run`
+            # closure elsewhere — traced functions never take self/cls
+            args = node.args.posonlyargs + node.args.args
+            is_method = bool(args) and args[0].arg in ("self", "cls")
+            if node.name in self._names_traced and not is_method:
+                return True
+            for dec in node.decorator_list:
+                d = _dotted(dec if not isinstance(dec, ast.Call)
+                            else dec.func)
+                if d.rsplit(".", 1)[-1] in ("jit", "custom_vjp",
+                                            "custom_jvp", "checkpoint",
+                                            "remat"):
+                    return True
+        return self._traced_depth > 0      # nested def inside traced code
+
+    def _visit_fn(self, node, args: Optional[ast.arguments]):
+        traced = self._is_traced_def(node)
+        if traced:
+            self._traced_depth += 1
+            names = set()
+            if args is not None:
+                for a in (list(args.posonlyargs) + list(args.args)
+                          + list(args.kwonlyargs)):
+                    if a.arg not in ("self", "cls"):
+                        names.add(a.arg)
+            self._params.append(names)
+        outer_loop = self._loop_depth
+        self._loop_depth = 0            # loops outside a def don't leak in
+        self.generic_visit(node)
+        self._loop_depth = outer_loop
+        if traced:
+            self._traced_depth -= 1
+            self._params.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_fn(node, node.args)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_fn(node, node.args)
+
+    # -- rules ---------------------------------------------------------------
+
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_While(self, node):
+        if self._traced_depth and self._references_param(node.test):
+            self._flag(node, "nonstatic-branch",
+                       "`while` on a traced-function parameter — python "
+                       "control flow cannot depend on traced values "
+                       "(use lax.while_loop)")
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def _references_param(self, test: ast.AST) -> bool:
+        if not self._params:
+            return False
+        params = self._params[-1]
+        # `x is None` / isinstance / hasattr tests are static dispatch on
+        # python structure, not traced-value branching
+        for n in ast.walk(test):
+            if isinstance(n, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+                return False
+            if isinstance(n, ast.Call) and _dotted(n.func) in (
+                    "isinstance", "hasattr", "callable", "len"):
+                return False
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in params:
+                return True
+        return False
+
+    def visit_If(self, node):
+        if self._traced_depth and self._references_param(node.test):
+            self._flag(node, "nonstatic-branch",
+                       "`if` on a traced-function parameter — python "
+                       "branching on a traced value (use jnp.where / "
+                       "lax.cond, or mark the arg static)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = _dotted(node.func)
+        last = fn.rsplit(".", 1)[-1]
+
+        if self._loop_depth and last == "jit" and fn.split(".")[0] in (
+                "jax", "jit"):
+            self._flag(node, "jit-in-loop",
+                       "jax.jit constructed inside a loop body — a fresh "
+                       "wrapper per iteration retraces every time; build "
+                       "it once and cache it")
+
+        if self._traced_depth:
+            if fn in _HOST_SYNC_CASTS and node.args \
+                    and not _is_static_arg(node.args[0]):
+                self._flag(node, "host-sync",
+                           f"{fn}() on a computed value inside traced "
+                           f"code — device fence / ConcretizationError")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_ATTRS:
+                self._flag(node, "host-sync",
+                           f".{node.func.attr}() inside traced code — "
+                           f"forces a device->host transfer")
+            elif fn.startswith("np.") and last in _NP_ARRAYIFY:
+                self._flag(node, "host-sync",
+                           f"{fn}() inside traced code materializes a "
+                           f"host array from a traced value")
+            elif (fn.startswith("time.") and last in _TIME_FNS) \
+                    or fn in ("datetime.now", "datetime.datetime.now"):
+                self._flag(node, "host-time",
+                           f"{fn}() inside traced code bakes the "
+                           f"trace-time clock in as a constant")
+            elif fn.startswith("np.random.") or fn.startswith(
+                    "numpy.random."):
+                self._flag(node, "host-rng",
+                           f"{fn}() inside traced code is a per-trace "
+                           f"host constant — thread a jax key instead")
+            elif last in _HOST_KEY_FNS and "random" in fn:
+                self._flag(node, "host-rng",
+                           f"{fn}() inside traced code — keys must enter "
+                           f"as arguments and derive via fold_in/split")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Violation]:
+    tree = ast.parse(source)
+    linter = _Linter(path, source)
+    linter.visit(tree)
+    linter.violations.sort(key=lambda v: (v.path, v.line))
+    return linter.violations
+
+
+def lint_file(path: str) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return lint_source(src, path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "parse-error", str(e))]
+
+
+def lint_paths(paths: Sequence[str]) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.extend(lint_file(os.path.join(root, f)))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    show_waived = "--show-waived" in argv
+    argv = [a for a in argv if not a.startswith("--")]
+    if not argv:
+        print("usage: python -m paddle_tpu.analysis.trace_lint "
+              "[--show-waived] <paths...>")
+        return 2
+    violations = lint_paths(argv)
+    hard = [v for v in violations if not v.waived]
+    for v in violations:
+        if v.waived and not show_waived:
+            continue
+        print(v.render())
+    print(f"{len(hard)} violation(s), "
+          f"{sum(v.waived for v in violations)} waived")
+    return 1 if hard else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

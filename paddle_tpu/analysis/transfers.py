@@ -1,0 +1,78 @@
+"""Host-sync / host-transfer detector for hot compiled graphs.
+
+PR 2's superstep exists so that NOTHING in the train step talks to the
+host; PR 3's serving tick made stop detection device-resident for the
+same reason. A single stray ``jax.debug.print``, ``io_callback``,
+``host_callback`` or infeed/outfeed inside one of these graphs
+reintroduces a device→host fence per step and silently caps throughput —
+and nothing in the test suite would notice, because numerics are
+unchanged. This pass scans the optimized HLO for every construct that
+implies host traffic:
+
+* ``custom-call`` instructions whose target names a python/host callback
+  (``xla_python_cpu_callback``, ``xla_python_gpu_callback``,
+  ``xla_ffi_python_*``, anything containing "callback" or "host");
+* ``infeed`` / ``outfeed`` instructions;
+* ``send`` / ``recv`` (+ ``-done``) pairs — host transfers on TPU are
+  lowered this way (``is_host_transfer=true``);
+* ``copy-start``/``copy-done`` pairs that cross memory spaces into host
+  memory (S(5) annotations in TPU dumps).
+
+Each finding carries its op_name/source metadata so the failure message
+points at the python line that planted the callback.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from .hlo import HloModule
+
+__all__ = ["host_transfer_report"]
+
+_CALLBACK_PAT = re.compile(r"callback|host", re.IGNORECASE)
+
+
+def host_transfer_report(mod: HloModule) -> Dict:
+    callbacks: List[str] = []
+    infeed: List[str] = []
+    outfeed: List[str] = []
+    sendrecv: List[str] = []
+    host_copies: List[str] = []
+
+    def where(ins) -> str:
+        bits = [f"%{ins.name}"]
+        if ins.op_name:
+            bits.append(ins.op_name)
+        if ins.source:
+            bits.append(ins.source)
+        return " ".join(bits)
+
+    for ins in mod.instructions:
+        if ins.opcode == "custom-call":
+            tgt = ins.attr("custom_call_target") or ""
+            if _CALLBACK_PAT.search(tgt):
+                callbacks.append(f"{tgt}: {where(ins)}")
+        elif ins.opcode in ("infeed", "infeed-done"):
+            infeed.append(where(ins))
+        elif ins.opcode in ("outfeed", "outfeed-done"):
+            outfeed.append(where(ins))
+        elif ins.opcode in ("send", "send-done", "recv", "recv-done"):
+            if "is_host_transfer=true" in ins.raw:
+                sendrecv.append(where(ins))
+        elif ins.opcode in ("copy-start", "copy-done"):
+            # TPU memory-space crossing: S(5) marks host memory space in
+            # the dump; plain device copies carry no space annotation
+            if re.search(r"S\(5\)", ins.raw):
+                host_copies.append(where(ins))
+
+    return {
+        "host_callbacks": callbacks,
+        "infeed": infeed,
+        "outfeed": outfeed,
+        "host_sendrecv": sendrecv,
+        "host_copies": host_copies,
+        "host_transfer_count": (len(callbacks) + len(infeed) + len(outfeed)
+                                + len(sendrecv) + len(host_copies)),
+    }

@@ -47,6 +47,7 @@ from ..observability.metrics import REGISTRY as _REG
 __all__ = [
     "acquire", "aval_signature", "fingerprint", "configure_compilation_cache",
     "save_aot", "load_aot", "stats", "reset_stats", "clear", "note_trace",
+    "explain_fingerprint_change",
 ]
 
 _LOCK = threading.Lock()
@@ -55,6 +56,10 @@ _MAX_EXECUTABLES = 64
 
 _STATS = {"hits": 0, "misses": 0, "aot_hits": 0, "traces": 0}
 _PERSISTENT_DIR: Optional[str] = None
+# why the last stale AOT artifact was rejected (ISSUE 8: "a fingerprint
+# changed" is useless — operators need to know WHICH key drifted):
+# {"name": ..., "diff": [path: old -> new, ...]} or None
+_LAST_STALE: Optional[Dict[str, Any]] = None
 
 AOT_META_SUFFIX = ".meta.json"
 AOT_BIN_SUFFIX = ".stablehlo.bin"
@@ -73,6 +78,7 @@ def stats() -> Dict[str, Any]:
         out = dict(_STATS)
     out["persistent_dir"] = _PERSISTENT_DIR
     out["executables"] = len(_EXECUTABLES)
+    out["last_stale"] = _LAST_STALE
     return out
 
 
@@ -85,10 +91,12 @@ def reset_stats() -> None:
 def clear() -> None:
     """Drop cached executables + counters (tests use this to simulate a
     process restart without spawning one)."""
+    global _LAST_STALE
     with _LOCK:
         _EXECUTABLES.clear()
         for k in _STATS:
             _STATS[k] = 0
+        _LAST_STALE = None
 
 
 # -- fingerprinting ----------------------------------------------------------
@@ -135,6 +143,48 @@ def fingerprint(parts) -> str:
     return hashlib.sha256(blob).hexdigest()
 
 
+def _norm_parts(parts):
+    """JSON-normalized view (tuples become lists, keys stay) so parts
+    saved to a meta sidecar and parts computed live compare structurally."""
+    return json.loads(json.dumps(parts, sort_keys=True, default=str))
+
+
+def explain_fingerprint_change(old_parts, new_parts, limit: int = 12):
+    """Human-readable paths where two fingerprint part trees diverge —
+    the "WHY did this recompile / reject the AOT artifact" report. Parts
+    are labeled dicts (Trainer._fp_parts), so paths read like
+    ``static.env.PT_NAIVE_LOSS_HEAD: False -> True`` instead of a tuple
+    index. Returns at most ``limit`` lines."""
+    diffs: list = []
+
+    def walk(a, b, path):
+        if len(diffs) >= limit:
+            return
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) | set(b), key=str):
+                if len(diffs) >= limit:
+                    return
+                p = f"{path}.{k}" if path else str(k)
+                if k not in a:
+                    diffs.append(f"{p}: <absent> -> {b[k]!r}"[:240])
+                elif k not in b:
+                    diffs.append(f"{p}: {a[k]!r} -> <absent>"[:240])
+                elif a[k] != b[k]:
+                    walk(a[k], b[k], p)
+        elif isinstance(a, list) and isinstance(b, list):
+            if len(a) != len(b):
+                diffs.append(f"{path}: length {len(a)} -> {len(b)}")
+                return
+            for i, (x, y) in enumerate(zip(a, b)):
+                if x != y:
+                    walk(x, y, f"{path}[{i}]")
+        elif a != b:
+            diffs.append(f"{path}: {a!r} -> {b!r}"[:300])
+
+    walk(_norm_parts(old_parts), _norm_parts(new_parts), "")
+    return diffs
+
+
 # -- in-process executable cache ---------------------------------------------
 
 def _store(fp: str, fn) -> None:
@@ -147,7 +197,8 @@ def _store(fp: str, fn) -> None:
 
 def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
             name: str = "step", save_artifact: bool = False,
-            donate_argnums: Tuple[int, ...] = ()):
+            donate_argnums: Tuple[int, ...] = (),
+            fp_parts=None):
     """Return ``(callable, outcome)`` for fingerprint ``fp``.
 
     Lookup order: in-process executable ("hit") → serialized AOT artifact
@@ -159,6 +210,12 @@ def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
     unavailable for this function/backend the live jitted callable is
     cached instead — caching never changes semantics, only who pays the
     compile.
+
+    ``fp_parts`` (optional, a labeled dict): the pre-hash fingerprint
+    parts. Saved into the AOT meta sidecar, and on a stale-artifact
+    rejection diffed against the stored parts so the log says WHICH key
+    drifted (model scalar, env escape, aval signature) instead of just
+    "fingerprint mismatch".
     """
     with _LOCK:
         fn = _EXECUTABLES.get(fp)
@@ -174,13 +231,14 @@ def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
             # precompile-after-train: the executable was already resident,
             # but the restart artifact must still land on disk
             try:
-                save_aot(aot_dir, name, fp, jitted, args)
+                save_aot(aot_dir, name, fp, jitted, args, parts=fp_parts)
             except Exception:
                 pass
         return hit, "hit"
     if aot_dir:
         with _goodput_ledger().span("compile"):
-            fn = load_aot(aot_dir, name, fp, donate_argnums=donate_argnums)
+            fn = load_aot(aot_dir, name, fp, donate_argnums=donate_argnums,
+                          expect_parts=fp_parts)
         if fn is not None:
             _store(fp, fn)
             with _LOCK:
@@ -207,7 +265,7 @@ def acquire(fp: str, jitted, args, *, aot_dir: Optional[str] = None,
         _STATS["misses"] += 1
     if aot_dir and save_artifact:
         try:
-            save_aot(aot_dir, name, fp, jitted, args)
+            save_aot(aot_dir, name, fp, jitted, args, parts=fp_parts)
         except Exception:
             pass             # artifact write is best-effort, never fatal
     _store(fp, fn)
@@ -228,10 +286,12 @@ def _artifact_matches(aot_dir: str, name: str, fp: str) -> bool:
         return False
 
 
-def save_aot(aot_dir: str, name: str, fp: str, jitted, args) -> str:
+def save_aot(aot_dir: str, name: str, fp: str, jitted, args,
+             parts=None) -> str:
     """Serialize ``jitted`` specialized to ``args``' avals via ``jax.export``
-    and write it (plus a meta sidecar carrying the fingerprint) under
-    ``aot_dir``. Returns the artifact path."""
+    and write it (plus a meta sidecar carrying the fingerprint — and, when
+    given, the labeled pre-hash ``parts`` a later mismatch is explained
+    against) under ``aot_dir``. Returns the artifact path."""
     import jax
     from jax import export
 
@@ -245,6 +305,8 @@ def save_aot(aot_dir: str, name: str, fp: str, jitted, args) -> str:
     os.replace(tmp, base + AOT_BIN_SUFFIX)
     meta = {"fingerprint": fp, "jax_version": jax.__version__,
             "backend": jax.default_backend(), "name": name}
+    if parts is not None:
+        meta["parts"] = _norm_parts(parts)
     tmp = base + AOT_META_SUFFIX + ".tmp"
     with open(tmp, "w") as f:
         json.dump(meta, f, indent=1, sort_keys=True)
@@ -253,16 +315,24 @@ def save_aot(aot_dir: str, name: str, fp: str, jitted, args) -> str:
 
 
 def load_aot(aot_dir: str, name: str, fp: str,
-             donate_argnums: Tuple[int, ...] = ()):
+             donate_argnums: Tuple[int, ...] = (),
+             expect_parts=None):
     """Deserialize the ``name`` artifact if its meta matches ``fp`` (and the
     current jax version/backend); returns a jitted callable or None. A
-    mismatched or unreadable artifact is ignored — the caller compiles.
+    mismatched or unreadable artifact is ignored — the caller compiles —
+    but a STALE artifact's rejection is explained: when the sidecar stored
+    the labeled fingerprint parts and the caller supplies its current
+    ``expect_parts``, the differing keys are warned and recorded in
+    ``stats()["last_stale"]`` (e.g. ``static.env.PT_NAIVE_LOSS_HEAD:
+    False -> True`` — the operator knows the recompile is the env flip,
+    not corruption).
     ``donate_argnums`` must restate the original jit's donation: the
     exported call wrapper does not carry it, and silently dropping it
     would double the params+opt-state HBM footprint on the resume path."""
     import jax
     from jax import export
 
+    global _LAST_STALE
     base = _artifact_base(aot_dir, name)
     try:
         with open(base + AOT_META_SUFFIX) as f:
@@ -270,6 +340,27 @@ def load_aot(aot_dir: str, name: str, fp: str,
         if (meta.get("fingerprint") != fp
                 or meta.get("jax_version") != jax.__version__
                 or meta.get("backend") != jax.default_backend()):
+            # explanation is OPT-IN (expect_parts supplied): callers on
+            # the old contract keep the silent-ignore behavior — a
+            # routine jax upgrade must not start raising under -W error
+            if expect_parts is not None:
+                diff = []
+                for key, want in (("jax_version", jax.__version__),
+                                  ("backend", jax.default_backend())):
+                    if meta.get(key) != want:
+                        diff.append(f"{key}: {meta.get(key)!r} -> "
+                                    f"{want!r}")
+                if meta.get("fingerprint") != fp and "parts" in meta:
+                    diff.extend(explain_fingerprint_change(meta["parts"],
+                                                           expect_parts))
+                if diff:
+                    _LAST_STALE = {"name": name, "diff": diff}
+                    import warnings
+                    warnings.warn(
+                        "compile_cache: AOT artifact '%s' is stale, "
+                        "recompiling; drift:\n  %s" % (name,
+                                                       "\n  ".join(diff)),
+                        stacklevel=2)
             return None
         with open(base + AOT_BIN_SUFFIX, "rb") as f:
             data = f.read()

@@ -74,6 +74,8 @@ def check_output(fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
         xs = [jnp.asarray(v) for v in _as_arrays(inputs, dtype)]
         paths = [("eager", fn)]
         if with_jit:
+            # one trace per dtype under test is the POINT of this helper —
+            # trace-lint: waive(jit-in-loop) correctness oracle, not hot path
             paths.append(("jit", jax.jit(lambda *args: fn(*args, **kwargs))))
         for name, f in paths:
             got = f(*xs, **({} if name == "jit" else kwargs))

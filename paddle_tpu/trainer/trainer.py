@@ -317,26 +317,46 @@ class Trainer:
         if sched is not None and self._lr_fn is not None:
             sched_part = sched_constants(sched)
         import os
-        self._static_fp = (
-            jax.__version__, jax.default_backend(),
-            type(model).__qualname__, scalars(model),
-            scalars(cfg) if cfg is not None and hasattr(cfg, "__dict__")
-            else (),
+        # LABELED parts (ISSUE 8): the fingerprint used to be a bare
+        # positional tuple, so a stale-AOT-artifact rejection could only
+        # say "fingerprint mismatch". Named keys make
+        # compile_cache.explain_fingerprint_change render actionable paths
+        # (env.PT_NAIVE_LOSS_HEAD: False -> True). Hash COVERAGE (which
+        # program facts key the cache) is identical, but the JSON
+        # rendering — and hence the hash VALUE — changes once at this
+        # boundary: pre-existing AOT artifacts recompile one time (their
+        # tuple-era sidecars carry no "parts", so that one rejection is
+        # silent, exactly the old behavior).
+        self._static_fp = {
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "model_class": type(model).__qualname__,
+            "model_scalars": scalars(model),
+            "config_scalars": (scalars(cfg) if cfg is not None
+                               and hasattr(cfg, "__dict__") else ()),
             # trace-affecting env escapes: the loss-head override flips
             # which program gets traced with identical avals and cfg —
             # without this key a restart under PT_NAIVE_LOSS_HEAD=1 would
             # aot-hit the stale FUSED executable (and vice versa)
-            bool(os.environ.get("PT_NAIVE_LOSS_HEAD")),
-            bool(os.environ.get("PT_DISABLE_PALLAS")),
-            structure,
-            type(opt).__qualname__, scalars(opt),
-            type(sched).__qualname__ if sched is not None else None,
-            sched_part,
-            bool(self._lr_fn),
-            type(clip).__qualname__ if clip is not None else None,
-            scalars(clip) if clip is not None else (),
-            self._donate, self.accumulate_steps,
-        )
+            "env": {
+                "PT_NAIVE_LOSS_HEAD":
+                    bool(os.environ.get("PT_NAIVE_LOSS_HEAD")),
+                "PT_DISABLE_PALLAS":
+                    bool(os.environ.get("PT_DISABLE_PALLAS")),
+            },
+            "sublayers": structure,
+            "optimizer_class": type(opt).__qualname__,
+            "optimizer_scalars": scalars(opt),
+            "scheduler_class": (type(sched).__qualname__
+                                if sched is not None else None),
+            "scheduler_constants": sched_part,
+            "functional_lr": bool(self._lr_fn),
+            "grad_clip_class": (type(clip).__qualname__
+                                if clip is not None else None),
+            "grad_clip_scalars": scalars(clip) if clip is not None else (),
+            "donate": self._donate,
+            "accumulate_steps": self.accumulate_steps,
+        }
         return self._static_fp
 
     def _dispatch(self, kind: str, args):
@@ -368,10 +388,14 @@ class Trainer:
             sig = compile_cache.aval_signature(args)
             fn = exec_cache.get(sig)
             if fn is None:
-                fp = compile_cache.fingerprint((self._fp_parts(), kind, sig))
+                parts = {"static": self._fp_parts(), "kind": kind,
+                         "avals": sig}
+                fp = compile_cache.fingerprint(
+                    (self._fp_parts(), kind, sig))
                 fn, _ = compile_cache.acquire(
                     fp, jitted, args, aot_dir=self._aot_dir, name=kind,
-                    donate_argnums=(0, 1) if self._donate else ())
+                    donate_argnums=(0, 1) if self._donate else (),
+                    fp_parts=parts)
                 exec_cache[sig] = fn
             if fast is not None:
                 self._fast_exec[fast] = fn
@@ -445,7 +469,9 @@ class Trainer:
         fn, outcome = compile_cache.acquire(
             fp, jitted, avals, aot_dir=self._aot_dir, name=kind,
             save_artifact=self._aot_dir is not None,
-            donate_argnums=(0, 1) if self._donate else ())
+            donate_argnums=(0, 1) if self._donate else (),
+            fp_parts={"static": self._fp_parts(), "kind": kind,
+                      "avals": sig})
         exec_cache[sig] = fn
         return {"kind": kind, "outcome": outcome, "fingerprint": fp,
                 "aot_dir": self._aot_dir}

@@ -10,8 +10,6 @@ composition matches the dense oracle under shard_map on the faked
 of size B*S*V (the regression this head exists to prevent — the HLO
 guard)."""
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -276,25 +274,17 @@ def test_tp_block_not_dividing_shard_falls_back():
 
 
 # -- the HLO guard: no B*S*V intermediate in the compiled train step --------
-
-def _bsv_buffers(hlo_text, n_tokens, vocab):
-    """Shapes in the optimized HLO whose last dim == vocab and whose other
-    dims multiply to n_tokens — i.e. [B,S,V] / [B*S,V] logits buffers, any
-    dtype."""
-    hits = set()
-    for dims in re.findall(r"[a-z0-9]+\[([0-9,]+)\]", hlo_text):
-        shape = [int(x) for x in dims.split(",")]
-        if (len(shape) >= 2 and shape[-1] == vocab
-                and int(np.prod(shape[:-1])) == n_tokens):
-            hits.add(tuple(shape))
-    return hits
-
+# The detector itself moved to paddle_tpu.analysis (ISSUE 8): the one-off
+# _bsv_buffers regex became the materialization analyzer's BanRule, so the
+# "no logits buffer" check has ONE definition shared by this test, the
+# train-step graph contract and tools/graph_lint.py.
 
 def test_hlo_guard_no_bsv_intermediate():
     """THE regression this PR exists to prevent: the compiled fused train
     step (loss + grads, the Trainer's jit shape) must contain no buffer of
     size B*S*V in its optimized HLO. The naive path must trip the same
     detector — proving the guard can see the buffer it bans."""
+    from paddle_tpu.analysis import BanRule, banned_buffers, parse_hlo
     pt.seed(0)
     cfg = LlamaConfig.tiny()            # V=512, H=128
     m = LlamaForCausalLM(cfg)
@@ -303,14 +293,17 @@ def test_hlo_guard_no_bsv_intermediate():
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)))
     lab = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, S)))
+    rule = BanRule(cfg.vocab_size, B * S, label="BSV-logits")
 
     def step(p):
         return m.functional_call(p, ids, labels=lab)[0]
 
     fused_hlo = jax.jit(jax.value_and_grad(step)).lower(params) \
         .compile().as_text()
-    assert _bsv_buffers(fused_hlo, B * S, cfg.vocab_size) == set(), \
-        "fused train step materialized a B*S*V logits buffer"
+    hits = banned_buffers(parse_hlo(fused_hlo), [rule])
+    assert hits == [], (
+        "fused train step materialized a B*S*V logits buffer:\n"
+        + "\n".join(h.describe() for h in hits))
     # the profiler span: loss-head ops carry the named_scope in their op
     # metadata, so device traces (xplane/chrome) attribute the loss head
     assert "loss_head" in fused_hlo
@@ -321,7 +314,7 @@ def test_hlo_guard_no_bsv_intermediate():
             .compile().as_text()
     finally:
         cfg.loss_impl = "fused"
-    assert _bsv_buffers(naive_hlo, B * S, cfg.vocab_size), \
+    assert banned_buffers(parse_hlo(naive_hlo), [rule]), \
         "guard sanity: the naive path should materialize logits"
 
 

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Graph-contract lint gate (ISSUE 8) — tier-1 alongside obs_smoke.py.
+
+Builds every canonical compiled entrypoint (train step K=1/K=4, serving
+tick spec on/off, prefix-hit admit dispatch, fused CE fwd+bwd, dp2xtp2
+TP fused CE), runs the static analyzers (materialization, donation,
+host-sync, collective census) over the optimized HLO, and checks:
+
+1. the declarative ``GraphContract`` invariants (no banned buffer, the
+   donations the design requires, zero host transfers, the designed
+   collective pattern);
+2. the checked-in budget snapshots (tools/graph_budgets.json): byte
+   ceilings, donation floors, exact collective counts, and the waived
+   set of donat-able-but-undonated inputs.
+
+Failures print a diff — budget vs actual, with the producing HLO
+instruction — so the message names WHO re-materialized the logits or
+WHICH donation went missing. Intentional graph changes are accepted
+with ``--update-budgets`` (waivers and their rationales are preserved).
+
+Also lints the hot-path packages (trainer/, inference/, ops/) with
+``paddle_tpu.analysis.trace_lint``: unwaived retrace/host-sync hazards
+fail the gate.
+
+Usage:
+    python tools/graph_lint.py                  # check (CI mode)
+    python tools/graph_lint.py --update-budgets # re-pin snapshots
+    python tools/graph_lint.py --graphs train_step_k1,serving_tick
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the census graph needs a 2x2 mesh: fake the devices BEFORE jax
+# initializes (harmless when the caller — e.g. tests/conftest — already
+# forced a count)
+if "jax" not in sys.modules:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+DEFAULT_BUDGETS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "graph_budgets.json")
+LINT_PATHS = ("paddle_tpu/trainer", "paddle_tpu/inference",
+              "paddle_tpu/ops", "paddle_tpu/analysis")
+
+
+def main(budgets_path: str = DEFAULT_BUDGETS, update: bool = False,
+         graphs=None, verbose: bool = True):
+    """Returns ``{"ok", "violations", "snapshots", "trace_lint", ...}``;
+    importable in-process (the tier-1 test drives it this way)."""
+    import jax
+
+    import paddle_tpu.analysis as A
+    from paddle_tpu.analysis import trace_lint
+
+    def log(msg):
+        if verbose:
+            print(msg, flush=True)
+
+    budgets = A.load_budgets(budgets_path)
+    entries = budgets.setdefault("graphs", {})
+    names = ([g.strip() for g in graphs if g.strip()] if graphs
+             else A.graph_names())
+    unknown = [n for n in names if n not in A.REGISTRY]
+    if unknown:
+        known = ", ".join(A.graph_names())
+        raise SystemExit(f"graph_lint: unknown graph(s) "
+                         f"{', '.join(unknown)}; known: {known}")
+    violations = []
+    snapshots = {}
+    skipped = []
+
+    for name in names:
+        log(f"graph_lint: building {name} ...")
+        try:
+            g = A.build_graph(name)
+        except A.GraphSkipped as e:
+            skipped.append(name)
+            if name in entries and not update:
+                violations.append(A.Violation(
+                    name, "build.skipped",
+                    f"budgeted graph could not be built here: {e}"))
+            continue
+        rep = A.analyze(g.compiled, g.name, g.contract, mesh=g.mesh)
+        snapshots[name] = A.snapshot_report(rep)
+        violations.extend(A.check_contract(g.contract, rep))
+        if update:
+            entry = entries.setdefault(name, {})
+            entry["budget"] = snapshots[name]
+            entry.setdefault("waivers", {})
+            entry["notes"] = g.contract.notes
+        elif name in entries:
+            violations.extend(A.check_budget(rep, entries[name]))
+        else:
+            violations.append(A.Violation(
+                name, "budget.missing",
+                f"no checked-in budget for '{name}' — run "
+                f"tools/graph_lint.py --update-budgets and commit "
+                f"{os.path.relpath(budgets_path, _REPO)}"))
+
+    log("graph_lint: trace_lint over " + ", ".join(LINT_PATHS))
+    lint_violations = trace_lint.lint_paths(
+        [os.path.join(_REPO, p) for p in LINT_PATHS])
+    hard_lint = [v for v in lint_violations if not v.waived]
+    for v in hard_lint:
+        violations.append(A.Violation(
+            os.path.relpath(v.path, _REPO), f"trace_lint.{v.rule}",
+            f"line {v.line}: {v.message} (waive inline with "
+            f"`# trace-lint: waive({v.rule}) <reason>`)"))
+
+    if update:
+        budgets["_meta"] = {
+            "generated_by": "tools/graph_lint.py --update-budgets",
+            "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "semantics": {
+                "largest_intermediate_bytes": "ceiling",
+                "host_transfer_count": "ceiling",
+                "collective_bytes": "ceiling",
+                "donated_bytes": "floor",
+                "aliased_param_count": "floor",
+                "collective_counts": "exact",
+                "undonated_candidates":
+                    "closed set; new entries need a fix or a waiver",
+            },
+        }
+        A.save_budgets(budgets_path, budgets)
+        log(f"graph_lint: budgets written to {budgets_path}")
+
+    ok = not violations
+    log("")
+    log(A.render_violations(violations))
+    log(f"graph_lint: {len(names) - len(skipped)} graph(s) checked"
+        + (f", {len(skipped)} skipped ({', '.join(skipped)})"
+           if skipped else "")
+        + f", {sum(v.waived for v in lint_violations)} trace-lint "
+          f"waiver(s) honored")
+    return {
+        "ok": ok,
+        "violations": [v.render() for v in violations],
+        "snapshots": snapshots,
+        "skipped": skipped,
+        "trace_lint": {
+            "violations": len(hard_lint),
+            "waived": sum(v.waived for v in lint_violations),
+        },
+    }
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="re-pin tools/graph_budgets.json (preserves "
+                         "waivers) instead of checking")
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS)
+    ap.add_argument("--graphs", default=None,
+                    help="comma-separated subset of canonical graphs")
+    ap.add_argument("--json", action="store_true",
+                    help="print the result dict as JSON")
+    args = ap.parse_args()
+    res = main(budgets_path=args.budgets, update=args.update_budgets,
+               graphs=args.graphs.split(",") if args.graphs else None,
+               verbose=not args.json)
+    if args.json:
+        print(json.dumps(res, indent=1, sort_keys=True))
+    sys.exit(0 if res["ok"] else 1)
