@@ -76,7 +76,12 @@ def _make_loader(cfg, batch_size, seq_len, steps, extra_batches=4):
 def _train_bench(cfg, batch_size, seq_len, steps, warmup,
                  superstep_probe=False):
     """Returns (tokens_per_sec_total, step_time_s, input_stall_s, loss,
-    model, fenced_per_step_times, superstep_detail)."""
+    model, fenced_per_step_times, superstep_detail, cost_attr).
+
+    ``cost_attr`` is the cost observatory's analytical attribution of the
+    HEADLINE step executable's optimized HLO (flops/bytes/comm bytes +
+    roofline-predicted step seconds), or None when the executable can't
+    render HLO — it prices the very program the timed loop ran."""
     import jax
 
     import paddle_tpu as pt
@@ -165,9 +170,27 @@ def _train_bench(cfg, batch_size, seq_len, steps, warmup,
             superstep = {"superstep_error":
                          f"{type(e).__name__}: {str(e)[:150]}"}
 
+    # analytical attribution of the step executable that just ran (ISSUE
+    # 9): ONE flop definition — the observability/costs analyzer over the
+    # optimized HLO — shared with the live gauge and graph_lint's floor
+    cost_attr = None
+    try:
+        from paddle_tpu.analysis.hlo import parse_hlo
+        from paddle_tpu.observability import costs
+        fn = next(iter(tr._step_exec.values()), None)
+        if fn is not None and hasattr(fn, "as_text"):
+            rep = costs.attribute_costs(parse_hlo(fn.as_text()))
+            cost_attr = {"flops": rep.total_flops,
+                         "bytes": rep.total_bytes,
+                         "comm_bytes": rep.total_comm_bytes,
+                         "predicted_s": rep.predicted_step_s,
+                         "unmodeled_ops": sum(rep.unmodeled.values())}
+    except Exception as e:
+        _log(f"cost attribution failed (headline kept): {e}")
+
     tokens = batch_size * seq_len * steps
     return (tokens / dt, dt / steps, stall / steps, float(loss),
-            model, per_step, superstep)
+            model, per_step, superstep, cost_attr)
 
 
 def _spawn_probe(strip_flags):
@@ -861,7 +884,7 @@ def _decode_bench(cfg, on_tpu):
                 _log(f"long-context: compiling s=8192 b={lb} recompute={lrec}")
                 try:
                     (ltps, lstep, _stall, _loss, lmodel,
-                     _ps, _ss) = _train_bench(lcfg, lb, 8192, 5, 2)
+                     _ps, _ss, _ca) = _train_bench(lcfg, lb, 8192, 5, 2)
                     break
                 except Exception as e:
                     # clear frame locals: the traceback pins the failed
@@ -1271,8 +1294,9 @@ def _run(error_note):
         apply()
         try:
             (tps, step_s, stall_s, loss, model, per_step,
-             superstep) = _train_bench(cfg, batch_size, seq_len, steps,
-                                       warmup, superstep_probe=True)
+             superstep, cost_attr) = _train_bench(
+                 cfg, batch_size, seq_len, steps, warmup,
+                 superstep_probe=True)
             if tier != "as-configured":
                 note = (f"degraded to {tier} after: "
                         f"{type(last_exc).__name__}: {str(last_exc)[:200]}")
@@ -1342,6 +1366,28 @@ def _run(error_note):
         "final_loss": loss,
     }
     detail.update(superstep)
+    # cost-observatory rows (ISSUE 9) — ratio metrics per the bench-
+    # variance policy: `mfu_analytical` is HLO-attributed flops of the
+    # HEADLINE step executable / (measured step time x device peak) —
+    # same analyzer as the live pt_model_flops_utilization gauge and
+    # graph_lint's flop floor (vs `mfu`, the PaLM closed form);
+    # `step_time_predicted_over_measured` is roofline-predicted /
+    # measured (cost-model drift); `comm_time_predicted_s` prices the
+    # step's collective census bytes against the axis link bandwidth
+    # (0.0 single-chip — a sharded pod shows its real comm price here)
+    if cost_attr:
+        try:
+            from paddle_tpu.observability.costs import device_spec
+            spec = device_spec()
+            detail["mfu_analytical"] = round(
+                cost_attr["flops"] / (step_s * spec.peak_flops), 4)
+            detail["step_time_predicted_over_measured"] = round(
+                cost_attr["predicted_s"] / step_s, 4)
+            detail["comm_time_predicted_s"] = round(
+                cost_attr["comm_bytes"] / spec.link_bw, 6)
+            detail["cost_unmodeled_ops"] = cost_attr["unmodeled_ops"]
+        except Exception as e:
+            detail["cost_rows_error"] = f"{type(e).__name__}: {str(e)[:150]}"
     # which loss head actually trained: fused (blockwise vocab-CE, no
     # [b, s, V] logits) is the default; PT_NAIVE_LOSS_HEAD or
     # cfg.loss_impl flip it back

@@ -160,6 +160,11 @@ def check_contract(contract: GraphContract,
 
 def snapshot_report(report: GraphReport) -> Dict:
     """The JSON-able measured quantities a budget pins."""
+    # lazy import: the cost analyzer lives in observability/costs (ISSUE
+    # 9) but is driven by THIS module's budget machinery — deferred so
+    # `analysis` stays importable for jax-free saved-dump workflows
+    from ..observability.costs import attribute_costs
+    flops = int(attribute_costs(report.module).total_flops)
     return {
         "largest_intermediate_bytes":
             report.materialization["largest_intermediate_bytes"],
@@ -170,6 +175,11 @@ def snapshot_report(report: GraphReport) -> Dict:
         "host_transfer_count": report.transfers["host_transfer_count"],
         "collective_counts": report.collectives["counts"],
         "collective_bytes": report.collectives["total_collective_bytes"],
+        # floor: the fused train step's analytical flop count — an op
+        # silently falling OUT of the fused/compiled path (a loss head
+        # reverting to naive-elsewhere, a layer dropped by a refactor)
+        # shows up as a flop drop long before anyone reads a bench row
+        "analytical_flops": flops,
     }
 
 
@@ -200,22 +210,26 @@ def check_budget(report: GraphReport, entry: Dict) -> List[Violation]:
                 (report.materialization["largest_buffers"][:4]
                  if key == "largest_intermediate_bytes" else [])))
 
-    def floor(key, why):
+    def floor(key, why, details=()):
         if key in budget and snap[key] < budget[key]:
             v.append(Violation(
                 report.name, f"budget.{key}",
                 f"{why}: budget {budget[key]:,} -> actual {snap[key]:,} "
-                f"({snap[key] - budget[key]:,})",
-                [a["label"] for a in report.donation["aliased"][:8]]))
+                f"({snap[key] - budget[key]:,})", list(details)))
 
+    donated = [a["label"] for a in report.donation["aliased"][:8]]
     ceiling("largest_intermediate_bytes",
             "largest live buffer grew past its budget")
     ceiling("host_transfer_count", "host transfers appeared in a hot graph")
     ceiling("collective_bytes", "collective payload bytes grew")
     floor("donated_bytes",
-          "donated bytes dropped — a buffer donation was lost")
+          "donated bytes dropped — a buffer donation was lost", donated)
     floor("aliased_param_count",
-          "fewer parameters are donated than the budget pins")
+          "fewer parameters are donated than the budget pins", donated)
+    floor("analytical_flops",
+          "analytical flop count dropped — an op fell out of the "
+          "fused/compiled path (intentional? re-pin with "
+          "--update-budgets)")
 
     if "collective_counts" in budget:
         if snap["collective_counts"] != budget["collective_counts"]:

@@ -45,6 +45,10 @@ class BuiltGraph:
     compiled: object                   # jax.stages.Compiled
     contract: GraphContract
     mesh: Optional[object] = None
+    #: the concrete arrays the graph was lowered on — lets the cost probe
+    #: (tools/op_cost_probe.py) EXECUTE the canonical graph for measured
+    #: timings (donation-safe: the probe copies per call)
+    example_args: Optional[tuple] = None
 
 
 def _micro_cfg():
@@ -94,7 +98,7 @@ def build_train_step_k1() -> BuiltGraph:
     compiled = tr._step_jit.lower(*args).compile()
     return BuiltGraph("train_step_k1", compiled, GraphContract(
         "train_step_k1", notes="per-step trainer dispatch",
-        **_TRAIN_CONTRACT_KW))
+        **_TRAIN_CONTRACT_KW), example_args=args)
 
 
 def build_train_step_k4() -> BuiltGraph:
@@ -110,7 +114,7 @@ def build_train_step_k4() -> BuiltGraph:
     compiled = tr._superstep_jit.lower(*args).compile()
     return BuiltGraph("train_step_k4", compiled, GraphContract(
         "train_step_k4", notes="K=4 superstep scan",
-        **_TRAIN_CONTRACT_KW))
+        **_TRAIN_CONTRACT_KW), example_args=args)
 
 
 def _engine(**kw):
@@ -130,12 +134,13 @@ def build_serving_tick() -> BuiltGraph:
     import jax.numpy as jnp
     eng = _engine()
     fn = eng._build_decode(4, any_sample=False, attn_impl="paged")
-    compiled = fn.lower(eng._params, eng.pools, jnp.asarray(eng.tables),
-                        eng._base_key, eng._state, eng._knobs).compile()
+    args = (eng._params, eng.pools, jnp.asarray(eng.tables),
+            eng._base_key, eng._state, eng._knobs)
+    compiled = fn.lower(*args).compile()
     return BuiltGraph("serving_tick", compiled, GraphContract(
         "serving_tick", require_aliased=("pools",),
         max_host_transfers=0,
-        notes="decode_block=4 paged scan, spec off"))
+        notes="decode_block=4 paged scan, spec off"), example_args=args)
 
 
 def build_serving_tick_spec() -> BuiltGraph:
@@ -145,13 +150,13 @@ def build_serving_tick_spec() -> BuiltGraph:
     import jax.numpy as jnp
     eng = _engine(spec_k=3)
     fn = eng._build_spec_decode(3, any_sample=False)
-    compiled = fn.lower(eng._params, eng.pools, jnp.asarray(eng.tables),
-                        eng._base_key, eng._state, eng._knobs,
-                        eng._hist).compile()
+    args = (eng._params, eng.pools, jnp.asarray(eng.tables),
+            eng._base_key, eng._state, eng._knobs, eng._hist)
+    compiled = fn.lower(*args).compile()
     return BuiltGraph("serving_tick_spec", compiled, GraphContract(
         "serving_tick_spec", require_aliased=("pools", "hist"),
         max_host_transfers=0,
-        notes="spec_k=3 draft+verify tick"))
+        notes="spec_k=3 draft+verify tick"), example_args=args)
 
 
 def build_prefix_admit() -> BuiltGraph:
@@ -161,15 +166,15 @@ def build_prefix_admit() -> BuiltGraph:
     import jax.numpy as jnp
     eng = _engine()
     fn = eng._tail_logits_fn()
-    compiled = fn.lower(
-        eng._params, jnp.zeros((1, 1), jnp.int32),
-        jnp.zeros((1,), jnp.int32), eng.pools,
-        jnp.asarray(eng.tables[0:1]), jnp.int32(1),
-        jnp.int32(2)).compile()
+    args = (eng._params, jnp.zeros((1, 1), jnp.int32),
+            jnp.zeros((1,), jnp.int32), eng.pools,
+            jnp.asarray(eng.tables[0:1]), jnp.int32(1),
+            jnp.int32(2))
+    compiled = fn.lower(*args).compile()
     return BuiltGraph("prefix_admit", compiled, GraphContract(
         "prefix_admit", require_aliased=("pools",),
         max_host_transfers=0,
-        notes="prefix-hit COW + 1-token re-forward"))
+        notes="prefix-hit COW + 1-token re-forward"), example_args=args)
 
 
 def build_fused_ce() -> BuiltGraph:
@@ -194,7 +199,7 @@ def build_fused_ce() -> BuiltGraph:
         "fused_ce",
         ban_rules=(BanRule(_VOCAB, N, label="NV-logits"),),
         max_host_transfers=0,
-        notes="lse_and_target fwd+bwd, xla impl"))
+        notes="lse_and_target fwd+bwd, xla impl"), example_args=(h, w))
 
 
 def build_tp_fused_ce() -> BuiltGraph:

@@ -331,6 +331,11 @@ class ContinuousBatchingEngine:
         # per-tick inter-token gaps of retired requests (incl. stalls a
         # preemption or a long peer prefill inflicted on them)
         self._itl_gaps = deque(maxlen=100_000)
+        # cost observatory (ISSUE 9): attached when a decode executable is
+        # built with the metrics plane on; drain timestamps give the
+        # measured seconds-per-block its breakdown gauges divide
+        self._cost_watch = None
+        self._drain_stamps = deque(maxlen=256)
         # metrics-plane lifetime counters (plain attrs: zero cost until
         # publish_metrics mirrors them into the registry as deltas)
         self._tokens_emitted = 0
@@ -432,6 +437,11 @@ class ContinuousBatchingEngine:
         {rid: np.ndarray of generated tokens} for the requests finished by
         this call and RELEASES them (a long-lived engine must not retain
         every request it ever served)."""
+        # run() is a burst boundary: drop drain stamps from earlier runs
+        # so the cost observatory's seconds-per-block never averages in
+        # inter-run idle gaps (the median filter alone loses once idle
+        # gaps outnumber genuine ones under short bursty runs)
+        self._drain_stamps.clear()
         while self.has_work():
             self.step()
         # leftover speculative blocks are fully masked on device (every
@@ -514,6 +524,55 @@ class ContinuousBatchingEngine:
         if self._prefix is not None:
             self._g_prefix_pages.set(self._prefix.num_pages)
 
+    def _decode_args(self, spec_mode: bool) -> tuple:
+        """The decode tick's argument tuple — ONE definition shared by
+        the dispatch call and the cost observatory's eager lower, so a
+        signature change can't leave the two silently diverged."""
+        args = (self._params, self.pools, self._tables_dev,
+                self._base_key, self._state, self._knobs)
+        return args + (self._hist,) if spec_mode else args
+
+    def _maybe_compile_with_costs(self, jfn, spec_mode: bool):
+        """Resolve a freshly built decode tick for dispatch. With the
+        metrics plane OFF this returns the jitted fn untouched (it
+        compiles lazily at first call, exactly the old behavior). With
+        the plane ON it pays the same one trace+compile EAGERLY —
+        ``lower().compile()`` on the concrete args of this dispatch — so
+        the cost observatory can attribute flops/bytes from the
+        optimized HLO of the executable that will actually run. Any
+        failure falls back to the jitted fn."""
+        if not _REG.enabled:
+            return jfn
+        try:
+            compiled = jfn.lower(*self._decode_args(spec_mode)).compile()
+        except Exception:
+            return jfn
+        try:
+            from ..observability.costs import CostWatch
+            if self._cost_watch is None:
+                self._cost_watch = CostWatch("serving")
+            self._cost_watch.observe_executable(compiled)
+        except Exception:
+            pass
+        return compiled
+
+    def _publish_cost_metrics(self) -> None:
+        """Breakdown/MFU gauges for the serving tick: measured seconds
+        per decode block from drain-to-drain gaps (median-filtered so
+        idle gaps between runs don't pollute the estimate), attributed
+        against the analyzed tick executable."""
+        watch = self._cost_watch
+        if watch is None or not watch.attached:
+            return
+        stamps = list(self._drain_stamps)
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        if not gaps:
+            return
+        gaps.sort()
+        med = gaps[len(gaps) // 2]
+        kept = [g for g in gaps if g <= 10 * med] or [med]
+        watch.publish(sum(kept) / len(kept))
+
     def publish_metrics(self) -> Dict[str, float]:
         """Mirror the engine's telemetry into the process metrics registry
         — the counters/percentiles ``stats()``/``latency_stats()`` used to
@@ -572,6 +631,7 @@ class ContinuousBatchingEngine:
         _REG.gauge("pt_serving_window_requests",
                    "retired requests in the latency window").set(
             lat.get("requests", 0))
+        self._publish_cost_metrics()
         self._tick_gauges()
         return lat
 
@@ -1366,23 +1426,25 @@ class ContinuousBatchingEngine:
                          else "paged")
             self.attn_path_ticks[attn_impl] += 1
             fkey = (K, any_sample, attn_impl)
-        fn = self._decode_fns.get(fkey)
-        if fn is None:
-            fn = self._decode_fns[fkey] = (
-                self._build_spec_decode(self.spec_k, any_sample)
-                if spec else self._build_decode(K, any_sample, attn_impl))
+        # tables upload BEFORE executable resolution: the cost-observatory
+        # eager compile below lowers on the concrete args of this dispatch
         if self._tables_dirty:
             self._tables_dev = jnp.asarray(self.tables)
             self._tables_dirty = False
+        fn = self._decode_fns.get(fkey)
+        if fn is None:
+            jfn = (self._build_spec_decode(self.spec_k, any_sample)
+                   if spec else self._build_decode(K, any_sample,
+                                                   attn_impl))
+            fn = self._decode_fns[fkey] = \
+                self._maybe_compile_with_costs(jfn, spec)
         with RecordEvent("serving::dispatch"):
             if spec:
                 toks, kept, self._state, self.pools, self._hist = fn(
-                    self._params, self.pools, self._tables_dev,
-                    self._base_key, self._state, self._knobs, self._hist)
+                    *self._decode_args(True))
             else:
                 toks, kept, self._state, self.pools = fn(
-                    self._params, self.pools, self._tables_dev,
-                    self._base_key, self._state, self._knobs)
+                    *self._decode_args(False))
             # start the device→host copies NOW so reconciliation (one or
             # more blocks later) finds the bytes already on host
             for arr in (toks, kept, self._state[1], self._state[2]):
@@ -1433,6 +1495,10 @@ class ContinuousBatchingEngine:
         # block's tokens only exist on host once its drain completes, so
         # percentiles stay honest about what a client would observe
         now = time.perf_counter()
+        if _REG.enabled:
+            # cost observatory: drain-to-drain gaps are the measured
+            # seconds-per-block its breakdown divides
+            self._drain_stamps.append(now)
         for slot, req in blk.participants:
             if self._slots[slot] is not req or req.done:
                 continue      # retired by an earlier block's reconcile

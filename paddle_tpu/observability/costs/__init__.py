@@ -1,0 +1,41 @@
+"""paddle_tpu.observability.costs — the op-level cost observatory.
+
+Three coupled layers (ISSUE 9, the back half of ROADMAP item 3):
+
+1. **Analytical attribution** (:mod:`analyzer`): every fusion/dot/
+   collective in a compiled graph's optimized HLO gets a flops + bytes
+   estimate, yielding a per-graph roofline (compute- vs HBM- vs
+   comm-bound per op, predicted step time from the
+   :mod:`device_db` peak-flops/HBM-BW/link-BW tables with CPU-tier
+   fallbacks) and :func:`price_census` prices the PR 8 collective census
+   per mesh axis (bytes ÷ axis link bandwidth).
+2. **Measured timings**: ``tools/op_cost_probe.py`` times the canonical
+   registry graphs and their dominant dots (interleaved min-of-rounds)
+   and persists an :class:`OpCostDB` next to the kernel ``TuneDB``
+   (``ops/pallas/autotune.py``) keyed by op signature + device kind —
+   the sharding planner that follows reads measured latencies instead of
+   guesses.
+3. **Live breakdown** (:mod:`live`): trainer and serving publish
+   ``pt_step_time_breakdown`` / ``pt_model_flops_utilization`` /
+   ``pt_hbm_bw_utilization`` / ``pt_step_time_predicted_over_measured``
+   through the PR 4 registry.
+
+Deliberately NOT imported by ``paddle_tpu.observability``'s own
+``__init__`` — the metrics plane stays importable without the analysis
+stack; consumers import ``paddle_tpu.observability.costs`` explicitly.
+"""
+
+from .analyzer import (CostReport, OpCost, attribute_costs, dominant_dots,
+                       price_census)
+from .device_db import DeviceSpec, current_device_kind, device_spec
+from .live import CostWatch
+
+# the measured-latency DB lives next to TuneDB (same load/merge/corrupt-
+# warning machinery); re-exported here as the observatory's public handle
+from ...ops.pallas.autotune import OpCostDB, get_op_cost_db
+
+__all__ = [
+    "CostReport", "OpCost", "attribute_costs", "dominant_dots",
+    "price_census", "DeviceSpec", "device_spec", "current_device_kind",
+    "CostWatch", "OpCostDB", "get_op_cost_db",
+]

@@ -92,7 +92,13 @@ def _prom_labels(labels: Dict[str, str]) -> str:
 
 def render_prometheus(snapshot: List[dict]) -> str:
     """Render a collect() snapshot in Prometheus text exposition format
-    (one # TYPE header per metric, histogram as _bucket/_sum/_count)."""
+    (one # TYPE header per metric, histogram as _bucket/_sum/_count).
+
+    Empty histograms are NOT special-cased here: ``collect()`` emits a
+    zeroed series (all ``_bucket`` counts 0, ``_count`` 0) for every
+    registered histogram with no observations, so the exposition carries
+    a stable series set from the first scrape — this renderer just prints
+    whatever bucket rows the snapshot holds."""
     by_name: Dict[str, List[dict]] = {}
     for e in snapshot:
         by_name.setdefault(e["name"], []).append(e)

@@ -248,6 +248,17 @@ class MetricsRegistry:
         with self._lock:
             items = [(m, dict(m._series)) for m in self._metrics.values()]
         for m, series in items:
+            if m.kind == "histogram" and not series:
+                # stable series set for scrapers (ISSUE 9 satellite): a
+                # registered histogram that has observed nothing still
+                # exposes its zeroed _bucket/_sum/_count lines — a series
+                # that appears only on first observation looks like a
+                # target reset to dashboards and breaks rate() queries
+                rows = [[le, 0] for le in list(m.buckets) + ["+Inf"]]
+                out.append({"name": m.name, "type": m.kind, "unit": m.unit,
+                            "labels": {}, "count": 0, "sum": 0.0,
+                            "buckets": rows})
+                continue
             for key, payload in series.items():
                 entry = {"name": m.name, "type": m.kind, "unit": m.unit,
                          "labels": dict(key)}

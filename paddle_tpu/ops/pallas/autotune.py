@@ -36,17 +36,31 @@ def _user_db_path() -> str:
 
 
 class TuneDB:
-    """Merged shipped + user kernel-config database."""
+    """Merged shipped + user kernel-config database.
 
-    def __init__(self):
+    ``shipped_path`` / ``user_path`` parameterize the two merge sources so
+    sibling databases (the cost observatory's :class:`OpCostDB`) share the
+    exact load/merge/corrupt-warning machinery instead of re-implementing
+    it; the defaults keep the original kernel-config behavior."""
+
+    #: human label used in the corrupt-file warning
+    db_label = "kernel tune DB"
+
+    def __init__(self, shipped_path: Optional[str] = None,
+                 user_path: Optional[str] = None):
         self._db: Dict[str, dict] = {}
         self._loaded = False
         self._dirty = False
+        self._shipped_path = shipped_path or _SHIPPED
+        self._user_path = user_path
+
+    def user_path(self) -> str:
+        return self._user_path or _user_db_path()
 
     def _load(self):
         if self._loaded:
             return
-        for path in (_SHIPPED, _user_db_path()):
+        for path in (self._shipped_path, self.user_path()):
             try:
                 with open(path) as f:
                     self._db.update(json.load(f))
@@ -57,7 +71,7 @@ class TuneDB:
                 # offline-tuned configs vanish without a trace — say so once
                 import warnings
                 warnings.warn(
-                    f"ignoring corrupt kernel tune DB at {path} ({e}); "
+                    f"ignoring corrupt {self.db_label} at {path} ({e}); "
                     f"offline-tuned configs from that file will not be "
                     f"applied", RuntimeWarning, stacklevel=2)
         self._loaded = True
@@ -86,7 +100,7 @@ class TuneDB:
         self._dirty = True
 
     def save(self, path: Optional[str] = None):
-        path = path or _user_db_path()
+        path = path or self.user_path()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -198,5 +212,62 @@ def get_db() -> TuneDB:
     return _DB
 
 
+# ---------------------------------------------------------------------------
+# OpCostDB: measured op/graph latencies (ISSUE 9 cost observatory)
+# ---------------------------------------------------------------------------
+
+_COST_SHIPPED = os.path.join(os.path.dirname(__file__), "op_cost_db.json")
+
+
+def _user_cost_db_path() -> str:
+    env = os.environ.get("PT_OP_COST_DB")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "op_cost_db.json")
+
+
+class OpCostDB(TuneDB):
+    """Measured-latency database the cost observatory calibrates
+    (``tools/op_cost_probe.py``) and the sharding planner will read.
+
+    Same persistence discipline as the kernel TuneDB it sits next to —
+    shipped + user overlay merge, atomic merge-over-existing save, and the
+    corrupt-file warning path (a corrupt calibration file must degrade to
+    analytical estimates loudly, never silently) — but keyed on MEASURED
+    quantities: ``graph:<name>|<device_kind>|any|`` records a canonical
+    graph's min-of-rounds execution seconds + its analytical flop/byte
+    attribution, ``dot|<device_kind>|<dtype>|k=...,m=...,n=...`` records a
+    dominant matmul shape's microbench seconds. Entries carry the numbers
+    the planner prices configs with, so calibration survives restarts."""
+
+    db_label = "op cost DB"
+
+    def __init__(self, user_path: Optional[str] = None):
+        super().__init__(shipped_path=_COST_SHIPPED, user_path=user_path)
+
+    def user_path(self) -> str:
+        # resolved LAZILY per call, matching TuneDB's PT_TUNE_DB
+        # discipline — a PT_OP_COST_DB set after import must still win
+        return self._user_path or _user_cost_db_path()
+
+    @staticmethod
+    def graph_key(name: str, device_kind: str) -> str:
+        return TuneDB.key(f"graph:{name}", device_kind, "any")
+
+    @staticmethod
+    def dot_key(m: int, k: int, n: int, dtype: str,
+                device_kind: str) -> str:
+        return TuneDB.key("dot", device_kind, dtype, m=m, k=k, n=n)
+
+
+_COST_DB = OpCostDB()
+
+
+def get_op_cost_db() -> OpCostDB:
+    return _COST_DB
+
+
 __all__ = ["TuneDB", "get_db", "flash_attention_config",
-           "fused_vocab_ce_config", "paged_decode_crossover"]
+           "fused_vocab_ce_config", "paged_decode_crossover",
+           "OpCostDB", "get_op_cost_db"]

@@ -149,6 +149,17 @@ class Trainer:
         #: dispatch_overhead_s_per_step = dispatch_host_s / steps).
         self.dispatch_stats = {"steps": 0, "dispatches": 0,
                                "dispatch_host_s": 0.0}
+        # cost observatory (ISSUE 9): lazily attached at the first log
+        # boundary with the metrics plane on; publishes the step-time
+        # breakdown + analytical-MFU gauges (observability/costs/live.py).
+        # _last_exec tracks the executable the CURRENT dispatch actually
+        # ran (bucketed batch shapes mean several live executables — the
+        # gauges must attribute the one on the clock, not the first
+        # compiled)
+        self._cost_watch = None
+        self._cost_watch_kind = None
+        self._last_exec = None
+        self._last_exec_kind = None
 
     # -- step function -------------------------------------------------------
 
@@ -399,6 +410,8 @@ class Trainer:
                 exec_cache[sig] = fn
             if fast is not None:
                 self._fast_exec[fast] = fn
+        self._last_exec = fn
+        self._last_exec_kind = kind
         with RecordEvent("trainer::dispatch"):
             out = fn(*args)
         self.dispatch_stats["dispatches"] += 1
@@ -640,6 +653,45 @@ class Trainer:
                 self._watchdog.stop()
                 self._watchdog = None
 
+    def _publish_step_costs(self, m: "TrainMetrics", kind: str = "step",
+                            steps_per_exec: int = 1) -> None:
+        """Cost-observatory gauges at a log boundary (ISSUE 9): the
+        measured step time split into compute/collective/host/stall, plus
+        analytical MFU / HBM-BW utilization and the predicted-over-
+        measured drift ratio — all derived from the ACTIVE executable's
+        optimized HLO by the one ``observability/costs`` analyzer.
+        Lazily attached, cached per executable, and fully guarded: the
+        loop never fails (or slows down, beyond one HLO parse per
+        compile) on account of its own telemetry."""
+        if not _obs.enabled():
+            return
+        try:
+            if (self._cost_watch is None
+                    or self._cost_watch_kind != kind):
+                from ..observability.costs import CostWatch
+                self._cost_watch = CostWatch("train")
+                self._cost_watch_kind = kind
+            watch = self._cost_watch
+            # attribute the executable the clocked window actually
+            # dispatched (re-observed on change — bucketed shapes mean
+            # several live executables; reports are cached per id)
+            if self._last_exec_kind == kind:
+                watch.observe_executable(self._last_exec)
+            # per-WINDOW host overhead: the lifetime average would carry
+            # the first dispatch's trace+compile seconds forever and the
+            # host bucket would swallow the whole breakdown
+            ds = self.dispatch_stats
+            mark = getattr(self, "_cost_disp_mark", None) or (0, 0.0)
+            dsteps = ds["steps"] - mark[0]
+            dhost = ds["dispatch_host_s"] - mark[1]
+            self._cost_disp_mark = (ds["steps"], ds["dispatch_host_s"])
+            if dsteps <= 0 or dhost < 0:      # stats were reset externally
+                dsteps, dhost = max(ds["steps"], 1), ds["dispatch_host_s"]
+            watch.publish(m.step_time_s, host_s=dhost / max(dsteps, 1),
+                          steps_per_exec=steps_per_exec)
+        except Exception:
+            pass
+
     def _fit_loop(self, it, target, log_every, on_metrics, seq_len,
                   history, t_last, tokens_since, loss, mgr=None, anomaly=None,
                   guard=None, data=None):
@@ -703,6 +755,7 @@ class Trainer:
                                  mfu=mfu, lr=self.optimizer.get_lr())
                 history.append(m)
                 _obs.observe_train_metrics(m)
+                self._publish_step_costs(m)
                 if on_metrics:
                     on_metrics(m)
                 t_last = time.perf_counter()
@@ -825,6 +878,8 @@ class Trainer:
                             mfu=mfu, lr=lr_at)
                         history.append(m)
                         _obs.observe_train_metrics(m)
+                        self._publish_step_costs(m, kind="superstep",
+                                                 steps_per_exec=K)
                         if on_metrics:
                             on_metrics(m)
                         # advance by the consumed share; the steps after the

@@ -210,3 +210,20 @@ def test_places():
 def test_memory_stats_shape():
     st = dev.memory_stats()
     assert isinstance(st, dict)
+
+
+def test_summary_fallback_rate_labeled_steps_per_sec():
+    """ISSUE 9 satellite: summary()'s trailing throughput line inherits
+    step_info's fallback labeling — steps without num_samples must render
+    a `steps/sec` label there too, never `samples/sec` over a
+    steps-derived number (the docs drift this regression pins)."""
+    from paddle_tpu.profiler import SortedKeys
+
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        p.step()
+    p.stop()
+    s = p.summary(sorted_by=SortedKeys.CPUAvg)
+    assert "steps/sec" in s
+    assert "samples/sec" not in s

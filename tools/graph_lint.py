@@ -129,6 +129,7 @@ def main(budgets_path: str = DEFAULT_BUDGETS, update: bool = False,
                 "donated_bytes": "floor",
                 "aliased_param_count": "floor",
                 "collective_counts": "exact",
+                "analytical_flops": "floor",
                 "undonated_candidates":
                     "closed set; new entries need a fix or a waiver",
             },

@@ -10,7 +10,11 @@ both with the JSONL + Prometheus exporters attached, then checks:
   carries the headline series (goodput buckets, compile cache, serving
   telemetry);
 * the goodput buckets sum to the run's accounted wall-time;
-* a forced flight-recorder dump is strict JSON.
+* a forced flight-recorder dump is strict JSON;
+* the cost-observatory leg (ISSUE 9): OpCostDB calibration on two micro
+  canonical graphs reload-hits through a fresh instance, the live
+  ``pt_model_flops_utilization`` gauge is finite, and the breakdown/MFU
+  series round-trip the exporters.
 
 Usage::
 
@@ -150,6 +154,42 @@ def _serving_leg():
     return served, spec.spec_stats(), px.prefix_stats()
 
 
+def _cost_leg(out_dir: str, errors: list) -> dict:
+    """Cost-observatory leg (ISSUE 9): calibrate the OpCostDB on two
+    micro canonical graphs, prove the DB round-trips through a fresh
+    instance (reload hits), and check the live analytical-MFU gauge the
+    train leg published is finite — the exporters round-trip the new
+    series in the main body below."""
+    import math
+
+    from paddle_tpu.observability.costs import OpCostDB
+    from paddle_tpu.observability.metrics import REGISTRY
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from op_cost_probe import CI_GRAPHS, calibrate
+
+    db_path = os.path.join(out_dir, "op_cost_db.json")
+    cal = calibrate(graphs=list(CI_GRAPHS), rounds=2, iters=2,
+                    db_path=db_path)
+    if not cal["recorded"]:
+        errors.append("op_cost_probe recorded nothing")
+    fresh = OpCostDB(user_path=db_path)
+    for key in cal["recorded"]:
+        if fresh.lookup(key) is None:
+            errors.append(f"OpCostDB reload missed {key}")
+    mfu = REGISTRY.gauge("pt_model_flops_utilization").value(
+        component="train")
+    if not (math.isfinite(mfu) and mfu > 0):
+        errors.append(f"pt_model_flops_utilization not finite-positive: "
+                      f"{mfu}")
+    return {"recorded_keys": len(cal["recorded"]),
+            "mfu_gauge": round(mfu, 6),
+            "graphs": sorted(k for k in cal["graphs"]
+                             if k != "_skipped")}
+
+
 def main(out_dir: str) -> dict:
     os.makedirs(out_dir, exist_ok=True)
     import paddle_tpu.observability as obs
@@ -166,6 +206,7 @@ def main(out_dir: str) -> dict:
     try:
         emissions = _train_leg()
         served, spec_stats, prefix_stats = _serving_leg()
+        cost = _cost_leg(out_dir, errors)
         obs.publish()
 
         # goodput invariant: buckets sum to accounted wall-time
@@ -194,7 +235,11 @@ def main(out_dir: str) -> dict:
                      "pt_serving_prefix_hit_tokens_total",
                      "pt_serving_cow_copies_total",
                      "pt_serving_prefix_shared_pages",
-                     "pt_serving_prefix_hit_rate"):
+                     "pt_serving_prefix_hit_rate",
+                     "pt_model_flops_utilization",
+                     "pt_hbm_bw_utilization",
+                     "pt_step_time_breakdown",
+                     "pt_step_time_predicted_over_measured"):
             if want not in names:
                 errors.append(f"{want} missing from JSONL series")
             if not any(k.startswith(want) for k in parsed):
@@ -224,6 +269,7 @@ def main(out_dir: str) -> dict:
                 prefix_stats.get("prefix_hit_rate", 0.0), 3),
             "prefix_cow_copies": int(
                 prefix_stats.get("prefix_cow_copies", 0)),
+            "cost": cost,
             "jsonl_records": len(records),
             "prom_metrics": len(parsed),
             "goodput_fraction": t["goodput_fraction"],
