@@ -124,6 +124,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability.metrics import REGISTRY as _REG
+from ..observability.sentry import sentry as _sentry
 from ..profiler import RecordEvent
 from .admission import AdmissionPolicy, VictimInfo
 from .generation import (GenerationConfig, decode_stop_update,
@@ -430,6 +431,12 @@ class ContinuousBatchingEngine:
             emitted.extend(self._reconcile_one())
         if _REG.enabled:
             self._tick_gauges()
+            # SLO sentry (ISSUE 10): drain boundary — the gauges above
+            # are fresh. A default-constructed sentry evaluates EVERY
+            # tick (a full registry snapshot); production installs on a
+            # busy engine should set min_interval_s (README shows 1.0).
+            # Uninstalled is a load + branch.
+            _sentry.maybe_tick()
         return emitted
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -455,6 +462,9 @@ class ContinuousBatchingEngine:
             del self._requests[rid]
         if _REG.enabled:
             self.publish_metrics()
+            # run() completion republished the percentile gauges — the
+            # drain boundary an ITL/TTFT ceiling rule should see
+            _sentry.maybe_tick()
         return out
 
     def stats(self) -> Dict[str, int]:
@@ -625,9 +635,16 @@ class ContinuousBatchingEngine:
                             ("itl", "pt_serving_itl_seconds")):
             for q in ("p50", "p99"):
                 v = lat.get(f"{key}_{q}_s")
+                g = _REG.gauge(metric, f"{key} percentile over the "
+                                       f"retired-request window", "s")
                 if v is not None:
-                    _REG.gauge(metric, f"{key} percentile over the retired-"
-                                       f"request window", "s").set(v, q=q)
+                    g.set(v, q=q)
+                else:
+                    # empty/reset window: CLEAR rather than leave the
+                    # previous publish reading as current — an absent
+                    # percentile is honest (and what the sentry's
+                    # Staleness rule exists to notice), a stale one lies
+                    g.clear(q=q)
         _REG.gauge("pt_serving_window_requests",
                    "retired requests in the latency window").set(
             lat.get("requests", 0))

@@ -35,6 +35,7 @@ from typing import List, Optional
 
 from . import exporters as exporters  # noqa: F401 (re-export module)
 from . import flight_recorder, goodput
+from . import sentry as sentry  # noqa: F401 (re-export module)
 from .exporters import (ConsoleSummary, JSONLExporter, PrometheusExporter,
                         parse_prometheus, render_prometheus)
 from .goodput import GoodputLedger, ledger
@@ -45,8 +46,9 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
     "REGISTRY", "enabled", "enable", "disable", "collect", "publish",
     "console", "GoodputLedger", "ledger", "goodput", "flight_recorder",
-    "exporters", "JSONLExporter", "PrometheusExporter", "ConsoleSummary",
-    "render_prometheus", "parse_prometheus", "observe_train_metrics",
+    "sentry", "exporters", "JSONLExporter", "PrometheusExporter",
+    "ConsoleSummary", "render_prometheus", "parse_prometheus",
+    "observe_train_metrics",
 ]
 
 _EXPORTERS: List[object] = []
@@ -56,12 +58,17 @@ def enable(jsonl_path: Optional[str] = None,
            prom_path: Optional[str] = None,
            prom_http_port: Optional[int] = None,
            console: bool = False,
-           flight_dir: Optional[str] = None) -> MetricsRegistry:
+           flight_dir: Optional[str] = None,
+           jsonl_max_bytes: Optional[int] = None,
+           jsonl_keep_segments: int = 3) -> MetricsRegistry:
     """Flip the metrics plane on and attach the requested consumers.
 
     Every argument is optional — ``enable()`` with none just arms the
     registry (tests, ad-hoc inspection). ``prom_http_port=0`` picks an
     ephemeral port (read it back from the exporter's ``.port``).
+    ``jsonl_max_bytes`` turns on JSONL segment rotation (keep-last-
+    ``jsonl_keep_segments``) so a long-lived job's time-series stays
+    bounded on disk.
 
     Idempotent per exporter kind: re-enabling replaces (closes) a
     previously attached exporter of the same kind instead of stacking a
@@ -82,7 +89,9 @@ def enable(jsonl_path: Optional[str] = None,
         _EXPORTERS.append(factory())
 
     if jsonl_path:
-        _replace(JSONLExporter, lambda: JSONLExporter(jsonl_path))
+        _replace(JSONLExporter, lambda: JSONLExporter(
+            jsonl_path, max_bytes=jsonl_max_bytes,
+            keep_segments=jsonl_keep_segments))
     if prom_path or prom_http_port is not None:
         _replace(PrometheusExporter,
                  lambda: PrometheusExporter(path=prom_path,

@@ -13,6 +13,7 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Dict, List, Optional
 
 __all__ = ["JSONLExporter", "PrometheusExporter", "ConsoleSummary",
@@ -27,13 +28,30 @@ class JSONLExporter:
     """Append-only JSONL: each export appends one line per series with a
     shared timestamp. Crash-safe by construction — lines are written with
     a single ``write`` + flush, so a crash can at worst leave one torn
-    final line, which a line-by-line reader skips (``load_jsonl``)."""
+    final line, which a line-by-line reader skips (``load_jsonl``).
 
-    def __init__(self, path: str):
+    Long runs rotate (ISSUE 10 satellite): with ``max_bytes`` set, an
+    export that would push the live file past the cap first rotates it to
+    ``<path>.1`` (shifting ``.1 -> .2`` … and dropping beyond
+    ``keep_segments``), so a week-long serving job holds at most
+    ``(keep_segments + 1) * max_bytes`` of telemetry on disk. One export
+    is never split across segments — each segment stays independently
+    parseable, and :meth:`load_rotated` reads them oldest-first."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 keep_segments: int = 3):
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError("max_bytes must be positive (None disables "
+                             "rotation)")
+        if keep_segments < 1:
+            raise ValueError("keep_segments must be >= 1")
         self.path = path
+        self.max_bytes = max_bytes
+        self.keep_segments = int(keep_segments)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
+        self._closed = False
         self._lock = threading.Lock()
 
     def export(self, snapshot: List[dict]) -> int:
@@ -45,16 +63,79 @@ class JSONLExporter:
             lines.append(json.dumps(rec, sort_keys=True))
         blob = "".join(ln + "\n" for ln in lines)
         with self._lock:
+            if self._closed:
+                # close() is final — enable()'s replace-and-close relies
+                # on a replaced exporter never appending again
+                raise ValueError("export() on a closed JSONLExporter")
+            if self._f is None or self._f.closed:
+                # a failed rotation reopen must not brick the exporter
+                # forever — retry the open on the next export
+                self._f = open(self.path, "a", encoding="utf-8")
+            if (self.max_bytes is not None and self._f.tell() > 0
+                    and self._f.tell() + len(blob.encode("utf-8"))
+                    > self.max_bytes):
+                self._rotate_locked()
             self._f.write(blob)
             self._f.flush()
         return len(lines)
 
+    def _rotate_locked(self) -> None:
+        """Shift the segment chain by one: live -> .1, .k -> .k+1,
+        .keep_segments dropped. The live file reopens empty; a crash
+        mid-rotation at worst loses the oldest (dropped-anyway) segment
+        — the newest data always survives because the live file is only
+        renamed, never rewritten. A filesystem that accepts appends but
+        refuses renames disables rotation after ONE failed attempt
+        (warned): re-shifting the chain on every export would delete
+        every kept segment while the live file grew anyway."""
+        try:
+            self._f.close()
+        except Exception:
+            pass
+        self._f = None
+        try:
+            # drop the end of the chain AND any segments beyond it — a
+            # previous run with a larger keep_segments leaves .k files
+            # this run's shift would otherwise never touch, silently
+            # breaking the (keep_segments + 1) * max_bytes disk bound
+            for k in self._segment_numbers(self.path):
+                if k >= self.keep_segments:
+                    os.remove(f"{self.path}.{k}")
+            for k in range(self.keep_segments - 1, 0, -1):
+                src = f"{self.path}.{k}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{k + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError as e:
+            warnings.warn(f"JSONLExporter: segment rotation of "
+                          f"{self.path} failed ({e}); rotation disabled "
+                          f"for this exporter", RuntimeWarning)
+            self.max_bytes = None
+        finally:
+            self._f = open(self.path, "a", encoding="utf-8")
+
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             try:
-                self._f.close()
+                if self._f is not None:
+                    self._f.close()
             except Exception:
                 pass
+
+    @staticmethod
+    def _segment_numbers(path: str) -> List[int]:
+        """Numeric suffixes of ``<path>.N`` segments on disk, ascending
+        — the ONE definition of what belongs to the rotation chain."""
+        ks = []
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        base = os.path.basename(path)
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    ks.append(int(suffix))
+        return sorted(ks)
 
     @staticmethod
     def load_jsonl(path: str) -> List[dict]:
@@ -74,6 +155,20 @@ class JSONLExporter:
                     rest = f.read().strip()
                     if rest:
                         raise
+        return out
+
+    @staticmethod
+    def load_rotated(path: str) -> List[dict]:
+        """Load the full rotated series oldest-first: ``<path>.N`` …
+        ``<path>.1`` then the live file, each through the torn-tail-
+        tolerant per-file parser (a rotated segment was closed cleanly,
+        but a crash can still tear its final line — same tolerance
+        applies)."""
+        out: List[dict] = []
+        for k in reversed(JSONLExporter._segment_numbers(path)):
+            out.extend(JSONLExporter.load_jsonl(f"{path}.{k}"))
+        if os.path.exists(path):
+            out.extend(JSONLExporter.load_jsonl(path))
         return out
 
 

@@ -30,17 +30,26 @@ from __future__ import annotations
 import math
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-           "registry", "enabled", "DEFAULT_BUCKETS"]
+           "registry", "enabled", "DEFAULT_BUCKETS", "MAX_LABEL_SETS"]
 
 # Prometheus-style default latency buckets (seconds), inf implied
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 _RESERVOIR = 1024        # recent observations kept per histogram series
+
+# label-cardinality guard (ISSUE 10 satellite): distinct label sets a
+# single metric may hold before new ones fold into the overflow series —
+# a buggy per-request label (rid=..., trace_id=...) must not grow
+# collect()/export cost without bound in a long-lived process
+MAX_LABEL_SETS = 128
+_OVERFLOW_LABELS = {"label_overflow": "true"}
+_OVERFLOW_KEY = (("label_overflow", "true"),)
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -60,6 +69,29 @@ class _Metric:
         self.unit = unit
         self._series: Dict[Tuple, object] = {}
         self._reg = registry
+        self._overflow_warned = False
+
+    def _slot(self, labels: Dict[str, str]) -> Tuple[Tuple, Dict]:
+        """(series key, effective labels) under the registry lock.
+        Existing series always resolve to themselves; a NEW label set
+        past the per-metric cap folds into the ``label_overflow="true"``
+        series (warned once per metric) so cardinality stays bounded
+        while the mutation is still counted somewhere visible."""
+        key = _label_key(labels)
+        if key in self._series:
+            return key, labels
+        cap = self._reg.max_label_sets
+        if cap is not None and len(self._series) >= cap \
+                and key != _OVERFLOW_KEY:
+            if not self._overflow_warned:
+                self._overflow_warned = True
+                warnings.warn(
+                    f"metric {self.name!r}: over {cap} distinct label "
+                    f"sets — folding new ones into label_overflow="
+                    f"\"true\" (check for an unbounded per-request "
+                    f"label)", RuntimeWarning, stacklevel=3)
+            return _OVERFLOW_KEY, dict(_OVERFLOW_LABELS)
+        return key, labels
 
     def _sample(self, labels: Dict[str, str], value: float) -> None:
         ring = self._reg._ring
@@ -69,6 +101,19 @@ class _Metric:
     def labels_seen(self) -> List[Dict[str, str]]:
         with self._reg._lock:
             return [dict(k) for k in self._series]
+
+    def clear(self, **labels) -> None:
+        """Drop one series. The percentile-publishing contract (ISSUE 10
+        satellite audit): a publisher whose source window went empty
+        clears its gauge rather than leaving the last value to read as
+        current — an absent series is honest (and is what the Staleness
+        rule kind watches for), a stale one lies. Disabled plane: no-op
+        like every other mutator — disable() disarms but deliberately
+        keeps series (reset() is the destructive call)."""
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            self._series.pop(_label_key(labels), None)
 
 
 class Counter(_Metric):
@@ -80,8 +125,8 @@ class Counter(_Metric):
             return
         if value < 0:
             raise ValueError(f"counter {self.name}: negative increment")
-        key = _label_key(labels)
         with reg._lock:
+            key, labels = self._slot(labels)
             self._series[key] = self._series.get(key, 0.0) + value
             self._sample(labels, self._series[key])
 
@@ -97,8 +142,8 @@ class Gauge(_Metric):
         reg = self._reg
         if not reg.enabled:
             return
-        key = _label_key(labels)
         with reg._lock:
+            key, labels = self._slot(labels)
             self._series[key] = float(value)
             self._sample(labels, float(value))
 
@@ -106,8 +151,8 @@ class Gauge(_Metric):
         reg = self._reg
         if not reg.enabled:
             return
-        key = _label_key(labels)
         with reg._lock:
+            key, labels = self._slot(labels)
             self._series[key] = self._series.get(key, 0.0) + float(value)
             self._sample(labels, self._series[key])
 
@@ -140,8 +185,8 @@ class Histogram(_Metric):
         if not reg.enabled:
             return
         value = float(value)
-        key = _label_key(labels)
         with reg._lock:
+            key, labels = self._slot(labels)
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = _HistSeries(len(self.buckets))
@@ -186,6 +231,8 @@ class MetricsRegistry:
         self._metrics: Dict[str, _Metric] = {}
         self.enabled = False
         self._ring: Optional[deque] = None
+        # per-metric distinct-label-set cap (None disables the guard)
+        self.max_label_sets: Optional[int] = MAX_LABEL_SETS
 
     # -- construction (get-or-create; idempotent by name) -------------------
 

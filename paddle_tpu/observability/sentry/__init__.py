@@ -1,0 +1,42 @@
+"""paddle_tpu.observability.sentry — declarative SLOs over the metrics
+plane, correlated incident capture, noise-aware bench regression gating.
+
+The closing third of the observability loop (ISSUE 10): PR 4's registry
+records, PR 9's cost observatory attributes, this package *watches*.
+
+Quickstart::
+
+    from paddle_tpu.observability import sentry as sn
+
+    rules = sn.trainer_rules() + sn.serving_rules(itl_p99_ceiling_s=0.2)
+    sn.install(sn.SloSentry(rules, incident_log="incidents.jsonl",
+                            flight_dump=True, min_interval_s=1.0))
+    trainer.fit(...)          # ticks at log boundaries
+    engine.run()              # ticks at drain boundaries
+    for inc in sn.active().incidents:
+        print(inc.rule, inc.severity, inc.context["goodput"])
+
+The bench half (:mod:`baselines` + ``tools/bench_diff.py``) applies the
+same watch-the-ratios discipline to the checked-in bench artifacts.
+"""
+
+from __future__ import annotations
+
+from . import baselines as baselines  # noqa: F401 (re-export module)
+from .baselines import (RATIO_METRICS, BenchDiff, RatioMetric, backend_of,
+                        diff_records, load_record, pin_baseline,
+                        ratio_metrics_of)
+from .rules import (EwmaSpike, RatioBand, SloRule, Staleness, Threshold,
+                    default_rules, serving_rules, trainer_rules)
+from .sentry import (Incident, SloSentry, active, install, maybe_tick,
+                     uninstall)
+
+__all__ = [
+    "SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
+    "trainer_rules", "serving_rules", "default_rules",
+    "Incident", "SloSentry", "install", "uninstall", "active",
+    "maybe_tick",
+    "baselines", "RatioMetric", "RATIO_METRICS", "BenchDiff",
+    "load_record", "backend_of", "ratio_metrics_of", "pin_baseline",
+    "diff_records",
+]

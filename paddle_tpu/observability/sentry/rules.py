@@ -1,0 +1,316 @@
+"""Declarative SLO rule vocabulary evaluated over metrics snapshots.
+
+A rule names ONE series in the ``MetricsRegistry.collect()`` snapshot
+(metric name + a label subset + a field — ``value`` for counters/gauges,
+``p50``/``p99``/``count``/``sum`` for histograms) and a breach predicate
+over its windowed value. The vocabulary is deliberately small — the same
+four shapes the reference stack's fleet monitors reduce to:
+
+* :class:`Threshold` — ceiling and/or floor on the value (or, with
+  ``delta=True``, on the per-window change — the rate form a monotonic
+  counter like ``pt_serving_pool_dry_drains_total`` needs);
+* :class:`EwmaSpike` — value exceeds ``spike_ratio`` x its own EWMA
+  (after a warmup), the step-time-jumped-3x detector;
+* :class:`RatioBand` — value ÷ a pinned baseline falls outside
+  ``[low, high]`` — the bench-variance policy's ratio-not-absolute
+  discipline as a live rule (and the drift band the sharding planner
+  reads to know its cost tables are stale);
+* :class:`Staleness` — the series is absent from the snapshot (or, with
+  ``require_change=True``, present but frozen) — the watcher's watcher:
+  a plane that silently stopped publishing looks healthy to every other
+  rule kind.
+
+Rules carry their own *hysteresis* (``breach_for`` consecutive breached
+windows before an incident) and *cooldown* (``cooldown_s`` between
+incidents while the breach persists) — both enforced by the sentry core,
+so every rule kind shares one tested implementation. A rule whose series
+is missing is SKIPPED, not breached (except Staleness, whose whole job is
+absence): a serving pack applied to a train-only process must stay quiet.
+
+Evaluation is pure bookkeeping over plain floats — no device work, no
+threads; per-rule mutable state lives in the dict the sentry owns, so a
+rule object itself is immutable and shareable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
+           "trainer_rules", "serving_rules", "default_rules"]
+
+
+class SloRule:
+    """Base: identity + series selector + hysteresis/cooldown knobs.
+
+    ``labels`` is a SUBSET match against a series' label set (``{}``
+    matches any); when several series match, the exact label set wins,
+    else the first in snapshot order. ``field`` picks the snapshot entry
+    key to read (histogram entries expose p50/p99/count/sum), plus the
+    derived ``window_mean`` — mean of a histogram's new observations
+    since the previous tick, the right input for a spike rule (reservoir
+    percentiles lag a transient by half the reservoir).
+    """
+
+    kind = "rule"
+
+    def __init__(self, name: str, metric: str,
+                 labels: Optional[Dict[str, str]] = None,
+                 field: str = "value", severity: str = "warning",
+                 breach_for: int = 1, cooldown_s: float = 60.0,
+                 description: str = ""):
+        if breach_for < 1:
+            raise ValueError(f"rule {name!r}: breach_for must be >= 1")
+        if severity not in ("info", "warning", "critical"):
+            raise ValueError(f"rule {name!r}: unknown severity "
+                             f"{severity!r}")
+        self.name = name
+        self.metric = metric
+        self.labels = dict(labels or {})
+        self.field = field
+        self.severity = severity
+        self.breach_for = int(breach_for)
+        self.cooldown_s = float(cooldown_s)
+        self.description = description
+
+    def check(self, value: Optional[float], state: dict,
+              now: float) -> Tuple[bool, dict]:
+        """One evaluation window: ``value`` is the resolved series value
+        (None = series missing). Returns ``(breached, stats)``; ``stats``
+        rides into the incident so the post-mortem carries the rule's
+        windowed view, not just "it fired"."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.name!r}, "
+                f"metric={self.metric!r})")
+
+
+class Threshold(SloRule):
+    """Ceiling and/or floor on the value; ``delta=True`` evaluates the
+    per-window change instead (first window establishes the anchor and
+    never breaches)."""
+
+    kind = "threshold"
+
+    def __init__(self, name: str, metric: str, ceiling: float = None,
+                 floor: float = None, delta: bool = False, **kw):
+        super().__init__(name, metric, **kw)
+        if ceiling is None and floor is None:
+            raise ValueError(f"rule {name!r}: need a ceiling or a floor")
+        self.ceiling = None if ceiling is None else float(ceiling)
+        self.floor = None if floor is None else float(floor)
+        self.delta = bool(delta)
+
+    def check(self, value, state, now):
+        if value is None:
+            return False, {"skipped": "series missing"}
+        if self.delta:
+            prev = state.get("prev")
+            state["prev"] = value
+            if prev is None:
+                return False, {"skipped": "first window (delta anchor)"}
+            value = value - prev
+        stats = {"value": value, "ceiling": self.ceiling,
+                 "floor": self.floor, "delta": self.delta}
+        breached = ((self.ceiling is not None and value > self.ceiling)
+                    or (self.floor is not None and value < self.floor))
+        return breached, stats
+
+
+class EwmaSpike(SloRule):
+    """Value exceeds ``spike_ratio`` x its own exponentially-weighted
+    moving average. The EWMA warms up for ``warmup`` windows before the
+    rule can breach. While a breach streak is still short of
+    ``breach_for`` the EWMA is FROZEN — each consecutive spiked window
+    is judged against the pre-spike average, otherwise the first
+    breached sample inflates the baseline and ``breach_for >= 2`` could
+    only ever fire on a spike that out-spiked its own absorption
+    (~spike_ratio² for the shipped defaults — a dead detector).
+    Once the streak reaches ``breach_for`` (the window the sentry
+    fires) absorption resumes, so a persistent level shift still
+    becomes the new normal and stops re-breaching after one incident —
+    the spike-vs-new-normal distinction this kind encodes (a permanent
+    shift belongs to Threshold/RatioBand)."""
+
+    kind = "ewma_spike"
+
+    def __init__(self, name: str, metric: str, spike_ratio: float = 2.0,
+                 alpha: float = 0.3, warmup: int = 3, **kw):
+        super().__init__(name, metric, **kw)
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"rule {name!r}: alpha must be in (0, 1]")
+        if spike_ratio <= 1.0:
+            raise ValueError(f"rule {name!r}: spike_ratio must be > 1")
+        self.spike_ratio = float(spike_ratio)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+
+    def check(self, value, state, now):
+        if value is None:
+            return False, {"skipped": "series missing"}
+        ewma = state.get("ewma")
+        n = state.get("ewma_n", 0)
+        breached = False
+        stats = {"value": value, "ewma": ewma,
+                 "spike_ratio": self.spike_ratio, "windows_seen": n}
+        if ewma is not None and n >= self.warmup:
+            breached = value > self.spike_ratio * ewma
+        # state["streak"] is the sentry's count BEFORE this window
+        if not breached or state.get("streak", 0) + 1 >= self.breach_for:
+            state["ewma"] = (value if ewma is None
+                             else ewma + self.alpha * (value - ewma))
+        state["ewma_n"] = n + 1
+        return breached, stats
+
+
+class RatioBand(SloRule):
+    """``value ÷ baseline`` outside ``[low, high]`` breaches. The
+    baseline is PINNED at rule-construction time (a bench artifact, a
+    design constant like 1.0 for a self-ratio such as
+    ``pt_step_time_predicted_over_measured``) — the rule never learns,
+    so it cannot normalize a slow drift away."""
+
+    kind = "ratio_band"
+
+    def __init__(self, name: str, metric: str, baseline: float,
+                 low: float = 0.75, high: float = 1.25, **kw):
+        super().__init__(name, metric, **kw)
+        if baseline <= 0:
+            raise ValueError(f"rule {name!r}: baseline must be positive")
+        if not low < high:
+            raise ValueError(f"rule {name!r}: need low < high")
+        self.baseline = float(baseline)
+        self.low = float(low)
+        self.high = float(high)
+
+    def check(self, value, state, now):
+        if value is None:
+            return False, {"skipped": "series missing"}
+        ratio = value / self.baseline
+        stats = {"value": value, "baseline": self.baseline,
+                 "ratio": ratio, "low": self.low, "high": self.high}
+        return (ratio < self.low or ratio > self.high), stats
+
+
+class Staleness(SloRule):
+    """Breaches when the series is ABSENT from the snapshot — or, with
+    ``require_change=True``, present but bit-identical to the previous
+    window (a counter that should be moving, a percentile gauge a dead
+    publisher left behind). Combine with ``breach_for`` for the number
+    of quiet windows tolerated."""
+
+    kind = "staleness"
+
+    def __init__(self, name: str, metric: str,
+                 require_change: bool = False, **kw):
+        super().__init__(name, metric, **kw)
+        self.require_change = bool(require_change)
+
+    def check(self, value, state, now):
+        prev = state.get("prev")
+        state["prev"] = value
+        if value is None:
+            return True, {"value": None, "reason": "series missing"}
+        if self.require_change and prev is not None and value == prev:
+            return True, {"value": value, "reason": "series frozen"}
+        return False, {"value": value}
+
+
+# ---------------------------------------------------------------------------
+# default rule packs
+# ---------------------------------------------------------------------------
+
+def trainer_rules(goodput_floor: float = 0.5,
+                  drift_band: Tuple[float, float] = (0.33, 3.0),
+                  step_spike_ratio: float = 3.0,
+                  breach_for: int = 3,
+                  cooldown_s: float = 300.0) -> List[SloRule]:
+    """The training-loop pack: watches the PR 4 goodput ledger and the
+    PR 9 cost-model drift at the log boundaries ``Trainer.fit`` already
+    crosses. Defaults are deliberately loose — a pack must be quiet on a
+    healthy run and demand ``breach_for`` consecutive bad windows, not
+    page on one noisy boundary."""
+    return [
+        Threshold(
+            "goodput_floor", "pt_goodput_fraction", floor=goodput_floor,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="productive wall-time fraction collapsed: the "
+                        "run is mostly compiling/checkpointing/replaying"),
+        RatioBand(
+            "step_time_predicted_drift",
+            "pt_step_time_predicted_over_measured",
+            labels={"component": "train"}, baseline=1.0,
+            low=drift_band[0], high=drift_band[1],
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="cost-model drift: the roofline prediction and "
+                        "the measured step time disagree past the band "
+                        "— recalibrate OpCostDB before trusting a plan"),
+        EwmaSpike(
+            "step_time_spike", "pt_train_step_seconds",
+            field="window_mean",
+            spike_ratio=step_spike_ratio, alpha=0.3, warmup=3,
+            severity="critical", breach_for=2, cooldown_s=cooldown_s,
+            description="per-step wall time spiked vs its own EWMA: "
+                        "input stall, thermal/contention event, or a "
+                        "recompile storm"),
+    ]
+
+
+def serving_rules(itl_p99_ceiling_s: float = 0.25,
+                  ttft_p99_ceiling_s: float = 2.0,
+                  prefix_hit_floor: float = 0.2,
+                  spec_accept_floor: float = 0.2,
+                  pool_dry_ceiling_per_window: float = 8.0,
+                  breach_for: int = 3,
+                  cooldown_s: float = 300.0) -> List[SloRule]:
+    """The serving pack over the engine's published gauges. The hit-rate
+    and accept-rate floors only engage on engines that publish those
+    series (prefix_cache / spec_k enabled) — missing series skip."""
+    return [
+        Threshold(
+            "itl_p99_ceiling", "pt_serving_itl_seconds",
+            labels={"q": "p99"}, ceiling=itl_p99_ceiling_s,
+            severity="critical", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="inter-token latency p99 over target: running "
+                        "decodes are stalling behind prefills or "
+                        "preemptions"),
+        Threshold(
+            "ttft_p99_ceiling", "pt_serving_ttft_seconds",
+            labels={"q": "p99"}, ceiling=ttft_p99_ceiling_s,
+            severity="critical", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="time-to-first-token p99 over target: admission "
+                        "queue is backing up"),
+        Threshold(
+            "prefix_hit_rate_floor", "pt_serving_prefix_hit_rate",
+            floor=prefix_hit_floor, severity="warning",
+            breach_for=breach_for, cooldown_s=cooldown_s,
+            description="radix-cache hit rate collapsed: workload "
+                        "stopped sharing prefixes or the tree is being "
+                        "evicted under pool pressure"),
+        Threshold(
+            "spec_accept_rate_floor", "pt_spec_accept_rate",
+            floor=spec_accept_floor, severity="warning",
+            breach_for=breach_for, cooldown_s=cooldown_s,
+            description="speculative accept rate collapsed: the draft "
+                        "provider no longer predicts this workload"),
+        Threshold(
+            "pool_dry_drain_rate", "pt_serving_pool_dry_drains_total",
+            ceiling=pool_dry_ceiling_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="KV pool running dry every window: capacity "
+                        "pressure — shrink admission or grow num_pages"),
+    ]
+
+
+def default_rules() -> List[SloRule]:
+    """trainer + serving packs at their defaults. Takes NO kwargs on
+    purpose: callers wanting tuned thresholds compose
+    ``trainer_rules(...) + serving_rules(...)`` directly — silently
+    ignoring a misplaced threshold kwarg would watch the wrong SLO."""
+    return trainer_rules() + serving_rules()

@@ -756,6 +756,10 @@ class Trainer:
                 history.append(m)
                 _obs.observe_train_metrics(m)
                 self._publish_step_costs(m)
+                # SLO sentry (ISSUE 10): rules evaluate at the same log
+                # boundary the gauges above were refreshed at — no
+                # sentry installed or plane off is a load + branch
+                _obs.sentry.maybe_tick()
                 if on_metrics:
                     on_metrics(m)
                 t_last = time.perf_counter()
@@ -880,6 +884,7 @@ class Trainer:
                         _obs.observe_train_metrics(m)
                         self._publish_step_costs(m, kind="superstep",
                                                  steps_per_exec=K)
+                        _obs.sentry.maybe_tick()
                         if on_metrics:
                             on_metrics(m)
                         # advance by the consumed share; the steps after the

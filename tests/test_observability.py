@@ -455,3 +455,210 @@ def test_obs_smoke_tool_in_process(tmp_path):
     assert out["ok"]
     assert out["jsonl_records"] > 0
     assert out["prom_metrics"] > 0
+
+
+# -- JSONL segment rotation (ISSUE 10 satellite) ----------------------------
+
+def _fake_snapshot(n=4):
+    return [{"name": f"pt_fake_{i}", "type": "gauge", "unit": "",
+             "labels": {}, "value": float(i)} for i in range(n)]
+
+
+def test_jsonl_rotation_boundary_and_reload(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    snap = _fake_snapshot()
+    exp = obs.JSONLExporter(path, max_bytes=1, keep_segments=2)
+    # max_bytes=1: EVERY export past the first rotates, but one export
+    # is never split across segments
+    for _ in range(5):
+        exp.export(snap)
+    exp.close()
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")   # keep-last-N enforced
+    # each segment holds whole exports (parseable independently)
+    for seg in (path, path + ".1", path + ".2"):
+        recs = obs.JSONLExporter.load_jsonl(seg)
+        assert len(recs) % len(snap) == 0 and recs
+    # rotated reload: oldest-first, newest data last, torn live tail
+    # still tolerated
+    with open(path, "a") as f:
+        f.write('{"torn')
+    allr = obs.JSONLExporter.load_rotated(path)
+    assert len(allr) == 3 * len(snap)        # live + 2 kept segments
+    assert allr[-1]["name"] == "pt_fake_3"
+
+
+def test_jsonl_no_rotation_without_max_bytes(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    exp = obs.JSONLExporter(path)
+    for _ in range(20):
+        exp.export(_fake_snapshot())
+    exp.close()
+    assert not os.path.exists(path + ".1")
+    assert len(obs.JSONLExporter.load_jsonl(path)) == 80
+    assert len(obs.JSONLExporter.load_rotated(path)) == 80
+
+
+def test_jsonl_rotation_preserves_order_across_boundary(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    exp = obs.JSONLExporter(path, max_bytes=400, keep_segments=3)
+    for i in range(12):
+        exp.export([{"name": "pt_seq", "type": "counter", "unit": "",
+                     "labels": {}, "value": float(i)}])
+    exp.close()
+    vals = [r["value"] for r in obs.JSONLExporter.load_rotated(path)
+            ]
+    # whatever survived retention is the most recent window, in order
+    assert vals == sorted(vals)
+    assert vals[-1] == 11.0
+
+
+# -- label-cardinality guard (ISSUE 10 satellite) ---------------------------
+
+def test_label_cardinality_guard_folds_overflow():
+    import warnings as _w
+    from paddle_tpu.observability.metrics import MAX_LABEL_SETS
+    obs.REGISTRY.enable()
+    g = obs.REGISTRY.gauge("pt_cardinality_probe", "guard test")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        for i in range(MAX_LABEL_SETS + 50):
+            g.set(float(i), rid=str(i))
+    warns = [w for w in caught if "label_overflow" in str(w.message)]
+    assert len(warns) == 1                  # warned ONCE
+    labels = g.labels_seen()
+    assert len(labels) <= MAX_LABEL_SETS + 1
+    assert {"label_overflow": "true"} in labels
+    # the overflow series keeps absorbing (last overflow write wins)
+    assert g.value(label_overflow="true") == float(MAX_LABEL_SETS + 49)
+    # existing series keep mutating normally past the cap
+    g.set(123.0, rid="0")
+    assert g.value(rid="0") == 123.0
+
+
+def test_label_cardinality_guard_counter_accumulates():
+    from paddle_tpu.observability.metrics import MAX_LABEL_SETS
+    obs.REGISTRY.enable()
+    c = obs.REGISTRY.counter("pt_cardinality_counter_probe", "guard")
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        for i in range(MAX_LABEL_SETS + 10):
+            c.inc(rid=str(i))
+    # 10 increments landed on the fold — no mutation was dropped
+    assert c.value(label_overflow="true") == 10.0
+    snap = [e for e in obs.REGISTRY.collect()
+            if e["name"] == "pt_cardinality_counter_probe"]
+    assert len(snap) <= MAX_LABEL_SETS + 1
+
+
+# -- percentile-gauge publishing audit (ISSUE 10 satellite) -----------------
+
+def test_empty_histogram_percentile_none_and_collect_omits():
+    obs.REGISTRY.enable()
+    h = obs.REGISTRY.histogram("pt_empty_hist_probe", "audit")
+    assert h.percentile(99) is None
+    entry = [e for e in obs.REGISTRY.collect()
+             if e["name"] == "pt_empty_hist_probe"][0]
+    # zeroed bucket/sum/count series for scrape stability, but NO
+    # p50/p99 keys — absent, not a stale zero
+    assert entry["count"] == 0
+    assert "p50" not in entry and "p99" not in entry
+
+
+def test_serving_percentile_gauges_cleared_when_window_empty():
+    obs.enable()
+    eng = tiny_engine()
+    rs = np.random.RandomState(0)
+    for L in (6, 8):
+        eng.submit(rs.randint(0, 32, (L,)).astype(np.int32))
+    eng.run()
+    names = lambda: {tuple(sorted(e["labels"].items()))  # noqa: E731
+                     for e in obs.collect()
+                     if e["name"] == "pt_serving_ttft_seconds"}
+    assert (("q", "p50"),) in names()
+    # window reset: the next publish must CLEAR the percentile series,
+    # not leave the previous values reading as current
+    eng.reset_latency_stats()
+    eng.publish_metrics()
+    assert names() == set()
+
+
+def test_jsonl_rotation_failure_disables_rotation_not_exporter(
+        tmp_path, monkeypatch):
+    """A filesystem that appends but refuses renames: ONE warned failed
+    rotation disables rotation for the exporter — it must not re-shift
+    (and delete) the kept chain every export, and must keep writing."""
+    import warnings as _w
+    path = str(tmp_path / "m.jsonl")
+    exp = obs.JSONLExporter(path, max_bytes=200, keep_segments=2)
+    exp.export(_fake_snapshot())
+
+    real_replace = os.replace
+
+    def deny(src, dst):
+        raise OSError("rename denied")
+
+    monkeypatch.setattr(os, "replace", deny)
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        exp.export(_fake_snapshot())     # triggers the failing rotation
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert any("rotation disabled" in str(w.message) for w in caught)
+    assert exp.max_bytes is None
+    # exporter still alive: subsequent exports append to the live file
+    exp.export(_fake_snapshot())
+    exp.close()
+    recs = obs.JSONLExporter.load_jsonl(path)
+    assert len(recs) == 3 * len(_fake_snapshot())
+    assert not os.path.exists(path + ".2")
+
+
+def test_jsonl_rotation_removes_segments_beyond_cap(tmp_path):
+    """Segments left by a previous run with a LARGER keep_segments must
+    be dropped at the next rotation — the shift loop alone never touches
+    them, breaking the (keep_segments + 1) * max_bytes disk bound and
+    prepending multi-run-old telemetry to every load_rotated()."""
+    path = str(tmp_path / "m.jsonl")
+    for k in (3, 4, 5):                          # stale wider-chain run
+        with open(f"{path}.{k}", "w") as f:
+            f.write('{"name": "pt_stale", "value": 0.0}\n')
+    exp = obs.JSONLExporter(path, max_bytes=1, keep_segments=2)
+    exp.export(_fake_snapshot())
+    exp.export(_fake_snapshot())                 # triggers a rotation
+    exp.close()
+    assert obs.JSONLExporter._segment_numbers(path) == [1]
+    assert all(r["name"] != "pt_stale"
+               for r in obs.JSONLExporter.load_rotated(path))
+
+
+def test_jsonl_export_after_close_raises(tmp_path):
+    """close() is final: the failed-rotation retry-open must not let a
+    REPLACED exporter (enable() called twice, stale handle kept) quietly
+    resurrect itself and interleave into the live writer's file."""
+    path = str(tmp_path / "m.jsonl")
+    exp = obs.JSONLExporter(path)
+    exp.export(_fake_snapshot())
+    exp.close()
+    with pytest.raises(ValueError, match="closed"):
+        exp.export(_fake_snapshot())
+    assert len(obs.JSONLExporter.load_jsonl(path)) == len(_fake_snapshot())
+
+
+def test_enable_passes_rotation_through(tmp_path):
+    """Segment rotation is reachable from the public entry point — a
+    long-lived job using obs.enable() must be able to bound its JSONL."""
+    path = str(tmp_path / "m.jsonl")
+    try:
+        obs.enable(jsonl_path=path, jsonl_max_bytes=1,
+                   jsonl_keep_segments=2)
+        exp = [e for e in obs.attached_exporters()
+               if isinstance(e, obs.JSONLExporter)][0]
+        assert exp.max_bytes == 1 and exp.keep_segments == 2
+        for _ in range(3):
+            exp.export(_fake_snapshot())
+        assert os.path.exists(path + ".1")
+    finally:
+        obs.disable()

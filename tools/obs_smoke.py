@@ -190,9 +190,47 @@ def _cost_leg(out_dir: str, errors: list) -> dict:
                              if k != "_skipped")}
 
 
+def _sentry_checks(out_dir: str, errors: list, sentry) -> dict:
+    """Sentry leg (ISSUE 10 satellite): the synthetic rule installed
+    before the train leg is breached by construction (any published
+    train loss exceeds its ceiling), so the REAL wiring — Trainer.fit
+    log-boundary ticks, engine drain ticks — must have fired exactly one
+    incident: hysteresis holds the first breached window, cooldown
+    suppresses the storm afterwards."""
+    from paddle_tpu.observability.metrics import REGISTRY
+    from paddle_tpu.observability.sentry import SloSentry
+
+    n = len(sentry.incidents)
+    if n != 1:
+        errors.append(f"synthetic sentry rule fired {n} incidents, "
+                      f"expected exactly 1 (hysteresis+cooldown)")
+    moved = REGISTRY.counter("pt_slo_incidents_total").value(
+        rule="smoke_synthetic_breach")
+    if moved < 1:
+        errors.append("pt_slo_incidents_total{rule=...} never moved")
+    inc_path = os.path.join(out_dir, "incidents.jsonl")
+    recs = SloSentry.load_incidents(inc_path) if os.path.exists(
+        inc_path) else []
+    if not recs:
+        errors.append("no incident landed in the incident JSONL")
+    else:
+        inc = recs[-1]
+        if inc.get("rule") != "smoke_synthetic_breach":
+            errors.append(f"unexpected incident rule: {inc.get('rule')}")
+        ctx = inc.get("context", {})
+        if not ctx.get("goodput", {}).get("total_s", 0) > 0:
+            errors.append("incident missing correlated goodput snapshot")
+        if not ctx.get("step_time_breakdown"):
+            errors.append("incident missing correlated step-time "
+                          "breakdown buckets")
+    return {"incidents": n, "ticks": sentry.ticks,
+            "jsonl_incidents": len(recs)}
+
+
 def main(out_dir: str) -> dict:
     os.makedirs(out_dir, exist_ok=True)
     import paddle_tpu.observability as obs
+    from paddle_tpu.observability import sentry as sn
     from paddle_tpu.observability.exporters import (JSONLExporter,
                                                     parse_prometheus)
 
@@ -202,11 +240,22 @@ def main(out_dir: str) -> dict:
     obs.ledger().reset()
     obs.enable(jsonl_path=jsonl_path, prom_path=prom_path,
                flight_dir=flight_dir)
+    # deliberately-breached synthetic rule: every published train loss
+    # exceeds the ceiling, so breach/hysteresis/cooldown ride the real
+    # log-boundary ticks (12 steps / log_every=4 = 3 windows)
+    sentry = sn.install(sn.SloSentry(
+        [sn.Threshold("smoke_synthetic_breach", "pt_train_loss",
+                      ceiling=-1e9, breach_for=2, cooldown_s=3600.0,
+                      severity="critical",
+                      description="obs_smoke synthetic always-breached "
+                                  "rule")],
+        incident_log=os.path.join(out_dir, "incidents.jsonl")))
     errors = []
     try:
         emissions = _train_leg()
         served, spec_stats, prefix_stats = _serving_leg()
         cost = _cost_leg(out_dir, errors)
+        sentry_out = _sentry_checks(out_dir, errors, sentry)
         obs.publish()
 
         # goodput invariant: buckets sum to accounted wall-time
@@ -239,7 +288,8 @@ def main(out_dir: str) -> dict:
                      "pt_model_flops_utilization",
                      "pt_hbm_bw_utilization",
                      "pt_step_time_breakdown",
-                     "pt_step_time_predicted_over_measured"):
+                     "pt_step_time_predicted_over_measured",
+                     "pt_slo_incidents_total"):
             if want not in names:
                 errors.append(f"{want} missing from JSONL series")
             if not any(k.startswith(want) for k in parsed):
@@ -270,6 +320,7 @@ def main(out_dir: str) -> dict:
             "prefix_cow_copies": int(
                 prefix_stats.get("prefix_cow_copies", 0)),
             "cost": cost,
+            "sentry": sentry_out,
             "jsonl_records": len(records),
             "prom_metrics": len(parsed),
             "goodput_fraction": t["goodput_fraction"],
@@ -277,6 +328,7 @@ def main(out_dir: str) -> dict:
             "errors": errors,
         }
     finally:
+        sn.uninstall()
         obs.disable()
     return summary
 
