@@ -1199,6 +1199,72 @@ def _graph_contracts_probe(on_tpu):
     return out
 
 
+def _planner_probe(on_tpu):
+    """Sharding-planner rows (ISSUE 11): predicted-vs-measured rank
+    order over the legal configs of a small mesh, on the micro model.
+
+    Ratio rows per the bench-variance policy:
+    ``planner_rank_agreement`` (pairwise concordance of the predicted
+    and measured step-time orderings), ``planner_top1_is_measured_top2``
+    (1.0 when the planner's pick lands in the measured top 2 — the
+    acceptance bar), ``planner_predicted_mfu`` (the chosen config's
+    predicted MFU), plus the chosen config string as a detail row.
+
+    With ≥4 local devices the validation runs inline on the real mesh;
+    a single-device host delegates to ``tools/plan.py --validate`` in a
+    subprocess on 8 virtual CPU devices (the dryrun tier) —
+    ``planner_backend`` records which, so cross-round readers know what
+    the numbers rode on."""
+    out = {}
+    try:
+        import jax
+        if jax.device_count() >= 4:
+            from paddle_tpu.distributed import auto_parallel as ap
+            from paddle_tpu.models import LlamaConfig
+            _log("planner: pricing configs on the local mesh")
+            mcfg = LlamaConfig(
+                vocab_size=320, hidden_size=64, intermediate_size=96,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=128)
+            n = 8 if jax.device_count() >= 8 else 4
+            rep = ap.plan(mcfg, n_devices=n, global_batch=8, seq_len=64,
+                          keep_builds=True, model_name="llama-micro")
+            v = ap.validate_rank_order(rep)
+            chosen_cfg = str(rep.chosen.config)
+            chosen_mfu = rep.chosen.predicted_mfu
+            out["planner_backend"] = "inline"
+        else:
+            import subprocess
+            _log("planner: validating on an 8-virtual-device subprocess")
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            cmd = [sys.executable,
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools", "plan.py"),
+                   "--mesh", "4x2", "--model", "llama-micro",
+                   "--batch", "8", "--seq", "64",
+                   "--validate", "--json", "--virtual-devices", "8"]
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=900, env=env)
+            if res.returncode != 0:
+                raise RuntimeError(f"plan.py rc={res.returncode}: "
+                                   f"{res.stderr[-300:]}")
+            d = json.loads(res.stdout.strip().splitlines()[-1])
+            v = d["validation"]
+            chosen_cfg = d["chosen"]
+            chosen_mfu = d["ranked"][0]["predicted_mfu"]
+            out["planner_backend"] = "cpu-subprocess"
+        out["planner_rank_agreement"] = round(v["agreement"], 4)
+        out["planner_top1_is_measured_top2"] = \
+            float(v["top1_is_measured_top2"])
+        out["planner_predicted_mfu"] = round(chosen_mfu, 4)
+        out["planner_chosen_config"] = chosen_cfg
+        out["planner_n_configs"] = v["n_configs"]
+    except Exception as e:
+        out["planner_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    return out
+
+
 _ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_artifacts")
 
@@ -1452,6 +1518,7 @@ def _run(error_note):
     detail.update(_loss_head_probe(cfg, on_tpu, step_s))
     detail.update(_obs_probe(on_tpu))
     detail.update(_graph_contracts_probe(on_tpu))
+    detail.update(_planner_probe(on_tpu))
     # noise-aware regression verdict vs the checked-in pinned baseline
     # (ISSUE 10): ratio metrics only, per the bench-variance policy —
     # the round records whether it moved past the band, mechanically

@@ -241,6 +241,59 @@ def build_tp_fused_ce() -> BuiltGraph:
         mesh=hm)
 
 
+def build_planner() -> BuiltGraph:
+    """The sharding planner's emit/price contract (ISSUE 11): price the
+    dp2×tp2 micro-model config, then compile the train step THROUGH the
+    emitted ``ShardingPlan`` (``Trainer.apply_plan`` — the consumer
+    path) and require the emitted graph's collective census to EXACTLY
+    match the priced census the planner ranked with. A pricing/emission
+    divergence (plan says replicate, runtime shards — or vice versa)
+    changes the census and fails CI like any other contract."""
+    import jax
+
+    if jax.device_count() < 4:
+        raise GraphSkipped("needs >= 4 devices (dp=2 x tp=2 mesh); run "
+                           "under XLA_FLAGS=--xla_force_host_platform_"
+                           "device_count=8")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from ..distributed.auto_parallel import (ParallelConfig,
+                                             price_config)
+    from ..models import LlamaForCausalLM
+    from ..optimizer import AdamW
+    from ..trainer import Trainer
+
+    cfg = _micro_cfg()
+    priced = price_config(ParallelConfig(dp=2, tp=2), cfg,
+                          devices=jax.devices()[:4], global_batch=4,
+                          seq_len=32, check_memory=False)
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    tr = Trainer(model, AdamW(learning_rate=1e-3, parameters=model),
+                 donate=False)
+    hm = tr.apply_plan(priced.plan, devices=jax.devices()[:4])
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (4, 33))
+    with hm:
+        batch = priced.plan.shard_batch(
+            {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}, hm)
+        tr._ensure_built()
+        args = (tr.params, tr.opt_state, batch, tr._lr_scalar(),
+                tr._key_data())
+        compiled = tr._step_jit.lower(*args).compile()
+    return BuiltGraph("planner", compiled, GraphContract(
+        "planner",
+        expect_collectives=dict(priced.graph.census_counts),
+        max_host_transfers=0,
+        notes=f"emitted {priced.config} plan == priced census "
+              f"(closed set)"),
+        mesh=hm, example_args=args)
+
+
 REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "train_step_k1": build_train_step_k1,
     "train_step_k4": build_train_step_k4,
@@ -249,6 +302,7 @@ REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "prefix_admit": build_prefix_admit,
     "fused_ce": build_fused_ce,
     "tp_fused_ce": build_tp_fused_ce,
+    "planner": build_planner,
 }
 
 

@@ -39,6 +39,21 @@ def _install_shard_map() -> None:
     jax.shard_map = shard_map
 
 
+def _install_pcast() -> None:
+    """``jax.lax.pcast`` (vma re-typing, jax ≥ 0.7) has no effect on
+    values — on releases without the varying-manual-axes type system the
+    identity is the exact semantics (the ring-attention dense fallback
+    uses it to mark its carry varying over the ring axis)."""
+    if hasattr(jax.lax, "pcast"):
+        return
+
+    def pcast(x, axes=None, *, to=None):
+        del axes, to
+        return x
+
+    jax.lax.pcast = pcast
+
+
 def _install_jax_ffi() -> None:
     """jax<0.5 ships the FFI surface as ``jax.extend.ffi``; alias it to the
     modern ``jax.ffi`` spelling (same functions: ffi_call, ffi_lowering,
@@ -73,4 +88,5 @@ def install_pallas_compat() -> None:
 
 
 _install_shard_map()
+_install_pcast()
 _install_jax_ffi()

@@ -1,13 +1,34 @@
-"""paddle.distributed.auto_parallel module-path parity (reference:
-python/paddle/distributed/auto_parallel/ — the semi-auto DistTensor API,
-api.py:118 shard_tensor etc.). The implementations live in
-paddle_tpu.parallel (GSPMD mesh/placement API); re-exported here so
-auto-parallel recipes import from the reference path."""
+"""paddle_tpu.distributed.auto_parallel — the semi-auto + search layer.
+
+Reference: python/paddle/distributed/auto_parallel/ — two halves:
+
+* the **semi-auto DistTensor API** (reference api.py:118 shard_tensor
+  etc.): implemented in ``paddle_tpu.parallel`` (GSPMD mesh/placement
+  API) and re-exported here so auto-parallel recipes import from the
+  reference path;
+* the **search half** (reference ``tuner``/``cost_model``: pick the
+  hybrid-parallel placement for the user): :mod:`planner` — enumerate
+  legal 4D ``(dp, tp, pp, sep)`` configs over a declared mesh, prune
+  with the per-chip HBM model (:mod:`memory_model`), price survivors by
+  compiling and attributing their real graphs (PR 8 collective census ×
+  PR 9 ``attribute_costs``/``price_census``/``OpCostDB``), and emit the
+  winner as concrete GSPMD annotations (:mod:`emit.ShardingPlan`) the
+  trainer consumes directly. ``tools/plan.py`` is the CLI face.
+"""
 
 from ...parallel.mesh import HybridMesh, current_mesh
 from ...parallel.api import (shard_tensor, reshard, shard_layer,
                              shard_optimizer_state, param_spec_tree,
                              Shard, Replicate, Partial)
+
+# the planner surface (ISSUE 11)
+from .planner import (ParallelConfig, PricedConfig, PlanReport,
+                      StaleCostModelError, InfeasibleMeshError,
+                      enumerate_configs, price_compiled, price_config,
+                      plan, rank_agreement, check_drift,
+                      validate_rank_order)
+from .memory_model import MemoryEstimate, estimate_hbm, hbm_capacity
+from .emit import ShardingPlan, emit_plan
 
 
 def dtensor_from_fn(fn, mesh=None, placements=(), *args, **kwargs):
@@ -22,4 +43,11 @@ from ..strategy import DistributedStrategy as Strategy
 __all__ = ["ProcessMesh", "shard_tensor", "reshard", "shard_layer",
            "shard_optimizer_state", "dtensor_from_fn", "Shard",
            "Replicate", "Partial", "Strategy", "HybridMesh",
-           "current_mesh", "param_spec_tree"]
+           "current_mesh", "param_spec_tree",
+           # planner API
+           "ParallelConfig", "PricedConfig", "PlanReport",
+           "StaleCostModelError", "InfeasibleMeshError",
+           "enumerate_configs", "price_compiled", "price_config",
+           "plan", "rank_agreement", "check_drift",
+           "validate_rank_order", "MemoryEstimate", "estimate_hbm",
+           "hbm_capacity", "ShardingPlan", "emit_plan"]

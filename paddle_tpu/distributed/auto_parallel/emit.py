@@ -1,0 +1,160 @@
+"""Plan emission: the winning config as concrete GSPMD annotations.
+
+The planner's output is not advice — it is the ``Mesh`` + per-parameter
+``NamedSharding``/``PartitionSpec`` table the trainer consumes directly
+(the pjit/mesh annotation surface of SNIPPETS.md [1][3]). A
+:class:`ShardingPlan` is deliberately a dumb, serializable artifact:
+axis sizes, a name→spec table, and the batch spec — so the ranked-table
+JSON a planning run persists can be loaded later and applied to a fresh
+model on a fresh mesh without re-running the search (the elastic-resume
+flow of ROADMAP item 6 re-plans only when the device count changed).
+
+``apply`` places parameters from the PLAN's table, not from live
+``Parameter.sharding`` annotations — that indirection is the point:
+an emission/pricing divergence (plan says replicate, annotation says
+shard) becomes visible as a census mismatch, which the graph_lint
+``planner`` budget pins in CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["ShardingPlan", "emit_plan"]
+
+
+def _spec_to_json(spec) -> List:
+    out = []
+    for e in tuple(spec):
+        if isinstance(e, (tuple, list)):
+            out.append(list(e))
+        else:
+            out.append(e)
+    return out
+
+
+def _spec_from_json(entries) -> "PartitionSpec":
+    from jax.sharding import PartitionSpec
+    fixed = [tuple(e) if isinstance(e, list) else e for e in entries]
+    return PartitionSpec(*fixed)
+
+
+@dataclass
+class ShardingPlan:
+    """One emitted plan: mesh axis sizes + parameter/batch specs."""
+    config_str: str
+    axes: Dict[str, int]                     # dp/fsdp/tp/pp/sep sizes
+    batch_spec: object                       # PartitionSpec
+    param_specs: Dict[str, object]           # name -> PartitionSpec
+    sequence_parallel: bool = False
+    notes: str = ""
+
+    # -- construction --------------------------------------------------------
+
+    def build_mesh(self, devices=None):
+        """The HybridMesh this plan shards over."""
+        from ...parallel.mesh import HybridMesh
+        import jax
+        n = 1
+        for v in self.axes.values():
+            n *= v
+        devices = (list(devices) if devices is not None
+                   else list(jax.devices()))[:n]
+        return HybridMesh.build(
+            dp=self.axes.get("dp", 1), fsdp=self.axes.get("fsdp", 1),
+            tp=self.axes.get("tp", 1), pp=self.axes.get("pp", 1),
+            sep=self.axes.get("sep", 1), devices=devices)
+
+    # -- application ---------------------------------------------------------
+
+    def apply(self, model, mesh=None, devices=None):
+        """Place every parameter of ``model`` per the plan table
+        (unlisted params replicate), buffers replicated — returns the
+        mesh so callers enter it for training. The GSPMD annotation
+        surface: ``NamedSharding(mesh, spec)`` per array.
+
+        The plan is keyed by parameter NAME: applying a plan emitted
+        for a different model class (a pp winner's pipe-stacked names
+        onto a plain model, or vice versa) would match nothing and
+        silently replicate everything — that mis-apply raises instead,
+        naming both sides."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        hm = mesh if mesh is not None else self.build_mesh(devices)
+        m = getattr(hm, "mesh", hm)
+        model_names = [name for name, _ in model.named_parameters()]
+        if self.param_specs and model_names:
+            matched = set(self.param_specs) & set(model_names)
+            # emit_plan records EVERY trainable param (empty specs
+            # included), so a same-model apply matches ~all names; a
+            # minority match means the plan was emitted for a different
+            # architecture (a pipe winner onto a plain model matches
+            # only the odd shared name like 'lm_head')
+            if len(matched) * 2 < len(model_names):
+                missing = sorted(set(model_names)
+                                 - set(self.param_specs))[:3]
+                raise ValueError(
+                    f"ShardingPlan({self.config_str}): only "
+                    f"{len(matched)}/{len(model_names)} parameters of "
+                    f"{type(model).__name__} appear in the plan's name "
+                    f"table (e.g. missing {missing}) — the plan was "
+                    f"emitted for a different model class/architecture "
+                    f"and would silently replicate everything; re-plan "
+                    f"for this model instead of applying a mismatched "
+                    f"artifact")
+        for name, p in model.named_parameters():
+            spec = self.param_specs.get(name, PartitionSpec())
+            p.value = jax.device_put(p.value, NamedSharding(m, spec))
+        for _, b in model.named_buffers():
+            b.value = jax.device_put(b.value,
+                                     NamedSharding(m, PartitionSpec()))
+        return hm
+
+    def shard_batch(self, batch: Dict, mesh=None):
+        """Place a training batch per the plan's batch spec."""
+        import jax
+        from jax.sharding import NamedSharding
+        hm = mesh if mesh is not None else self.build_mesh()
+        m = getattr(hm, "mesh", hm)
+        sh = NamedSharding(m, self.batch_spec)
+        return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+    # -- serialization -------------------------------------------------------
+
+    def as_dict(self) -> Dict:
+        return {"config": self.config_str, "axes": dict(self.axes),
+                "batch_spec": _spec_to_json(self.batch_spec),
+                "sequence_parallel": self.sequence_parallel,
+                "param_specs": {k: _spec_to_json(v)
+                                for k, v in self.param_specs.items()},
+                "notes": self.notes}
+
+    @staticmethod
+    def from_dict(d: Dict) -> "ShardingPlan":
+        return ShardingPlan(
+            config_str=d["config"], axes=dict(d["axes"]),
+            batch_spec=_spec_from_json(d["batch_spec"]),
+            param_specs={k: _spec_from_json(v)
+                         for k, v in d["param_specs"].items()},
+            sequence_parallel=bool(d.get("sequence_parallel", False)),
+            notes=d.get("notes", ""))
+
+
+def emit_plan(model, mesh, config) -> ShardingPlan:
+    """Freeze ``model``'s per-parameter placements on ``mesh`` into a
+    plan artifact. Uses the same ``param_spec_tree``/``_clean_spec``
+    definition the runtime sharding path uses — emission and execution
+    cannot disagree about what a spec means."""
+    from jax.sharding import PartitionSpec
+    from ...parallel.api import param_spec_tree, _clean_spec
+    m = getattr(mesh, "mesh", mesh)
+    axes = {name: int(m.shape[name]) for name in m.axis_names}
+    batch_spec = _clean_spec([("dp", "fsdp"), None], m)
+    return ShardingPlan(
+        config_str=str(config),
+        axes=axes,
+        batch_spec=batch_spec,
+        param_specs=param_spec_tree(model, mesh=m),
+        sequence_parallel=bool(getattr(config, "sep", 1) > 1),
+        notes=f"emitted for {config}")

@@ -1,0 +1,156 @@
+"""Per-config HBM model: the planner's feasibility gate.
+
+Before a candidate ``(dp, tp, pp, sep)`` config is worth a compile, it
+must FIT — params + optimizer state + gradients + activations under the
+model's remat policy, per chip. This module prices that closed-form from
+the ``LlamaConfig`` alone (no instantiation: pruning runs BEFORE the
+per-config compile the planner pays for survivors only).
+
+Conventions, and why each term looks the way it does:
+
+* **params** — analytical count from the config (embedding + L decoder
+  layers + final norm + untied lm_head), divided by ``tp * pp``: tensor
+  parallelism shards every projection along exactly one axis
+  (models/llama.py ``sharding=("fsdp","tp")`` annotations) and the pipe
+  model stacks layers over ``pp``. Norm vectors are replicated over tp
+  but are O(H) — lost in the noise, deliberately not special-cased.
+* **optimizer state** — slot count × fp32 per sharded param (AdamW: m+u,
+  ``optimizer.py _init_slots``), sharded like the params
+  (``shard_optimizer_state`` places slots with the param's spec).
+* **gradients** — one param-dtype copy; XLA's donation keeps only one
+  live generation, which is what the train-step budget pins.
+* **activations** — boundary activations per layer are
+  ``B/dp × S/sep × H`` (batch sharded over dp, sequence over sep — the
+  ``_seq_shard`` constraint); with remat "full" only boundaries survive
+  the forward plus one layer's recompute working set, without remat every
+  layer keeps its internal intermediates (qkv + attn out + the two MLP
+  halves ≈ ``4H + 2M`` per token). The fused CE head (PR 5) means NO
+  ``B×S×V`` logits term — the planner would otherwise veto every config
+  on vocab-heavy models for a buffer the runtime never materializes.
+
+The capacity table lives here (device_db carries bandwidths, not sizes)
+with the same public-spec sourcing discipline and a CPU tier so the
+planner is testable on smoke hosts. ``utilization`` headroom (default
+90%) covers XLA's workspace + fragmentation — same convention as the
+reference's memory estimater (auto_parallel cost model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+__all__ = ["HBM_CAPACITY", "MemoryEstimate", "estimate_hbm",
+           "hbm_capacity"]
+
+# bytes per chip (cloud.google.com/tpu/docs per-generation spec sheets;
+# same sources as observability/costs/device_db.py bandwidth tables)
+HBM_CAPACITY = {
+    "tpu v4": 32e9,          # 32 GiB
+    "tpu v5 lite": 16e9,     # v5e: 16 GiB
+    "tpu v5e": 16e9,
+    "tpu v5": 95e9,          # v5p: 95 GiB
+    "tpu v5p": 95e9,
+    "tpu v6 lite": 32e9,     # v6e (trillium): 32 GiB
+    "cpu": 8e9,              # nominal smoke-host tier
+}
+
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "float64": 8}
+
+
+def hbm_capacity(kind: Optional[str] = None) -> float:
+    """Capacity for ``kind`` (defaults to the current device), longest-
+    substring matched like every device_db lookup."""
+    if kind is None:
+        from ...observability.costs import current_device_kind
+        kind = current_device_kind()
+    kind = kind.lower()
+    best, best_len = HBM_CAPACITY["cpu"], -1
+    for k, v in HBM_CAPACITY.items():
+        if k in kind and len(k) > best_len:
+            best, best_len = v, len(k)
+    return best
+
+
+@dataclass
+class MemoryEstimate:
+    """Per-chip high-water estimate for one parallel config."""
+    params_bytes: float
+    opt_bytes: float
+    grads_bytes: float
+    acts_bytes: float
+    budget_bytes: float
+    feasible: bool
+    detail: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total_bytes(self) -> float:
+        return (self.params_bytes + self.opt_bytes + self.grads_bytes
+                + self.acts_bytes)
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"params_bytes": self.params_bytes,
+                "opt_bytes": self.opt_bytes,
+                "grads_bytes": self.grads_bytes,
+                "acts_bytes": self.acts_bytes,
+                "total_bytes": self.total_bytes,
+                "budget_bytes": self.budget_bytes,
+                "feasible": self.feasible}
+
+
+def _param_count(cfg) -> float:
+    """Analytical parameter count of a LlamaConfig-shaped model (matches
+    ``LlamaForCausalLM.num_params`` to the norm vectors)."""
+    H, M, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    hd = H // cfg.num_attention_heads
+    qkv = H * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * hd
+    per_layer = qkv + H * H + 3 * H * M + 2 * H      # attn + mlp + norms
+    n = V * H + L * per_layer + H                     # embed + layers + norm
+    if not getattr(cfg, "tie_word_embeddings", True):
+        n += H * V
+    return float(n)
+
+
+def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
+                 opt_slots: int = 2, budget_bytes: Optional[float] = None,
+                 device_kind: Optional[str] = None,
+                 utilization: float = 0.9) -> MemoryEstimate:
+    """Price one config's per-chip HBM high-water.
+
+    ``config`` carries ``dp/tp/pp/sep`` degrees (a planner
+    ``ParallelConfig`` or anything duck-shaped like one). ``opt_slots``
+    is the optimizer's fp32 slot count per param (AdamW m+u = 2).
+    ``budget_bytes`` overrides the device capacity lookup — the
+    HBM-infeasibility tests pin tiny budgets through it.
+    """
+    dp, tp, pp, sep = config.dp, config.tp, config.pp, config.sep
+    dt = _DTYPE_BYTES.get(getattr(model_cfg, "dtype", "float32"), 4)
+    H, M, L = (model_cfg.hidden_size, model_cfg.intermediate_size,
+               model_cfg.num_hidden_layers)
+
+    shard = float(tp * pp)
+    params_b = _param_count(model_cfg) * dt / shard
+    opt_b = _param_count(model_cfg) * 4.0 * opt_slots / shard
+    grads_b = params_b
+
+    tokens_local = (global_batch / dp) * (seq_len / sep)
+    boundary = tokens_local * H * dt                  # one layer boundary
+    remat = getattr(model_cfg, "recompute", "none") in ("full", "selective")
+    layers_local = L / pp
+    if remat:
+        # boundaries survive the forward; one layer re-runs at a time
+        acts_b = layers_local * boundary + (4 * H + 2 * M) / H * boundary
+    else:
+        # every layer keeps qkv/attn-out/gate/up intermediates
+        acts_b = layers_local * (boundary + (4 * H + 2 * M) / H * boundary)
+
+    budget = budget_bytes if budget_bytes is not None else \
+        hbm_capacity(device_kind) * utilization
+    total = params_b + opt_b + grads_b + acts_b
+    return MemoryEstimate(
+        params_bytes=params_b, opt_bytes=opt_b, grads_bytes=grads_b,
+        acts_bytes=acts_b, budget_bytes=float(budget),
+        feasible=total <= budget,
+        detail={"tokens_local": tokens_local,
+                "layers_local": layers_local, "dtype_bytes": dt})

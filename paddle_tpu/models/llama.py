@@ -847,6 +847,25 @@ class LlamaForCausalLMPipe(nn.Layer):
             logits = jnp.matmul(hidden, w.astype(hidden.dtype))
         return loss, logits
 
+    # -- size accounting (MFU calculator input) -----------------------------
+    # Same definitions as LlamaForCausalLM: the Trainer's MFU row and the
+    # sharding planner's predicted-MFU both call these, and a pipe model
+    # that reported 0 flops (missing attr) made every pp config look free.
+
+    def num_params(self) -> int:
+        return sum(int(math.prod(p.shape))
+                   for _, p in self.named_parameters())
+
+    def flops_per_token(self, seq_len: int, causal: bool = False) -> float:
+        cfg = self.cfg
+        n = self.num_params()
+        if not cfg.tie_word_embeddings:
+            n -= cfg.vocab_size * cfg.hidden_size  # gather-only table
+        attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        if causal:
+            attn *= (seq_len + 1) / (2 * seq_len)
+        return 6 * n + attn
+
     def loss_and_grads(self, params, input_ids, labels):
         """Fused 1F1B forward+backward over the pipeline (reference:
         pipeline_parallel.py:440 forward_backward_pipeline). Returns

@@ -100,6 +100,13 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("prefix_reuse_ttft_speedup", "lower", band=0.35),
     RatioMetric("prefix_hit_rate", "lower"),
     RatioMetric("loss_head_fused_speedup", "lower", band=0.35),
+    # sharding planner (ISSUE 11): rank-order validation vs measured.
+    # top1-in-top2 is binary (1.0 healthy) — any drop to 0 must page,
+    # hence the tight band; agreement is a 0.5-1.0 concordance score
+    # riding measured step times, so it keeps the wide default
+    RatioMetric("planner_top1_is_measured_top2", "lower", band=0.01),
+    RatioMetric("planner_rank_agreement", "lower", band=0.3),
+    RatioMetric("planner_predicted_mfu", "lower", cpu_band=0.45),
 ]}
 
 

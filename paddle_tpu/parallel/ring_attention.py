@@ -50,8 +50,14 @@ NEG_INF = -1e30
 
 def _block_attn(q, k, v, scale, mask):
     """One q-block vs one kv-block, returning (unnormalized acc, m, l).
-    q: [b, sq, h, d]; k/v: [b, sk, h, d]; mask [sq, sk] or [b, sq, sk]
-    (dense fallback path)."""
+    q: [b, sq, h, d]; k/v: [b, sk, h_kv, d]; mask [sq, sk] or
+    [b, sq, sk] (dense fallback path). GQA kv heads broadcast here — at
+    the block, so the rotating ring shards stay h_kv-sized (the flash
+    path leaves broadcasting to the kernel the same way)."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
@@ -111,13 +117,18 @@ def _merge_norm(out0, lse0, out1, lse1):
     return out0 * wt(w0) + out1 * wt(w1), lse_new
 
 
-def _ring_flash(q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal, scale,
-                bq, bk, interpret):
+def _ring_flash(pos_l, q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal,
+                scale, bq, bk, interpret):
     """shard_map-local ring attention on flash blocks with a hand-written
     ring VJP. All inputs are the per-device shards [b, sl, h(_kv), d];
     ``qseg_l``/``kseg_l`` [b, sl] (or None) carry packed-sequence segment
     ids — kseg rotates WITH its k/v block, and the kernel masks
-    cross-segment pairs in VMEM (no dense mask in HBM)."""
+    cross-segment pairs in VMEM (no dense mask in HBM). ``pos_l`` is the
+    device's [1] shard of ``arange(n)`` over the ring axis — the ring
+    index arrives as DATA because ``jax.lax.axis_index`` under a
+    partially-manual legacy shard_map lowers to a bare PartitionId the
+    SPMD partitioner rejects (jax < 0.6; same program either way on
+    modern releases)."""
     from ..ops.pallas.flash_attention import (flash_bwd_block,
                                               flash_fwd_block)
 
@@ -159,12 +170,12 @@ def _ring_flash(q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal, scale,
         return jnp.zeros((x.shape[0], 0), jnp.int32)
 
     @jax.custom_vjp
-    def ring(q_l, k_l, v_l, qs_l, ks_l):
-        out, lse = _ring_fwd(q_l, k_l, v_l, qs_l, ks_l)[0]
+    def ring(pos_l, q_l, k_l, v_l, qs_l, ks_l):
+        out, lse = _ring_fwd(pos_l, q_l, k_l, v_l, qs_l, ks_l)[0]
         return out.astype(q_l.dtype)
 
-    def _ring_fwd(q_l, k_l, v_l, qs_l, ks_l):
-        my = jax.lax.axis_index(axis)
+    def _ring_fwd(pos_l, q_l, k_l, v_l, qs_l, ks_l):
+        my = pos_l[0, 0]
         b, sl, h, d = q_l.shape
         out0 = vary(jnp.zeros((b, sl, h, d), jnp.float32))
         lse0 = vary(jnp.full((b, h, sl), NEG_INF, jnp.float32))
@@ -184,13 +195,14 @@ def _ring_flash(q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal, scale,
                    ks_l if has_seg else _seg0(k_l)), jnp.arange(n))
         return (out, lse), None
 
-    def ring_fwd_rule(q_l, k_l, v_l, qs_l, ks_l):
-        (out, lse), _ = _ring_fwd(q_l, k_l, v_l, qs_l, ks_l)
-        return out.astype(q_l.dtype), (q_l, k_l, v_l, qs_l, ks_l, out, lse)
+    def ring_fwd_rule(pos_l, q_l, k_l, v_l, qs_l, ks_l):
+        (out, lse), _ = _ring_fwd(pos_l, q_l, k_l, v_l, qs_l, ks_l)
+        return out.astype(q_l.dtype), (pos_l, q_l, k_l, v_l, qs_l, ks_l,
+                                       out, lse)
 
     def ring_bwd_rule(res, dout):
-        q_l, k_l, v_l, qs_l, ks_l, out, lse = res
-        my = jax.lax.axis_index(axis)
+        pos_l, q_l, k_l, v_l, qs_l, ks_l, out, lse = res
+        my = pos_l[0, 0]
         out_c = out.astype(q_l.dtype)
         dout_c = dout.astype(q_l.dtype)
 
@@ -247,11 +259,11 @@ def _ring_flash(q_l, k_l, v_l, qseg_l, kseg_l, axis, n, causal, scale,
                    dk0, dv0), jnp.arange(n))
         import numpy as _np
         zseg = lambda x: _np.zeros(x.shape, jax.dtypes.float0)
-        return (dq.astype(q_l.dtype), dk.astype(k_l.dtype),
+        return (zseg(pos_l), dq.astype(q_l.dtype), dk.astype(k_l.dtype),
                 dv.astype(v_l.dtype), zseg(qs_l), zseg(ks_l))
 
     ring.defvjp(ring_fwd_rule, ring_bwd_rule)
-    return ring(q_l, k_l, v_l,
+    return ring(pos_l, q_l, k_l, v_l,
                 qseg_l if has_seg else _seg0(q_l),
                 kseg_l if has_seg else _seg0(k_l))
 
@@ -293,6 +305,28 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
     blocks = _flash_blocks_ok(sl, h, h_kv, d, has_seg=has_seg,
                               interpret=interpret)
 
+    # Legacy jaxlib (< 0.6) cannot lower collective-permute inside a
+    # partially-manual shard_map when ANOTHER mesh axis has size > 1
+    # (hlo_sharding_util manual-subgroup check aborts; all-reduce-style
+    # collectives are fine, which is why the tp paths work). On those
+    # builds a hybrid mesh falls back to pure GSPMD: q stays
+    # seq-sharded, XLA all-gathers K/V over the ring axis — the
+    # Megatron-SP communication pattern, exact numerics, no manual
+    # lowering. Modern jax (and any single-manual-axis mesh) keeps the
+    # real ring.
+    if jax.__version_info__ < (0, 6) and any(
+            mesh_.shape[a] > 1 for a in mesh_.axis_names if a != axis):
+        from ..ops.attention import _sdpa_xla
+        return _sdpa_xla(q, k, v, causal=causal, scale=scale,
+                         segment_ids=segment_ids)
+
+    # each device's ring index as DATA (its [1, 1] shard of a [1, n]
+    # arange over the ring axis): see _ring_flash's docstring for why
+    # axis_index can't be used here. Rank 2 deliberately — a rank-1
+    # axis-sharded operand trips XLA's manual-subgroup sharding check
+    # under the legacy partial-manual lowering.
+    ring_pos = jnp.arange(n, dtype=jnp.int32)[None]
+
     if blocks is not None:
         bq, bk = blocks
         kw = dict(axis=axis, n=n, causal=causal, scale=scale, bq=bq,
@@ -301,30 +335,38 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
             fn = shard_map(
                 functools.partial(_ring_flash, **kw),
                 mesh=mesh_, axis_names=frozenset({axis}),
-                in_specs=(P(None, axis, None, None),) * 3
+                in_specs=(P(None, axis),)
+                + (P(None, axis, None, None),) * 3
                 + (P(None, axis), P(None, axis)),
                 out_specs=P(None, axis, None, None), check_vma=False)
-            return fn(q, k, v, segment_ids, segment_ids)
+            return fn(ring_pos, q, k, v, segment_ids, segment_ids)
         fn = shard_map(
             functools.partial(_ring_flash, qseg_l=None, kseg_l=None, **kw),
             mesh=mesh_, axis_names=frozenset({axis}),
-            in_specs=(P(None, axis, None, None),) * 3,
+            in_specs=(P(None, axis),)
+            + (P(None, axis, None, None),) * 3,
             out_specs=P(None, axis, None, None), check_vma=False)
-        return fn(q, k, v)
+        return fn(ring_pos, q, k, v)
 
     # dense fallback (unnormalized online-softmax ring; correctness-grade)
-    def local_fn(q_l, k_l, v_l, qs_l, ks_l):
-        my = jax.lax.axis_index(axis)
+    def local_fn(pos_l, q_l, k_l, v_l, qs_l, ks_l):
+        my = pos_l[0, 0]
         b, sl, h, _ = q_l.shape
         rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
         diag_mask = cols <= rows                         # intra-block causal
         perm = [(i, (i + 1) % n) for i in range(n)]      # rotate kv rightward
 
-        vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
-        acc0 = vary(jnp.zeros((b, sl, h, d), jnp.float32))
-        m0 = vary(jnp.full((b, h, sl), NEG_INF, jnp.float32))
-        l0 = vary(jnp.zeros((b, h, sl), jnp.float32))
+        # the running state is per-device ("varying over the ring"): seed
+        # it FROM the varying q shard instead of replicated constants —
+        # data dependence is the one spelling every jax release agrees
+        # marks it varying (modern vma typing and the legacy check_rep
+        # tracker alike; jax.lax.pcast only exists on ≥0.7)
+        zq = 0.0 * q_l.astype(jnp.float32)           # [b, sl, h, d]
+        zrow = jnp.moveaxis(zq[..., 0], 1, 2)        # [b, h, sl]
+        acc0 = zq
+        m0 = zrow + NEG_INF
+        l0 = zrow
 
         def step(carry, t):
             acc, m, l, k_cur, v_cur, ks_cur = carry
@@ -353,9 +395,10 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sep",
         return (acc / safe).astype(q_l.dtype)
 
     fn = shard_map(local_fn, mesh=mesh_, axis_names=frozenset({axis}),
-                   in_specs=(P(None, axis, None, None),) * 3
+                   in_specs=(P(None, axis),)
+                   + (P(None, axis, None, None),) * 3
                    + (P(None, axis), P(None, axis)),
                    out_specs=P(None, axis, None, None))
     # [b, 0] dummy when unpacked: nothing to shard, rotate, or read
     seg = segment_ids if has_seg else jnp.zeros((b, 0), jnp.int32)
-    return fn(q, k, v, seg, seg)
+    return fn(ring_pos, q, k, v, seg, seg)

@@ -503,6 +503,25 @@ class Trainer:
             self._offload = True
             self.opt_state = self._place_opt_state("pinned_host")
 
+    def apply_plan(self, plan, devices=None):
+        """Adopt a sharding-planner plan (ISSUE 11): place params and
+        optimizer state per the emitted ``ShardingPlan`` and return the
+        mesh to train under. The next dispatch recompiles against the
+        new placements automatically (the compile-cache aval signature
+        includes shardings). Usage::
+
+            report = auto_parallel.plan(cfg, n_devices=8)
+            hm = trainer.apply_plan(report.chosen.plan)
+            with hm:
+                trainer.fit(loader, steps=...)
+        """
+        from ..parallel.api import shard_optimizer_state
+        hm = plan.apply(self.model, devices=devices)
+        self.params = dict(self.model.raw_parameters())
+        self.opt_state = shard_optimizer_state(
+            self.opt_state, plan.param_specs, mesh=hm)
+        return hm
+
     def train_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
         """One optimization step. ``batch`` maps forward kwarg names to
         arrays (e.g. {"input_ids": ..., "labels": ...}). Returns the loss
