@@ -715,6 +715,179 @@ def _decode_bench(cfg, on_tpu):
     except Exception as e:
         out["chunked_prefill_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
+    try:
+        # serving fabric (ISSUE 12): 2 in-process replicas under a mixed
+        # two-tenant trace — 4 shared-prefix families (tenant "shared")
+        # + cold long prompts (tenant "cold") — affinity vs round-robin,
+        # interleaved min-of-rounds, RATIO rows (bench-variance policy).
+        # The pool is sized so ONE replica cannot hold every family's
+        # prefix: affinity partitions families across replicas and every
+        # admit hits; round-robin scatters them and the trees thrash.
+        from paddle_tpu.serving_fabric import (InProcTransport,
+                                               ServingFabric,
+                                               TenantFairPolicy,
+                                               build_replicas)
+        fb_page = 128 if on_tpu else 8
+        # family prefixes sized so a MISS costs a real prefill (the PR 7
+        # leg's scale: 160 shared tokens on cpu, 512 on tpu); TPU cold
+        # prompts capped at 896 — the dcfg rope table (max_position
+        # 1152) must cover prompt + new, same bound the chunked leg
+        # lives with
+        fb_fam_pages, fb_tail, fb_new = (4, 32, 16) if on_tpu \
+            else (20, 4, 6)
+        fb_cold_pages = 7 if on_tpu else 10
+        n_fam, per_fam, n_cold, fb_rounds = 4, 3, 2, 3
+        fb_rs = np.random.RandomState(6)
+        fam_heads = [fb_rs.randint(0, dcfg.vocab_size,
+                                   (fb_fam_pages * fb_page,))
+                     .astype(np.int32) for _ in range(n_fam)]
+        colds = [fb_rs.randint(0, dcfg.vocab_size,
+                               (fb_cold_pages * fb_page,))
+                 .astype(np.int32) for _ in range(n_cold)]
+
+        # ONE fixed trace — shuffled so round-robin cannot accidentally
+        # partition the families — reused by every leg and round: the
+        # A/B compares routing policies, so both legs must see the same
+        # prompts (and repeat rounds measure the steady state)
+        fb_fixed_trace = []
+        for j in range(per_fam):
+            for h in fam_heads:
+                fb_fixed_trace.append(("shared", np.concatenate(
+                    [h, fb_rs.randint(0, dcfg.vocab_size, (fb_tail,))
+                     .astype(np.int32)])))
+        for c in colds:
+            fb_fixed_trace.append(("cold", c))
+        fb_order = np.random.RandomState(3).permutation(
+            len(fb_fixed_trace))
+        fb_fixed_trace = [fb_fixed_trace[i] for i in fb_order]
+
+        def fb_trace():
+            return fb_fixed_trace
+
+        fb_max_len = (max(fb_fam_pages, fb_cold_pages) + 3) * fb_page
+        # per-replica pool: HALF the families' prefixes + a working set
+        # fit, all four do NOT — affinity partitions 2 families per
+        # replica and keeps hitting, round-robin sprays all 4 onto both
+        # and the trees thrash (the regime the router exists for)
+        fb_pages = (n_fam // 2) * fb_fam_pages + (8 if on_tpu else 4)
+
+        def fb_build(policy):
+            reps = build_replicas(
+                dmodel, 2, page_size=fb_page, max_len=fb_max_len,
+                max_batch=8, num_pages=fb_pages,
+                names=[f"{policy[:2]}0", f"{policy[:2]}1"],
+                generation_config=GenerationConfig(
+                    max_new_tokens=fb_new, do_sample=False))
+            return ServingFabric(InProcTransport(reps), policy=policy,
+                                 fair=TenantFairPolicy(),
+                                 name=f"bench-{policy}")
+
+        _log("decode: serving-fabric affinity-vs-round-robin A/B")
+        legs = {p: fb_build(p) for p in ("affinity", "round-robin")}
+        warm_streams = {}
+        for p, fb in legs.items():
+            # TWO warmup rounds: round 1 compiles the cold-prefill
+            # buckets and seeds the trees, round 2 reaches the steady
+            # eviction state whose suffix-prefill widths the timed
+            # rounds reuse (a fresh width mid-round is a ~1s retrace
+            # that would poison a TTFT percentile)
+            for _ in range(2):
+                fids = [fb.submit(pr, fb_new, tenant=tn)
+                        for tn, pr in fb_trace()]
+                res = fb.run()
+            warm_streams[p] = [res[f].tolist() for f in fids]
+        assert warm_streams["affinity"] == warm_streams["round-robin"], \
+            "fabric streams diverged across routing policies"
+        best_ttft = {p: float("inf") for p in legs}
+        best_tps = {p: 0.0 for p in legs}
+        for _ in range(fb_rounds):
+            for p, fb in legs.items():   # interleaved legs
+                fb.reset_latency_stats()
+                fids = [fb.submit(pr, fb_new, tenant=tn)
+                        for tn, pr in fb_trace()]
+                t0 = time.perf_counter()
+                res = fb.run()
+                dt = time.perf_counter() - t0
+                toks = sum(len(v) for v in res.values())
+                best_tps[p] = max(best_tps[p], toks / dt)
+                best_ttft[p] = min(
+                    best_ttft[p], fb.latency_stats()["ttft_p50_s"])
+        out["fabric_affinity_ttft_speedup"] = round(
+            best_ttft["round-robin"] / best_ttft["affinity"], 3)
+        out["fabric_goodput_ratio"] = round(
+            best_tps["affinity"] / best_tps["round-robin"], 3)
+        out["fabric_affinity_ttft_p50_s"] = round(
+            best_ttft["affinity"], 5)
+        out["fabric_rr_ttft_p50_s"] = round(
+            best_ttft["round-robin"], 5)
+        st = legs["affinity"].stats()
+        out["fabric_affinity_hits"] = st["affinity_hits"]
+        out["fabric_routed"] = st["routed"]
+        for p, fb in legs.items():
+            hr = [round(r.engine.prefix_hit_tokens
+                        / max(r.engine._prefix_prompt_tokens, 1), 3)
+                  for r in fb.transport._replicas.values()]
+            out[f"fabric_{p.replace('-', '_')}_hit_rates"] = hr
+        del legs
+
+        # disaggregation A/B: same 3-replica capacity, mixed trace of
+        # decode-heavy shorts + the cold long prompts; WITH a dedicated
+        # prefill replica + handoff the decode replicas never run the
+        # long cold prefill, so their ITL p99 holds — the ratio row is
+        # disagg ÷ no-disagg p99 ITL (< 1 is the win, worse=higher)
+        _log("decode: serving-fabric disaggregation A/B")
+        shorts = [fb_rs.randint(0, dcfg.vocab_size, (fb_page - 2,))
+                  .astype(np.int32) for _ in range(6)]
+
+        def dg_build(disagg):
+            reps = build_replicas(
+                dmodel, 3,
+                roles=(["prefill", "both", "both"] if disagg
+                       else ["both"] * 3),
+                page_size=fb_page, max_len=fb_max_len, max_batch=4,
+                names=[f"dg{'a' if disagg else 'b'}{i}"
+                       for i in range(3)],
+                generation_config=GenerationConfig(
+                    max_new_tokens=fb_new, do_sample=False))
+            return ServingFabric(
+                InProcTransport(reps), policy="least-loaded",
+                disagg_threshold_tokens=(2 * fb_page if disagg
+                                         else None),
+                name=f"bench-{'disagg' if disagg else 'plain'}")
+
+        def dg_run(fb):
+            fids = [fb.submit(s, fb_new, tenant="short")
+                    for s in shorts[:3]]
+            fids += [fb.submit(c, fb_new, tenant="cold")
+                     for c in colds]
+            fids += [fb.submit(s, fb_new, tenant="short")
+                     for s in shorts[3:]]
+            res = fb.run()
+            return [res[f].tolist() for f in fids]
+
+        dg_legs = {lbl: dg_build(d) for lbl, d in (("disagg", True),
+                                                   ("plain", False))}
+        dg_warm = {lbl: dg_run(fb) for lbl, fb in dg_legs.items()}
+        assert dg_warm["disagg"] == dg_warm["plain"], \
+            "disaggregated streams diverged from plain fabric"
+        dg_itl = {lbl: float("inf") for lbl in dg_legs}
+        for _ in range(fb_rounds):
+            for lbl, fb in dg_legs.items():
+                fb.reset_latency_stats()
+                dg_run(fb)
+                dg_itl[lbl] = min(dg_itl[lbl],
+                                  fb.latency_stats()["itl_p99_s"])
+        out["fabric_p99_itl_with_disagg_ratio"] = round(
+            dg_itl["disagg"] / dg_itl["plain"], 3)
+        out["fabric_disagg_itl_p99_s"] = round(dg_itl["disagg"], 5)
+        out["fabric_plain_itl_p99_s"] = round(dg_itl["plain"], 5)
+        out["fabric_handoffs"] = dg_legs["disagg"].stats()["handoffs"]
+        out["fabric_handoff_bytes"] = \
+            dg_legs["disagg"].stats()["handoff_bytes"]
+        del dg_legs
+    except Exception as e:
+        out["fabric_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
     def _amortized_ab_us(fa, fb, x0, length=20, rounds=6):
         """A/B kernel timing robust to a SHARED chip: each leg runs
         `length` applications chained in one compiled scan (per-call

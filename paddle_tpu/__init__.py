@@ -65,6 +65,7 @@ from . import decomposition
 from . import dataset
 from . import version
 from . import inference
+from . import serving_fabric
 from . import linalg
 from . import resilience
 from . import text

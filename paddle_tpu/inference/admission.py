@@ -30,6 +30,14 @@ The policy is deliberately host-pure and engine-agnostic: ``select``
 and ``choose_victim`` take plain snapshots, so unit tests drive them
 with synthetic gauges (tests/test_serving_prefix.py) and the engine
 calls them with live ones.
+
+In a multi-replica deployment this policy is the per-replica LEAF of
+the fabric's policy tree (ISSUE 12): ``serving_fabric.TenantFairPolicy``
+decides which tenant's request leaves the ROUTER's global queue
+(weighted fairness + token buckets, priced in the same uncached-suffix
+unit ``uncached_of`` computes here), and each engine's
+``SLOAdmissionPolicy`` still orders and defers its own admits against
+its own gauges.
 """
 
 from __future__ import annotations

@@ -208,6 +208,20 @@ class RadixPrefixCache:
         self._locks.append(lock)
         return lock
 
+    def match_page_ids(self, tokens, touch: bool = False) -> List[int]:
+        """Physical ids of every FULLY matched page along the match path
+        of ``tokens``, in order — the serialize_pages export set (and
+        exactly the pages ``lock_prefix`` could map). Defaults to a
+        non-touching read: an export must not bump LRU rank the way a
+        mapping admission does."""
+        ps = self.page_size
+        ids: List[int] = []
+        for child, m in self._walk(tokens, touch):
+            ids.extend(int(p) for p in child.pages[:m // ps])
+            if m < len(child.tokens):
+                break
+        return ids
+
     def page_at(self, tokens, page_index: int) -> Optional[int]:
         """Physical id of page ``page_index`` along the match path of
         ``tokens`` — the engine's COW source. The page is returned as

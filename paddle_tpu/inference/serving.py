@@ -113,6 +113,7 @@ tests/test_serving_spec.py, tests/test_serving_prefix.py).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import time
 from collections import deque
@@ -144,6 +145,11 @@ class _Request:
     top_p: float = 1.0
     do_sample: bool = False
     eos_token_id: Optional[int] = None
+    # sampling-stream identity: the value folded into the per-token keys
+    # (defaults to rid). A router re-admitting a request on ANOTHER
+    # engine passes the original identity so the sampled stream is
+    # engine-independent (serving_fabric failover replay).
+    rseed: Optional[int] = None
     generated: List[int] = field(default_factory=list)
     done: bool = False
     slot: int = -1                      # active slot, -1 = queued/finished
@@ -176,6 +182,11 @@ class _InflightBlock:
     steps: Optional[Dict[int, int]] = None
 
 
+# self-describing KV-page handoff payload format (serialize_pages /
+# adopt_pages); bump on any layout change — adoption REJECTS unknown fmts
+HANDOFF_FMT = "pt-kv-pages-v1"
+
+
 class _PoolDry(Exception):
     """Page pool exhausted while speculative blocks are still in flight:
     drain them first (retirements may free pages) before preempting."""
@@ -204,8 +215,17 @@ class ContinuousBatchingEngine:
                  attn_crossover: Optional[int] = None, spec_k: int = 0,
                  draft_provider: Optional[DraftProvider] = None,
                  prefix_cache: bool = False,
-                 admission: Optional[AdmissionPolicy] = None):
+                 admission: Optional[AdmissionPolicy] = None,
+                 name: Optional[str] = None):
         self.model = model
+        # replica identity (ISSUE 12 satellite): N engines in one process
+        # (the in-proc serving fabric) must not merge their registry
+        # series — every gauge/counter this engine publishes carries an
+        # engine=<name> label when a name is given. Unnamed engines keep
+        # their historical label-free series.
+        self.name = name or ""
+        self._mlabels: Dict[str, str] = ({"engine": self.name}
+                                         if self.name else {})
         self.core = getattr(model, "model", model)
         if spec_k and not hasattr(self.core, "decode_verify_paged"):
             raise ValueError(
@@ -303,6 +323,12 @@ class ContinuousBatchingEngine:
         self._price_cache: Dict[int, tuple] = {}   # rid -> (key, price)
         self._cow_fn = None                 # jitted page copy (COW)
         self._tail_fn = None                # 1-token re-forward for logits
+        # KV-page handoff (ISSUE 12): jitted gather/scatter for
+        # serialize_pages/adopt_pages + lifetime transfer counters
+        self._gather_fn = None
+        self._scatter_fn = None
+        self.pages_exported = 0
+        self.pages_adopted = 0
         # chunked prefill (Sarathi/vLLM prefill-extend): admission claims
         # pages but prefill proceeds one chunk per scheduler tick,
         # interleaved with decode of running slots — bounds the per-tick
@@ -367,8 +393,24 @@ class ContinuousBatchingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(self, input_ids, max_new_tokens: Optional[int] = None,
-               generation_config: Optional[GenerationConfig] = None) -> int:
+               generation_config: Optional[GenerationConfig] = None,
+               rseed: Optional[int] = None,
+               replay_prefix=None) -> int:
         """Queue one request; returns its id.
+
+        ``rseed`` overrides the sampling-stream identity folded into the
+        per-token keys (default: this engine's rid). A router spreading
+        one logical request stream across replicas — or re-admitting it
+        on a survivor after a replica death — passes the ORIGINAL
+        identity so sampled tokens are engine-independent.
+
+        ``replay_prefix`` seeds the request with tokens ALREADY emitted
+        by a previous incarnation (a failed replica): the engine treats
+        it exactly like its own recompute-preemption replay — the prefix
+        is re-prefilled (or prefix-cache mapped), generation resumes at
+        token index ``len(replay_prefix)`` with the remaining budget,
+        and the replay-exact keys make the continuation token-identical
+        to the uninterrupted stream.
 
         ``generation_config`` overrides the engine's sampling knobs
         (do_sample/temperature/top_k/top_p) and eos_token_id for THIS
@@ -392,14 +434,28 @@ class ContinuousBatchingEngine:
         if len(ids) + new > self.max_len:
             raise ValueError(f"prompt {len(ids)} + max_new {new} exceeds "
                              f"engine max_len {self.max_len}")
-        if -(-len(ids) // self.page_size) > self._total_pages:
-            raise ValueError(f"prompt needs more pages than the pool holds "
-                             f"({self._total_pages}); raise num_pages")
+        replay = ([] if replay_prefix is None
+                  else [int(t) for t in np.asarray(replay_prefix,
+                                                   np.int32).reshape(-1)])
+        if len(replay) >= new:
+            raise ValueError(f"replay_prefix ({len(replay)} tokens) "
+                             f"exhausts max_new_tokens ({new})")
+        # the replay prefix re-prefills WITH the prompt, so it counts
+        # against the pool here — otherwise a router failover re-submit
+        # passes validation and _admit raises mid-step, which would
+        # crash the whole fabric instead of failing one request
+        if -(-(len(ids) + len(replay)) // self.page_size) \
+                > self._total_pages:
+            raise ValueError(f"prompt needs more pages than the pool "
+                             f"holds ({self._total_pages}); raise "
+                             f"num_pages")
         req = _Request(next(self._rid), ids, new,
                        temperature=float(gc.temperature),
                        top_k=int(gc.top_k), top_p=float(gc.top_p),
                        do_sample=bool(gc.do_sample),
-                       eos_token_id=gc.eos_token_id)
+                       eos_token_id=gc.eos_token_id,
+                       rseed=None if rseed is None else int(rseed))
+        req.generated = replay
         req.submit_t = time.perf_counter()
         self._requests[req.rid] = req
         self._queue.append(req)
@@ -520,19 +576,171 @@ class ContinuousBatchingEngine:
                 self.spec_tokens_accepted / self._spec_drains)
         return out
 
+    def take_finished(self) -> Dict[int, np.ndarray]:
+        """Finished requests' full token streams (replay prefix
+        included), RELEASING them — the incremental analogue of
+        ``run()``'s collection for callers (a fabric replica) that drive
+        ``step()`` themselves and must observe completions between
+        ticks."""
+        out = {rid: np.asarray(r.generated, np.int32)
+               for rid, r in self._requests.items() if r.done}
+        for rid in out:
+            del self._requests[rid]
+        return out
+
+    # -- KV-page handoff (serving-fabric disaggregation, ISSUE 12) -----------
+
+    @staticmethod
+    def _handoff_bucket(n: int) -> int:
+        """Next power of two ≥ n: the gather/scatter executable count
+        stays O(log max pages) instead of one per distinct page
+        count."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def serialize_pages(self, tokens) -> Optional[Dict[str, object]]:
+        """Export the KV pages the radix tree holds for the longest
+        page-aligned prefix of ``tokens``: page contents (every layer's
+        K and V, gathered in one jitted dispatch), the covered token
+        run, and a sha256 over both — the prefill→decode handoff unit.
+        Returns None when the tree covers no full page of ``tokens``.
+
+        The payload is self-describing (``shape``/``dtype``/``sha256``)
+        so :meth:`adopt_pages` can validate it END-TO-END before
+        touching its own pool; the wire codec (base64 over TCP) lives in
+        ``serving_fabric.transport``, this dict is the in-process
+        form."""
+        if self._prefix is None:
+            raise RuntimeError("serialize_pages needs prefix_cache=True "
+                               "(the radix tree owns the exportable "
+                               "pages)")
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        ids = self._prefix.match_page_ids(toks)
+        if not ids:
+            return None
+        toks = toks[:len(ids) * self.page_size]
+        if self._gather_fn is None:
+            def run(pools, pids):
+                return jnp.stack(
+                    [jnp.stack([kp[:, pids], vp[:, pids]], axis=0)
+                     for kp, vp in pools], axis=0)
+            self._gather_fn = jax.jit(run)
+        # page count padded to a power-of-two bucket (extra rows read
+        # the garbage page, sliced off below): the jit retraces per
+        # page-count SHAPE, and unbucketed counts would pay a fresh
+        # compile per distinct prompt length on the serving path
+        b = self._handoff_bucket(len(ids))
+        kv = np.asarray(self._gather_fn(
+            self.pools,
+            jnp.asarray(ids + [0] * (b - len(ids)), jnp.int32)))
+        kv = np.ascontiguousarray(kv[:, :, :, :len(ids)])
+        self.pages_exported += len(ids)
+        return {"fmt": HANDOFF_FMT, "page_size": self.page_size,
+                "tokens": toks, "kv": kv, "dtype": str(kv.dtype),
+                "shape": list(kv.shape),
+                "sha256": hashlib.sha256(toks.tobytes()
+                                         + kv.tobytes()).hexdigest()}
+
+    def adopt_pages(self, payload) -> List[int]:
+        """Adopt a :meth:`serialize_pages` payload into THIS engine's
+        pool + radix tree: pages land in freshly allocated pool slots
+        (under pressure the allocator's existing tree eviction makes
+        room) and the token run is inserted at refcount 0 — cached, so
+        the NEXT admission of a matching prompt prefix-hits, which is
+        how a prefill→decode transfer seeds future sharing. Returns the
+        page ids that became tree-owned ([] when the tree already
+        covered the whole run).
+
+        Validation is strictly first: a corrupt, truncated or
+        mis-shaped payload raises ValueError before anything mutates."""
+        if self._prefix is None:
+            raise RuntimeError("adopt_pages needs prefix_cache=True")
+        if not isinstance(payload, dict) \
+                or payload.get("fmt") != HANDOFF_FMT:
+            raise ValueError("handoff payload: unknown format")
+        if int(payload.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"handoff payload: page_size {payload.get('page_size')} "
+                f"!= engine page_size {self.page_size}")
+        toks = np.asarray(payload.get("tokens"), np.int32).reshape(-1)
+        kv = payload.get("kv")
+        ps = self.page_size
+        if len(toks) == 0 or len(toks) % ps:
+            raise ValueError("handoff payload: token run is not a "
+                             "whole-page multiple")
+        n = len(toks) // ps
+        kp0, _ = self.pools[0]
+        want = (len(self.pools), 2, kp0.shape[0], n, ps, kp0.shape[3])
+        if not isinstance(kv, np.ndarray) or kv.shape != want \
+                or list(kv.shape) != list(payload.get("shape", [])):
+            raise ValueError(
+                f"handoff payload: kv shape "
+                f"{getattr(kv, 'shape', None)} != expected {want}")
+        if str(kv.dtype) != payload.get("dtype") \
+                or str(kv.dtype) != str(kp0.dtype):
+            raise ValueError(
+                f"handoff payload: dtype {payload.get('dtype')} != pool "
+                f"dtype {kp0.dtype}")
+        digest = hashlib.sha256(toks.tobytes() + kv.tobytes()).hexdigest()
+        if digest != payload.get("sha256"):
+            raise ValueError("handoff payload: checksum mismatch "
+                             "(corrupt or truncated transfer)")
+        # -- validated; now (and only now) touch the pool. Only the
+        # UNCOVERED whole-page suffix is staged: pages the tree already
+        # serves would be scattered and immediately freed — and worse,
+        # allocating them under pressure could evict the very cached
+        # prefixes the transfer exists to seed.
+        k = min(self._prefix.match(toks, touch=False) // ps, n)
+        if k >= n:
+            return []                   # tree already covers the run
+        pages = self._alloc_pages(n - k, protect=toks)
+        if pages is None:
+            raise RuntimeError(
+                f"adopt_pages: pool cannot hold {n - k} more pages "
+                f"even after tree eviction; raise num_pages")
+        if self._scatter_fn is None:
+            def run(pools, pids, data):
+                return [(kp.at[:, pids].set(data[i, 0]),
+                         vp.at[:, pids].set(data[i, 1]))
+                        for i, (kp, vp) in enumerate(pools)]
+            self._scatter_fn = jax.jit(run, donate_argnums=(0,))
+        # same power-of-two bucketing as the gather: padded rows write
+        # the garbage page (reserved junk — the designated sink)
+        b = self._handoff_bucket(n - k)
+        kv_pad = np.zeros(kv.shape[:3] + (b,) + kv.shape[4:], kv.dtype)
+        kv_pad[:, :, :, :n - k] = kv[:, :, :, k:]
+        self.pools = self._scatter_fn(
+            self.pools,
+            jnp.asarray(list(pages) + [0] * (b - (n - k)), jnp.int32),
+            jnp.asarray(kv_pad))
+        # insert walks the FULL run; the covered prefix needs page-id
+        # placeholders that are never read (insert only consumes ids
+        # from the first uncovered boundary on — and a coverage that
+        # ends mid-page donates nothing at all, freeing the stage)
+        donated = self._prefix.insert(toks, [0] * k + pages, lock=None)
+        assert all(p in set(pages) for p in donated), \
+            "placeholder page id donated to the tree"
+        taken = set(donated)
+        self._free.extend(p for p in pages if p not in taken)
+        self.pages_adopted += len(donated)
+        return donated
+
     # -- metrics plane -------------------------------------------------------
 
     def _tick_gauges(self) -> None:
         """Per-tick point-in-time view (cheap: five cached-handle gauge
         sets, and only ever reached when the registry is enabled)."""
-        self._g_queue.set(len(self._queue))
-        self._g_inflight.set(len(self._inflight))
-        self._g_active.set(sum(s is not None for s in self._slots))
-        self._g_free.set(len(self._free))
+        lb = self._mlabels
+        self._g_queue.set(len(self._queue), **lb)
+        self._g_inflight.set(len(self._inflight), **lb)
+        self._g_active.set(sum(s is not None for s in self._slots), **lb)
+        self._g_free.set(len(self._free), **lb)
         self._g_occupancy.set(
-            1.0 - len(self._free) / max(self._total_pages, 1))
+            1.0 - len(self._free) / max(self._total_pages, 1), **lb)
         if self._prefix is not None:
-            self._g_prefix_pages.set(self._prefix.num_pages)
+            self._g_prefix_pages.set(self._prefix.num_pages, **lb)
 
     def _decode_args(self, spec_mode: bool) -> tuple:
         """The decode tick's argument tuple — ONE definition shared by
@@ -594,6 +802,7 @@ class ContinuousBatchingEngine:
         lat = self.latency_stats()
         if not _REG.enabled:
             return lat
+        lb = self._mlabels
         for name, val, help in (
                 ("pt_serving_preemptions_total", self.preemptions,
                  "recompute-policy slot evictions"),
@@ -616,20 +825,20 @@ class ContinuousBatchingEngine:
                  "shared pages copy-on-written at divergence")):
             prev = self._published.get(name, 0)
             if val > prev:
-                _REG.counter(name, help).inc(val - prev)
+                _REG.counter(name, help).inc(val - prev, **lb)
             self._published[name] = val
         sp = self.spec_stats()
         if "spec_accept_rate" in sp:
             _REG.gauge("pt_spec_accept_rate",
                        "accepted / proposed speculative drafts").set(
-                sp["spec_accept_rate"])
+                sp["spec_accept_rate"], **lb)
         if "spec_mean_accepted_len" in sp:
             _REG.gauge("pt_spec_mean_accepted_len",
                        "mean committed tokens per speculative drain").set(
-                sp["spec_mean_accepted_len"])
+                sp["spec_mean_accepted_len"], **lb)
         if self._prefix is not None and self._prefix_prompt_tokens:
             self._g_prefix_hit.set(self.prefix_hit_tokens
-                                   / self._prefix_prompt_tokens)
+                                   / self._prefix_prompt_tokens, **lb)
         for key, metric in (("ttft", "pt_serving_ttft_seconds"),
                             ("latency", "pt_serving_latency_seconds"),
                             ("itl", "pt_serving_itl_seconds")):
@@ -638,16 +847,16 @@ class ContinuousBatchingEngine:
                 g = _REG.gauge(metric, f"{key} percentile over the "
                                        f"retired-request window", "s")
                 if v is not None:
-                    g.set(v, q=q)
+                    g.set(v, q=q, **lb)
                 else:
                     # empty/reset window: CLEAR rather than leave the
                     # previous publish reading as current — an absent
                     # percentile is honest (and what the sentry's
                     # Staleness rule exists to notice), a stale one lies
-                    g.clear(q=q)
+                    g.clear(q=q, **lb)
         _REG.gauge("pt_serving_window_requests",
                    "retired requests in the latency window").set(
-            lat.get("requests", 0))
+            lat.get("requests", 0), **lb)
         self._publish_cost_metrics()
         self._tick_gauges()
         return lat
@@ -756,7 +965,8 @@ class ContinuousBatchingEngine:
             self._state, self._knobs, np.int32(slot), logits_row,
             np.int32(L), np.int32(req.max_new_tokens - len(req.generated)),
             np.int32(len(req.generated)),
-            np.uint32(req.rid & 0x7FFFFFFF),
+            np.uint32((req.rid if req.rseed is None else req.rseed)
+                      & 0x7FFFFFFF),
             np.int32(-1 if eos is None else eos),
             np.float32(req.temperature), np.int32(req.top_k),
             np.float32(req.top_p), np.bool_(req.do_sample))
@@ -1663,4 +1873,4 @@ class _null:
         return False
 
 
-__all__ = ["ContinuousBatchingEngine"]
+__all__ = ["ContinuousBatchingEngine", "HANDOFF_FMT"]

@@ -99,6 +99,13 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("spec_mean_accepted_len", "lower"),
     RatioMetric("prefix_reuse_ttft_speedup", "lower", band=0.35),
     RatioMetric("prefix_hit_rate", "lower"),
+    # serving fabric (ISSUE 12): within-run A/B ratios over interleaved
+    # min-of-rounds legs — affinity÷round-robin TTFT and goodput, and
+    # the disagg÷no-disagg decode ITL p99 (lower is better there, so
+    # HIGHER is worse; generous band, ITL p99 tails ride host noise)
+    RatioMetric("fabric_affinity_ttft_speedup", "lower", band=0.35),
+    RatioMetric("fabric_goodput_ratio", "lower", band=0.35),
+    RatioMetric("fabric_p99_itl_with_disagg_ratio", "higher", band=0.5),
     RatioMetric("loss_head_fused_speedup", "lower", band=0.35),
     # sharding planner (ISSUE 11): rank-order validation vs measured.
     # top1-in-top2 is binary (1.0 healthy) — any drop to 0 must page,

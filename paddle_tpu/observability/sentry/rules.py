@@ -37,7 +37,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
-           "trainer_rules", "serving_rules", "default_rules"]
+           "trainer_rules", "serving_rules", "fabric_rules",
+           "default_rules"]
 
 
 class SloRule:
@@ -306,6 +307,93 @@ def serving_rules(itl_p99_ceiling_s: float = 0.25,
             description="KV pool running dry every window: capacity "
                         "pressure — shrink admission or grow num_pages"),
     ]
+
+
+def fabric_rules(replicas: Optional[List[str]] = None,
+                 ttft_p99_ceiling_s: float = 2.0,
+                 itl_p99_ceiling_s: float = 0.25,
+                 replica_itl_p99_ceiling_s: Optional[float] = None,
+                 prefix_hit_floor: float = 0.2,
+                 replicas_alive_floor: Optional[float] = None,
+                 handoff_failures_per_window: float = 2.0,
+                 breach_for: int = 3,
+                 cooldown_s: float = 300.0) -> List[SloRule]:
+    """The serving-fabric pack (ISSUE 12): AGGREGATE p99 TTFT/ITL
+    ceilings at the router boundary, a replica-death floor on the
+    heartbeat gauge, a per-window handoff-failure ceiling, and — when
+    ``replicas`` names the pool — a per-replica prefix-hit-rate floor
+    and ITL ceiling over the engine series' ``engine=<name>`` label
+    sets. Per-replica rules skip while that replica publishes nothing
+    (the serving pack's missing-series contract), so one pack serves
+    any pool size.
+
+    ``replicas_alive_floor`` defaults to ``len(replicas)`` when the
+    pool is named (any death pages after ``breach_for`` windows) and
+    stays off otherwise. ``replica_itl_p99_ceiling_s`` defaults to the
+    aggregate ceiling."""
+    rules: List[SloRule] = [
+        Threshold(
+            "fabric_ttft_p99_ceiling", "pt_fabric_ttft_seconds",
+            labels={"q": "p99"}, ceiling=ttft_p99_ceiling_s,
+            severity="critical", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="fabric-aggregate time-to-first-token p99 over "
+                        "target: the global queue is backing up or "
+                        "routing is concentrating load"),
+        Threshold(
+            "fabric_itl_p99_ceiling", "pt_fabric_itl_seconds",
+            labels={"q": "p99"}, ceiling=itl_p99_ceiling_s,
+            severity="critical", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="fabric-aggregate inter-token latency p99 over "
+                        "target: decode replicas are stalling (cold "
+                        "long prefills landing on them? disaggregate)"),
+        Threshold(
+            "fabric_handoff_failure_rate",
+            "pt_fabric_handoff_failures_total",
+            ceiling=handoff_failures_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="prefill→decode handoffs failing every window: "
+                        "transfers are corrupt, pools too small to "
+                        "adopt, or a transport is flapping — requests "
+                        "are falling back to cold serving"),
+    ]
+    if replicas:
+        if replicas_alive_floor is None:
+            replicas_alive_floor = float(len(replicas))
+        per_itl = (replica_itl_p99_ceiling_s
+                   if replica_itl_p99_ceiling_s is not None
+                   else itl_p99_ceiling_s)
+        for r in replicas:
+            rules.append(Threshold(
+                f"fabric_replica_{r}_prefix_hit_floor",
+                "pt_serving_prefix_hit_rate",
+                labels={"engine": r}, floor=prefix_hit_floor,
+                severity="warning", breach_for=breach_for,
+                cooldown_s=cooldown_s,
+                description=f"replica {r}: radix hit rate collapsed — "
+                            f"affinity routing stopped landing its "
+                            f"prefix traffic here, or its tree is "
+                            f"being evicted under pool pressure"))
+            rules.append(Threshold(
+                f"fabric_replica_{r}_itl_p99_ceiling",
+                "pt_serving_itl_seconds",
+                labels={"engine": r, "q": "p99"}, ceiling=per_itl,
+                severity="critical", breach_for=breach_for,
+                cooldown_s=cooldown_s,
+                description=f"replica {r}: decode ITL p99 over its "
+                            f"ceiling — the router's hysteresis should "
+                            f"be spilling affinity traffic off it"))
+    if replicas_alive_floor is not None:
+        rules.append(Threshold(
+            "fabric_replicas_alive_floor", "pt_fabric_replicas_alive",
+            floor=replicas_alive_floor, severity="critical",
+            breach_for=1, cooldown_s=cooldown_s,
+            description="router lost contact with at least one "
+                        "replica: failover re-admission is running, "
+                        "capacity is reduced"))
+    return rules
 
 
 def default_rules() -> List[SloRule]:

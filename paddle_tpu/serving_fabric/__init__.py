@@ -1,0 +1,50 @@
+"""paddle_tpu.serving_fabric — router + replica pool + disaggregated
+prefill/decode over N ContinuousBatchingEngines (ISSUE 12).
+
+The L6 orchestration layer (reference: ``fleet``/``ps``/``rpc``) for the
+serving stack PRs 3/6/7 built inside one engine:
+
+* :class:`ServingFabric` — the front door: global queue, PREFIX-AFFINITY
+  routing on replica-advertised digests (least-loaded fallback, ITL
+  hysteresis), per-tenant weighted fair admission, prefill/decode
+  disaggregation via KV-page handoff, and failover re-admission with
+  replay-exact streams.
+* :class:`Replica` / :func:`build_replicas` — one engine behind the
+  fabric verb set (submit/poll/status/extract/adopt).
+* :class:`InProcTransport` / :class:`TcpTransport` — the fleet/rpc
+  split: same verbs in-process (tier-1, chaos) or over JSON/TCP.
+* :class:`PrefixDigest` — the compact routing signal: rolling page
+  fingerprints of a replica's radix-tree top.
+* :class:`TenantFairPolicy` / :class:`TenantSpec` — router-level
+  weighted fairness + token-bucket quotas priced in uncached-suffix
+  tokens.
+
+Quickstart::
+
+    from paddle_tpu.serving_fabric import (ServingFabric, InProcTransport,
+                                           build_replicas)
+
+    reps = build_replicas(model, 2, page_size=128, max_len=2048)
+    fabric = ServingFabric(InProcTransport(reps), policy="affinity")
+    fid = fabric.submit(prompt_ids, max_new_tokens=64, tenant="a")
+    out = fabric.run()          # {fid: np.ndarray tokens}
+"""
+
+from __future__ import annotations
+
+from .digest import PrefixDigest
+from .fair import TenantFairPolicy, TenantSpec
+from .replica import Replica, build_replicas
+from .router import FabricRequest, ServingFabric
+from .transport import (FabricTransport, InProcTransport, ReplicaDown,
+                        TcpReplicaServer, TcpTransport, payload_from_wire,
+                        payload_to_wire)
+
+__all__ = [
+    "ServingFabric", "FabricRequest",
+    "Replica", "build_replicas",
+    "FabricTransport", "InProcTransport", "TcpTransport",
+    "TcpReplicaServer", "ReplicaDown",
+    "payload_to_wire", "payload_from_wire",
+    "PrefixDigest", "TenantFairPolicy", "TenantSpec",
+]

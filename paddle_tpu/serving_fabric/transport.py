@@ -1,0 +1,331 @@
+"""FabricTransport: how the router reaches its replicas.
+
+The reference stack splits orchestration (``fleet``) from the byte
+mover (``rpc``); this module is that split for the serving fabric. The
+router speaks ONE verb set — submit / poll / status / extract / adopt —
+against a :class:`FabricTransport`, and two implementations provide it:
+
+* :class:`InProcTransport` — N :class:`~.replica.Replica` objects in one
+  process, direct method calls. This is the tier-1/CI shape (CPU, no
+  sockets) and the chaos harness's: ``kill()`` drops a replica exactly
+  the way a SIGKILL would look from the router's side — every
+  subsequent op raises :class:`ReplicaDown`, with no goodbye.
+* :class:`TcpTransport` + :class:`TcpReplicaServer` — newline-delimited
+  JSON over TCP for multi-host; KV-page handoff payloads cross as
+  base64 (:func:`payload_to_wire` / :func:`payload_from_wire`). Thin on
+  purpose: framing, encoding and death detection only — routing policy
+  never leaks down here.
+
+Every fault surfaces as :class:`ReplicaDown`; the ROUTER owns recovery
+(re-admission with the request's remaining budget), transports only
+detect.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ReplicaDown", "FabricTransport", "InProcTransport",
+           "TcpTransport", "TcpReplicaServer", "payload_to_wire",
+           "payload_from_wire"]
+
+
+class ReplicaDown(RuntimeError):
+    """The replica is unreachable/dead; the router must fail over."""
+
+    def __init__(self, name: str, why: str = ""):
+        super().__init__(f"replica {name!r} is down"
+                         + (f": {why}" if why else ""))
+        self.name = name
+
+
+class FabricTransport:
+    """The verb set the router drives; every method may raise
+    :class:`ReplicaDown` for its replica."""
+
+    def replica_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def submit(self, name: str, req: dict) -> int:
+        """Queue a request payload on ``name``; returns its local rid."""
+        raise NotImplementedError
+
+    def poll(self, name: str) -> dict:
+        """Advance ``name`` one scheduler tick; returns
+        ``{"emitted": [[rid, tok], ...], "finished": {rid: [tokens]}}``."""
+        raise NotImplementedError
+
+    def status(self, name: str) -> dict:
+        """Heartbeat: load, pool, latency gauges + prefix digest."""
+        raise NotImplementedError
+
+    def extract(self, name: str, tokens) -> Optional[dict]:
+        """serialize_pages on ``name`` for ``tokens`` (handoff source)."""
+        raise NotImplementedError
+
+    def adopt(self, name: str, payload: dict) -> int:
+        """adopt_pages on ``name``; returns pages adopted."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# in-process
+# ---------------------------------------------------------------------------
+
+class InProcTransport(FabricTransport):
+    """N replicas, one process — the tier-1-testable fabric. ``kill``
+    simulates replica death for the chaos tests: the object stays (its
+    pages/engine die with it conceptually) but every op raises
+    :class:`ReplicaDown` from then on."""
+
+    def __init__(self, replicas):
+        # accepts a list (names from the replicas) or a dict
+        if isinstance(replicas, dict):
+            self._replicas = dict(replicas)
+        else:
+            self._replicas = {r.name: r for r in replicas}
+        self._dead: set = set()
+
+    def _get(self, name: str):
+        if name in self._dead:
+            raise ReplicaDown(name, "killed")
+        r = self._replicas.get(name)
+        if r is None:
+            raise ReplicaDown(name, "unknown replica")
+        return r
+
+    def replica_names(self) -> List[str]:
+        return list(self._replicas)
+
+    def kill(self, name: str) -> None:
+        """Drop ``name`` mid-whatever-it-was-doing (chaos helper)."""
+        self._dead.add(name)
+
+    def alive(self, name: str) -> bool:
+        return name in self._replicas and name not in self._dead
+
+    def submit(self, name, req):
+        return self._get(name).submit(req)
+
+    def poll(self, name):
+        return self._get(name).poll()
+
+    def status(self, name):
+        return self._get(name).status()
+
+    def extract(self, name, tokens):
+        return self._get(name).extract(tokens)
+
+    def adopt(self, name, payload):
+        return self._get(name).adopt(payload)
+
+
+# ---------------------------------------------------------------------------
+# KV-payload wire codec (shared by the TCP transport and any file/queue
+# transport a deployment adds)
+# ---------------------------------------------------------------------------
+
+def payload_to_wire(payload: dict) -> dict:
+    """serialize_pages dict → JSON-safe dict (tokens as list, kv as
+    base64 of the raw buffer; shape/dtype/sha256 ride along so the far
+    side validates END-TO-END, not per-hop)."""
+    kv = payload["kv"]
+    return {"fmt": payload["fmt"], "page_size": payload["page_size"],
+            "tokens": np.asarray(payload["tokens"],
+                                 np.int32).tolist(),
+            "dtype": payload["dtype"], "shape": list(payload["shape"]),
+            "sha256": payload["sha256"],
+            "kv_b64": base64.b64encode(
+                np.ascontiguousarray(kv).tobytes()).decode("ascii")}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def payload_from_wire(wire: dict) -> dict:
+    """Inverse of :func:`payload_to_wire`. Decode errors become
+    ValueError — the same rejection class adopt_pages raises, so a
+    mangled wire payload can't crash the replica loop."""
+    try:
+        raw = base64.b64decode(wire["kv_b64"])
+        kv = np.frombuffer(raw, dtype=_np_dtype(wire["dtype"])) \
+            .reshape(wire["shape"])
+    except Exception as e:
+        raise ValueError(f"handoff payload: undecodable wire form "
+                         f"({e})")
+    return {"fmt": wire.get("fmt"), "page_size": wire.get("page_size"),
+            "tokens": np.asarray(wire.get("tokens", ()), np.int32),
+            "kv": kv, "dtype": wire.get("dtype"),
+            "shape": list(wire.get("shape", ())),
+            "sha256": wire.get("sha256")}
+
+
+# ---------------------------------------------------------------------------
+# TCP (multi-host)
+# ---------------------------------------------------------------------------
+
+class TcpReplicaServer:
+    """Host one replica behind newline-delimited JSON on a TCP socket.
+    Single-threaded request handling on purpose: the router is the only
+    client and the engine is not thread-safe — ops execute in arrival
+    order, exactly like the in-proc transport."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+        self.replica = replica
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(4)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._active: Optional[socket.socket] = None
+
+    def _handle(self, op: str, args: dict):
+        if op == "submit":
+            return self.replica.submit(args["req"])
+        if op == "poll":
+            return self.replica.poll()
+        if op == "status":
+            return self.replica.status()
+        if op == "extract":
+            payload = self.replica.extract(args["tokens"])
+            return None if payload is None else payload_to_wire(payload)
+        if op == "adopt":
+            return self.replica.adopt(payload_from_wire(args["payload"]))
+        raise ValueError(f"unknown op {op!r}")
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.25)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with conn:
+                self._active = conn
+                f = conn.makefile("rwb")
+                for line in f:
+                    try:
+                        msg = json.loads(line)
+                        result = self._handle(msg.get("op", ""),
+                                              msg.get("args", {}))
+                        out = {"ok": True, "result": result}
+                    except Exception as e:
+                        out = {"ok": False,
+                               "error": f"{type(e).__name__}: {e}"}
+                    f.write(json.dumps(out).encode() + b"\n")
+                    f.flush()
+                    if self._stop.is_set():
+                        break
+
+    def start(self) -> "TcpReplicaServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Tear the replica down like a kill: the LISTENER closes and
+        the live router connection is severed too — the router's next
+        op sees a reset (→ ReplicaDown), not a replica that keeps
+        answering through a socket it already held."""
+        self._stop.set()
+        for s in (self._sock, self._active):
+            if s is None:
+                continue
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class TcpTransport(FabricTransport):
+    """Router-side client: one persistent connection per replica,
+    request/response JSON lines. Any socket fault — refused, reset,
+    torn mid-line — is :class:`ReplicaDown`; the router decides what to
+    do about it."""
+
+    def __init__(self, endpoints: Dict[str, tuple],
+                 connect_timeout_s: float = 2.0,
+                 op_timeout_s: float = 60.0):
+        self._endpoints = dict(endpoints)
+        self._conns: Dict[str, object] = {}
+        self._connect_timeout = float(connect_timeout_s)
+        self._op_timeout = float(op_timeout_s)
+
+    def replica_names(self) -> List[str]:
+        return list(self._endpoints)
+
+    def _call(self, name: str, op: str, args: dict):
+        try:
+            f = self._conns.get(name)
+            if f is None:
+                host, port = self._endpoints[name]
+                s = socket.create_connection(
+                    (host, port), timeout=self._connect_timeout)
+                s.settimeout(self._op_timeout)
+                f = self._conns[name] = s.makefile("rwb")
+            f.write(json.dumps({"op": op, "args": args}).encode() + b"\n")
+            f.flush()
+            line = f.readline()
+            if not line:
+                raise ConnectionError("connection closed")
+            resp = json.loads(line)
+        except (OSError, ValueError, KeyError) as e:
+            self._conns.pop(name, None)
+            raise ReplicaDown(name, str(e))
+        if not resp.get("ok"):
+            # an application error (bad payload) is NOT replica death —
+            # re-raise as ValueError so the router treats it as a
+            # failed op against a live replica
+            raise ValueError(resp.get("error", "remote error"))
+        return resp.get("result")
+
+    def submit(self, name, req):
+        # numpy arrays → lists for the JSON hop
+        wire = dict(req)
+        for k in ("prompt", "replay"):
+            if wire.get(k) is not None:
+                wire[k] = np.asarray(wire[k], np.int32).tolist()
+        return self._call(name, "submit", {"req": wire})
+
+    def poll(self, name):
+        return self._call(name, "poll", {})
+
+    def status(self, name):
+        return self._call(name, "status", {})
+
+    def extract(self, name, tokens):
+        wire = self._call(name, "extract",
+                          {"tokens": np.asarray(tokens,
+                                                np.int32).tolist()})
+        return None if wire is None else payload_from_wire(wire)
+
+    def adopt(self, name, payload):
+        return self._call(name, "adopt",
+                          {"payload": payload_to_wire(payload)})
+
+    def close(self) -> None:
+        for f in self._conns.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._conns.clear()

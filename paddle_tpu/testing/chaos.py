@@ -28,7 +28,8 @@ from typing import Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 __all__ = ["kill_mid_save", "corrupt_checkpoint", "nan_batch",
-           "nan_injector", "kill_at_step", "spawn_trainer"]
+           "nan_injector", "kill_at_step", "spawn_trainer",
+           "kill_replica"]
 
 
 def kill_mid_save(manager, step: int, tree) -> str:
@@ -118,6 +119,24 @@ def kill_at_step(step: int, sig: int = signal.SIGTERM):
         if metrics.step >= step:
             os.kill(os.getpid(), sig)
     return cb
+
+
+def kill_replica(transport, name: str) -> None:
+    """Drop a serving-fabric replica mid-whatever-it-was-doing — the
+    serving analogue of kill -9. Requires a transport with a ``kill``
+    hook (the in-process transport: every later router op raises
+    ``ReplicaDown``, exactly what a SIGKILLed remote looks like through
+    the TCP transport); for transports without one (TCP), SIGKILL the
+    replica's server process directly — the raised TypeError says so.
+    The router's failover re-admission (replay-exact continuation on a
+    survivor) is what the chaos tests then assert."""
+    k = getattr(transport, "kill", None)
+    if k is not None:
+        k(name)
+        return
+    raise TypeError(f"transport {type(transport).__name__} has no kill "
+                    f"hook; SIGKILL the replica's server process "
+                    f"directly (TcpReplicaServer.stop / os.kill)")
 
 
 def spawn_trainer(ckpt_dir: str, *, steps: int, extra_args: Sequence[str] = (),

@@ -36,6 +36,17 @@ def _seed_rng():
     yield
 
 
+@pytest.fixture(scope="session")
+def tiny_llama():
+    """ONE tiny LlamaForCausalLM shared by the serving-fabric test
+    files (each module-scoped copy costs ~2.5s of tier-1 budget; the
+    engines under test never mutate parameters)."""
+    import paddle_tpu
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    paddle_tpu.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
 @pytest.fixture
 def mesh8():
     """2x4 (dp, tp) mesh over the 8 virtual CPU devices."""

@@ -1,0 +1,155 @@
+"""Fabric chaos (ISSUE 12 satellite): kill a replica mid-stream, the
+router re-admits on a survivor with the remaining token budget, and the
+replayed stream is token-identical from the first re-delivered token —
+zero duplicate, zero lost tokens (replay-exact sampling keys make this
+checkable for sampled streams, not just greedy).
+
+The SAMPLED kill runs in tier-1 (it subsumes greedy: acceptance is on
+the key-folded stream identity); the greedy variant, the
+prefill-phase kill and the cheap-replay assertion run in the slow
+tier."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.serving_fabric import (InProcTransport, ServingFabric,
+                                       build_replicas)
+from paddle_tpu.testing.chaos import kill_replica
+
+pytestmark = pytest.mark.chaos
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model(tiny_llama):
+    return tiny_llama
+
+
+def _reference_streams(model, prompts, gc, max_new, fids):
+    """What an uninterrupted engine emits for each (prompt, fid): the
+    fabric pins rseed=fid, so a bare engine with the same rseed is the
+    ground truth for any replica placement."""
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=96,
+        generation_config=gc)
+    rids = [eng.submit(p, max_new, rseed=f)
+            for p, f in zip(prompts, fids)]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def _kill_mid_stream(model, do_sample):
+    rs = np.random.RandomState(0)
+    gc = GenerationConfig(max_new_tokens=10, do_sample=do_sample,
+                          seed=9)
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=96,
+                          max_batch=2, generation_config=gc)
+    tr = InProcTransport(reps)
+    fab = ServingFabric(tr, policy="round-robin")
+    prompts = [rs.randint(0, 256, (n,)).astype(np.int32) for n in (5, 7)]
+    fids = [fab.submit(p, 10) for p in prompts]
+    refs = dict(zip(fids, _reference_streams(model, prompts, gc, 10,
+                                             fids)))
+    # stream until every request has a few tokens in flight, then
+    # SIGKILL (in-proc analogue) the replica serving the first one
+    live: dict = {f: [] for f in fids}
+    while min(len(v) for v in live.values()) < 3:
+        for f, t in fab.step():
+            live[f].append(t)
+    victim = fab._reqs[fids[0]].replica
+    assert victim is not None
+    kill_replica(tr, victim)
+    out = fab.run()
+    st = fab.stats()
+    assert st["replicas_dead"] == [victim]
+    assert fab.readmitted >= 1                  # its stream moved over
+    for f in fids:
+        # full stream token-identical to the uninterrupted reference
+        np.testing.assert_array_equal(out[f], refs[f])
+        # zero duplicates / zero losses at the DELIVERY boundary: what
+        # streamed before + after the kill is exactly the final stream
+        got_before = live[f]
+        np.testing.assert_array_equal(
+            np.asarray(got_before),
+            out[f][:len(got_before)])
+
+
+def test_kill_mid_stream_replays_token_identical_sampled(model):
+    _kill_mid_stream(model, do_sample=True)
+
+
+@pytest.mark.slow
+def test_kill_mid_stream_replays_token_identical_greedy(model):
+    _kill_mid_stream(model, do_sample=False)
+
+
+def test_all_replicas_dead_raises(model):
+    rs = np.random.RandomState(3)
+    gc = GenerationConfig(max_new_tokens=4, do_sample=False)
+    reps = build_replicas(model, 1, page_size=PAGE, max_len=64,
+                          max_batch=1, generation_config=gc)
+    tr = InProcTransport(reps)
+    fab = ServingFabric(tr, policy="round-robin")
+    fab.submit(rs.randint(0, 256, (5,)).astype(np.int32), 4)
+    kill_replica(tr, "r0")
+    with pytest.raises(RuntimeError, match="every replica is down"):
+        fab.run()
+
+
+@pytest.mark.slow
+def test_kill_during_disagg_prefill_recovers(model):
+    """A prefill-role replica dies holding the cold prompt: the request
+    re-queues and completes cold on the survivors, stream unchanged."""
+    rs = np.random.RandomState(1)
+    gc = GenerationConfig(max_new_tokens=5, do_sample=False)
+    reps = build_replicas(model, 3, roles=["prefill", "both", "both"],
+                          page_size=PAGE, max_len=96, max_batch=2,
+                          generation_config=gc,
+                          chunked_prefill=True)
+    tr = InProcTransport(reps)
+    fab = ServingFabric(tr, policy="affinity",
+                        disagg_threshold_tokens=3 * PAGE)
+    long_p = rs.randint(0, 256, (5 * PAGE,)).astype(np.int32)
+    fid = fab.submit(long_p, 5)
+    # one pass routes it to the prefill replica; kill that replica
+    # while the chunked prefill is still running
+    fab.step()
+    req = fab._reqs[fid]
+    assert req.state == "prefill" and req.replica == "r0"
+    kill_replica(tr, "r0")
+    out = fab.run()
+    ref = _reference_streams(model, [long_p], gc, 5, [fid])[0]
+    np.testing.assert_array_equal(out[fid], ref)
+    assert fab.stats()["replicas_dead"] == ["r0"]
+
+
+@pytest.mark.slow
+def test_survivor_prefix_cache_makes_replay_cheap(model):
+    """The re-admitted request's replay prefix re-prefills on the
+    survivor — when the survivor's tree already holds the prompt
+    family, the replay admission HITS instead of recomputing."""
+    rs = np.random.RandomState(2)
+    gc = GenerationConfig(max_new_tokens=10, do_sample=False)
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=96,
+                          max_batch=2, generation_config=gc)
+    tr = InProcTransport(reps)
+    fab = ServingFabric(tr, policy="round-robin")
+    prompt = rs.randint(0, 256, (3 * PAGE,)).astype(np.int32)
+    # seed BOTH trees with the prompt family (round-robin spreads)
+    warm = [fab.submit(prompt, 3) for _ in range(2)]
+    fab.run()
+    by_name = {r.name: r for r in reps}
+    fid = fab.submit(prompt, 10)
+    while not fab._reqs[fid].delivered:
+        fab.step()
+    victim = fab._reqs[fid].replica
+    survivor = [n for n in by_name if n != victim][0]
+    hits0 = by_name[survivor].engine.prefix_hit_tokens
+    kill_replica(tr, victim)
+    out = fab.run()
+    ref = _reference_streams(model, [prompt], gc, 10, [fid])[0]
+    np.testing.assert_array_equal(out[fid], ref)
+    # the replay admission on the survivor hit its tree
+    assert by_name[survivor].engine.prefix_hit_tokens > hits0

@@ -1,0 +1,237 @@
+"""KV-page + radix-path handoff tests (ISSUE 12 satellite):
+serialize_pages → adopt_pages round-trips bit-exact, adoption under
+pool pressure rides the in-allocator eviction, and corrupt/truncated
+payloads are rejected without mutating the pool.
+
+Engine economy: tier-1 shares ONE exporter engine (whose tree holds a
+long donated run — payloads are serialized PREFIXES of it) and ONE
+adopter; the serving-heavy legs (eviction pressure, partial coverage)
+run in the slow tier."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.serving_fabric import payload_from_wire, payload_to_wire
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model(tiny_llama):
+    return tiny_llama
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("generation_config",
+                  GenerationConfig(max_new_tokens=4, do_sample=False))
+    kw.setdefault("prefix_cache", True)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _seed_tree(eng, prompt):
+    rid = eng.submit(prompt)
+    return eng.run()[rid]
+
+
+def _pool_snapshot(eng):
+    return (sorted(eng._free), eng._prefix.num_pages,
+            eng._prefix.num_nodes(), eng._prefix.epoch)
+
+
+@pytest.fixture(scope="module")
+def exporter(model):
+    """One engine whose tree holds an 8-page run (and a disjoint
+    2-page run for the wire test); payloads below are serialized
+    prefixes of it."""
+    rs = np.random.RandomState(0)
+    run_long = rs.randint(0, 256, (8 * PAGE,)).astype(np.int32)
+    run2 = rs.randint(256, 500, (2 * PAGE + 3,)).astype(np.int32)
+    A = _engine(model, max_len=96, num_pages=14, max_batch=1)
+    _seed_tree(A, run_long)
+    _seed_tree(A, run2)
+    return A, run_long, run2
+
+
+@pytest.fixture(scope="module")
+def adopter(model, exporter):
+    """One adopter engine holding the 3-page prefix of run_long (the
+    round-trip test adopts; later tests only assert rejections leave
+    it untouched)."""
+    A, run_long, _ = exporter
+    return _engine(model)
+
+
+def test_round_trip_bit_exact(model, exporter, adopter):
+    """A→B→re-export: page bytes, token run and checksum identical;
+    B's tree serves the same match; adopted nodes at refcount 0;
+    re-adoption of a covered run is a no-op."""
+    A, run_long, _ = exporter
+    B = adopter
+    pay = A.serialize_pages(run_long[:3 * PAGE])
+    assert pay is not None
+    assert pay["kv"].shape[3] == 3 and len(pay["tokens"]) == 3 * PAGE
+    donated = B.adopt_pages(pay)
+    assert len(donated) == 3
+    assert B.pages_adopted == 3
+    assert B._prefix.match(run_long, touch=False) == 3 * PAGE
+    for p in donated:
+        assert B._prefix._pages[p].ref == 0       # cached, evictable
+    B._check_page_invariants()
+    # bit-exact re-export
+    pay2 = B.serialize_pages(run_long[:3 * PAGE])
+    assert pay2["sha256"] == pay["sha256"]
+    np.testing.assert_array_equal(pay2["tokens"], pay["tokens"])
+    np.testing.assert_array_equal(
+        np.asarray(pay2["kv"], np.float32),
+        np.asarray(pay["kv"], np.float32))
+    # idempotent: the tree already covers the run, pool untouched
+    free_after = sorted(B._free)
+    assert B.adopt_pages(pay) == []
+    assert sorted(B._free) == free_after
+    B._check_page_invariants()
+
+
+def test_corrupt_payload_rejected_without_mutation(model, exporter,
+                                                   adopter):
+    A, run_long, _ = exporter
+    B = adopter
+    base = A.serialize_pages(run_long[:3 * PAGE])
+    for corruption in ("flip_kv", "truncate_kv", "flip_token",
+                       "bad_fmt", "bad_page_size", "short_tokens"):
+        pay = dict(base)
+        if corruption == "flip_kv":
+            kv = pay["kv"].copy()
+            kv.flat[7] += 1
+            pay["kv"] = kv
+        elif corruption == "truncate_kv":
+            pay["kv"] = pay["kv"][:, :, :, :1]    # pages torn off
+        elif corruption == "flip_token":
+            toks = pay["tokens"].copy()
+            toks[0] ^= 1
+            pay["tokens"] = toks
+        elif corruption == "bad_fmt":
+            pay["fmt"] = "pt-kv-pages-v999"
+        elif corruption == "bad_page_size":
+            pay["page_size"] = PAGE * 2
+        elif corruption == "short_tokens":
+            pay["tokens"] = pay["tokens"][:PAGE + 3]
+        before = _pool_snapshot(B)
+        with pytest.raises(ValueError):
+            B.adopt_pages(pay)
+        assert _pool_snapshot(B) == before, corruption
+    B._check_page_invariants()
+
+
+def test_wire_codec_round_trip_and_reject(model, exporter, adopter):
+    """TCP wire form: base64 round-trips to an adoptable payload;
+    mangled wire bytes surface as the same ValueError class."""
+    A, _, run2 = exporter
+    B = adopter
+    pay = A.serialize_pages(run2)
+    assert pay["kv"].shape[3] == 2                # full pages only
+    import json
+    wire = json.loads(json.dumps(payload_to_wire(pay)))  # JSON-safe
+    back = payload_from_wire(wire)
+    assert back["sha256"] == pay["sha256"]
+    assert len(B.adopt_pages(back)) == 2
+    B._check_page_invariants()
+    torn = dict(wire)
+    torn["kv_b64"] = torn["kv_b64"][:len(torn["kv_b64"]) // 2]
+    before = _pool_snapshot(B)
+    with pytest.raises(ValueError):
+        B.adopt_pages(payload_from_wire(torn))
+    assert _pool_snapshot(B) == before
+
+
+def test_adopt_rejects_pool_overflow_without_corruption(model,
+                                                        exporter):
+    """A payload larger than the whole pool fails cleanly (before any
+    page is written)."""
+    A, run_long, _ = exporter
+    pay = A.serialize_pages(run_long)             # all 8 pages
+    B = _engine(model, num_pages=4, max_batch=1)
+    with pytest.raises(RuntimeError, match="cannot hold"):
+        B.adopt_pages(pay)
+    B._check_page_invariants()
+
+
+def test_serialize_requires_prefix_cache(model):
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=64,
+        generation_config=GenerationConfig(max_new_tokens=2))
+    with pytest.raises(RuntimeError, match="prefix_cache"):
+        eng.serialize_pages(np.arange(PAGE, dtype=np.int32))
+    with pytest.raises(RuntimeError, match="prefix_cache"):
+        eng.adopt_pages({})
+
+
+def test_serialize_unknown_prefix_returns_none(model, exporter):
+    A, _, _ = exporter
+    assert A.serialize_pages(
+        np.arange(2 * PAGE, dtype=np.int32) + 500) is None
+
+
+# -- serving-heavy legs (slow tier) -----------------------------------------
+
+@pytest.mark.slow
+def test_partial_coverage_frees_duplicate_pages(model):
+    """B already holds the first page of the run: adoption donates only
+    the uncovered suffix and the duplicate page id goes straight back
+    to the free list — no leak, invariant holds."""
+    rs = np.random.RandomState(2)
+    head = rs.randint(0, 256, (PAGE,)).astype(np.int32)
+    full = np.concatenate([head,
+                           rs.randint(0, 256, (2 * PAGE,))
+                           .astype(np.int32)])
+    A, B = _engine(model), _engine(model)
+    _seed_tree(A, full)
+    _seed_tree(B, np.concatenate(
+        [head, rs.randint(0, 256, (3,)).astype(np.int32)]))
+    assert B._prefix.match(full, touch=False) == PAGE
+    free0 = len(B._free)
+    pay = A.serialize_pages(full)
+    donated = B.adopt_pages(pay)
+    assert len(donated) == 2                      # suffix only
+    assert len(B._free) == free0 - 2              # duplicate returned
+    assert B._prefix.match(full, touch=False) == 3 * PAGE
+    B._check_page_invariants()
+
+
+@pytest.mark.slow
+def test_adopt_under_pressure_triggers_tree_eviction(model):
+    """A near-full pool makes adoption evict B's own refcount-0 tree
+    pages through the allocator's existing path."""
+    rs = np.random.RandomState(3)
+    B = _engine(model, num_pages=6, max_batch=1)
+    for i in range(2):
+        _seed_tree(B, rs.randint(0, 256, (2 * PAGE,)).astype(np.int32))
+    assert B._prefix.num_pages >= 4               # tree holds the pool
+    assert len(B._free) < 3
+    run = rs.randint(0, 256, (3 * PAGE,)).astype(np.int32)
+    A = _engine(model)
+    _seed_tree(A, run)
+    pay = A.serialize_pages(run)
+    donated = B.adopt_pages(pay)
+    assert len(donated) == 3                      # eviction made room
+    assert B._prefix.match(run, touch=False) == 3 * PAGE
+    B._check_page_invariants()
+
+
+@pytest.mark.slow
+def test_adopted_pages_serve_identical_stream(model):
+    """A request admitted over adopted pages prefix-hits and emits the
+    same stream a cold engine would."""
+    rs = np.random.RandomState(4)
+    prompt = rs.randint(0, 256, (3 * PAGE + 5,)).astype(np.int32)
+    A, B = _engine(model), _engine(model)
+    ref = _seed_tree(A, prompt)
+    pay = A.serialize_pages(prompt)
+    B.adopt_pages(pay)
+    out = _seed_tree(B, prompt)
+    np.testing.assert_array_equal(out, ref)
+    assert B.prefix_hit_tokens >= 3 * PAGE - 1
+    B._check_page_invariants()

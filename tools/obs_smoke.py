@@ -151,7 +151,78 @@ def _serving_leg():
     assert px.prefix_cow_copies > 0, "full-prompt hit skipped COW path"
     gauge = REGISTRY.gauge("pt_serving_prefix_shared_pages").value()
     assert gauge > 0, "shared-page gauge never moved"
-    return served, spec.spec_stats(), px.prefix_stats()
+    return served, spec.spec_stats(), px.prefix_stats(), model
+
+
+def _fabric_leg(out_dir: str, errors: list, model=None) -> dict:
+    """Serving-fabric leg (ISSUE 12 satellite): route 4 requests across
+    2 NAMED replicas — their engine series must land under distinct
+    ``engine=`` labels — then kill one replica with a request mid-
+    stream: the router re-admits on the survivor and a fabric sentry
+    pack fires EXACTLY one replicas-alive incident (breach_for=1 fires
+    the first tick, cooldown suppresses the storm)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability.metrics import REGISTRY
+    from paddle_tpu.observability.sentry import SloSentry, fabric_rules
+    from paddle_tpu.serving_fabric import (InProcTransport, ServingFabric,
+                                           build_replicas)
+    from paddle_tpu.testing.chaos import kill_replica
+
+    if model is None:
+        pt.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+    reps = build_replicas(
+        model, 2, names=["fab0", "fab1"], page_size=8, max_len=32,
+        max_batch=2,
+        generation_config=GenerationConfig(max_new_tokens=3,
+                                           do_sample=False))
+    tr = InProcTransport(reps)
+    fab = ServingFabric(tr, policy="affinity")
+    sentry = SloSentry(
+        fabric_rules(replicas=["fab0", "fab1"]),
+        incident_log=os.path.join(out_dir, "fabric_incidents.jsonl"))
+    rs = np.random.RandomState(7)
+    shorts = [fab.submit(rs.randint(0, 32, (6,)).astype(np.int32), 3)
+              for _ in range(3)]
+    flong = fab.submit(rs.randint(0, 32, (6,)).astype(np.int32), 8)
+    # drive until the shorts retired (both replicas publish their
+    # engine= series) while the long one is still mid-stream
+    while any(fab._reqs[f].state != "done" for f in shorts):
+        fab.step()
+    tok = REGISTRY.counter("pt_serving_tokens_total")
+    for n in ("fab0", "fab1"):
+        if fab.routed.get(n, 0) and tok.value(engine=n) <= 0:
+            errors.append(f"per-replica token series never moved for "
+                          f"engine={n}")
+    routed = REGISTRY.counter("pt_fabric_routed_total")
+    if sum(routed.value(replica=n, how=h) for n in ("fab0", "fab1")
+           for h in ("affinity", "rr", "ll", "cold", "spill",
+                     "prefill", "disagg")) < 4:
+        errors.append("pt_fabric_routed_total never moved")
+    victim = fab._reqs[flong].replica
+    kill_replica(tr, victim)
+    out = fab.run()                       # survivor completes it
+    if len(out) != 4:
+        errors.append(f"fabric served {len(out)}/4 requests")
+    if len(out.get(flong, ())) != 8:
+        errors.append("killed replica's request did not complete on "
+                      "the survivor")
+    for _ in range(3):
+        sentry.tick()
+    alive = [i for i in sentry.incidents
+             if i.rule == "fabric_replicas_alive_floor"]
+    if len(alive) != 1:
+        errors.append(f"replica kill fired {len(alive)} alive-floor "
+                      f"incidents, expected exactly 1")
+    return {"served": len(out),
+            "routed": dict(fab.routed),
+            "killed": victim,
+            "readmitted": fab.readmitted,
+            "fabric_incidents": len(alive)}
 
 
 def _cost_leg(out_dir: str, errors: list) -> dict:
@@ -253,7 +324,8 @@ def main(out_dir: str) -> dict:
     errors = []
     try:
         emissions = _train_leg()
-        served, spec_stats, prefix_stats = _serving_leg()
+        served, spec_stats, prefix_stats, smodel = _serving_leg()
+        fabric = _fabric_leg(out_dir, errors, model=smodel)
         cost = _cost_leg(out_dir, errors)
         sentry_out = _sentry_checks(out_dir, errors, sentry)
         obs.publish()
@@ -285,6 +357,11 @@ def main(out_dir: str) -> dict:
                      "pt_serving_cow_copies_total",
                      "pt_serving_prefix_shared_pages",
                      "pt_serving_prefix_hit_rate",
+                     "pt_fabric_routed_total",
+                     "pt_fabric_replicas_alive",
+                     "pt_fabric_readmitted_total",
+                     "pt_fabric_replica_deaths_total",
+                     "pt_fabric_ttft_seconds",
                      "pt_model_flops_utilization",
                      "pt_hbm_bw_utilization",
                      "pt_step_time_breakdown",
@@ -320,6 +397,7 @@ def main(out_dir: str) -> dict:
             "prefix_cow_copies": int(
                 prefix_stats.get("prefix_cow_copies", 0)),
             "cost": cost,
+            "fabric": fabric,
             "sentry": sentry_out,
             "jsonl_records": len(records),
             "prom_metrics": len(parsed),
