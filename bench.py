@@ -253,6 +253,9 @@ def _overlap_ab(on_tpu, degraded):
                 4)
             # >0: flags help (off leg slower)
             out["overlap_delta"] = round((off - on) / off, 4)
+            # ISSUE 14 pinned ratio row: off ÷ on, >1.0 when the
+            # latency-hiding flags actually buy step time
+            out["overlap_on_step_speedup"] = round(off / on, 4)
         else:
             out["overlap_ab_error"] = (p_off.get("error")
                                        or p_on.get("error")
@@ -1351,7 +1354,15 @@ def _graph_contracts_probe(on_tpu):
     the canonical train-step graph (0 single-chip; a sharded trainer on a
     pod shows its real comm load), ``serving_tick_donated_bytes`` is the
     aliased (donated) input bytes of the serving decode tick — the number
-    that drops when a refactor silently loses a donation."""
+    that drops when a refactor silently loses a donation.
+
+    ISSUE 14 adds ``overlap_exposed_comm_fraction``: the exposed
+    (un-overlapped) comm fraction of the dp2xtp2 canonical step
+    (``tp_fused_ce``) from the same start→done pairing the budget gate
+    enforces. The graph needs a 2x2 mesh, so a single-device host
+    delegates to a ``tools/graph_lint.py --json`` subprocess on 8
+    virtual CPU devices (it self-forces the count) and reads the
+    snapshot; ``overlap_backend`` records which path the number rode."""
     out = {}
     try:
         import paddle_tpu.analysis as A
@@ -1369,6 +1380,48 @@ def _graph_contracts_probe(on_tpu):
             rep.transfers["host_transfer_count"]
     except Exception as e:
         out["graph_contracts_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    try:
+        import jax
+
+        import paddle_tpu.analysis as A
+        if jax.device_count() >= 4:
+            _log("graph contracts: overlap report on the dp2xtp2 step")
+            g = A.build_graph("tp_fused_ce")
+            rep = A.analyze(g.compiled, g.name, g.contract, mesh=g.mesh)
+            snap = A.snapshot_report(rep)
+            out["overlap_backend"] = "inline"
+        else:
+            import subprocess
+
+            from paddle_tpu.distributed.overlap import OVERLAP_XLA_FLAGS
+            _log("graph contracts: overlap report via graph_lint "
+                 "subprocess (8 virtual devices)")
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            # the child runs on forced CPU devices — the parent's vetted
+            # TPU overlap flags would be rejected there, so strip them
+            env["PT_NO_OVERLAP"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            toks = set(OVERLAP_XLA_FLAGS.split())
+            env["XLA_FLAGS"] = " ".join(
+                t for t in env.get("XLA_FLAGS", "").split()
+                if t not in toks)
+            cmd = [sys.executable,
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools", "graph_lint.py"),
+                   "--graphs", "tp_fused_ce", "--json"]
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=600, env=env)
+            # --json prints one indented JSON object (verbose output is
+            # suppressed); tolerate stray preamble lines before it
+            d = json.loads(res.stdout[res.stdout.index("{"):])
+            snap = d["snapshots"]["tp_fused_ce"]
+            out["overlap_backend"] = "cpu-subprocess"
+        out["overlap_exposed_comm_fraction"] = \
+            snap["exposed_comm_fraction"]
+        out["overlap_min_distance"] = snap["min_overlap_distance"]
+    except Exception as e:
+        out["overlap_row_error"] = f"{type(e).__name__}: {str(e)[:150]}"
     return out
 
 

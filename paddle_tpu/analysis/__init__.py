@@ -12,7 +12,11 @@ to numerics tests. This subsystem makes it checkable:
 * :mod:`transfers`       — host callbacks / infeed / outfeed / host
                            copies inside hot graphs;
 * :mod:`collectives`     — per-mesh-axis collective census (the comm
-                           table ROADMAP item 3's planner will price);
+                           table ROADMAP item 3's planner will price),
+                           including the ONE start→done pairing walk;
+* :mod:`overlap`         — async-collective overlap windows: per-pair
+                           distance, priced in-window compute, exposed
+                           comm fraction (ISSUE 14 budget kinds);
 * :mod:`contracts`       — declarative ``GraphContract`` + JSON budget
                            snapshots with diff-style failures;
 * :mod:`graphs`          — canonical compiled entrypoints (train step
@@ -35,6 +39,8 @@ from .graphs import (REGISTRY, BuiltGraph, GraphSkipped, build_graph,
                      graph_names)
 from .hlo import HloModule, parse_hlo
 from .materialization import banned_buffers, materialization_report
+from .overlap import (OverlapWindow, UnmatchedCollectiveError,
+                      overlap_report)
 from .transfers import host_transfer_report
 
 __all__ = [
@@ -44,5 +50,6 @@ __all__ = [
     "load_budgets", "save_budgets", "render_violations",
     "materialization_report", "banned_buffers", "donation_report",
     "host_transfer_report", "collective_census", "mesh_axis_groups",
+    "OverlapWindow", "UnmatchedCollectiveError", "overlap_report",
     "REGISTRY", "BuiltGraph", "GraphSkipped", "build_graph", "graph_names",
 ]

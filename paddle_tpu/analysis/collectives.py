@@ -46,6 +46,16 @@ class CollectiveInstr:
     axis: str                   # mesh axis name, "?" when unclassified
     op_name: str
     source: str
+    # position of the (start) instruction in module walk order, and the
+    # matched -done for async lowerings. The census is the ONE place
+    # start->done pairing happens; analysis/overlap.py consumes these
+    # indices instead of re-walking the module.
+    index: int = -1
+    name: str = ""
+    computation: str = ""
+    is_async: bool = False
+    done_index: Optional[int] = None
+    done_name: Optional[str] = None
 
     def describe(self) -> str:
         src = f" ({self.source})" if self.source else ""
@@ -84,6 +94,23 @@ def _parse_groups(text: str) -> Optional[frozenset]:
                      for row in rows)
 
 
+def _find_done(flat, start_idx: int) -> Tuple[Optional[int], Optional[str]]:
+    """Index+name of the ``<op>-done`` consuming ``flat[start_idx]``'s
+    value, or (None, None) when the module is truncated / unpaired.
+    A -done names its -start as an operand, so the match is textual:
+    same computation, matching opcode, start's name referenced."""
+    start = flat[start_idx]
+    want = start.opcode[:-len(_START_SUFFIX)] + _DONE_SUFFIX
+    ref = re.compile(r"%?" + re.escape(start.name) + r"(?![\w.-])")
+    for j in range(start_idx + 1, len(flat)):
+        ins = flat[j]
+        if ins.computation != start.computation:
+            break  # instructions of one computation are contiguous
+        if ins.opcode == want and ref.search(ins.raw):
+            return j, ins.name
+    return None, None
+
+
 def collective_census(mod: HloModule, mesh=None) -> Dict:
     """Per-instruction table + summary. ``mesh`` (optional) enables axis
     classification; without it every collective reports axis "?"."""
@@ -94,12 +121,14 @@ def collective_census(mod: HloModule, mesh=None) -> Dict:
         except Exception:
             axis_groups = {}
 
+    flat = list(mod.instructions)
     table: List[CollectiveInstr] = []
-    for ins in mod.instructions:
+    for idx, ins in enumerate(flat):
         op = ins.opcode
         if op.endswith(_DONE_SUFFIX):
             continue
-        base = op[:-len(_START_SUFFIX)] if op.endswith(_START_SUFFIX) else op
+        is_async = op.endswith(_START_SUFFIX)
+        base = op[:-len(_START_SUFFIX)] if is_async else op
         if base not in COLLECTIVE_OPS:
             continue
         groups_txt = ins.attr("replica_groups")
@@ -110,10 +139,15 @@ def collective_census(mod: HloModule, mesh=None) -> Dict:
                 if groups == ag:
                     axis = name
                     break
+        done_index, done_name = (_find_done(flat, idx) if is_async
+                                 else (None, None))
         table.append(CollectiveInstr(
             opcode=base, bytes=ins.bytes, replica_groups=groups_txt,
             channel_id=ins.attr("channel_id"), axis=axis,
-            op_name=ins.op_name, source=ins.source))
+            op_name=ins.op_name, source=ins.source,
+            index=idx, name=ins.name, computation=ins.computation,
+            is_async=is_async, done_index=done_index,
+            done_name=done_name))
 
     counts: Dict[str, int] = {}
     bytes_by_op: Dict[str, int] = {}

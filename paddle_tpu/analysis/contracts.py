@@ -33,6 +33,7 @@ from .collectives import collective_census
 from .donation import donation_report
 from .hlo import HloModule, parse_hlo
 from .materialization import BanRule, materialization_report
+from .overlap import overlap_report
 from .transfers import host_transfer_report
 
 __all__ = [
@@ -49,6 +50,13 @@ class GraphContract:
     require_aliased: Tuple[str, ...] = ()     # param-label prefixes
     max_host_transfers: int = 0
     expect_collectives: Optional[Dict[str, int]] = None
+    # ISSUE 14 overlap invariants: floor on the smallest async
+    # start->done window (priced independent ops), ceiling on the
+    # fraction of priced comm seconds no window compute covers. ``None``
+    # leaves enforcement to the budget snapshot (CPU CI lowers
+    # collectives synchronously, so canonical contracts pin via budgets)
+    min_overlap_distance: Optional[int] = None
+    max_exposed_comm_fraction: Optional[float] = None
     notes: str = ""
 
 
@@ -60,6 +68,7 @@ class GraphReport:
     donation: Dict
     transfers: Dict
     collectives: Dict
+    overlap: Dict = field(default_factory=dict)
 
 
 @dataclass
@@ -86,12 +95,15 @@ def analyze(compiled_or_text, name: str = "graph",
         text = compiled_or_text.as_text()
     mod = parse_hlo(text)
     rules = contract.ban_rules if contract is not None else ()
+    census = collective_census(mod, mesh=mesh)
     return GraphReport(
         name=name, module=mod,
         materialization=materialization_report(mod, rules),
         donation=donation_report(mod),
         transfers=host_transfer_report(mod),
-        collectives=collective_census(mod, mesh=mesh),
+        collectives=census,
+        # shares the census's single pairing walk (ISSUE 14)
+        overlap=overlap_report(mod, census=census),
     )
 
 
@@ -153,6 +165,26 @@ def check_contract(contract: GraphContract,
                 report.name, "collectives.expect",
                 "collective census diverged from the contract",
                 _dict_diff(contract.expect_collectives, actual)))
+
+    ov = report.overlap or {}
+    if contract.min_overlap_distance is not None:
+        actual_d = ov.get("min_overlap_distance", 0)
+        if actual_d < contract.min_overlap_distance:
+            v.append(Violation(
+                report.name, "overlap.min_overlap_distance",
+                f"a collective's start->done window collapsed: contract "
+                f"floor {contract.min_overlap_distance} -> actual "
+                f"{actual_d} independent op(s) in the window",
+                [l for l in [ov.get("min_distance_collective", "")] if l]))
+    if contract.max_exposed_comm_fraction is not None:
+        actual_f = ov.get("exposed_comm_fraction", 0.0)
+        if actual_f > contract.max_exposed_comm_fraction:
+            v.append(Violation(
+                report.name, "overlap.max_exposed_comm_fraction",
+                f"exposed (un-overlapped) comm fraction "
+                f"{actual_f:.4f} exceeds the contract ceiling "
+                f"{contract.max_exposed_comm_fraction:.4f}",
+                [l for l in [ov.get("most_exposed_collective", "")] if l]))
     return v
 
 
@@ -165,6 +197,8 @@ def snapshot_report(report: GraphReport) -> Dict:
     # `analysis` stays importable for jax-free saved-dump workflows
     from ..observability.costs import attribute_costs
     flops = int(attribute_costs(report.module).total_flops)
+    ov = report.overlap or overlap_report(report.module,
+                                          census=report.collectives)
     return {
         "largest_intermediate_bytes":
             report.materialization["largest_intermediate_bytes"],
@@ -180,6 +214,14 @@ def snapshot_report(report: GraphReport) -> Dict:
         # reverting to naive-elsewhere, a layer dropped by a refactor)
         # shows up as a flop drop long before anyone reads a bench row
         "analytical_flops": flops,
+        # ISSUE 14: floor on the tightest async start->done window and
+        # ceiling on the comm seconds no window compute covers. A graph
+        # whose collectives lower synchronously (CPU CI) honestly pins
+        # distance 0 / fraction 1.0; a comm-free graph pins 0 / 0.0 —
+        # the ceiling then has real teeth: ANY exposed comm appearing
+        # later breaks the budget
+        "min_overlap_distance": ov["min_overlap_distance"],
+        "exposed_comm_fraction": ov["exposed_comm_fraction"],
     }
 
 
@@ -200,15 +242,16 @@ def check_budget(report: GraphReport, entry: Dict) -> List[Violation]:
     snap = snapshot_report(report)
     v: List[Violation] = []
 
-    def ceiling(key, why):
+    def ceiling(key, why, details=()):
         if key in budget and snap[key] > budget[key]:
+            extra = (report.materialization["largest_buffers"][:4]
+                     if key == "largest_intermediate_bytes"
+                     else list(details))
             v.append(Violation(
                 report.name, f"budget.{key}",
                 f"{why}: budget {budget[key]:,} -> actual {snap[key]:,} "
                 f"(+{snap[key] - budget[key]:,}); intentional? re-pin with "
-                f"--update-budgets",
-                (report.materialization["largest_buffers"][:4]
-                 if key == "largest_intermediate_bytes" else [])))
+                f"--update-budgets", extra))
 
     def floor(key, why, details=()):
         if key in budget and snap[key] < budget[key]:
@@ -230,6 +273,17 @@ def check_budget(report: GraphReport, entry: Dict) -> List[Violation]:
           "analytical flop count dropped — an op fell out of the "
           "fused/compiled path (intentional? re-pin with "
           "--update-budgets)")
+
+    ov = report.overlap or {}
+    floor("min_overlap_distance",
+          "a collective's start->done overlap window collapsed — the "
+          "latency-hiding scheduler no longer places independent "
+          "compute inside the async window",
+          [l for l in [ov.get("min_distance_collective", "")] if l])
+    ceiling("exposed_comm_fraction",
+            "exposed (un-overlapped) comm fraction grew — more of the "
+            "collective lane now serializes against compute",
+            [l for l in [ov.get("most_exposed_collective", "")] if l])
 
     if "collective_counts" in budget:
         if snap["collective_counts"] != budget["collective_counts"]:

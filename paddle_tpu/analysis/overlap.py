@@ -1,0 +1,161 @@
+"""Overlap analyzer: how much of each collective's latency is hidden.
+
+The paper's fleet stack exists to hide communication behind compute
+(mp_async_allreduce, allreduce_matmul_grad_overlapping); on TPU the
+equivalent lever is XLA's latency-hiding scheduler placing async
+collectives as ``<op>-start`` / ``<op>-done`` pairs with independent
+compute scheduled inside the window. This module turns that placement
+into a MEASURABLE, BUDGETABLE artifact:
+
+* every ``-start`` is paired with its ``-done`` — the pairing itself is
+  recorded by the collective census (``analysis/collectives.py``) during
+  its single module walk; this analyzer only CONSUMES those indices, so
+  there is exactly one pairing definition in the repo;
+* the **overlap distance** of a pair is the number of priced (nonzero
+  flop/byte) non-collective instructions strictly between start and done
+  — ops that by construction cannot consume the in-flight result and are
+  therefore schedulable concurrently with the transfer;
+* the window's **compute seconds** price those instructions against the
+  device roofline (``max(flops/peak, bytes/hbm_bw)`` per op, via the
+  ISSUE 9 cost walker — no second flop formula);
+* a collective's **exposed** seconds are its priced comm time minus the
+  window compute covering it (floored at zero); a synchronously lowered
+  collective (no ``-start``) has a zero-width window and is fully
+  exposed by definition.
+
+``min_overlap_distance`` (floor) and ``max_exposed_comm_fraction``
+(ceiling) become graph-budget kinds: ``tools/graph_lint.py`` fails when
+a start→done window collapses, the same way it fails when the logits
+re-materialize. An unmatched ``-start`` (truncated module, parser miss)
+raises :class:`UnmatchedCollectiveError` naming the op rather than
+silently reporting the collective as free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .collectives import COLLECTIVE_OPS, collective_census
+from .hlo import HloModule
+
+__all__ = ["OverlapWindow", "UnmatchedCollectiveError", "overlap_report"]
+
+
+class UnmatchedCollectiveError(ValueError):
+    """An async collective ``-start`` has no matching ``-done``."""
+
+
+@dataclass
+class OverlapWindow:
+    """One collective and the compute scheduled inside its window."""
+    name: str                    # HLO instruction name of the (start) op
+    opcode: str                  # base opcode (suffix stripped)
+    axis: str
+    op_name: str
+    is_async: bool
+    index: int                   # module-walk position of the start
+    done_index: Optional[int]    # position of the paired -done
+    distance: int                # priced independent ops inside window
+    window_compute_s: float      # roofline seconds of those ops
+    comm_s: float                # priced transfer seconds (census bw)
+    hidden_s: float              # min(comm_s, window_compute_s)
+    exposed_s: float             # comm_s - hidden_s
+
+    def describe(self) -> str:
+        kind = "async" if self.is_async else "sync"
+        return (f"{self.opcode}[{self.axis}] %{self.name} ({kind}) "
+                f"distance={self.distance} "
+                f"window={self.window_compute_s:.3e}s "
+                f"comm={self.comm_s:.3e}s exposed={self.exposed_s:.3e}s")
+
+
+def _is_collective_op(opcode: str) -> bool:
+    base = opcode
+    for suf in ("-start", "-done"):
+        if base.endswith(suf):
+            base = base[:-len(suf)]
+            break
+    return base in COLLECTIVE_OPS
+
+
+def overlap_report(mod: HloModule, census: Optional[Dict] = None,
+                   mesh=None, spec=None,
+                   bandwidths: Optional[Dict[str, float]] = None) -> Dict:
+    """Pair every collective with its window and price the overlap.
+
+    ``census`` (a :func:`collective_census` result) is accepted so a
+    caller that already ran the census — ``analysis.analyze`` does —
+    shares the single pairing walk; when omitted one is taken here.
+    Returns windows plus the two budgetable aggregates:
+    ``min_overlap_distance`` (min distance over async pairs; 0 when
+    collectives exist but none lowered async — fully serialized — and 0
+    when there are no collectives at all) and ``exposed_comm_fraction``
+    (exposed ÷ total priced comm seconds, 0.0 for a comm-free module).
+    """
+    # lazy: analysis/ stays importable without the observability stack
+    from ..observability.costs.analyzer import _Walker
+    from ..observability.costs.device_db import device_spec
+
+    if census is None:
+        census = collective_census(mod, mesh=mesh)
+    spec = spec or device_spec()
+    bandwidths = bandwidths or {}
+    flat = list(mod.instructions)
+    walker = _Walker(mod)
+
+    windows: List[OverlapWindow] = []
+    for c in census.get("table", []):
+        if c.index < 0:
+            raise ValueError(
+                "census table lacks instruction indices — rebuild it with "
+                "collective_census() (stale or hand-built table?)")
+        if c.is_async and c.done_index is None:
+            raise UnmatchedCollectiveError(
+                f"async collective '%{c.name}' ({c.opcode}-start in "
+                f"computation '{c.computation}', module position "
+                f"{c.index}) has no matching {c.opcode}-done — truncated "
+                f"module text or a lowering this parser does not pair; "
+                f"refusing to report the transfer as hidden")
+        comm_s = c.bytes / float(bandwidths.get(c.axis, spec.link_bw))
+        distance = 0
+        window_s = 0.0
+        if c.is_async:
+            for ins in flat[c.index + 1:c.done_index]:
+                # other collectives occupy the comm lane; they do not
+                # hide THIS transfer, so only compute/HBM work counts
+                if _is_collective_op(ins.opcode):
+                    continue
+                f, b, _ = walker.ins_cost(ins, fused=False)
+                if f == 0.0 and b == 0.0:
+                    continue
+                distance += 1
+                window_s += max(f / spec.peak_flops, b / spec.hbm_bw)
+        hidden = min(comm_s, window_s)
+        windows.append(OverlapWindow(
+            name=c.name, opcode=c.opcode, axis=c.axis, op_name=c.op_name,
+            is_async=c.is_async, index=c.index, done_index=c.done_index,
+            distance=distance, window_compute_s=window_s, comm_s=comm_s,
+            hidden_s=hidden, exposed_s=comm_s - hidden))
+
+    total = sum(w.comm_s for w in windows)
+    exposed = sum(w.exposed_s for w in windows)
+    async_ws = [w for w in windows if w.is_async]
+    min_distance = min((w.distance for w in async_ws), default=0)
+    if not async_ws and windows:
+        min_distance = 0  # collectives present, all serialized
+    worst = max(windows, key=lambda w: w.exposed_s, default=None)
+    tightest = min(async_ws, key=lambda w: w.distance, default=None)
+    return {
+        "windows": windows,
+        "async_collectives": len(async_ws),
+        "sync_collectives": len(windows) - len(async_ws),
+        "min_overlap_distance": int(min_distance),
+        "min_distance_collective": tightest.describe() if tightest else "",
+        "total_comm_s": total,
+        "hidden_comm_s": total - exposed,
+        "exposed_comm_s": exposed,
+        "exposed_comm_fraction": (round(exposed / total, 6) if total > 0.0
+                                  else 0.0),
+        "most_exposed_collective": worst.describe() if worst else "",
+    }

@@ -49,6 +49,21 @@ OVERLAP_XLA_FLAGS = (
     "--xla_enable_async_collective_permute=true "
 )
 
+# process-lifetime memo of vet verdicts (ISSUE 14 satellite): a Trainer
+# is constructed per experiment but the flag set an XLA build accepts
+# cannot change within one process — never probe the same set twice
+_VET_MEMO: Dict[str, List[str]] = {}
+_WARNED: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    """stderr warning emitted at most once per process per key — the
+    overlap policy is consulted per Trainer construction and per
+    compile, and a repeated warning is noise, not information."""
+    if key not in _WARNED:
+        _WARNED.add(key)
+        sys.stderr.write(msg)
+
 
 def validate_xla_flags(candidates: List[str], *, cwd: Optional[str] = None,
                        timeout: Optional[float] = None) -> List[str]:
@@ -74,6 +89,8 @@ def validate_xla_flags(candidates: List[str], *, cwd: Optional[str] = None,
     fp = _xla_build_fingerprint()
     cacheable = "plugin-meta-unavailable" not in fp
     key = fp + "|" + " ".join(sorted(candidates))
+    if key in _VET_MEMO:
+        return [c for c in candidates if c in _VET_MEMO[key]]
     # repo root, shared by the cache file and the probe child's PYTHONPATH
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -84,6 +101,7 @@ def validate_xla_flags(candidates: List[str], *, cwd: Optional[str] = None,
             with open(cache_path) as f:
                 cache = _json.load(f)
             if key in cache:
+                _VET_MEMO[key] = list(cache[key])
                 return [c for c in candidates if c in cache[key]]
         except Exception:
             cache = {}
@@ -125,14 +143,20 @@ def validate_xla_flags(candidates: List[str], *, cwd: Optional[str] = None,
         live = []
         definitive = False  # hang/TPU-busy/import error: retry next run
         break
-    if definitive and cacheable:
-        try:
-            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
-            cache[key] = live
-            with open(cache_path, "w") as f:
-                _json.dump(cache, f, indent=1)
-        except Exception:
-            pass
+    # definitive verdicts memoize for the process lifetime (the build's
+    # accepted flag set cannot change underneath a running process) and,
+    # when the build is identifiable, persist to disk; transient
+    # failures (hang, TPU busy) stay uncached so a later call/run retries
+    if definitive:
+        _VET_MEMO[key] = list(live)
+        if cacheable:
+            try:
+                os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+                cache[key] = live
+                with open(cache_path, "w") as f:
+                    _json.dump(cache, f, indent=1)
+            except Exception:
+                pass
     return live
 
 
@@ -199,7 +223,8 @@ def apply_overlap_flags(enable: bool = True, *, target: str = "tpu",
     if initialized:
         # checked BEFORE validate: vetting spawns multi-minute backend-init
         # subprocesses, pointless when flags can no longer be applied
-        sys.stderr.write(
+        _warn_once(
+            "backend-initialized",
             "paddle_tpu.overlap: backend already initialized; XLA overlap "
             "flags NOT applied (set strategy before first jax use)\n")
         return cur
@@ -211,6 +236,83 @@ def apply_overlap_flags(enable: bool = True, *, target: str = "tpu",
     new = (cur + " " + " ".join(missing)).strip()
     os.environ["XLA_FLAGS"] = new
     return new
+
+
+def overlap_fingerprint() -> str:
+    """The overlap-relevant environment state as a stable string: which
+    OVERLAP_XLA_FLAGS names are present in XLA_FLAGS (with their values,
+    so an explicit ``=false`` differs from installed) plus the
+    PT_NO_OVERLAP A/B lever. ``Trainer._fp_parts`` folds this into the
+    compile-cache fingerprint so a flag flip between runs can never hit
+    a stale AOT executable compiled under the other schedule."""
+    ours = {f.split("=")[0] for f in OVERLAP_XLA_FLAGS.split()}
+    toks = sorted(t for t in os.environ.get("XLA_FLAGS", "").split()
+                  if t.split("=")[0] in ours)
+    no = "PT_NO_OVERLAP;" if os.environ.get("PT_NO_OVERLAP") else ""
+    return no + " ".join(toks)
+
+
+def enable_overlap(enable: bool = True, *, target: Optional[str] = None,
+                   validate: Optional[bool] = None,
+                   cwd: Optional[str] = None,
+                   timeout: Optional[float] = None) -> Dict[str, object]:
+    """THE applied overlap policy (ISSUE 14): validate and install the
+    async-collective / latency-hiding flag set before backend init.
+
+    * strict no-op when off — ``enable=False`` or ``PT_NO_OVERLAP=1``
+      touches nothing and says so in the returned ``reason``;
+    * TPU-only — ``target`` defaults to :func:`_detect_target`; on a CPU
+      target the flags would make backend init fatal, so nothing is
+      installed;
+    * vetted — on TPU targets ``validate`` defaults to True (unknown
+      flags abort the process; see :func:`validate_xla_flags`, whose
+      verdict is memoized for the process lifetime);
+    * warn-once — an unsupported libtpu build (vet drops flags) warns a
+      single time per process, not per Trainer construction.
+
+    Returns ``{"enabled", "applied", "reason", "xla_flags",
+    "fingerprint"}``; ``fingerprint`` is :func:`overlap_fingerprint`
+    AFTER the install, the value trainers fold into the compile cache.
+    """
+    cur = os.environ.get("XLA_FLAGS", "")
+    if target is None:
+        target = _detect_target()
+    if os.environ.get("PT_NO_OVERLAP"):
+        reason = "PT_NO_OVERLAP"
+    elif not enable:
+        reason = "disabled"
+    elif target != "tpu":
+        reason = f"target={target}"
+    else:
+        reason = ""
+    if reason:
+        return {"enabled": False, "applied": [], "reason": reason,
+                "xla_flags": cur, "fingerprint": overlap_fingerprint()}
+    if validate is None:
+        validate = True
+    try:
+        initialized = bool(jax._src.xla_bridge._backends)  # noqa: SLF001
+    except AttributeError:
+        initialized = False
+    new = apply_overlap_flags(True, target=target, validate=validate,
+                              cwd=cwd, validate_timeout=timeout)
+    after = {t.split("=")[0] for t in new.split()}
+    wanted = [f.split("=")[0] for f in OVERLAP_XLA_FLAGS.split()]
+    applied = [n for n in wanted if n in after]
+    missing = [n for n in wanted if n not in after]
+    if initialized and missing:
+        reason = "backend-initialized"  # apply_overlap_flags warned once
+    elif missing:
+        reason = "partial" if applied else "no-flags-accepted"
+        _warn_once(
+            "unsupported:" + ",".join(missing),
+            f"paddle_tpu.overlap: this XLA/libtpu build rejects "
+            f"{len(missing)}/{len(wanted)} overlap flag(s) "
+            f"({', '.join(missing)}); continuing without them\n")
+    else:
+        reason = "applied"
+    return {"enabled": bool(applied), "applied": applied, "reason": reason,
+            "xla_flags": new, "fingerprint": overlap_fingerprint()}
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +490,7 @@ def _detect_target() -> str:
     return "tpu" if ("tpu" in jp or "axon" in jp) else "cpu"
 
 
-__all__ = ["OVERLAP_XLA_FLAGS", "apply_overlap_flags", "validate_xla_flags",
+__all__ = ["OVERLAP_XLA_FLAGS", "enable_overlap", "overlap_fingerprint",
+           "apply_overlap_flags", "validate_xla_flags",
            "backward_overlap_independent", "collectives_in_loop",
            "strategy_overlap_summary", "apply_strategy_overlap"]

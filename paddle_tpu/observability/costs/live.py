@@ -5,12 +5,20 @@ report (:func:`analyzer.attribute_costs`) with measured wall times and
 publishes, through the PR 4 registry:
 
 * ``pt_step_time_breakdown{component,bucket}`` — the measured per-step
-  wall time split into compute / collective / host / stall seconds. The
-  buckets SUM TO the measured step time by construction (same discipline
-  as the goodput ledger): compute and collective are the analytical
-  predictions, scaled down proportionally if they exceed what the wall
-  clock allows, and stall is the unattributed residual (input pipeline,
-  dispatch gaps, overlap the serialized model didn't credit).
+  wall time split into compute / collective / exposed_comm / host /
+  stall seconds. The buckets SUM TO the measured step time by
+  construction (same discipline as the goodput ledger): compute and
+  comm are the analytical predictions, scaled down proportionally if
+  they exceed what the wall clock allows, and stall is the unattributed
+  residual (input pipeline, dispatch gaps, overlap the serialized model
+  didn't credit). The comm share is further split by the ISSUE 14
+  overlap analyzer: ``collective`` is the part start→done windows hide
+  behind compute, ``exposed_comm`` the priced census minus that
+  overlap-window compute — the serialization actually on the clock.
+* ``pt_exposed_comm_fraction{component}`` — exposed ÷ total priced comm
+  seconds, published ONLY when the executable has async collective
+  windows (a sync-lowered backend is trivially 100% exposed and would
+  page a sentry on every CPU run for a structural non-event).
 * ``pt_model_flops_utilization{component}`` — HLO-attributed flops ÷
   (measured time × device peak): the MFU definition shared with bench's
   ``mfu_analytical`` and graph_lint's flop floor.
@@ -44,6 +52,13 @@ class CostWatch:
         self.component = component
         self.spec = spec or device_spec()
         self.report: Optional[CostReport] = None
+        # overlap verdict for the observed executable: fraction of its
+        # priced comm seconds NOT covered by start->done window compute,
+        # and how many async windows it has. Defaults (1.0, 0) = "all
+        # exposed, no async machinery" — the conservative truth for a
+        # report attached without HLO overlap analysis.
+        self.overlap_fraction: float = 1.0
+        self.overlap_async: int = 0
         self._exec_id: Optional[int] = None
         # per-executable report cache: a trainer alternating between two
         # bucketed batch shapes re-observes a different executable every
@@ -63,19 +78,32 @@ class CostWatch:
             return True
         cached = self._reports.get(rid)
         if cached is not None:
-            self.report, self._exec_id = cached, rid
+            (self.report, self.overlap_fraction,
+             self.overlap_async) = cached
+            self._exec_id = rid
             return True
         as_text = getattr(compiled, "as_text", None)
         if as_text is None:
             return False
         try:
             from ...analysis.hlo import parse_hlo
-            self.report = attribute_costs(parse_hlo(as_text()),
-                                          spec=self.spec)
+            mod = parse_hlo(as_text())
+            self.report = attribute_costs(mod, spec=self.spec)
+            # overlap split of the comm bucket (ISSUE 14); any analysis
+            # failure (unpaired start, exotic lowering) falls back to
+            # fully-exposed rather than silently crediting the overlap
+            try:
+                from ...analysis.overlap import overlap_report
+                ov = overlap_report(mod, spec=self.spec)
+                self.overlap_fraction = ov["exposed_comm_fraction"]
+                self.overlap_async = ov["async_collectives"]
+            except Exception:
+                self.overlap_fraction, self.overlap_async = 1.0, 0
             self._exec_id = rid
             if len(self._reports) >= 8:     # bounded; ids are stable while
                 self._reports.clear()       # the owner caches executables
-            self._reports[rid] = self.report
+            self._reports[rid] = (self.report, self.overlap_fraction,
+                                  self.overlap_async)
             return True
         except Exception:
             return False
@@ -115,16 +143,35 @@ class CostWatch:
         compute *= scale
         comm *= scale
         stall = max(0.0, measured_step_s - host - compute - comm)
+        # split the scaled comm share by the overlap verdict — hidden
+        # (start->done windows cover it with compute) vs exposed. The
+        # split preserves the exact-sum invariant: hidden + exposed is
+        # the comm share by construction.
+        exposed = comm * min(max(self.overlap_fraction, 0.0), 1.0)
+        hidden = comm - exposed
 
         lbl = {"component": self.component}
         g = REGISTRY.gauge(
             "pt_step_time_breakdown",
             "measured per-step wall time split into compute/collective/"
-            "host/stall (buckets sum to the measured step time)", "s")
+            "exposed_comm/host/stall (buckets sum to the measured step "
+            "time; collective = comm hidden behind overlap-window "
+            "compute, exposed_comm = the rest)", "s")
         g.set(compute, bucket="compute", **lbl)
-        g.set(comm, bucket="collective", **lbl)
+        g.set(hidden, bucket="collective", **lbl)
+        g.set(exposed, bucket="exposed_comm", **lbl)
         g.set(host, bucket="host", **lbl)
         g.set(stall, bucket="stall", **lbl)
+        if self.overlap_async > 0:
+            # sync-lowered backends (CPU CI) are structurally 100%
+            # exposed; publishing that would page the sentry's ratio
+            # band on a non-event, so the fraction gauge exists only
+            # where overlap machinery is actually in play
+            REGISTRY.gauge(
+                "pt_exposed_comm_fraction",
+                "exposed / total priced comm seconds of the executable "
+                "on the clock (only published when it has async "
+                "collective windows)").set(self.overlap_fraction, **lbl)
         REGISTRY.gauge(
             "pt_model_flops_utilization",
             "HLO-attributed flops / (measured time x device peak) — the "
@@ -140,5 +187,7 @@ class CostWatch:
             "as a monitored signal").set(ratio, **lbl)
         return {"mfu": mfu, "hbm_bw_utilization": hbm,
                 "predicted_over_measured": ratio,
-                "breakdown": {"compute": compute, "collective": comm,
+                "exposed_comm_fraction": self.overlap_fraction,
+                "breakdown": {"compute": compute, "collective": hidden,
+                              "exposed_comm": exposed,
                               "host": host, "stall": stall}}

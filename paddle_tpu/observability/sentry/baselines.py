@@ -114,6 +114,13 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("planner_top1_is_measured_top2", "lower", band=0.01),
     RatioMetric("planner_rank_agreement", "lower", band=0.3),
     RatioMetric("planner_predicted_mfu", "lower", cpu_band=0.45),
+    # latency-hiding contract (ISSUE 14): exposed (un-overlapped) comm
+    # fraction of the dp2xtp2 canonical step — structural per build, a
+    # GROWING fraction means a hiding window collapsed (higher=worse) —
+    # and the overlap-flags off÷on step-time ratio (interleaved
+    # min-of-rounds subprocess A/B; rides host noise, wide band)
+    RatioMetric("overlap_exposed_comm_fraction", "higher", band=0.5),
+    RatioMetric("overlap_on_step_speedup", "lower", band=0.35),
 ]}
 
 

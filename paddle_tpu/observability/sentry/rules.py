@@ -225,6 +225,7 @@ class Staleness(SloRule):
 def trainer_rules(goodput_floor: float = 0.5,
                   drift_band: Tuple[float, float] = (0.33, 3.0),
                   step_spike_ratio: float = 3.0,
+                  exposed_comm_ceiling: float = 0.6,
                   breach_for: int = 3,
                   cooldown_s: float = 300.0) -> List[SloRule]:
     """The training-loop pack: watches the PR 4 goodput ledger and the
@@ -257,6 +258,18 @@ def trainer_rules(goodput_floor: float = 0.5,
             description="per-step wall time spiked vs its own EWMA: "
                         "input stall, thermal/contention event, or a "
                         "recompile storm"),
+        RatioBand(
+            "exposed_comm", "pt_exposed_comm_fraction",
+            labels={"component": "train"}, baseline=1.0,
+            low=0.0, high=exposed_comm_ceiling,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="exposed (un-overlapped) comm fraction over the "
+                        "band: start->done windows collapsed — a flag "
+                        "flip, libtpu downgrade, or a schedule "
+                        "regression serialized the collective lane. The "
+                        "gauge only exists on executables with async "
+                        "windows, so sync-lowered (CPU) runs skip"),
     ]
 
 

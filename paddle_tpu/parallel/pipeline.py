@@ -174,14 +174,19 @@ def pipeline_spmd(stage_fn: Callable, stacked_params, x_microbatches,
                                            keepdims=False)
         state = state.at[0].set(inj)
         out = vstage(stacked_params, state, *extras)
+        # advance the pipe: stage s feeds stage s+1 (CollectivePermute on
+        # pp). Posted immediately after the stage compute — before the
+        # output-drain bookkeeping below — so the permute's start->done
+        # window spans the drain's gather/scatter instead of sitting
+        # exposed at the scan-body tail (double-buffered send, ISSUE 14;
+        # its only consumer is the NEXT tick's vstage).
+        state = jnp.roll(out, 1, axis=0)
         # drain stage S-1 for microbatch t-(S-1)
         oidx = t - (S - 1)
         oclip = jnp.clip(oidx, 0, M - 1)
         prev = jax.lax.dynamic_index_in_dim(outputs, oclip, 0, keepdims=False)
         val = jnp.where(oidx >= 0, out[-1], prev)
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, oclip, 0)
-        # advance the pipe: stage s feeds stage s+1 (CollectivePermute on pp)
-        state = jnp.roll(out, 1, axis=0)
         return (state, outputs), None
 
     (_, outputs), _ = jax.lax.scan(tick, (state0, out0),

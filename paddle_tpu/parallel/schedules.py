@@ -124,7 +124,14 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
                                     jnp.float32(1.0))
 
     fin0 = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
-    bcot0 = jnp.zeros((S,) + x_mb.shape[1:], jnp.float32)
+    # the carry holds the UN-rolled backward cotangent (dh, stage-local);
+    # the boundary exchange (roll = pp CollectivePermute) is posted at the
+    # TOP of the consuming tick — double-buffered sends (ISSUE 14): the
+    # permute's start->done window then spans the tick's forward compute
+    # instead of sitting exposed at the body tail, where XLA's
+    # latency-hiding scheduler cannot reach across the scan iteration.
+    # roll(zeros) == zeros, so dh0 reproduces the old bcot0 bit-exactly.
+    dh0 = jnp.zeros((S,) + x_mb.shape[1:], jnp.float32)
     dx0 = jnp.zeros(x_mb.shape, jnp.float32)
 
     # ---- F-cell: forward one stage, saving what backward will need ------
@@ -151,7 +158,7 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
         ring0 = [jnp.zeros((s.shape[0], R) + tuple(s.shape[1:]), s.dtype)
                  for s in leaf_sh]
 
-    carry0 = (fin0, bcot0, ring0, dx0, _tree_zeros(stacked_params),
+    carry0 = (fin0, dh0, ring0, dx0, _tree_zeros(stacked_params),
               _tree_zeros(head_params), jnp.float32(0.0), jnp.float32(0.0))
 
     def ring_write(ring_s, h_s, idx, valid):
@@ -218,15 +225,26 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
 
     # ---- fill: t in [0, S-1) — only F-slots can be live -----------------
     def fill_tick(carry, t):
-        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        fin, dh, ring, dx, gacc, hacc, lacc, wacc = carry
         out_f, ring = f_cell(fin, ring, t)
         fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
-        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+        return (fin, dh, ring, dx, gacc, hacc, lacc, wacc), None
 
     # ---- steady: t in [S-1, M+S-1) — one F and one B per tick -----------
     def steady_tick(carry, t):
-        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
+        fin, dh, ring, dx, gacc, hacc, lacc, wacc = carry
+        # double-buffered boundary exchange: post the backward permute
+        # FIRST — b_cell (its only consumer) runs after the forward cell
+        # and the loss head, so the transfer rides behind them. Same
+        # values the old tail-roll produced, one tick later by carry.
+        bcot = jnp.roll(dh, -1, axis=0)     # stage s -> s-1
         out_f, ring = f_cell(fin, ring, t)
+        # forward permute posted right after the F-cell: its consumer is
+        # the NEXT tick's f_cell, so the head + backward below are its
+        # in-window compute. The two opposite-direction permutes remain
+        # independent — XLA runs them concurrently over bidirectional ICI
+        # (reference's send_forward_recv_backward pairing).
+        fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
         # loss head (once, un-vmapped): stage S-1 backwards microbatch m in
         # the very tick that forwarded it, so the head consumes this tick's
         # F-slot output directly. m_b[S-1] = t-(S-1) is always valid here.
@@ -239,19 +257,14 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x_mb, targets_mb,
         wacc = wacc + w
         hacc = _tree_add(hacc, g_head)
         dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t, g_loss)
-        # fused neighbor exchange: the two opposite-direction permutes are
-        # independent — XLA runs them concurrently over bidirectional ICI
-        # (reference's send_forward_recv_backward pairing).
-        fin = jnp.roll(out_f, 1, axis=0)    # stage s -> s+1
-        bcot = jnp.roll(dh, -1, axis=0)     # stage s -> s-1
-        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+        return (fin, dh, ring, dx, gacc, hacc, lacc, wacc), None
 
     # ---- drain: t in [M+S-1, M+2S-2) — only B-slots can be live ---------
     def drain_tick(carry, t):
-        fin, bcot, ring, dx, gacc, hacc, lacc, wacc = carry
-        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t)
+        fin, dh, ring, dx, gacc, hacc, lacc, wacc = carry
         bcot = jnp.roll(dh, -1, axis=0)
-        return (fin, bcot, ring, dx, gacc, hacc, lacc, wacc), None
+        dh, dx, gacc = b_cell(bcot, ring, dx, gacc, t)
+        return (fin, dh, ring, dx, gacc, hacc, lacc, wacc), None
 
     carry, _ = jax.lax.scan(fill_tick, carry0, jnp.arange(S - 1))
     carry, _ = jax.lax.scan(steady_tick, carry, jnp.arange(S - 1, M + S - 1))

@@ -43,6 +43,7 @@ import numpy as np
 from .. import observability as _obs
 from ..core import compile_cache
 from ..core.rng import rng_tracker
+from ..distributed.overlap import overlap_fingerprint as _overlap_fingerprint
 from ..nn.layer import Layer
 from ..optimizer.optimizer import Optimizer
 from ..profiler import RecordEvent
@@ -354,6 +355,11 @@ class Trainer:
                     bool(os.environ.get("PT_NAIVE_LOSS_HEAD")),
                 "PT_DISABLE_PALLAS":
                     bool(os.environ.get("PT_DISABLE_PALLAS")),
+                # overlap scheduler flags change the compiled schedule
+                # (async start/done placement) with identical avals — a
+                # flag flip between runs must not aot-hit the executable
+                # compiled under the other schedule (ISSUE 14)
+                "overlap": _overlap_fingerprint(),
             },
             "sublayers": structure,
             "optimizer_class": type(opt).__qualname__,
@@ -984,7 +990,14 @@ class Trainer:
                 last_saved = self._step
                 if rolled:
                     continue
-                self._save_ckpt(mgr, data)
+                # async: the save enqueues (synchronous device->host
+                # snapshot, background serialize/IO) and the NEXT
+                # superstep dispatches immediately — the write overlaps
+                # compute instead of extending the drain. Commit
+                # (PENDING -> _COMMITTED, PR 1 protocol) happens at the
+                # manager's next finalize: the following save, a
+                # restore, or the sync end-of-fit save below (ISSUE 14).
+                self._save_ckpt(mgr, data, async_save=True)
         if guard is not None and guard.preempted:
             self._preempt_exit(mgr, data)
         if mgr is not None:

@@ -288,7 +288,8 @@ def test_trainer_publishes_breakdown_and_mfu_gauges():
         assert math.isfinite(ratio) and ratio > 0
         bd = {b: REGISTRY.gauge("pt_step_time_breakdown").value(
             bucket=b, **lbl)
-            for b in ("compute", "collective", "host", "stall")}
+            for b in ("compute", "collective", "exposed_comm",
+                      "host", "stall")}
         assert all(v >= 0 for v in bd.values())
         # the breakdown invariant: buckets sum EXACTLY to the measured
         # per-step time of the last published window
@@ -340,7 +341,8 @@ def test_serving_publishes_cost_gauges():
         bd_sum = sum(
             REGISTRY.gauge("pt_step_time_breakdown").value(
                 bucket=b, component="serving")
-            for b in ("compute", "collective", "host", "stall"))
+            for b in ("compute", "collective", "exposed_comm",
+                      "host", "stall"))
         assert bd_sum > 0
     finally:
         REGISTRY.disable()

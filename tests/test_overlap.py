@@ -201,9 +201,11 @@ class TestFlagVetting:
 
     def _no_cache(self, monkeypatch, tmp_path):
         # point the cache at a throwaway dir: tests must not poison (or
-        # read) the real build/xla_flag_cache.json
+        # read) the real build/xla_flag_cache.json; the process-lifetime
+        # memo is likewise reset so each test sees a fresh process
         import paddle_tpu.distributed.overlap as ov
         real = os.path.abspath
+        monkeypatch.setattr(ov, "_VET_MEMO", {})
         monkeypatch.setattr(
             ov.os.path, "abspath",
             lambda p: str(tmp_path / "x" / "y" / "z.py")
@@ -272,6 +274,146 @@ class TestFlagVetting:
             calls.clear()
             assert overlap.validate_xla_flags(["--a=1"]) == ["--a=1"]
             assert calls == [], "cached verdict should skip the probe"
+
+
+class TestVetMemo:
+    """ISSUE 14 satellite: the vet verdict is memoized for the process
+    lifetime — Trainers are constructed per experiment, but the flag set
+    an XLA build accepts cannot change within one process."""
+
+    def test_definitive_verdict_probed_once_per_process(self, monkeypatch,
+                                                        tmp_path):
+        vet = TestFlagVetting()
+        vet._no_cache(monkeypatch, tmp_path)
+        calls = []
+        vet._patch_probe(monkeypatch, [(True, "TPU_OK")], calls)
+        assert overlap.validate_xla_flags(["--a=1", "--b=1"]) \
+            == ["--a=1", "--b=1"]
+        assert len(calls) == 1
+        calls.clear()
+        # same candidate set again: memo hit, no subprocess — even when
+        # the disk cache is unavailable (plugin-meta-unavailable builds)
+        assert overlap.validate_xla_flags(["--a=1", "--b=1"]) \
+            == ["--a=1", "--b=1"]
+        assert calls == []
+
+    def test_memo_filters_to_requested_candidates(self, monkeypatch,
+                                                  tmp_path):
+        vet = TestFlagVetting()
+        vet._no_cache(monkeypatch, tmp_path)
+        calls = []
+        vet._patch_probe(monkeypatch, [
+            (False, "UNKNOWN_XLA_FLAGS --a"),
+            (True, "TPU_OK"),
+        ], calls)
+        assert overlap.validate_xla_flags(["--a=1", "--b=1"]) == ["--b=1"]
+        calls.clear()
+        assert overlap.validate_xla_flags(["--a=1", "--b=1"]) == ["--b=1"]
+        assert calls == []
+
+
+class TestWarnOnce:
+    def test_backend_initialized_warns_once_per_process(self, monkeypatch,
+                                                        capsys):
+        # fresh warn-set: earlier tests in this process may have tripped it
+        monkeypatch.setattr(overlap, "_WARNED", set())
+        monkeypatch.setenv("XLA_FLAGS", "")
+        overlap.apply_overlap_flags(True, target="tpu")
+        assert "backend already initialized" in capsys.readouterr().err
+        overlap.apply_overlap_flags(True, target="tpu")
+        assert capsys.readouterr().err == "", \
+            "second refusal must not warn again (per-Trainer noise)"
+
+
+class TestEnableOverlap:
+    """enable_overlap(): the applied policy entrypoint (ISSUE 14)."""
+
+    def test_disabled_is_strict_noop(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "--sentinel=1")
+        monkeypatch.delenv("PT_NO_OVERLAP", raising=False)
+        res = overlap.enable_overlap(False)
+        assert res == {"enabled": False, "applied": [],
+                       "reason": "disabled", "xla_flags": "--sentinel=1",
+                       "fingerprint": ""}
+        assert os.environ["XLA_FLAGS"] == "--sentinel=1"
+
+    def test_pt_no_overlap_wins_and_keys_fingerprint(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        monkeypatch.setenv("PT_NO_OVERLAP", "1")
+        res = overlap.enable_overlap(True, target="tpu")
+        assert res["enabled"] is False
+        assert res["reason"] == "PT_NO_OVERLAP"
+        # the A/B lever itself is part of the compile-cache key
+        assert res["fingerprint"].startswith("PT_NO_OVERLAP;")
+
+    def test_cpu_target_is_noop_with_reason(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "--keep=1")
+        monkeypatch.delenv("PT_NO_OVERLAP", raising=False)
+        res = overlap.enable_overlap(True, target="cpu")
+        assert res["enabled"] is False and res["reason"] == "target=cpu"
+        assert os.environ["XLA_FLAGS"] == "--keep=1"
+
+    def test_initialized_backend_reports_reason(self, monkeypatch):
+        # this test process HAS a live backend: the tpu path must refuse
+        # (warn-once) and say why, leaving XLA_FLAGS untouched
+        monkeypatch.setattr(overlap, "_WARNED", set())
+        monkeypatch.setenv("XLA_FLAGS", "")
+        monkeypatch.delenv("PT_NO_OVERLAP", raising=False)
+        res = overlap.enable_overlap(True, target="tpu", validate=False)
+        assert res["enabled"] is False
+        assert res["reason"] == "backend-initialized"
+        assert os.environ["XLA_FLAGS"] == ""
+
+    def test_fingerprint_tracks_installed_flags(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        monkeypatch.delenv("PT_NO_OVERLAP", raising=False)
+        assert overlap.overlap_fingerprint() == ""
+        # foreign flags don't key the fingerprint...
+        monkeypatch.setenv("XLA_FLAGS", "--xla_something_else=1")
+        assert overlap.overlap_fingerprint() == ""
+        # ...ours do, with their values (an explicit =false differs from
+        # installed), in stable sorted order
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_enable_async_all_gather=true "
+            "--xla_tpu_overlap_compute_collective_tc=false")
+        fp = overlap.overlap_fingerprint()
+        assert fp == ("--xla_enable_async_all_gather=true "
+                      "--xla_tpu_overlap_compute_collective_tc=false")
+
+
+class TestTrainerFingerprint:
+    def test_compile_cache_keys_on_overlap_env(self, monkeypatch):
+        """A flag flip between runs must never aot-hit an executable
+        compiled under the other schedule: the overlap fingerprint is
+        part of Trainer._fp_parts (ISSUE 14)."""
+        from paddle_tpu import nn
+        from paddle_tpu.nn.layer import Layer
+        from paddle_tpu.optimizer import SGD
+        from paddle_tpu.trainer import Trainer
+
+        class M(Layer):
+            def __init__(self):
+                super().__init__()
+                self.l = nn.Linear(4, 1)
+
+            def forward(self, x):
+                return jnp.mean(self.l(x) ** 2)
+
+        def fp_env():
+            m = M()
+            tr = Trainer(m, SGD(learning_rate=0.1, parameters=m))
+            return tr._fp_parts()["env"]["overlap"]
+
+        monkeypatch.delenv("PT_NO_OVERLAP", raising=False)
+        monkeypatch.setenv("XLA_FLAGS", "")
+        base = fp_env()
+        monkeypatch.setenv(
+            "XLA_FLAGS", "--xla_tpu_overlap_compute_collective_tc=true")
+        flagged = fp_env()
+        assert flagged != base
+        monkeypatch.setenv("PT_NO_OVERLAP", "1")
+        assert fp_env() not in (base, flagged)
 
 
 class TestUnknownFlagParsing:

@@ -1,0 +1,317 @@
+"""Overlap analyzer (ISSUE 14): start->done pairing, window pricing, and
+the budget gate that fails when a hiding window collapses.
+
+What is pinned here:
+
+* the census's single-walk pairing on synthetic async HLO: a priced
+  window between ``-start``/``-done``, a zero-distance adjacent pair,
+  multiple interleaved in-flight windows each matched to ITS own done,
+  and an unmatched ``-start`` raising an actionable error naming the op
+  (never silently reporting the transfer as hidden);
+* nested fusions inside a window are priced through their called
+  computation (the ISSUE 9 cost walker — no second flop formula);
+* the serialized-variant acceptance: pin a budget from the overlapped
+  graph, re-check the SAME compute with its collective lowered
+  synchronously, and the budget check fails naming the collective and
+  budget -> actual for both overlap kinds;
+* ``tools/graph_lint.py`` exits nonzero (main() -> ok=False) when a
+  checked-in budget demands overlap a canonical graph doesn't deliver;
+* CostWatch splits the comm bucket into hidden (``collective``) vs
+  ``exposed_comm`` with the 5-bucket exact-sum invariant intact, and
+  publishes ``pt_exposed_comm_fraction`` only for executables that
+  actually have async windows.
+"""
+
+import json
+import os
+
+import pytest
+
+import paddle_tpu.analysis as A
+from paddle_tpu.analysis import UnmatchedCollectiveError, overlap_report
+from paddle_tpu.observability import costs
+from paddle_tpu.observability.costs.device_db import DeviceSpec
+from paddle_tpu.observability.metrics import REGISTRY
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+# roofline chosen so the window compute (a 128x128 dot + fusion) is far
+# larger than the 16 KiB transfer: the pair below is robustly hidden
+_SPEC = DeviceSpec(kind="test", peak_flops=1e12, hbm_bw=1e12, link_bw=1e13)
+
+_PREAMBLE = """\
+%sum_comp (a.1: f32[], b.1: f32[]) -> f32[] {
+  %a.1 = f32[] parameter(0)
+  %b.1 = f32[] parameter(1)
+  ROOT %add.s = f32[] add(f32[] %a.1, f32[] %b.1)
+}
+
+%win_fusion (param_0.3: f32[128,128]) -> f32[128,128] {
+  %param_0.3 = f32[128,128]{1,0} parameter(0)
+  ROOT %multiply.w = f32[128,128]{1,0} multiply(f32[128,128]{1,0} %param_0.3, f32[128,128]{1,0} %param_0.3)
+}
+"""
+
+_HDR = ("HloModule jit_step, entry_computation_layout="
+        "{(f32[64,64]{1,0},f32[128,128]{1,0})->"
+        "(f32[64,64]{1,0}, f32[128,128]{1,0})}\n\n")
+
+# async pair with a dot and a fusion scheduled inside the window
+_OVERLAPPED = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ars.1 = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, use_global_device_ids=true, to_apply=%sum_comp, metadata={op_name="jit(step)/psum"}
+  %dot.1 = f32[128,128]{1,0} dot(f32[128,128]{1,0} %p1.1, f32[128,128]{1,0} %p1.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.1 = f32[128,128]{1,0} fusion(f32[128,128]{1,0} %dot.1), kind=kLoop, calls=%win_fusion
+  %ard.1 = f32[64,64]{1,0} all-reduce-done(f32[64,64]{1,0} %ars.1), channel_id=1
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %ard.1, f32[128,128]{1,0} %fusion.1)
+}
+"""
+
+# the SAME compute, collective lowered synchronously — what the graph
+# looks like when the latency-hiding scheduler stops doing its job
+_SERIALIZED = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ar.1 = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, use_global_device_ids=true, to_apply=%sum_comp, metadata={op_name="jit(step)/psum"}
+  %dot.1 = f32[128,128]{1,0} dot(f32[128,128]{1,0} %p1.1, f32[128,128]{1,0} %p1.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %fusion.1 = f32[128,128]{1,0} fusion(f32[128,128]{1,0} %dot.1), kind=kLoop, calls=%win_fusion
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %ar.1, f32[128,128]{1,0} %fusion.1)
+}
+"""
+
+
+# -- pairing + pricing -------------------------------------------------------
+
+def test_async_pair_window_priced_and_hidden():
+    rep = overlap_report(A.parse_hlo(_OVERLAPPED), spec=_SPEC)
+    assert rep["async_collectives"] == 1
+    assert rep["sync_collectives"] == 0
+    # dot + fusion are the priced independent ops inside the window;
+    # the -done itself and the ROOT tuple are outside it
+    assert rep["min_overlap_distance"] == 2
+    (w,) = rep["windows"]
+    assert w.is_async and w.done_index is not None
+    assert w.window_compute_s > 0 and w.comm_s > 0
+    # window compute dwarfs the 16 KiB transfer: fully hidden
+    assert rep["exposed_comm_fraction"] == 0.0
+    assert rep["hidden_comm_s"] == pytest.approx(rep["total_comm_s"])
+    assert "all-reduce" in rep["min_distance_collective"]
+
+
+def test_nested_fusion_priced_via_called_computation():
+    """A fusion is priced through its called computation — a zero-cost
+    read of the fusion op itself would drop it from the window."""
+    txt = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ars.1 = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, to_apply=%sum_comp
+  %fusion.1 = f32[128,128]{1,0} fusion(f32[128,128]{1,0} %p1.1), kind=kLoop, calls=%win_fusion
+  %ard.1 = f32[64,64]{1,0} all-reduce-done(f32[64,64]{1,0} %ars.1), channel_id=1
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %ard.1, f32[128,128]{1,0} %fusion.1)
+}
+"""
+    rep = overlap_report(A.parse_hlo(txt), spec=_SPEC)
+    (w,) = rep["windows"]
+    assert w.distance == 1                     # the fusion, priced
+    assert w.window_compute_s > 0
+
+
+def test_zero_distance_adjacent_pair_fully_exposed():
+    txt = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ars.1 = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, to_apply=%sum_comp
+  %ard.1 = f32[64,64]{1,0} all-reduce-done(f32[64,64]{1,0} %ars.1), channel_id=1
+  %dot.1 = f32[128,128]{1,0} dot(f32[128,128]{1,0} %p1.1, f32[128,128]{1,0} %p1.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %ard.1, f32[128,128]{1,0} %dot.1)
+}
+"""
+    rep = overlap_report(A.parse_hlo(txt), spec=_SPEC)
+    (w,) = rep["windows"]
+    # adjacent pair: async machinery present but the window is empty —
+    # the dot AFTER the -done hides nothing
+    assert w.is_async and w.distance == 0
+    assert rep["min_overlap_distance"] == 0
+    assert rep["exposed_comm_fraction"] == 1.0
+
+
+def test_interleaved_windows_pair_to_their_own_done():
+    txt = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ars.a = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, to_apply=%sum_comp
+  %ars.b = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=2, replica_groups={{0,1}}, to_apply=%sum_comp
+  %dot.1 = f32[128,128]{1,0} dot(f32[128,128]{1,0} %p1.1, f32[128,128]{1,0} %p1.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %ard.a = f32[64,64]{1,0} all-reduce-done(f32[64,64]{1,0} %ars.a), channel_id=1
+  %ard.b = f32[64,64]{1,0} all-reduce-done(f32[64,64]{1,0} %ars.b), channel_id=2
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %ard.a, f32[128,128]{1,0} %dot.1)
+}
+"""
+    mod = A.parse_hlo(txt)
+    table = A.collective_census(mod)["table"]
+    assert [(c.name, c.done_name) for c in table] \
+        == [("ars.a", "ard.a"), ("ars.b", "ard.b")]
+    rep = overlap_report(mod, spec=_SPEC)
+    # each window holds exactly the dot: the other in-flight collective
+    # (b's start inside a's window, a's done inside b's) occupies the
+    # comm lane and must not count as hiding compute
+    assert [w.distance for w in rep["windows"]] == [1, 1]
+    assert rep["async_collectives"] == 2
+
+
+def test_unmatched_start_raises_actionable_error():
+    txt = _HDR + _PREAMBLE + """
+ENTRY %main.1 (p0.1: f32[64,64], p1.1: f32[128,128]) -> (f32[64,64], f32[128,128]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  %p1.1 = f32[128,128]{1,0} parameter(1)
+  %ars.1 = f32[64,64]{1,0} all-reduce-start(f32[64,64]{1,0} %p0.1), channel_id=1, replica_groups={{0,1}}, to_apply=%sum_comp
+  %dot.1 = f32[128,128]{1,0} dot(f32[128,128]{1,0} %p1.1, f32[128,128]{1,0} %p1.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %tuple.1 = (f32[64,64]{1,0}, f32[128,128]{1,0}) tuple(f32[64,64]{1,0} %p0.1, f32[128,128]{1,0} %dot.1)
+}
+"""
+    with pytest.raises(UnmatchedCollectiveError) as ei:
+        overlap_report(A.parse_hlo(txt), spec=_SPEC)
+    msg = str(ei.value)
+    assert "ars.1" in msg                       # names the op
+    assert "all-reduce-done" in msg             # says what is missing
+    assert "hidden" in msg                      # and why it refuses
+
+
+def test_hand_built_census_table_rejected():
+    """A census table without walk indices (stale/hand-built) must be
+    rejected, not silently analyzed with garbage positions."""
+    mod = A.parse_hlo(_OVERLAPPED)
+    census = A.collective_census(mod)
+    for c in census["table"]:
+        c.index = -1
+    with pytest.raises(ValueError, match="indices"):
+        overlap_report(mod, census=census, spec=_SPEC)
+
+
+# -- the budget gate ---------------------------------------------------------
+
+def test_serialized_variant_breaks_pinned_overlap_budget():
+    """ISSUE 14 acceptance: pin a budget from the overlapped graph, then
+    check the deliberately serialized variant — same compute, same
+    collective census — and the gate fails naming the collective and
+    budget -> actual for BOTH overlap budget kinds (and nothing else)."""
+    rep_o = A.analyze(_OVERLAPPED, "synthetic_step")
+    entry = {"budget": A.snapshot_report(rep_o), "waivers": {}}
+    assert not A.check_budget(rep_o, entry)     # budget holds on itself
+
+    rep_s = A.analyze(_SERIALIZED, "synthetic_step")
+    violations = A.check_budget(rep_s, entry)
+    rules = sorted(v.rule for v in violations)
+    assert rules == ["budget.exposed_comm_fraction",
+                     "budget.min_overlap_distance"]
+    rendered = A.render_violations(violations)
+    assert "%ar.1" in rendered                  # the collective, named
+    assert "budget" in rendered and "actual" in rendered
+    d = {v.rule: v for v in violations}
+    assert "-> actual 0" in d["budget.min_overlap_distance"].message
+    assert "1.0" in d["budget.exposed_comm_fraction"].message
+
+
+def test_overlap_contract_fields_enforced():
+    """The declarative GraphContract side of the same invariants."""
+    rep_s = A.analyze(_SERIALIZED, "synthetic_step")
+    c = A.GraphContract("synthetic_step", min_overlap_distance=2,
+                        max_exposed_comm_fraction=0.25)
+    rules = {v.rule for v in A.check_contract(c, rep_s)}
+    assert rules == {"overlap.min_overlap_distance",
+                     "overlap.max_exposed_comm_fraction"}
+    rep_o = A.analyze(_OVERLAPPED, "synthetic_step")
+    assert A.check_contract(c, rep_o) == []
+
+
+def test_graph_lint_fails_on_collapsed_overlap_budget(tmp_path):
+    """End to end through tools/graph_lint.py: a checked-in budget that
+    demands overlap a canonical multi-device graph doesn't deliver makes
+    main() return ok=False (the CLI exits nonzero on that), with the
+    violation naming budget -> actual."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graph_lint", os.path.join(TOOLS, "graph_lint.py"))
+    gl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gl)
+
+    with open(os.path.join(TOOLS, "graph_budgets.json")) as f:
+        budgets = json.load(f)
+    b = budgets["graphs"]["tp_fused_ce"]["budget"]
+    # CPU lowers the tp collectives synchronously: the honest pin is
+    # distance 0 / fraction 1.0 — demand more and the gate must fail
+    b["min_overlap_distance"] = 4
+    b["exposed_comm_fraction"] = 0.1
+    doctored = tmp_path / "budgets.json"
+    doctored.write_text(json.dumps(budgets))
+
+    res = gl.main(budgets_path=str(doctored), graphs=["tp_fused_ce"],
+                  verbose=False)
+    assert res["ok"] is False
+    joined = "\n".join(res["violations"])
+    assert "budget.min_overlap_distance" in joined
+    assert "budget.exposed_comm_fraction" in joined
+    assert "-> actual" in joined
+
+
+# -- CostWatch comm split ----------------------------------------------------
+
+class _FakeCompiled:
+    def __init__(self, text):
+        self._text = text
+
+    def as_text(self):
+        return self._text
+
+
+def _publish(text, measured=0.01, host=0.002):
+    w = costs.CostWatch("t", spec=_SPEC)
+    assert w.observe_executable(_FakeCompiled(text))
+    return w, w.publish(measured, host_s=host)
+
+
+def test_cost_watch_splits_comm_and_keeps_exact_sum():
+    REGISTRY.enable()
+    try:
+        w, out = _publish(_OVERLAPPED)
+        bd = out["breakdown"]
+        assert set(bd) == {"compute", "collective", "exposed_comm",
+                           "host", "stall"}
+        assert sum(bd.values()) == pytest.approx(0.01, rel=1e-9)
+        # the overlapped module hides everything: exposed share is zero
+        assert w.overlap_async == 1
+        assert out["exposed_comm_fraction"] == 0.0
+        assert bd["exposed_comm"] == 0.0
+        names = {e["name"] for e in REGISTRY.collect()}
+        assert "pt_exposed_comm_fraction" in names
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()
+
+
+def test_cost_watch_sync_module_fully_exposed_no_fraction_gauge():
+    REGISTRY.enable()
+    try:
+        w, out = _publish(_SERIALIZED)
+        bd = out["breakdown"]
+        assert sum(bd.values()) == pytest.approx(0.01, rel=1e-9)
+        # sync lowering: all comm seconds land in exposed_comm, none are
+        # credited as hidden
+        assert w.overlap_async == 0
+        assert out["exposed_comm_fraction"] == 1.0
+        assert bd["collective"] == 0.0
+        assert bd["exposed_comm"] > 0.0
+        # and the fraction gauge is NOT published (a structural 100% on
+        # a sync backend must never page the sentry)
+        names = {e["name"] for e in REGISTRY.collect()}
+        assert "pt_exposed_comm_fraction" not in names
+    finally:
+        REGISTRY.disable()
+        REGISTRY.reset()

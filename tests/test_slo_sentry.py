@@ -468,6 +468,30 @@ def test_disabled_plane_never_snapshots(monkeypatch):
     monkeypatch.setattr(REGISTRY, "collect", boom)
     assert s.tick() == []
     assert s.ticks == 0
+    # ISSUE 14: the full trainer pack (now incl. the exposed_comm ratio
+    # band) must keep the plane-off path one attr-load + branch — no
+    # rule may force a collect() just by existing in the list
+    full = sn.SloSentry(sn.trainer_rules())
+    assert full.tick() == []
+    assert full.ticks == 0
+
+
+def test_exposed_comm_rule_breaches_over_ceiling_and_skips_when_absent():
+    """ISSUE 14 trainer pack: the exposed_comm RatioBand fires when the
+    fraction gauge exceeds the ceiling, stays quiet inside the band, and
+    — crucially — SKIPS when the series is absent (sync-lowered CPU runs
+    never publish it, so they must never page)."""
+    rules = [r for r in sn.trainer_rules(breach_for=1)
+             if r.name == "exposed_comm"]
+    assert len(rules) == 1
+    s = sn.SloSentry(rules)
+    assert s.tick(now=1.0) == []          # series absent: skipped
+    g = REGISTRY.gauge("pt_exposed_comm_fraction", "t")
+    g.set(0.9, component="train")
+    fired = s.tick(now=2.0)
+    assert [i.rule for i in fired] == ["exposed_comm"]
+    g.set(0.2, component="train")         # healthy: mostly hidden
+    assert s.tick(now=1000.0) == []
 
 
 def test_maybe_tick_without_sentry_is_noop():

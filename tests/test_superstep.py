@@ -261,6 +261,36 @@ def test_resume_mid_superstep_bit_exact(tmp_path):
     assert digest(tr2.params) == digest(tr3.params)
 
 
+def test_superstep_mid_run_saves_async_and_all_committed(tmp_path):
+    """ISSUE 14: mid-run superstep checkpoints enqueue asynchronously —
+    the next superstep dispatches while the write drains in the
+    background — and every save is committed by a later finalize
+    (PENDING -> _COMMITTED, PR 1 protocol). The end-of-fit save stays
+    synchronous, so nothing is left pending when fit returns."""
+    from paddle_tpu.observability.metrics import REGISTRY
+    tr = build()
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=4)
+    REGISTRY.enable()
+    try:
+        tr.fit(iter(make_batches(12)), steps=12, log_every=100,
+               steps_per_dispatch=4, checkpoint_manager=mgr)
+        c = REGISTRY.counter("pt_checkpoint_saves_total")
+        assert c.value(mode="async") >= 2      # steps 4 and 8, mid-run
+        assert c.value(mode="sync") >= 1       # end-of-fit save
+    finally:
+        REGISTRY.disable()
+    assert mgr._pending is None
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".PENDING")]
+    assert mgr.latest_committed() == 12
+    # async-written steps verify their manifests and restore bit-exactly
+    s, tree = mgr.restore(tr._ckpt_tree(), step=8)
+    assert s == 8
+    s, tree = mgr.restore(tr._ckpt_tree())
+    assert s == 12
+    assert digest({k: np.asarray(v) for k, v in tree["params"].items()}) \
+        == digest(tr.params)
+
+
 def test_superstep_anomaly_rollback(tmp_path):
     """A NaN batch inside a superstep window rolls back to the last good
     checkpoint at the drain boundary and the run completes finite."""

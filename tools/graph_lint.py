@@ -130,6 +130,8 @@ def main(budgets_path: str = DEFAULT_BUDGETS, update: bool = False,
                 "aliased_param_count": "floor",
                 "collective_counts": "exact",
                 "analytical_flops": "floor",
+                "min_overlap_distance": "floor",
+                "exposed_comm_fraction": "ceiling",
                 "undonated_candidates":
                     "closed set; new entries need a fix or a waiver",
             },
