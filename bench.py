@@ -1491,6 +1491,50 @@ def _planner_probe(on_tpu):
     return out
 
 
+def _elastic_probe(on_tpu):
+    """Elastic scale-in rows (ISSUE 15): a timed mini kill→reshard cycle
+    on the micro model. ``elastic_reshard_seconds`` = wall time to
+    verify + reshard + place a checkpoint saved under the big mesh onto
+    half the devices; ``elastic_resume_steps_replayed`` = killed_step −
+    restored_step under the probe's save-every-4/kill-at-6 schedule
+    (2 by construction — any other value means the cadence or the
+    commit/fallback logic regressed). With ≥2 local devices the cycle
+    runs inline; a single-device host delegates to
+    ``paddle_tpu.testing._elastic_train --probe-reshard`` on 4 virtual
+    CPU devices — ``elastic_probe_backend`` records which."""
+    out = {}
+    try:
+        import jax
+        if jax.device_count() >= 2:
+            _log("elastic: timing reshard cycle on the local mesh")
+            from paddle_tpu.testing._elastic_train import reshard_probe
+            out.update(reshard_probe())
+            out["elastic_probe_backend"] = "inline"
+        else:
+            import subprocess
+            _log("elastic: reshard cycle on a 4-virtual-device subprocess")
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            cmd = [sys.executable, "-m",
+                   "paddle_tpu.testing._elastic_train",
+                   "--ckpt-dir", "unused", "--probe-reshard",
+                   "--virtual-devices", "4"]
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=900, env=env)
+            if res.returncode != 0:
+                raise RuntimeError(f"_elastic_train rc={res.returncode}: "
+                                   f"{res.stderr[-300:]}")
+            for line in res.stdout.splitlines():
+                if line.startswith("ELASTIC_PROBE "):
+                    out.update(json.loads(line[len("ELASTIC_PROBE "):]))
+            out["elastic_probe_backend"] = "cpu-subprocess"
+    except Exception as e:
+        out["elastic_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    return out
+
+
 _ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_artifacts")
 
@@ -1745,6 +1789,7 @@ def _run(error_note):
     detail.update(_obs_probe(on_tpu))
     detail.update(_graph_contracts_probe(on_tpu))
     detail.update(_planner_probe(on_tpu))
+    detail.update(_elastic_probe(on_tpu))
     # noise-aware regression verdict vs the checked-in pinned baseline
     # (ISSUE 10): ratio metrics only, per the bench-variance policy —
     # the round records whether it moved past the band, mechanically

@@ -104,9 +104,11 @@ def _target_like(state_dict: Dict[str, Any], mesh: Optional[Mesh],
     """Build the restore target: same shapes/dtypes, NEW shardings.
 
     ``spec_tree`` keys are matched against the leaf's full "/"-joined tree
-    path AND its final dict key (the param name) — so the same name →
-    PartitionSpec dict used for the model (param_spec_tree) also reshard
-    its optimizer slots.
+    path, its final dict key (the param name), then any enclosing path
+    component innermost-first — so the same name → PartitionSpec dict used
+    for the model (param_spec_tree) also reshards its optimizer slots
+    (``slots/<param name>/m`` picks up the param's spec via the component
+    match).
     """
     from jax.tree_util import tree_map_with_path
 
@@ -123,6 +125,11 @@ def _target_like(state_dict: Dict[str, Any], mesh: Optional[Mesh],
                 spec = spec_tree.get(full)
                 if spec is None:
                     spec = spec_tree.get(last)
+                if spec is None:
+                    for k in reversed(keys[:-1]):
+                        if k in spec_tree:
+                            spec = spec_tree[k]
+                            break
             if spec is None:
                 # scalars can't take a param's spec; keep replicated
                 spec = PartitionSpec()

@@ -28,7 +28,7 @@ from .planner import (ParallelConfig, PricedConfig, PlanReport,
                       plan, rank_agreement, check_drift,
                       validate_rank_order)
 from .memory_model import MemoryEstimate, estimate_hbm, hbm_capacity
-from .emit import ShardingPlan, emit_plan
+from .emit import ShardingPlan, emit_plan, plan_for_config
 
 
 def dtensor_from_fn(fn, mesh=None, placements=(), *args, **kwargs):
@@ -50,4 +50,4 @@ __all__ = ["ProcessMesh", "shard_tensor", "reshard", "shard_layer",
            "enumerate_configs", "price_compiled", "price_config",
            "plan", "rank_agreement", "check_drift",
            "validate_rank_order", "MemoryEstimate", "estimate_hbm",
-           "hbm_capacity", "ShardingPlan", "emit_plan"]
+           "hbm_capacity", "ShardingPlan", "emit_plan", "plan_for_config"]

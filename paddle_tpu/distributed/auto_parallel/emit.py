@@ -21,7 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["ShardingPlan", "emit_plan"]
+__all__ = ["ShardingPlan", "emit_plan", "plan_for_config"]
 
 
 def _spec_to_json(spec) -> List:
@@ -158,3 +158,30 @@ def emit_plan(model, mesh, config) -> ShardingPlan:
         param_specs=param_spec_tree(model, mesh=m),
         sequence_parallel=bool(getattr(config, "sep", 1) > 1),
         notes=f"emitted for {config}")
+
+
+def plan_for_config(model_cfg, config, devices=None) -> ShardingPlan:
+    """Emit the plan for ``config`` WITHOUT pricing: build the model's
+    annotation surface (no placement, no compile) and freeze its specs on
+    the config's mesh. Used where the winner is already known — the
+    elastic resume path re-applying a chosen config, the reshard CLI —
+    and only the spec table is needed."""
+    import dataclasses
+    import jax
+    from ...models import LlamaForCausalLM, LlamaForCausalLMPipe
+    from ...parallel.mesh import HybridMesh
+    import paddle_tpu as pt
+    sep = int(getattr(config, "sep", 1))
+    mcfg = dataclasses.replace(model_cfg, sequence_parallel=sep > 1)
+    pt.seed(0)
+    if int(getattr(config, "pp", 1)) > 1:
+        model = LlamaForCausalLMPipe(mcfg, num_stages=int(config.pp),
+                                     num_microbatches=2)
+    else:
+        model = LlamaForCausalLM(mcfg)
+    devices = (list(devices) if devices is not None
+               else list(jax.devices()))[:config.size]
+    hm = HybridMesh.build(dp=int(config.dp), tp=int(config.tp),
+                          pp=int(getattr(config, "pp", 1)), sep=sep,
+                          devices=devices)
+    return emit_plan(model, hm, config)

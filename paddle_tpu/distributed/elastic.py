@@ -9,10 +9,16 @@ regenerated rank map; checkpoint/resume provides continuity.
 TPU-native redesign: the registry is the native C++ TCPStore (no etcd in a
 TPU pod; the coordinator host plays master), membership is heartbeat keys
 checked against a timeout window, and the relaunch path reuses
-distributed.launch. On TPU slices the chip topology is fixed per slice, so
-"elastic" primarily means surviving preemption/restart of hosts with
-checkpoint resume — the fault-tolerance level — rather than changing world
-size mid-run.
+distributed.launch. ISSUE 15 adds the reference's ``_update_hosts`` half:
+a CHANGED world size is survivable, not just a restart of the same one —
+:meth:`ElasticManager.run_elastic` re-enters training when membership
+changes (full-jitter backoff, no restart budget burned), and
+:func:`replan_and_apply` asks the auto-parallel planner for the best legal
+config on the surviving devices and re-places the trainer's state through
+``Trainer.apply_plan``; the resharded checkpoint restore is
+``resilience/reshard.py``. ``pt_elastic_*`` counters publish the flow
+through the PR 4 registry; ``observability.sentry.elastic_rules()`` is the
+matching alert pack.
 """
 
 from __future__ import annotations
@@ -36,6 +42,22 @@ def backoff_delays(base: float, cap: float, attempts: int,
     for _ in range(attempts):
         yield rng.uniform(0.0, min(delay, cap))
         delay = min(delay * 2.0, cap)
+
+
+class WorldSizeChanged(RuntimeError):
+    """Membership changed under a live run (a worker died or joined).
+
+    Raised from inside a training callable (e.g. a heartbeat-driven
+    ``membership_probe`` callback) to unwind to
+    :meth:`ElasticManager.run_elastic`, which re-plans on the surviving
+    devices and re-enters — WITHOUT burning the failure-restart budget
+    (losing a host is the normal weather of preemptible pods, not a bug
+    in the training code)."""
+
+    def __init__(self, old_size: int, new_size: int):
+        super().__init__(f"world size changed {old_size} -> {new_size}")
+        self.old_size = int(old_size)
+        self.new_size = int(new_size)
 
 
 class ElasticLevel(IntEnum):
@@ -175,6 +197,24 @@ class ElasticManager:
             return ElasticStatus.RESTART
         return ElasticStatus.RESTART
 
+    def world_size(self) -> int:
+        """Live membership count (heartbeats inside the timeout window)."""
+        return len(self.alive_nodes())
+
+    def membership_probe(self, expected: int) -> Callable[..., None]:
+        """An ``on_metrics``-shaped callback that raises
+        :class:`WorldSizeChanged` when the heartbeat registry disagrees
+        with ``expected`` — the detection half of the reference's
+        ``_update_hosts`` watch loop, wired into the step loop the
+        trainer already runs."""
+        expected = int(expected)
+
+        def probe(*_args, **_kw):
+            ws = self.world_size()
+            if ws != expected:
+                raise WorldSizeChanged(expected, ws)
+        return probe
+
     # -- restart policy ----------------------------------------------------
 
     def run(self, train_fn: Callable[[int], None],
@@ -213,8 +253,126 @@ class ElasticManager:
                 print(f"[elastic] training failed ({e}); restart "
                       f"{self.restarts}/{self.max_restarts}")
 
+    def run_elastic(self, train_fn: Callable[[int, int], None], *,
+                    world_size_fn: Optional[Callable[[], int]] = None,
+                    max_membership_changes: int = 32,
+                    max_preemptions: int = 100,
+                    sleep: Callable[[float], None] = time.sleep) -> bool:
+        """:meth:`run` upgraded to the ELASTIC level: survive a CHANGED
+        world size, not just restarts of the same one.
+
+        ``train_fn(attempt, world_size)`` trains on ``world_size``
+        workers and is expected to (a) resume from its latest checkpoint
+        when ``attempt > 0`` and (b) raise :class:`WorldSizeChanged`
+        when its membership probe sees the registry disagree. On a
+        membership change the manager backs off with full jitter (the
+        survivors must not stampede re-registration), re-reads the world
+        size, and re-enters — burning ``max_membership_changes``, NOT
+        the failure-restart budget. Orderly preemptions keep their own
+        budget as in :meth:`run`. ``world_size_fn`` defaults to the
+        heartbeat registry; tests inject a schedule."""
+        from ..resilience.preemption import RESUMABLE_EXIT_CODE
+        ws_fn = world_size_fn or self.world_size
+        changes = 0
+        last_ws: Optional[int] = None
+        backoff = backoff_delays(self.reconnect_backoff_base,
+                                 self.reconnect_backoff_cap,
+                                 max(1, max_membership_changes))
+        while True:
+            ws = int(ws_fn())
+            if last_ws is not None and ws != last_ws:
+                changes += 1
+                _elastic_counter("pt_elastic_membership_changes_total",
+                                 "world-size changes survived",
+                                 direction=("in" if ws < last_ws
+                                            else "out"))
+                _elastic_gauge("pt_elastic_world_size", ws)
+                if changes > max_membership_changes:
+                    print(f"[elastic] giving up after {changes - 1} "
+                          f"membership changes")
+                    return False
+                sleep(next(backoff))
+                ws = int(ws_fn())    # may have changed again during backoff
+            elif last_ws is None:
+                _elastic_gauge("pt_elastic_world_size", ws)
+            last_ws = ws
+            attempt = self.restarts + self.preemptions + changes
+            try:
+                train_fn(attempt, ws)
+                return True
+            except WorldSizeChanged as e:
+                last_ws = e.old_size    # next loop top counts the change
+                print(f"[elastic] membership change detected "
+                      f"({e.old_size} -> {e.new_size}); re-planning "
+                      f"({changes + 1}/{max_membership_changes})")
+            except SystemExit as e:
+                if e.code != RESUMABLE_EXIT_CODE:
+                    raise
+                if self.preemptions >= max_preemptions:
+                    print(f"[elastic] giving up after {self.preemptions} "
+                          f"preemptions")
+                    return False
+                self.preemptions += 1
+                _elastic_counter("pt_elastic_resumes_total",
+                                 "orderly preemption resumes")
+                print(f"[elastic] preempted (checkpointed); resume "
+                      f"{self.preemptions}/{max_preemptions}")
+            except Exception as e:  # noqa: BLE001 — any training failure
+                if self.restarts >= self.max_restarts:
+                    print(f"[elastic] giving up after {self.restarts} "
+                          f"restarts: {e}")
+                    return False
+                self.restarts += 1
+                print(f"[elastic] training failed ({e}); restart "
+                      f"{self.restarts}/{self.max_restarts}")
+
     def exit(self) -> None:
         self._stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
         self.store.close()
+
+
+# -- metrics (PR 4 registry; no-ops when observability is disabled) ----------
+
+def _elastic_counter(name: str, desc: str, **labels) -> None:
+    from ..observability.metrics import REGISTRY
+    if REGISTRY.enabled:
+        REGISTRY.counter(name, desc).inc(**labels)
+
+
+def _elastic_gauge(name: str, value: float) -> None:
+    from ..observability.metrics import REGISTRY
+    if REGISTRY.enabled:
+        REGISTRY.gauge(name, "live world size seen by the elastic "
+                             "manager").set(float(value))
+
+
+# -- the replan half of a membership change ----------------------------------
+
+def replan_and_apply(trainer, model_cfg, *, devices=None, global_batch=8,
+                     seq_len=32, configs=None, drift="ignore", **plan_kw):
+    """Membership changed: ask the auto-parallel planner (ISSUE 11) for
+    the best legal config on the surviving ``devices`` (HBM-prune
+    included) and re-place the trainer's params/optimizer state through
+    ``Trainer.apply_plan``. Returns ``(plan, mesh)`` — the caller enters
+    the mesh and re-enters ``fit(resume='auto')``; the checkpoint
+    manager reshards the restore against the recorded source plan.
+    Raises ``InfeasibleMeshError`` when no legal config exists on the
+    survivors (e.g. fewer devices than any tp that divides the heads)."""
+    import time as _time
+    from .auto_parallel import plan as _plan
+    t0 = _time.perf_counter()
+    report = _plan(model_cfg, devices=devices, global_batch=global_batch,
+                   seq_len=seq_len, configs=configs, drift=drift, **plan_kw)
+    chosen = report.chosen.plan
+    hm = trainer.apply_plan(chosen, devices=devices)
+    _elastic_counter("pt_elastic_replans_total",
+                     "planner-picked re-configurations",
+                     config=chosen.config_str)
+    from ..observability.metrics import REGISTRY
+    if REGISTRY.enabled:
+        REGISTRY.histogram("pt_elastic_replan_seconds",
+                           "plan + re-place duration on membership change",
+                           "s").observe(_time.perf_counter() - t0)
+    return chosen, hm

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
            "trainer_rules", "serving_rules", "fabric_rules",
-           "default_rules"]
+           "elastic_rules", "default_rules"]
 
 
 class SloRule:
@@ -406,6 +406,54 @@ def fabric_rules(replicas: Optional[List[str]] = None,
             description="router lost contact with at least one "
                         "replica: failover re-admission is running, "
                         "capacity is reduced"))
+    return rules
+
+
+def elastic_rules(membership_changes_per_window: float = 2.0,
+                  reshard_failures_per_window: float = 0.0,
+                  world_size_floor: Optional[float] = None,
+                  breach_for: int = 1,
+                  cooldown_s: float = 300.0) -> List[SloRule]:
+    """Alert pack for the elastic scale-in/out flow (ISSUE 15).
+
+    A single membership change is the normal weather of preemptible
+    pods — the flow exists to absorb it. What pages is the PATTERN:
+    membership flapping faster than re-planning can converge (the run
+    spends its life resharding, not training), or any resharded restore
+    FAILING (the one mechanism that turns a lost host into a resumed
+    run is broken — the next preemption is unrecoverable)."""
+    rules: List[SloRule] = [
+        Threshold(
+            "elastic_membership_change_rate",
+            "pt_elastic_membership_changes_total",
+            ceiling=membership_changes_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="world size flapping every window: the pod is "
+                        "churning hosts faster than replan+reshard can "
+                        "converge — training throughput is going to "
+                        "replay and recompilation, not steps"),
+        Threshold(
+            "elastic_reshard_failures",
+            "pt_elastic_reshard_failures_total",
+            ceiling=reshard_failures_per_window, delta=True,
+            severity="critical", breach_for=1,
+            cooldown_s=cooldown_s,
+            description="a resharded restore failed this window: the "
+                        "checkpoint cannot be loaded on the surviving "
+                        "mesh (infeasible axis or corrupt shard) — the "
+                        "run is one preemption away from dead; pick a "
+                        "feasible config or fall back to a committed "
+                        "step that reshapes cleanly"),
+    ]
+    if world_size_floor is not None:
+        rules.append(Threshold(
+            "elastic_world_size_floor", "pt_elastic_world_size",
+            floor=float(world_size_floor), severity="critical",
+            breach_for=1, cooldown_s=cooldown_s,
+            description="surviving world size fell below the minimum "
+                        "the job can make progress on — scale the pod "
+                        "back up or lower the floor deliberately"))
     return rules
 
 

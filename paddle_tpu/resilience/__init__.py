@@ -32,11 +32,19 @@ from .anomaly import AnomalyGuard, DivergenceError
 
 __all__ = ["CheckpointManager", "CheckpointCorruption", "PreemptionGuard",
            "TrainingPreempted", "RESUMABLE_EXIT_CODE", "AnomalyGuard",
-           "DivergenceError"]
+           "DivergenceError", "ReshardError", "load_resharded", "read_plan",
+           "check_feasible", "PLAN_NAME"]
+
+_RESHARD_NAMES = ("ReshardError", "load_resharded", "read_plan",
+                  "write_plan", "check_feasible", "plans_equivalent",
+                  "effective_axes", "place_tree", "PLAN_NAME")
 
 
 def __getattr__(name):
     if name in ("CheckpointManager", "CheckpointCorruption"):
         from . import checkpoint_manager as _cm
         return getattr(_cm, name)
+    if name in _RESHARD_NAMES:
+        from . import reshard as _rs
+        return getattr(_rs, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -34,9 +34,11 @@ import random
 import re
 import shutil
 import time
+import warnings
 from typing import Any, Dict, List, Optional
 
 from .. import checkpoint as _ckpt
+from . import reshard as _reshard
 from ..observability.goodput import ledger as _ledger
 from ..observability.metrics import REGISTRY as _REG
 
@@ -94,7 +96,7 @@ class CheckpointManager:
                  keep_last_n: int = 3, keep_every_m: int = 0,
                  async_save: bool = False, max_retries: int = 3,
                  backoff_base_s: float = 0.25, backoff_max_s: float = 30.0,
-                 mesh=None, spec_tree=None):
+                 mesh=None, spec_tree=None, plan=None):
         self.root = os.path.abspath(os.path.expanduser(root))
         self.save_interval_steps = max(1, int(save_interval_steps))
         self.keep_last_n = max(1, int(keep_last_n))
@@ -105,6 +107,11 @@ class CheckpointManager:
         self.backoff_max_s = float(backoff_max_s)
         self.mesh = mesh
         self.spec_tree = spec_tree
+        # active ShardingPlan: recorded as _PLAN.json in every save; on
+        # restore, a saved plan with DIFFERENT axes triggers the reshard
+        # path (resilience/reshard.py). None = implicit single-device plan.
+        self.plan = plan
+        self.last_restored_plan = None
         self._pending: Optional[int] = None
         self._rng = random.Random()
         os.makedirs(self.root, exist_ok=True)
@@ -212,6 +219,11 @@ class CheckpointManager:
 
     def _commit(self, step: int, watchdog=None) -> None:
         sdir = self.step_dir(step)
+        # record the active plan BEFORE the manifest walk so _PLAN.json is
+        # hashed + verified like every other payload file
+        self._with_retries(
+            lambda: _reshard.write_plan(sdir, self.plan, step),
+            what=f"plan step_{step}")
         manifest = self._build_manifest(sdir, step, watchdog=watchdog)
         payload = json.dumps(manifest, sort_keys=True).encode()
         self._with_retries(
@@ -283,25 +295,28 @@ class CheckpointManager:
             return False
 
     def _quarantine(self, step: int, reason: str) -> None:
-        sdir = self.step_dir(step)
-        if not os.path.isdir(sdir):
-            return
-        qroot = os.path.join(self.root, QUARANTINE_DIR)
-        os.makedirs(qroot, exist_ok=True)
-        base = os.path.join(qroot, f"step_{step}-{reason}")
-        dst, k = base, 0
-        while os.path.exists(dst):
-            k += 1
-            dst = f"{base}-{k}"
-        shutil.move(sdir, dst)
-        if _REG.enabled:
-            _REG.counter("pt_checkpoint_quarantines_total",
-                         "step dirs moved aside as suspect").inc(
-                reason=reason)
+        self._quarantine_path(self.step_dir(step), f"step_{step}-{reason}",
+                              reason)
         try:
             os.remove(self._pending_path(step))
         except FileNotFoundError:
             pass
+
+    def _quarantine_path(self, path: str, tag: str, reason: str) -> None:
+        if not os.path.isdir(path):
+            return
+        qroot = os.path.join(self.root, QUARANTINE_DIR)
+        os.makedirs(qroot, exist_ok=True)
+        base = os.path.join(qroot, tag)
+        dst, k = base, 0
+        while os.path.exists(dst):
+            k += 1
+            dst = f"{base}-{k}"
+        shutil.move(path, dst)
+        if _REG.enabled:
+            _REG.counter("pt_checkpoint_quarantines_total",
+                         "step dirs moved aside as suspect").inc(
+                reason=reason)
 
     def quarantined(self) -> List[str]:
         qroot = os.path.join(self.root, QUARANTINE_DIR)
@@ -310,9 +325,18 @@ class CheckpointManager:
         return sorted(os.listdir(qroot))
 
     def _sweep_stale(self) -> None:
-        """At startup, quarantine step dirs a crashed predecessor left
-        mid-save (PENDING sidecar, no commit marker) and drop orphan
-        sidecars. Restores then see only committed checkpoints."""
+        """At startup, clean what a crashed predecessor left behind so
+        restores see only committed checkpoints:
+
+        * step dirs with a PENDING sidecar and no commit marker
+          (crash between orbax write and commit) → quarantine;
+        * orphan sidecars (dir never materialized) → delete;
+        * torn dirs from a SIGKILL mid-async-save — the orbax tmp dir
+          (``step_N.orbax-checkpoint-tmp-*``) that never got renamed, or
+          a ``step_N`` dir with neither commit marker nor orbax metadata
+          → quarantine if non-empty (evidence), delete if empty — with a
+          single aggregate warning, not silent skipping by latest_step."""
+        torn: List[str] = []
         for name in list(os.listdir(self.root)):
             if not name.endswith(".PENDING"):
                 continue
@@ -330,19 +354,57 @@ class CheckpointManager:
                     os.remove(os.path.join(self.root, name))
                 except FileNotFoundError:
                     pass
+        for name in list(os.listdir(self.root)):
+            full = os.path.join(self.root, name)
+            if not (name.startswith("step_") and os.path.isdir(full)):
+                continue
+            if _STEP_RE.match(name):
+                # plain step_N: torn only when neither our commit marker
+                # nor orbax's own metadata exists (a complete-but-
+                # uncommitted dir still has its sidecar and was handled
+                # above; a bare complete orbax dir is left alone)
+                if (os.path.isfile(os.path.join(full, COMMIT_MARKER))
+                        or _ckpt.is_complete_checkpoint(full)
+                        or os.path.isfile(self._pending_path(
+                            int(name.split("_", 1)[1])))):
+                    continue
+            elif ".orbax-checkpoint-tmp" not in name:
+                continue            # quarantine tags etc. — not ours
+            torn.append(name)
+            try:
+                empty = not os.listdir(full)
+            except OSError:
+                empty = False
+            if empty:
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                self._quarantine_path(full, f"{name}-torn", "torn")
+        if torn:
+            warnings.warn(
+                f"CheckpointManager({self.root}): swept {len(torn)} torn "
+                f"dir(s) left by a killed save: {sorted(torn)} — "
+                f"non-empty ones preserved under {QUARANTINE_DIR}/",
+                RuntimeWarning, stacklevel=2)
 
     # -- restore -----------------------------------------------------------
 
     def restore(self, like_tree: Dict[str, Any], *, step: Optional[int] = None,
-                mesh=None, spec_tree=None, watchdog=None):
+                mesh=None, spec_tree=None, watchdog=None, plan=None):
         """Load the newest committed checkpoint (or ``step``) into the
         structure of ``like_tree``. A step failing manifest verification is
         quarantined and the previous committed step is tried — resume after
-        corruption degrades, it does not crash. Returns ``(step, tree)`` or
-        ``None`` when nothing valid exists."""
+        corruption degrades, it does not crash. When the step's recorded
+        ``_PLAN.json`` differs from the target plan (``plan`` or
+        ``self.plan``), the load goes through the reshard path
+        (resilience/reshard.py); the saved plan is surfaced as
+        ``self.last_restored_plan``. A ReshardError (infeasible target
+        mesh) is permanent and raises — an older step cannot fix an
+        indivisible axis. Returns ``(step, tree)`` or ``None`` when
+        nothing valid exists."""
         self.finalize(watchdog=watchdog)
         mesh = mesh if mesh is not None else self.mesh
         spec_tree = spec_tree if spec_tree is not None else self.spec_tree
+        target_plan = plan if plan is not None else self.plan
         candidates = ([int(step)] if step is not None
                       else list(reversed(self.committed_steps())))
         t0 = time.perf_counter()
@@ -351,11 +413,22 @@ class CheckpointManager:
                 if not self.verify(s, watchdog=watchdog):
                     self._quarantine(s, "corrupt")
                     continue
-                tree = self._with_retries(
-                    lambda s=s: _ckpt.load_state_dict(
-                        self.step_dir(s), like_tree, mesh=mesh,
-                        spec_tree=spec_tree),
-                    what=f"restore step_{s}")
+                saved_plan = _reshard.read_plan(self.step_dir(s))
+                if (target_plan is not None and not _reshard.plans_equivalent(
+                        saved_plan, target_plan)):
+                    tree = self._with_retries(
+                        lambda s=s, sp=saved_plan: _reshard.load_resharded(
+                            self.step_dir(s), like_tree, target_plan,
+                            mesh=mesh, source_plan=sp),
+                        what=f"reshard step_{s}",
+                        no_retry=(_reshard.ReshardError,))
+                else:
+                    tree = self._with_retries(
+                        lambda s=s: _ckpt.load_state_dict(
+                            self.step_dir(s), like_tree, mesh=mesh,
+                            spec_tree=spec_tree),
+                        what=f"restore step_{s}")
+                self.last_restored_plan = saved_plan
                 if _REG.enabled:
                     _REG.counter("pt_checkpoint_restores_total",
                                  "checkpoint restores").inc()
@@ -378,10 +451,12 @@ class CheckpointManager:
 
     # -- retry --------------------------------------------------------------
 
-    def _with_retries(self, fn, what: str = "io"):
+    def _with_retries(self, fn, what: str = "io", no_retry=()):
         """Run ``fn`` retrying transient failures with jittered exponential
         backoff (the ONE schedule implementation:
-        distributed.elastic.backoff_delays)."""
+        distributed.elastic.backoff_delays). ``no_retry`` exception types
+        are permanent (e.g. an infeasible reshard target) and re-raise
+        immediately."""
         from ..distributed.elastic import backoff_delays
         delays = backoff_delays(self.backoff_base_s, self.backoff_max_s,
                                 self.max_retries, rng=self._rng)
@@ -390,7 +465,7 @@ class CheckpointManager:
                 return fn()
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except Exception:
-                if attempt >= self.max_retries:
+            except Exception as e:
+                if isinstance(e, no_retry) or attempt >= self.max_retries:
                     raise
                 time.sleep(next(delays))

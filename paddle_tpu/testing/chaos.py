@@ -29,7 +29,7 @@ import numpy as np
 
 __all__ = ["kill_mid_save", "corrupt_checkpoint", "nan_batch",
            "nan_injector", "kill_at_step", "spawn_trainer",
-           "kill_replica"]
+           "spawn_elastic", "kill_replica"]
 
 
 def kill_mid_save(manager, step: int, tree) -> str:
@@ -148,6 +148,27 @@ def spawn_trainer(ckpt_dir: str, *, steps: int, extra_args: Sequence[str] = (),
     cmd = [sys.executable, "-m", "paddle_tpu.testing._chaos_train",
            "--ckpt-dir", ckpt_dir, "--steps", str(steps), *extra_args]
     full_env = dict(os.environ)
+    full_env.setdefault("JAX_PLATFORMS", "cpu")
+    if env:
+        full_env.update(env)
+    return subprocess.Popen(cmd, env=full_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def spawn_elastic(ckpt_dir: str, *, steps: int, virtual_devices: int,
+                  extra_args: Sequence[str] = (),
+                  env: Optional[dict] = None) -> subprocess.Popen:
+    """Launch the elastic training script (llama-micro on a virtual-device
+    mesh): ``python -m paddle_tpu.testing._elastic_train``. The parent's
+    XLA_FLAGS is stripped so ``--virtual-devices`` alone decides the
+    child's device count — resume-on-fewer-devices IS the scenario. The
+    caller kills/waits on the returned Popen (SIGKILL shape: pass
+    ``--hard-exit-at K`` and assert exit code 137)."""
+    cmd = [sys.executable, "-m", "paddle_tpu.testing._elastic_train",
+           "--ckpt-dir", ckpt_dir, "--steps", str(steps),
+           "--virtual-devices", str(virtual_devices), *extra_args]
+    full_env = dict(os.environ)
+    full_env.pop("XLA_FLAGS", None)
     full_env.setdefault("JAX_PLATFORMS", "cpu")
     if env:
         full_env.update(env)

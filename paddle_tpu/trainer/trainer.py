@@ -131,6 +131,8 @@ class Trainer:
         self._seed = int(seed)
         self._peak = device_peak_flops()
         self._watchdog = None
+        self._active_plan = None      # set by apply_plan
+        self._active_mesh = None
         self.accumulate_steps = max(1, int(accumulate_steps))
         # compiled-step machinery (built lazily on first dispatch)
         self._one_step = None          # shared python body (step == scan body)
@@ -526,6 +528,11 @@ class Trainer:
         self.params = dict(self.model.raw_parameters())
         self.opt_state = shard_optimizer_state(
             self.opt_state, plan.param_specs, mesh=hm)
+        # remembered so fit() can hand the plan to the checkpoint manager
+        # (saves record it as _PLAN.json; restores on a different mesh
+        # reshard against it) without extra caller wiring
+        self._active_plan = plan
+        self._active_mesh = hm
         return hm
 
     def train_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
@@ -636,6 +643,18 @@ class Trainer:
             d = os.path.join(checkpoint_manager.root, "_compile_cache")
             if os.path.isdir(d):
                 self._aot_dir = d
+        if (checkpoint_manager is not None and self._active_plan is not None
+                and getattr(checkpoint_manager, "plan", None) is None):
+            # hand the applied ShardingPlan to the manager: saves record
+            # it as _PLAN.json, and a restore whose saved plan has
+            # different axes goes through the reshard path (ISSUE 15)
+            checkpoint_manager.plan = self._active_plan
+            if checkpoint_manager.mesh is None:
+                checkpoint_manager.mesh = getattr(
+                    self._active_mesh, "mesh", self._active_mesh)
+            if checkpoint_manager.spec_tree is None:
+                checkpoint_manager.spec_tree = dict(
+                    self._active_plan.param_specs)
         if (checkpoint_manager is not None
                 and _obs.flight_recorder.recorder().active):
             # crash dumps land next to the quarantine dir so a post-mortem

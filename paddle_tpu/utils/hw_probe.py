@@ -20,6 +20,13 @@ PROBE_CODE = ("import jax; d=jax.devices(); "
               "from paddle_tpu.ops.registry import device_is_tpu; "
               "print('TPU_OK' if device_is_tpu(d[0]) else d[0].platform)")
 
+# Seams for tests. Patch these, NOT time.sleep/time.monotonic: the stdlib
+# subprocess wait loop (used by _run_reset_hook) polls via time.sleep, so
+# hijacking the global time module leaks its sub-50ms poll intervals into
+# whatever the test is recording.
+_sleep = time.sleep
+_monotonic = time.monotonic
+
 
 def _one_probe(timeout: float, cwd: str,
                env: Optional[dict] = None) -> Tuple[bool, str]:
@@ -89,7 +96,7 @@ def probe_tpu(attempts: Optional[int] = None, timeout: Optional[float] = None,
     if attempts < 1:
         return False, "PT_PROBE_ATTEMPTS < 1: probing disabled"
     cwd = cwd or os.getcwd()
-    t0 = time.monotonic()
+    t0 = _monotonic()
     notes = []
     after_reset = False
     for i in range(attempts):
@@ -103,7 +110,7 @@ def probe_tpu(attempts: Optional[int] = None, timeout: Optional[float] = None,
             tmo = min(90.0, timeout)
         else:
             tmo = timeout
-        remaining = window - (time.monotonic() - t0)
+        remaining = window - (_monotonic() - t0)
         if i > 0 and remaining < 30:
             notes.append(f"window {window:.0f}s exhausted")
             break
@@ -115,10 +122,10 @@ def probe_tpu(attempts: Optional[int] = None, timeout: Optional[float] = None,
         if i < attempts - 1:
             after_reset = _run_reset_hook(notes)
             # exponential backoff, capped by 120s and the window left
-            remaining = window - (time.monotonic() - t0)
+            remaining = window - (_monotonic() - t0)
             gap = min(sleep * (2 ** i), 120.0, max(remaining - 30.0, 0.0))
             if gap > 0:
-                time.sleep(gap)
+                _sleep(gap)
     return False, "; ".join(notes[-4:])
 
 

@@ -22,7 +22,10 @@ def _patch(monkeypatch, responses, calls, sleeps):
         calls.append(timeout)
         return responses[min(len(calls) - 1, len(responses) - 1)]
     monkeypatch.setattr(hw_probe, "_one_probe", fake_probe)
-    monkeypatch.setattr(hw_probe.time, "sleep", lambda s: sleeps.append(s))
+    # patch the module seam, not time.sleep itself: the reset hook's
+    # subprocess.run polls via the global time.sleep and would pollute
+    # the recorded backoff gaps
+    monkeypatch.setattr(hw_probe, "_sleep", lambda s: sleeps.append(s))
 
 
 def test_reset_hook_runs_between_every_attempt(monkeypatch, tmp_path,
@@ -75,7 +78,7 @@ def test_backoff_capped_by_window(monkeypatch, no_cpu_force):
     calls, sleeps = [], []
     _patch(monkeypatch, [(False, "hung >240s")], calls, sleeps)
     t = {"now": 0.0}
-    monkeypatch.setattr(hw_probe.time, "monotonic", lambda: t["now"])
+    monkeypatch.setattr(hw_probe, "_monotonic", lambda: t["now"])
     ok, _ = hw_probe.probe_tpu(attempts=6, timeout=240, sleep=60,
                                window=900)
     assert not ok
