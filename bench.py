@@ -891,6 +891,48 @@ def _decode_bench(cfg, on_tpu):
     except Exception as e:
         out["fabric_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
+    try:
+        # front-door robustness (ISSUE 16): two pinned ratio rows over
+        # the full client → FrontDoor → fabric stack (legs live in
+        # tools/load_test.py so the CI smoke and the bench share one
+        # harness).
+        # 1) goodput under 2x+ offered load, shed ladder on ÷ off: both
+        #    legs share ONE calibrated deadline; shed-off admits deep
+        #    queue positions, burns their prefill/partial decode, then
+        #    the deadline cancels them — shed-on refuses them typed at
+        #    admission and finishes what it admits (>1 = shedding wins).
+        # 2) p99 TTFT with a replica HUNG mid-run, breaker budgets
+        #    tight ÷ loose (8x): "off" is a loose budget, not none — an
+        #    unbounded poll on a hung replica wedges the driver forever
+        #    (<1 = the breaker converts the hang into a fast failover).
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import load_test as _lt
+        _log("decode: front-door shed-on-vs-off goodput under overload")
+        fd_on = _lt.overload_leg(dmodel, shed=True)
+        fd_off = _lt.overload_leg(dmodel, shed=False,
+                                  deadline_ms=fd_on["deadline_ms"])
+        out["frontdoor_goodput_under_overload"] = round(
+            fd_on["goodput_tps"] / max(fd_off["goodput_tps"], 1e-9), 3)
+        out["frontdoor_shed_on_goodput_tps"] = round(
+            fd_on["goodput_tps"], 1)
+        out["frontdoor_shed_off_goodput_tps"] = round(
+            fd_off["goodput_tps"], 1)
+        out["frontdoor_shed_on_completed"] = fd_on["completed"]
+        out["frontdoor_shed_off_completed"] = fd_off["completed"]
+        _log("decode: front-door hung-replica breaker-vs-loose TTFT")
+        fd_tight = _lt.hang_leg(dmodel, poll_budget_s=0.75)
+        fd_loose = _lt.hang_leg(dmodel, poll_budget_s=6.0)
+        out["frontdoor_p99_ttft_with_breaker_ratio"] = round(
+            fd_tight["ttft_p99_s"] / max(fd_loose["ttft_p99_s"], 1e-9),
+            3)
+        out["frontdoor_breaker_ttft_p99_s"] = round(
+            fd_tight["ttft_p99_s"], 4)
+        out["frontdoor_nobreaker_ttft_p99_s"] = round(
+            fd_loose["ttft_p99_s"], 4)
+    except Exception as e:
+        out["frontdoor_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
     def _amortized_ab_us(fa, fb, x0, length=20, rounds=6):
         """A/B kernel timing robust to a SHARED chip: each leg runs
         `length` applications chained in one compiled scan (per-call

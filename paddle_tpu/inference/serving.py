@@ -588,6 +588,41 @@ class ContinuousBatchingEngine:
             del self._requests[rid]
         return out
 
+    def cancel(self, rid: int) -> bool:
+        """Terminate ``rid`` NOW and free its slot/pages (the front
+        door's slow-client / deadline / client-cancel path). A queued
+        request is simply removed; an active one drains the in-flight
+        blocks first (the preemption discipline — freed pages must not
+        be re-claimed while a dispatched block still writes them), then
+        the slot releases through the one ``_free_slot`` path with
+        ``cache=True``: a cancelled conversation's completed pages are
+        still future prefix hits. Returns True when the request existed
+        and had not already finished (a finished request stays for
+        ``take_finished`` — cancel does not eat a delivered result)."""
+        req = self._requests.get(rid)
+        if req is None or req.done:
+            return False
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        slot = next((i for i, s in enumerate(self._slots) if s is req),
+                    -1)
+        if slot >= 0:
+            # tokens other slots commit in this drain are NOT lost: they
+            # land in their requests' .generated and the full stream
+            # ships with each finish — only this tick's incremental
+            # emission view is bypassed
+            self._drain_all()
+            if not req.done and self._slots[slot] is req:
+                self._deactivate(slot)
+                self._free_slot(slot, cache=True)
+        if req.done:
+            return False
+        self._requests.pop(rid, None)
+        self._price_cache.pop(rid, None)
+        return True
+
     # -- KV-page handoff (serving-fabric disaggregation, ISSUE 12) -----------
 
     @staticmethod

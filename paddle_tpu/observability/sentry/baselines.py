@@ -121,6 +121,16 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     # min-of-rounds subprocess A/B; rides host noise, wide band)
     RatioMetric("overlap_exposed_comm_fraction", "higher", band=0.5),
     RatioMetric("overlap_on_step_speedup", "lower", band=0.35),
+    # front-door robustness (ISSUE 16): shed-enabled ÷ shed-disabled
+    # admitted goodput at 2x capacity offered load (shedding must BUY
+    # throughput for admitted work, lower = the ladder stopped paying
+    # for itself), and hung-replica p99 TTFT with breaker ÷ without
+    # (tight op budgets ÷ loose ones — the breaker's early trip must
+    # keep the tail DOWN, so higher is worse; both ride host noise and
+    # thread scheduling, generous bands)
+    RatioMetric("frontdoor_goodput_under_overload", "lower", band=0.4),
+    RatioMetric("frontdoor_p99_ttft_with_breaker_ratio", "higher",
+                band=0.5),
 ]}
 
 

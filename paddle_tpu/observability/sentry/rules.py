@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
            "trainer_rules", "serving_rules", "fabric_rules",
-           "elastic_rules", "default_rules"]
+           "frontdoor_rules", "elastic_rules", "default_rules"]
 
 
 class SloRule:
@@ -406,6 +406,98 @@ def fabric_rules(replicas: Optional[List[str]] = None,
             description="router lost contact with at least one "
                         "replica: failover re-admission is running, "
                         "capacity is reduced"))
+    return rules
+
+
+def frontdoor_rules(replicas: Optional[List[str]] = None,
+                    ttft_p99_ceiling_s: float = 2.0,
+                    shed_level_ceiling: float = 1.5,
+                    deadline_misses_per_window: float = 5.0,
+                    slow_disconnects_per_window: float = 3.0,
+                    retries_per_window: float = 10.0,
+                    breaker_trips_per_window: float = 0.0,
+                    breach_for: int = 3,
+                    cooldown_s: float = 300.0) -> List[SloRule]:
+    """The front-door robustness pack (ISSUE 16), watching the edge the
+    typed-refusal contract promises clients:
+
+    * admitted-request p99 TTFT at the router boundary stays under
+      ``ttft_p99_ceiling_s`` — the ceiling the load-test smoke leg
+      asserts under 2x offered load WITH shedding (if this fires, the
+      ladder is admitting more than the pool can serve on time);
+    * the shed ladder living at BROWNOUT (level 2) for ``breach_for``
+      windows — shedding is the mechanism, sustained brownout is the
+      capacity signal;
+    * deadline misses / slow-loris evictions / dedupe-resumed retries
+      per window — each a typed, bounded event individually, a storm
+      collectively (deadlines too tight, a stalled client fleet, or a
+      flapping connection path);
+    * per-replica breaker trips (when ``replicas`` names the pool):
+      ANY trip pages — a replica that hung or died took a failover,
+      capacity is reduced until its half-open probe readmits it.
+
+    Missing series skip (same contract as every pack): a fabric without
+    deadlines or a breaker stays quiet on those rules."""
+    rules: List[SloRule] = [
+        Threshold(
+            "frontdoor_ttft_p99_ceiling", "pt_fabric_ttft_seconds",
+            labels={"q": "p99"}, ceiling=ttft_p99_ceiling_s,
+            severity="critical", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="admitted-request p99 TTFT over the front-door "
+                        "ceiling: the shed ladder is admitting more "
+                        "than the pool serves on time — raise shed "
+                        "thresholds' aggression or grow the pool"),
+        Threshold(
+            "frontdoor_shed_brownout", "pt_frontdoor_shed_level",
+            ceiling=shed_level_ceiling, severity="warning",
+            breach_for=breach_for, cooldown_s=cooldown_s,
+            description="the load-shedding ladder is living at "
+                        "brownout: cold prefills deferred and spec_k "
+                        "capped every window — this is a capacity "
+                        "signal, not weather; add replicas"),
+        Threshold(
+            "frontdoor_slow_client_disconnects",
+            "pt_frontdoor_disconnects_total",
+            labels={"reason": "slow"},
+            ceiling=slow_disconnects_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="slow-loris evictions every window: a client "
+                        "fleet stopped reading its streams (or the "
+                        "outbox bound is too tight for their RTT)"),
+        Threshold(
+            "frontdoor_retry_rate", "pt_frontdoor_retries_total",
+            ceiling=retries_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="dedupe-resumed retries every window: clients "
+                        "are reconnecting in bulk — a flapping network "
+                        "path or a front door restarting under them"),
+    ]
+    for kind in ("ttft", "total"):
+        rules.append(Threshold(
+            f"frontdoor_deadline_miss_rate_{kind}",
+            "pt_frontdoor_deadline_miss_total",
+            labels={"kind": kind},
+            ceiling=deadline_misses_per_window, delta=True,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description=f"{kind}-deadline cancellations every window: "
+                        f"budgets too tight for current load, or "
+                        f"capacity quietly shrank (check the breaker "
+                        f"and replicas-alive rules)"))
+    for r in (replicas or ()):
+        rules.append(Threshold(
+            f"frontdoor_breaker_{r}_trips",
+            "pt_frontdoor_breaker_open_total",
+            labels={"replica": r},
+            ceiling=breaker_trips_per_window, delta=True,
+            severity="critical", breach_for=1, cooldown_s=cooldown_s,
+            description=f"replica {r}: circuit breaker opened (hung or "
+                        f"crashed) — failover re-admission ran, "
+                        f"capacity reduced until its half-open probe "
+                        f"readmits it"))
     return rules
 
 

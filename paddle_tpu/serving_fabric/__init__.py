@@ -19,6 +19,18 @@ serving stack PRs 3/6/7 built inside one engine:
   weighted fairness + token-bucket quotas priced in uncached-suffix
   tokens.
 
+Front-door robustness (ISSUE 16):
+
+* :class:`FrontDoor` / :class:`FabricClient` — concurrent streaming
+  TCP edge with per-id dedupe + replay resume, and the retrying /
+  hedging client of it.
+* :class:`BreakerTransport` — per-replica circuit breaker (op-class
+  timeouts, open → half-open probe → close) wrapping any transport.
+* :class:`LoadShedder` + the typed rejections
+  (:class:`FabricRejected`, :class:`Overloaded`,
+  :class:`AllReplicasDown`, :class:`DeadlineExceeded`) and
+  :class:`Backoff` — admission that can say no, typed and bounded.
+
 Quickstart::
 
     from paddle_tpu.serving_fabric import (ServingFabric, InProcTransport,
@@ -32,9 +44,14 @@ Quickstart::
 
 from __future__ import annotations
 
+from .breaker import BreakerTransport
+from .client import FabricClient
 from .digest import PrefixDigest
 from .fair import TenantFairPolicy, TenantSpec
+from .frontdoor import FrontDoor
 from .replica import Replica, build_replicas
+from .robust import (AllReplicasDown, Backoff, DeadlineExceeded,
+                     FabricRejected, LoadShedder, Overloaded)
 from .router import FabricRequest, ServingFabric
 from .transport import (FabricTransport, InProcTransport, ReplicaDown,
                         TcpReplicaServer, TcpTransport, payload_from_wire,
@@ -47,4 +64,7 @@ __all__ = [
     "TcpReplicaServer", "ReplicaDown",
     "payload_to_wire", "payload_from_wire",
     "PrefixDigest", "TenantFairPolicy", "TenantSpec",
+    "FrontDoor", "FabricClient", "BreakerTransport",
+    "LoadShedder", "Backoff", "FabricRejected", "Overloaded",
+    "AllReplicasDown", "DeadlineExceeded",
 ]

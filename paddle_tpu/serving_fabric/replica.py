@@ -51,6 +51,7 @@ class Replica:
         self._digest: Optional[dict] = None
         self._digest_epoch = -1
         self._lat_cache: tuple = (-1, {})
+        self._spec_k0 = int(getattr(engine, "spec_k", 0))
 
     # -- fabric verb set -----------------------------------------------------
 
@@ -128,6 +129,33 @@ class Replica:
 
     def adopt(self, payload: dict) -> int:
         return len(self.engine.adopt_pages(payload))
+
+    def cancel(self, rid: int) -> bool:
+        """Kill local ``rid`` now, freeing its slot/pages (front-door
+        deadline miss / client disconnect / slow-loris eviction)."""
+        return bool(self.engine.cancel(int(rid)))
+
+    def configure(self, knobs: dict) -> dict:
+        """Apply runtime knobs; returns what actually took effect.
+
+        ``spec_k``: brownout draft-budget cap, clamped to
+        ``[1, construction-time spec_k]`` — never toggled through 0
+        (the draft history only exists when the engine was built
+        speculative, and the 0↔k edge would flip executable shapes
+        mid-run). ``None`` restores the construction-time value. The
+        decode executable cache keys on the (spec_k+1) block width, so
+        a shrink is a cache switch, not a recompile storm, and spec
+        output stays verification-exact at any k."""
+        applied: Dict[str, object] = {}
+        if "spec_k" in knobs and self._spec_k0 > 0:
+            want = knobs["spec_k"]
+            if want is None:
+                self.engine.spec_k = self._spec_k0
+            else:
+                self.engine.spec_k = max(1, min(int(want),
+                                                self._spec_k0))
+            applied["spec_k"] = self.engine.spec_k
+        return applied
 
 
 def build_replicas(model, n: int, roles: Optional[List[str]] = None,

@@ -52,6 +52,7 @@ from ..observability.metrics import REGISTRY as _REG
 from ..observability.sentry import sentry as _sentry
 from .digest import PrefixDigest
 from .fair import TenantFairPolicy
+from .robust import AllReplicasDown, LoadShedder
 from .transport import FabricTransport, ReplicaDown
 
 __all__ = ["FabricRequest", "ServingFabric"]
@@ -69,6 +70,11 @@ class FabricRequest:
     error: Optional[str] = None      # set when state == "failed"
     replica: Optional[str] = None
     local_rid: Optional[int] = None
+    # sampling-stream identity override: a retry of an earlier logical
+    # request reuses ITS rseed so the continuation is token-identical
+    rseed: Optional[int] = None
+    ttft_deadline_ms: Optional[float] = None
+    deadline_ms: Optional[float] = None
     delivered: List[int] = field(default_factory=list)
     result: Optional[np.ndarray] = None
     prefill_done: bool = False
@@ -105,6 +111,8 @@ class ServingFabric:
                  hysteresis_band: float = 0.25,
                  disagg_threshold_tokens: Optional[int] = None,
                  affinity_min_pages: int = 1,
+                 shedder: Optional[LoadShedder] = None,
+                 default_retry_after_ms: float = 250.0,
                  name: Optional[str] = None):
         if policy not in self.POLICIES:
             raise ValueError(f"unknown policy {policy!r}; pick one of "
@@ -122,6 +130,13 @@ class ServingFabric:
         self.hysteresis_band = float(hysteresis_band)
         self.disagg_threshold_tokens = disagg_threshold_tokens
         self.affinity_min_pages = int(affinity_min_pages)
+        self.shedder = shedder
+        self.default_retry_after_ms = float(default_retry_after_ms)
+        self._browned = False
+        # local rids a dead replica still held: on breaker readmission
+        # they are best-effort cancelled so the recovered engine stops
+        # burning pages on streams a survivor already re-owns
+        self._stale_rids: Dict[str, List[int]] = {}
         self._fid = 0
         self._reqs: Dict[int, FabricRequest] = {}
         self._queue: deque = deque()
@@ -154,52 +169,108 @@ class ServingFabric:
 
     def submit(self, prompt, max_new_tokens: int,
                tenant: str = "default",
-               knobs: Optional[dict] = None) -> int:
+               knobs: Optional[dict] = None,
+               ttft_deadline_ms: Optional[float] = None,
+               deadline_ms: Optional[float] = None,
+               rseed: Optional[int] = None,
+               replay: Optional[List[int]] = None) -> int:
         """Queue one request; returns its fabric id. ``knobs`` (optional
         dict of do_sample/temperature/top_k/top_p/eos_token_id)
         overrides the replica engines' default GenerationConfig. The
         fabric id doubles as the sampling-stream identity (``rseed``),
         so a request's sampled tokens are the same whichever replica —
-        or sequence of replicas, after a failover — serves it."""
+        or sequence of replicas, after a failover — serves it.
+
+        ISSUE 16 lifecycle knobs: ``ttft_deadline_ms`` / ``deadline_ms``
+        bound time-to-first-token and total latency (a miss CANCELs the
+        request, frees its slot/pages and fails it typed). ``rseed`` +
+        ``replay`` let an idempotent RETRY of an earlier logical request
+        resume its exact stream: same rseed ⇒ same sampling keys, the
+        replay prefix is never re-emitted, so the retry delivers exactly
+        the tokens the first attempt didn't.
+
+        Raises :class:`~.robust.AllReplicasDown` when no replica is
+        reachable and :class:`~.robust.Overloaded` when the shed ladder
+        refuses this tenant — both carry ``retry_after_ms``."""
+        if not self._alive_names():
+            raise AllReplicasDown(
+                "serving fabric: every replica is down; submission "
+                "refused", retry_after_ms=self._retry_after_ms())
+        if self.shedder is not None:
+            w = (self.fair.spec(tenant).weight
+                 if self.fair is not None else 1.0)
+            self.shedder.admit(str(tenant), w, len(self._queue))
         ids = np.asarray(prompt, np.int32).reshape(-1)
         req = FabricRequest(self._fid, ids, int(max_new_tokens),
-                            tenant=str(tenant), knobs=knobs)
+                            tenant=str(tenant), knobs=knobs,
+                            rseed=rseed,
+                            ttft_deadline_ms=ttft_deadline_ms,
+                            deadline_ms=deadline_ms)
+        if replay:
+            req.delivered = [int(t) for t in replay]
         req.submit_t = time.perf_counter()
         self._fid += 1
         self._reqs[req.fid] = req
         self._queue.append(req)
         return req.fid
 
+    def cancel(self, fid: int, error: str = "cancelled") -> bool:
+        """Terminate ``fid`` NOW (client disconnect, slow-loris
+        eviction, deadline miss): dequeue it, cancel it replica-side so
+        its slot/pages free through the engine's one ``_free_slot``
+        path, and fail it with ``error``. True when it existed and had
+        not already finished."""
+        req = self._reqs.get(fid)
+        if req is None or req.state in ("done", "failed"):
+            return False
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        if req.replica is not None and req.local_rid is not None:
+            self._assign.pop((req.replica, req.local_rid), None)
+            self._outstanding[req.replica] = max(
+                0, self._outstanding.get(req.replica, 0) - 1)
+            try:
+                self.transport.cancel(req.replica, req.local_rid)
+            except Exception:       # noqa: BLE001 — replica down or
+                pass                # transport can't cancel: the slot
+                                    # is reaped with the replica instead
+        req.state, req.error = "failed", error
+        return True
+
     def has_work(self) -> bool:
         return any(r.state not in ("done", "failed")
                    for r in self._reqs.values())
 
     def step(self) -> List[Tuple[int, int]]:
-        """One fabric pass: heartbeat → release+route → poll. Returns
-        the (fid, token) pairs delivered this pass."""
+        """One fabric pass: heartbeat → breaker probes → shed ladder →
+        release+route → poll → deadline sweep. Returns the (fid, token)
+        pairs delivered this pass."""
         self._refresh_status()
+        self._probe_dead()
+        if self.shedder is not None:
+            # percentile aggregation only when a latency ceiling is
+            # actually armed — queue depth alone is a dict len
+            lat = (self.latency_stats()
+                   if (self.shedder.ttft_p99_ceiling_s is not None
+                       or self.shedder.itl_p99_ceiling_s is not None)
+                   else {})
+            self._apply_brownout(
+                self.shedder.observe(len(self._queue), lat))
         self._dispatch_queue()
         delivered = self._poll_replicas()
+        self._enforce_deadlines()
         if _REG.enabled:
             self._tick_gauges()
             _sentry.maybe_tick()
         return delivered
 
-    def run(self) -> Dict[int, np.ndarray]:
-        """Drive until every submitted request completes; returns
-        {fid: full token stream} for the requests finished by this call
-        and releases them (same contract as the engine's run()). A
-        request a replica REJECTED at submit (deterministic application
-        error, e.g. a prompt no pool can hold) maps to None here and
-        its error text is kept in ``self.failed[fid]``."""
-        while self.has_work():
-            if not self._alive_names():
-                raise RuntimeError(
-                    "serving fabric: every replica is down with "
-                    f"{sum(r.state not in ('done', 'failed') for r in self._reqs.values())}"
-                    " requests outstanding")
-            self.step()
-        out = {}
+    def take_finished(self) -> Dict[int, Optional[np.ndarray]]:
+        """Release every finished request: {fid: full stream} (None for
+        a failed one, its error text kept in ``self.failed[fid]``) —
+        the streaming front door's harvest; run() is this in a loop."""
+        out: Dict[int, Optional[np.ndarray]] = {}
         for fid, r in list(self._reqs.items()):
             if r.state == "done":
                 out[fid] = r.result
@@ -209,6 +280,26 @@ class ServingFabric:
             else:
                 continue
             del self._reqs[fid]
+        return out
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request completes; returns
+        {fid: full token stream} for the requests finished by this call
+        and releases them (same contract as the engine's run()). A
+        request a replica REJECTED at submit (deterministic application
+        error, e.g. a prompt no pool can hold) maps to None here and
+        its error text is kept in ``self.failed[fid]``."""
+        out: Dict[int, Optional[np.ndarray]] = {}
+        while self.has_work():
+            if not self._alive_names():
+                raise AllReplicasDown(
+                    "serving fabric: every replica is down with "
+                    f"{sum(r.state not in ('done', 'failed') for r in self._reqs.values())}"
+                    " requests outstanding",
+                    retry_after_ms=self._retry_after_ms())
+            self.step()
+            out.update(self.take_finished())
+        out.update(self.take_finished())
         if _REG.enabled:
             self.publish_metrics()
             _sentry.maybe_tick()
@@ -260,6 +351,104 @@ class ServingFabric:
                     elif itl < self.itl_p99_target_s * (
                             1.0 - self.hysteresis_band):
                         self._hot.discard(name)
+
+    def probe_recovery(self) -> None:
+        """Public half-open probe pass: drive breaker readmission while
+        the fabric is otherwise IDLE (step() probes as part of every
+        busy pass, but a recovered replica must not stay quarantined
+        just because traffic paused — the front door calls this on its
+        idle ticks)."""
+        self._probe_dead()
+
+    def _probe_dead(self) -> None:
+        """Half-open probing (ISSUE 16): when the transport is breaker-
+        wrapped, ask it to probe each replica the router holds as dead;
+        a CLOSEd breaker readmits the replica into routing. Stale local
+        rids it still held are best-effort cancelled — the survivors
+        re-own those streams, the recovered engine must not keep
+        burning pages on them. A genuinely crashed replica's probe just
+        keeps failing: readmission only ever follows demonstrated
+        progress."""
+        probe = getattr(self.transport, "probe", None)
+        if probe is None or not self._dead:
+            return
+        for name in sorted(self._dead):
+            try:
+                ok = bool(probe(name))
+            except Exception:       # noqa: BLE001 — a probe must never
+                ok = False          # kill the fabric loop
+            if not ok:
+                continue
+            self._dead.discard(name)
+            for rid in self._stale_rids.pop(name, ()):
+                try:
+                    self.transport.cancel(name, rid)
+                except Exception:   # noqa: BLE001 — best-effort reap
+                    pass
+            if _REG.enabled:
+                _REG.counter("pt_fabric_replica_readmitted_total",
+                             "replicas readmitted after a breaker "
+                             "half-open probe succeeded").inc(
+                    replica=name, **self._flabels)
+
+    def _retry_after_ms(self, default: Optional[float] = None) -> float:
+        """Server-side recovery estimate for typed rejections: the
+        breaker's soonest half-open window when one is armed, else the
+        configured default."""
+        hint = getattr(self.transport, "retry_after_ms", None)
+        v = None
+        if callable(hint):
+            try:
+                v = hint()
+            except Exception:       # noqa: BLE001 — hint is advisory
+                v = None
+        if v is None:
+            v = (self.default_retry_after_ms
+                 if default is None else default)
+        return float(v)
+
+    def _apply_brownout(self, level: int) -> None:
+        """Level 2 pushes the draft-budget cap to every live replica
+        (``spec_k`` shrink: verification-exact, just fewer drafts per
+        tick — FLOPs shift from speculation to admitted decodes);
+        leaving level 2 restores construction-time values."""
+        want = level >= 2
+        if want == self._browned:
+            return
+        knobs = {"spec_k": (self.shedder.spec_k_cap if want else None)}
+        for name in self._alive_names():
+            try:
+                self.transport.configure(name, knobs)
+            except Exception:       # noqa: BLE001 — a replica that
+                pass                # can't configure just keeps its k
+        self._browned = want
+
+    def _enforce_deadlines(self) -> None:
+        """Drain-boundary deadline sweep: a request past its TTFT or
+        total budget is CANCELLED (slot/pages freed replica-side) and
+        fails typed — the budget is spent, finishing late serves
+        nobody and the capacity goes to requests that can still make
+        theirs."""
+        now = time.perf_counter()
+        for req in list(self._reqs.values()):
+            if req.state in ("done", "failed"):
+                continue
+            age_ms = (now - req.submit_t) * 1000.0
+            kind = None
+            if (req.deadline_ms is not None
+                    and age_ms > req.deadline_ms):
+                kind = "total"
+            elif (req.ttft_deadline_ms is not None
+                    and req.first_tok_t == 0.0 and not req.delivered
+                    and age_ms > req.ttft_deadline_ms):
+                kind = "ttft"
+            if kind is None:
+                continue
+            self.cancel(req.fid, error=f"deadline_exceeded:{kind}")
+            if _REG.enabled:
+                _REG.counter("pt_frontdoor_deadline_miss_total",
+                             "requests cancelled past their deadline"
+                             ).inc(kind=kind, **self._flabels)
 
     # -- routing -------------------------------------------------------------
 
@@ -389,6 +578,12 @@ class ServingFabric:
     def _dispatch(self, req: FabricRequest) -> bool:
         """Route + submit ``req``; False when nothing can take it this
         pass (it stays queued)."""
+        # brownout (shed level 2): cold expensive prefills WAIT — the
+        # skip loop keeps cheap/warm requests flowing and running
+        # decodes keep their ITL; fairness still orders the wait
+        if (self.shedder is not None and not req.delivered
+                and self.shedder.defer_cold(self._est_uncached(req))):
+            return False
         # disaggregation: a cold long prompt goes to a prefill replica
         # first — unless it already prefilled (handoff done) or was
         # re-admitted with progress (its replay is the expensive part
@@ -464,7 +659,9 @@ class ServingFabric:
         payload = {"prompt": req.prompt,
                    "max_new_tokens": (1 if prefill
                                       else req.max_new_tokens),
-                   "rseed": req.fid, "knobs": req.knobs,
+                   "rseed": (req.fid if req.rseed is None
+                             else req.rseed),
+                   "knobs": req.knobs,
                    "replay": (None if prefill or not req.delivered
                               else list(req.delivered))}
         try:
@@ -516,7 +713,9 @@ class ServingFabric:
                 fid = self._assign.get((name, int(rid)))
                 if fid is None:
                     continue
-                req = self._reqs[fid]
+                req = self._reqs.get(fid)
+                if req is None:
+                    continue
                 if req.state != "decode" or req.replica != name:
                     continue         # prefill probe token: discarded
                 arrived.setdefault(fid, []).append(int(tok))
@@ -536,7 +735,9 @@ class ServingFabric:
                     continue
                 self._outstanding[name] = max(
                     0, self._outstanding.get(name, 0) - 1)
-                req = self._reqs[fid]
+                req = self._reqs.get(fid)
+                if req is None:
+                    continue
                 if req.state == "prefill" and req.replica == name:
                     self._complete_prefill(req, name)
                 elif req.state == "decode" and req.replica == name:
@@ -636,6 +837,8 @@ class ServingFabric:
         if name in self._dead:
             return
         self._dead.add(name)
+        self._stale_rids[name] = sorted(
+            rid for (n, rid) in self._assign if n == name)
         self._status.pop(name, None)
         self._digests.pop(name, None)
         self._hot.discard(name)
@@ -710,6 +913,8 @@ class ServingFabric:
                "replicas_alive": self._alive_names(),
                "replicas_dead": sorted(self._dead),
                "hot": sorted(self._hot)}
+        if self.shedder is not None:
+            out["shed"] = self.shedder.stats()
         if self.fair is not None:
             out["tenant_admitted"] = dict(self.fair.admitted)
             out["tenant_admitted_tokens"] = {

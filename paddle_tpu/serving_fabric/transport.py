@@ -73,6 +73,22 @@ class FabricTransport:
         """adopt_pages on ``name``; returns pages adopted."""
         raise NotImplementedError
 
+    # Optional verbs (ISSUE 16). Defaults are safe no-ops so scripted
+    # stub transports in tests (and third-party transports) keep
+    # working without implementing them: a False/{} answer just means
+    # "this transport can't do that", which the router tolerates.
+
+    def cancel(self, name: str, rid: int) -> bool:
+        """Terminate local ``rid`` on ``name`` and free its slot/pages
+        (deadline miss, client disconnect, slow-loris eviction).
+        Returns True when the request existed and was cancelled."""
+        return False
+
+    def configure(self, name: str, knobs: dict) -> dict:
+        """Push runtime knobs (brownout ``spec_k`` cap, …) to ``name``;
+        returns the knobs the replica actually applied."""
+        return {}
+
 
 # ---------------------------------------------------------------------------
 # in-process
@@ -91,10 +107,22 @@ class InProcTransport(FabricTransport):
         else:
             self._replicas = {r.name: r for r in replicas}
         self._dead: set = set()
+        self._hung: Dict[str, threading.Event] = {}
 
-    def _get(self, name: str):
+    def _get(self, name: str, op: str = ""):
         if name in self._dead:
             raise ReplicaDown(name, "killed")
+        ev = self._hung.get(name)
+        if ev is not None and op != "status":
+            # the hang failure mode (testing/chaos.hang_replica): the
+            # replica heartbeats but never progresses — callers block
+            # here exactly like a wedged remote. The engine is NEVER
+            # touched by a hung op, so no state mutates during the
+            # hang; on release the op reports ReplicaDown (the stalled
+            # RPC's answer is lost) and the breaker's half-open probe
+            # is what re-establishes service.
+            ev.wait()
+            raise ReplicaDown(name, "hang released; op abandoned")
         r = self._replicas.get(name)
         if r is None:
             raise ReplicaDown(name, "unknown replica")
@@ -106,24 +134,48 @@ class InProcTransport(FabricTransport):
     def kill(self, name: str) -> None:
         """Drop ``name`` mid-whatever-it-was-doing (chaos helper)."""
         self._dead.add(name)
+        ev = self._hung.pop(name, None)
+        if ev is not None:
+            ev.set()
+
+    def hang(self, name: str) -> None:
+        """Wedge ``name`` (chaos helper): ``status`` still answers but
+        every other op blocks — crash's evil twin, the failure mode the
+        circuit breaker's op-class timeouts exist for."""
+        if name not in self._replicas:
+            raise ReplicaDown(name, "unknown replica")
+        self._hung.setdefault(name, threading.Event())
+
+    def unhang(self, name: str) -> None:
+        """Release a hang: blocked ops wake (and report ReplicaDown);
+        fresh ops succeed again."""
+        ev = self._hung.pop(name, None)
+        if ev is not None:
+            ev.set()
 
     def alive(self, name: str) -> bool:
         return name in self._replicas and name not in self._dead
 
     def submit(self, name, req):
-        return self._get(name).submit(req)
+        return self._get(name, "submit").submit(req)
 
     def poll(self, name):
-        return self._get(name).poll()
+        return self._get(name, "poll").poll()
 
     def status(self, name):
-        return self._get(name).status()
+        return self._get(name, "status").status()
 
     def extract(self, name, tokens):
-        return self._get(name).extract(tokens)
+        return self._get(name, "extract").extract(tokens)
 
     def adopt(self, name, payload):
-        return self._get(name).adopt(payload)
+        return self._get(name, "adopt").adopt(payload)
+
+    def cancel(self, name, rid):
+        return self._get(name, "cancel").cancel(rid)
+
+    def configure(self, name, knobs):
+        return self._get(name, "configure").configure(knobs)
 
 
 # ---------------------------------------------------------------------------
@@ -180,8 +232,10 @@ class TcpReplicaServer:
     client and the engine is not thread-safe — ops execute in arrival
     order, exactly like the in-proc transport."""
 
-    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
+                 max_line_bytes: int = 32 << 20):
         self.replica = replica
+        self.max_line_bytes = int(max_line_bytes)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -189,7 +243,11 @@ class TcpReplicaServer:
         self.host, self.port = self._sock.getsockname()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._active: Optional[socket.socket] = None
+        # EVERY live connection, not just the latest: stop() must sever
+        # them all or a peer holding an older socket keeps a zombie
+        # replica answering after "death"
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def _handle(self, op: str, args: dict):
         if op == "submit":
@@ -203,6 +261,10 @@ class TcpReplicaServer:
             return None if payload is None else payload_to_wire(payload)
         if op == "adopt":
             return self.replica.adopt(payload_from_wire(args["payload"]))
+        if op == "cancel":
+            return self.replica.cancel(args["rid"])
+        if op == "configure":
+            return self.replica.configure(args.get("knobs") or {})
         raise ValueError(f"unknown op {op!r}")
 
     def serve_forever(self) -> None:
@@ -214,22 +276,36 @@ class TcpReplicaServer:
                 continue
             except OSError:
                 break
-            with conn:
-                self._active = conn
-                f = conn.makefile("rwb")
-                for line in f:
-                    try:
-                        msg = json.loads(line)
-                        result = self._handle(msg.get("op", ""),
-                                              msg.get("args", {}))
-                        out = {"ok": True, "result": result}
-                    except Exception as e:
-                        out = {"ok": False,
-                               "error": f"{type(e).__name__}: {e}"}
-                    f.write(json.dumps(out).encode() + b"\n")
-                    f.flush()
-                    if self._stop.is_set():
-                        break
+            with self._conns_lock:
+                self._conns.add(conn)
+            try:
+                with conn:
+                    f = conn.makefile("rwb")
+                    while not self._stop.is_set():
+                        # bounded read: a peer that streams bytes
+                        # without ever sending a newline gets cut off
+                        # at the cap instead of growing server memory
+                        line = f.readline(self.max_line_bytes + 1)
+                        if not line:
+                            break
+                        if (len(line) > self.max_line_bytes
+                                or not line.endswith(b"\n")):
+                            break
+                        try:
+                            msg = json.loads(line)
+                            result = self._handle(msg.get("op", ""),
+                                                  msg.get("args", {}))
+                            out = {"ok": True, "result": result}
+                        except Exception as e:
+                            out = {"ok": False,
+                                   "error": f"{type(e).__name__}: {e}"}
+                        f.write(json.dumps(out).encode() + b"\n")
+                        f.flush()
+            except OSError:
+                pass
+            finally:
+                with self._conns_lock:
+                    self._conns.discard(conn)
 
     def start(self) -> "TcpReplicaServer":
         self._thread = threading.Thread(target=self.serve_forever,
@@ -239,13 +315,13 @@ class TcpReplicaServer:
 
     def stop(self) -> None:
         """Tear the replica down like a kill: the LISTENER closes and
-        the live router connection is severed too — the router's next
+        every live router connection is severed too — the router's next
         op sees a reset (→ ReplicaDown), not a replica that keeps
         answering through a socket it already held."""
         self._stop.set()
-        for s in (self._sock, self._active):
-            if s is None:
-                continue
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in [self._sock] + conns:
             try:
                 s.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -264,16 +340,32 @@ class TcpTransport(FabricTransport):
 
     def __init__(self, endpoints: Dict[str, tuple],
                  connect_timeout_s: float = 2.0,
-                 op_timeout_s: float = 60.0):
+                 op_timeout_s: float = 60.0,
+                 max_line_bytes: int = 32 << 20):
         self._endpoints = dict(endpoints)
         self._conns: Dict[str, object] = {}
         self._connect_timeout = float(connect_timeout_s)
         self._op_timeout = float(op_timeout_s)
+        self.max_line_bytes = int(max_line_bytes)
 
     def replica_names(self) -> List[str]:
         return list(self._endpoints)
 
     def _call(self, name: str, op: str, args: dict):
+        # A persistent connection can be STALE (the server restarted
+        # since the last op): retry exactly once on a fresh socket in
+        # that case, so a rolling replica restart looks like a blip,
+        # not ReplicaDown. First-contact failures are never retried —
+        # nothing was stale, the replica is genuinely unreachable.
+        had_conn = name in self._conns
+        try:
+            return self._call_once(name, op, args)
+        except ReplicaDown:
+            if not had_conn:
+                raise
+            return self._call_once(name, op, args)
+
+    def _call_once(self, name: str, op: str, args: dict):
         try:
             f = self._conns.get(name)
             if f is None:
@@ -284,9 +376,12 @@ class TcpTransport(FabricTransport):
                 f = self._conns[name] = s.makefile("rwb")
             f.write(json.dumps({"op": op, "args": args}).encode() + b"\n")
             f.flush()
-            line = f.readline()
+            line = f.readline(self.max_line_bytes + 1)
             if not line:
                 raise ConnectionError("connection closed")
+            if (len(line) > self.max_line_bytes
+                    or not line.endswith(b"\n")):
+                raise ConnectionError("overlong response line")
             resp = json.loads(line)
         except (OSError, ValueError, KeyError) as e:
             self._conns.pop(name, None)
@@ -321,6 +416,12 @@ class TcpTransport(FabricTransport):
     def adopt(self, name, payload):
         return self._call(name, "adopt",
                           {"payload": payload_to_wire(payload)})
+
+    def cancel(self, name, rid):
+        return bool(self._call(name, "cancel", {"rid": int(rid)}))
+
+    def configure(self, name, knobs):
+        return self._call(name, "configure", {"knobs": dict(knobs)})
 
     def close(self) -> None:
         for f in self._conns.values():

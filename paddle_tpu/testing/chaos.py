@@ -29,7 +29,8 @@ import numpy as np
 
 __all__ = ["kill_mid_save", "corrupt_checkpoint", "nan_batch",
            "nan_injector", "kill_at_step", "spawn_trainer",
-           "spawn_elastic", "kill_replica"]
+           "spawn_elastic", "kill_replica", "hang_replica",
+           "unhang_replica"]
 
 
 def kill_mid_save(manager, step: int, tree) -> str:
@@ -137,6 +138,39 @@ def kill_replica(transport, name: str) -> None:
     raise TypeError(f"transport {type(transport).__name__} has no kill "
                     f"hook; SIGKILL the replica's server process "
                     f"directly (TcpReplicaServer.stop / os.kill)")
+
+
+def hang_replica(transport, name: str) -> None:
+    """Wedge a serving-fabric replica: it still answers ``status``
+    (heartbeats look healthy) but every op that would make PROGRESS —
+    poll, submit, extract, adopt — blocks forever. This is crash's
+    evil twin (GC pause, wedged accelerator, half-open partition) and
+    the failure mode the circuit breaker's op-class timeouts exist
+    for: without a breaker the router stalls on the hung poll; with
+    one the op times out, trips ReplicaDown, and PR 12's replay-exact
+    failover takes over. Requires a transport with a ``hang`` hook
+    (the in-process transport); for TCP, SIGSTOP the replica's server
+    process instead — the raised TypeError says so. Undo with
+    :func:`unhang_replica`."""
+    h = getattr(transport, "hang", None)
+    if h is not None:
+        h(name)
+        return
+    raise TypeError(f"transport {type(transport).__name__} has no hang "
+                    f"hook; SIGSTOP the replica's server process "
+                    f"directly (os.kill(pid, signal.SIGSTOP))")
+
+
+def unhang_replica(transport, name: str) -> None:
+    """Release :func:`hang_replica`: blocked ops wake and report
+    ReplicaDown (their answers are lost — that RPC already failed);
+    fresh ops succeed, so a breaker's half-open probe readmits."""
+    u = getattr(transport, "unhang", None)
+    if u is not None:
+        u(name)
+        return
+    raise TypeError(f"transport {type(transport).__name__} has no "
+                    f"unhang hook; SIGCONT the server process instead")
 
 
 def spawn_trainer(ckpt_dir: str, *, steps: int, extra_args: Sequence[str] = (),

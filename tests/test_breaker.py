@@ -1,0 +1,236 @@
+"""Circuit breaker (ISSUE 16): a HUNG replica must look exactly like a
+crashed one. The breaker's op-class timeouts convert "no answer within
+the verb's budget" into ReplicaDown — the same signal PR 12's
+replay-exact failover already handles — then gate readmission behind
+open → half-open probe → closed.
+
+Tier-1 proofs here:
+* unit lifecycle on a stub transport (trip, fail-fast while open,
+  half-open probes, close after ``probe_successes``);
+* `hang_replica` trips within the op-class budget, in-flight streams
+  replay token-identically on the survivor, and half-open probing
+  readmits the replica after recovery (acceptance b);
+* with EVERY breaker open, submissions get the typed
+  :class:`AllReplicasDown` rejection carrying ``retry_after_ms``
+  (ISSUE 16 satellite).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.serving_fabric import (AllReplicasDown, BreakerTransport,
+                                       InProcTransport, ServingFabric,
+                                       build_replicas)
+from paddle_tpu.serving_fabric.transport import FabricTransport, ReplicaDown
+from paddle_tpu.testing.chaos import hang_replica, unhang_replica
+
+pytestmark = pytest.mark.chaos
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model(tiny_llama):
+    return tiny_llama
+
+
+def _reference_streams(model, prompts, gc, max_new, fids):
+    """Uninterrupted ground truth: the fabric pins rseed=fid, so a bare
+    engine with the same rseed emits the exact stream any replica —
+    or post-failover sequence of replicas — must reproduce."""
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=96,
+        generation_config=gc)
+    rids = [eng.submit(p, max_new, rseed=f)
+            for p, f in zip(prompts, fids)]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+# -- unit lifecycle on a stub -----------------------------------------------
+
+class _StubTransport(FabricTransport):
+    """One fake replica whose poll can be made slower than any budget."""
+
+    def __init__(self):
+        self.slow = False
+        self.polls = 0
+
+    def replica_names(self):
+        return ["s0"]
+
+    def status(self, name):
+        return {"queued": 0, "running": 0}
+
+    def poll(self, name):
+        self.polls += 1
+        if self.slow:
+            time.sleep(0.3)
+        return []
+
+    def submit(self, name, req):
+        return 0
+
+    def extract(self, name, tokens):
+        return None
+
+    def adopt(self, name, payload):
+        return None
+
+    def cancel(self, name, rid):
+        return True
+
+    def configure(self, name, knobs):
+        return {}
+
+
+def test_breaker_lifecycle_unit():
+    tr = _StubTransport()
+    br = BreakerTransport(tr, op_timeouts={"poll": 0.05},
+                          open_cooldown_s=0.1, probe_successes=2,
+                          probe_timeout_s=0.5)
+    assert br.poll("s0") == []                 # healthy pass-through
+    assert br.state("s0") == "closed"
+    tr.slow = True
+    with pytest.raises(ReplicaDown):
+        br.poll("s0")                          # budget miss → trip
+    assert br.state("s0") == "open"
+    assert br.trips == 1
+    assert br.open_names() == ["s0"]
+    ra = br.retry_after_ms("s0")
+    assert ra is not None and 0.0 < ra <= 100.0
+    # open = fail FAST: the inner transport is not even touched
+    n = tr.polls
+    with pytest.raises(ReplicaDown):
+        br.poll("s0")
+    assert tr.polls == n
+    # recovery: cooldown elapses (and the stuck worker drains), then
+    # probe_successes consecutive good probes close the breaker
+    tr.slow = False
+    time.sleep(0.35)
+    assert br.probe("s0") is False             # 1 of 2
+    assert br.state("s0") == "half-open"
+    assert br.probe("s0") is True
+    assert br.state("s0") == "closed"
+    assert br.retry_after_ms("s0") is None
+    assert br.poll("s0") == []
+
+
+def test_probe_failure_reopens():
+    tr = _StubTransport()
+    br = BreakerTransport(tr, op_timeouts={"poll": 0.05},
+                          open_cooldown_s=0.05, probe_successes=1,
+                          probe_timeout_s=0.1)
+    tr.slow = True
+    with pytest.raises(ReplicaDown):
+        br.poll("s0")
+    time.sleep(0.45)                           # cooldown over, lock free
+    # still slow: the half-open probe must FAIL and re-open (a wedged
+    # replica that heartbeats fine is not readmitted)
+    assert br.probe("s0") is False
+    assert br.state("s0") == "open"
+    tr.slow = False
+    time.sleep(0.45)
+    assert br.probe("s0") is True
+    assert br.state("s0") == "closed"
+
+
+# -- acceptance (b): hang → trip → replay-exact failover → readmit ----------
+
+def test_hang_trips_breaker_replays_exact_and_readmits(model):
+    gc = GenerationConfig(max_new_tokens=10, do_sample=True, seed=9)
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=96,
+                          max_batch=2, generation_config=gc)
+    br = BreakerTransport(InProcTransport(reps), open_cooldown_s=0.3,
+                          probe_successes=2, probe_timeout_s=0.5)
+    fab = ServingFabric(br, policy="round-robin")
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 256, (n,)).astype(np.int32)
+               for n in (5, 7)]
+    fids = [fab.submit(p, 10) for p in prompts]
+    refs = dict(zip(fids,
+                    _reference_streams(model, prompts, gc, 10, fids)))
+    # stream until both requests are mid-flight (jit compiles paid —
+    # only now are tight budgets meaningful on the CPU CI shape)
+    live = {f: [] for f in fids}
+    while min(len(v) for v in live.values()) < 3:
+        for f, t in fab.step():
+            live[f].append(t)
+    victim = fab._reqs[fids[0]].replica
+    assert victim is not None
+    hang_replica(br, victim)
+    try:
+        # tight budgets ONLY for the detection window; restored before
+        # the survivor pays the failover re-prefill
+        br.op_timeouts["poll"] = 0.6
+        br.op_timeouts["submit"] = 0.6
+        t0 = time.monotonic()
+        while victim not in fab._dead:
+            assert time.monotonic() - t0 < 20.0, \
+                "hung replica never tripped the breaker"
+            for f, t in fab.step():
+                live[f].append(t)
+        tripped_s = time.monotonic() - t0
+        # hung == crashed within the op-class budget's scale (one poll
+        # budget + the pass that observes it), nowhere near the 30s a
+        # breakerless router would stall
+        assert tripped_s < 10.0
+        assert br.state(victim) in ("open", "half-open")
+        assert br.trips >= 1
+        br.op_timeouts["poll"] = 30.0
+        br.op_timeouts["submit"] = 30.0
+        out = fab.run()
+        assert fab.stats()["replicas_dead"] == [victim]
+        assert fab.readmitted >= 1             # stream moved to survivor
+        for f in fids:
+            # full stream token-identical to the uninterrupted
+            # reference, and what streamed before the hang is exactly
+            # its prefix: zero duplicated, zero lost tokens
+            np.testing.assert_array_equal(out[f], refs[f])
+            np.testing.assert_array_equal(
+                np.asarray(live[f]), out[f][:len(live[f])])
+        # recovery: unhang, half-open probes readmit and CLOSE
+        unhang_replica(br, victim)
+        t0 = time.monotonic()
+        while victim in fab._dead:
+            assert time.monotonic() - t0 < 15.0, \
+                "recovered replica never readmitted"
+            fab.probe_recovery()
+            time.sleep(0.02)
+        assert br.state(victim) == "closed"
+    finally:
+        unhang_replica(br, victim)             # never leak blocked threads
+
+
+# -- satellite: all breakers open → typed all-down with retry hint ----------
+
+def test_all_breakers_open_submissions_typed(model):
+    gc = GenerationConfig(max_new_tokens=4, do_sample=False)
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=64,
+                          max_batch=1, generation_config=gc)
+    tr = InProcTransport(reps)
+    br = BreakerTransport(tr, open_cooldown_s=5.0)
+    fab = ServingFabric(br, policy="round-robin")
+    names = list(br.replica_names())
+    for n in names:
+        tr.kill(n)
+    fab.submit([1, 2, 3], 4)
+    # driving the queued request walks every replica: each op raises,
+    # each breaker trips, and the fabric reports total loss typed
+    with pytest.raises(AllReplicasDown, match="every replica is down"):
+        fab.run()
+    assert set(br.open_names()) == set(names)
+    # a NEW submission against the all-open fabric is refused typed,
+    # with retry_after_ms = the soonest half-open window
+    with pytest.raises(AllReplicasDown) as ei:
+        fab.submit([1, 2, 3], 4)
+    e = ei.value
+    assert isinstance(e, RuntimeError)         # legacy callers still catch
+    assert e.retry_after_ms is not None
+    assert 0.0 < e.retry_after_ms <= 5000.0
+    wire = e.to_wire()
+    assert wire["kind"] == "all_down"
+    assert wire["retry_after_ms"] == e.retry_after_ms

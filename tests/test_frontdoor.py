@@ -1,0 +1,344 @@
+"""FrontDoor streaming server + FabricClient (ISSUE 16 tentpole).
+
+Tier-1 proofs:
+* framing: a torn frame gets a typed ``error`` event and the connection
+  SURVIVES; every event carries an ordered, gapless per-connection seq;
+* 8 concurrent client streams complete token-identical to the serial
+  single-engine reference (acceptance a, healthy half);
+* a slow-loris client is cancelled — slot/pages freed and reusable —
+  while concurrent healthy streams finish token-identical
+  (acceptance a, adversarial half);
+* client retry after a mid-stream disconnect resumes via the server's
+  dedupe record + ``replay_prefix``: zero duplicated, zero lost tokens
+  (acceptance c);
+* deadline misses and shed-ladder refusals surface as typed rejections
+  carrying ``kind`` + ``retry_after_ms``.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.serving_fabric import (DeadlineExceeded, FabricClient,
+                                       FrontDoor, InProcTransport,
+                                       LoadShedder, Overloaded,
+                                       ServingFabric, build_replicas)
+
+pytestmark = pytest.mark.chaos
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model(tiny_llama):
+    return tiny_llama
+
+
+@pytest.fixture(scope="module")
+def gc():
+    return GenerationConfig(max_new_tokens=16, do_sample=True, seed=9)
+
+
+@pytest.fixture(scope="module")
+def door_fab(model, gc):
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=96,
+                          max_batch=4, generation_config=gc)
+    fab = ServingFabric(InProcTransport(reps), policy="round-robin")
+    door = FrontDoor(fab).start()
+    yield door, fab
+    door.stop()
+
+
+def _reference_streams(model, prompts, gc, max_new, fids):
+    """The fabric pins rseed=fid: a bare serial engine with the same
+    rseed is the ground truth whatever the concurrency/placement."""
+    eng = ContinuousBatchingEngine(
+        model, max_batch=1, page_size=PAGE, max_len=96,
+        generation_config=gc)
+    rids = [eng.submit(p, max_new, rseed=f)
+            for p, f in zip(prompts, fids)]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def _connect(door, timeout=120.0):
+    s = socket.create_connection((door.host, door.port), timeout=5.0)
+    s.settimeout(timeout)
+    return s, s.makefile("rb")
+
+
+def _send(sock, msg):
+    sock.sendall(json.dumps(msg).encode() + b"\n")
+
+
+def _recv(f):
+    line = f.readline(1 << 20)
+    if not line:
+        raise ConnectionError("server closed the connection")
+    return json.loads(line)
+
+
+def _rseeds(door, sids):
+    with door._flock:
+        return [door._streams[s].rseed for s in sids]
+
+
+def _wait_state(door, sid, want, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if door.stream_states().get(sid) == want:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"stream {sid[:24]!r} never reached {want!r}: "
+        f"{door.stream_states().get(sid)!r}")
+
+
+# -- framing ----------------------------------------------------------------
+
+def test_torn_frame_survives_and_seq_gapless(door_fab):
+    door, _ = door_fab
+    s, f = _connect(door)
+    try:
+        s.sendall(b'{"op": "submit", "truncated\n')     # torn JSON
+        s.sendall(b'[1, 2, 3]\n')                       # not an object
+        _send(s, {"op": "frobnicate"})                  # unknown op
+        _send(s, {"op": "submit"})                      # no id
+        _send(s, {"op": "ping"})
+        evs = [_recv(f) for _ in range(5)]
+        # the connection survived four bad frames and still answers
+        assert [e["ev"] for e in evs] == ["error"] * 4 + ["pong"]
+        assert "bad frame" in evs[0]["error"]
+        # per-connection seq: ordered and gapless from 0
+        assert [e["seq"] for e in evs] == list(range(5))
+    finally:
+        s.close()
+
+
+# -- acceptance (a), healthy half -------------------------------------------
+
+def test_concurrent_streams_token_identical(model, gc, door_fab):
+    door, _ = door_fab
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, 256, (6,)).astype(np.int32)
+               for _ in range(8)]
+    sids = [f"cc-{i}" for i in range(8)]
+    results = [None] * 8
+    errs = []
+
+    def go(i):
+        try:
+            c = FabricClient(door.host, door.port, max_attempts=3,
+                             io_timeout_s=180.0)
+            results[i] = c.generate(prompts[i], 8, request_id=sids[i])
+        except Exception as e:          # noqa: BLE001 — reported below
+            errs.append((i, repr(e)))
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300.0)
+    assert not errs, f"client failures: {errs}"
+    refs = _reference_streams(model, prompts, gc, 8,
+                              _rseeds(door, sids))
+    for r, ref in zip(results, refs):
+        assert len(r.tokens) == 8
+        np.testing.assert_array_equal(np.asarray(r.tokens),
+                                      np.asarray(ref))
+
+
+# -- acceptance (a), adversarial half ---------------------------------------
+
+def test_slow_loris_cancelled_healthy_streams_unharmed(model, gc):
+    reps = build_replicas(model, 2, page_size=PAGE, max_len=96,
+                          max_batch=2, generation_config=gc)
+    fab = ServingFabric(InProcTransport(reps), policy="round-robin")
+    # tiny server-side send buffer + aggressive stall budget so the
+    # loris shows up in seconds, not minutes
+    door = FrontDoor(fab, outbox_max=64, write_stall_s=0.25,
+                     sndbuf=2048).start()
+    slow_sock = None
+    try:
+        # the loris: tiny receive window negotiated BEFORE connect, a
+        # long request id so every tok event is fat, a long stream so
+        # it cannot finish before the buffers fill — then never read
+        slow_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        slow_sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
+        slow_sock.connect((door.host, door.port))
+        slow_sid = "slow-" + "x" * 8000
+        _send(slow_sock, {"op": "submit", "id": slow_sid,
+                          "prompt": [1] * 6, "max_new_tokens": 90})
+        # 8 healthy concurrent streams against 4 slots (one of which
+        # the loris is squatting on until evicted)
+        rs = np.random.RandomState(2)
+        prompts = [rs.randint(0, 256, (6,)).astype(np.int32)
+                   for _ in range(8)]
+        sids = [f"h-{i}" for i in range(8)]
+        results = [None] * 8
+        errs = []
+
+        def go(i):
+            try:
+                c = FabricClient(door.host, door.port, max_attempts=3,
+                                 io_timeout_s=180.0)
+                results[i] = c.generate(prompts[i], 8,
+                                        request_id=sids[i])
+            except Exception as e:      # noqa: BLE001 — reported below
+                errs.append((i, repr(e)))
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300.0)
+        assert not errs, f"healthy clients failed: {errs}"
+        # healthy streams: token-identical to the serial reference —
+        # the loris cost them nothing but queueing
+        refs = _reference_streams(model, prompts, gc, 8,
+                                  _rseeds(door, sids))
+        for r, ref in zip(results, refs):
+            np.testing.assert_array_equal(np.asarray(r.tokens),
+                                          np.asarray(ref))
+        # the loris was detected and CANCELLED (not served, not hung):
+        # its dedupe record orphans, its fabric request is gone
+        _wait_state(door, slow_sid, "orphaned", timeout_s=90.0)
+        # ...and the slot/pages it held are reusable: a fresh request
+        # completes on the drained fabric
+        c = FabricClient(door.host, door.port, max_attempts=3,
+                         io_timeout_s=180.0)
+        after = c.generate(prompts[0], 8, request_id="after-loris")
+        refs2 = _reference_streams(model, [prompts[0]], gc, 8,
+                                   _rseeds(door, ["after-loris"]))
+        np.testing.assert_array_equal(np.asarray(after.tokens),
+                                      np.asarray(refs2[0]))
+    finally:
+        if slow_sock is not None:
+            slow_sock.close()
+        door.stop()
+
+
+# -- acceptance (c): disconnect → retry resumes exactly ---------------------
+
+def test_disconnect_retry_resumes_zero_dup_zero_loss(model, gc, door_fab):
+    door, _ = door_fab
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 256, (7,)).astype(np.int32)
+    sid = "rt-1"
+    n_new = 48          # long enough that the disconnect lands MID-stream
+    s, f = _connect(door)
+    got = []
+    try:
+        _send(s, {"op": "submit", "id": sid,
+                  "prompt": prompt.tolist(), "max_new_tokens": n_new})
+        while not got:
+            ev = _recv(f)
+            if ev.get("ev") == "tok" and ev.get("id") == sid:
+                got.extend(int(t) for t in ev["toks"])
+            elif ev.get("ev") == "done":
+                pytest.fail("stream finished before the disconnect")
+        assert 0 < len(got) < n_new
+    finally:
+        # a REAL disconnect: the makefile dups the fd, so the socket
+        # must be shut down, not just dropped
+        try:
+            s.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        f.close()
+        s.close()
+    _wait_state(door, sid, "orphaned")
+    # retry on a fresh connection: same id, have = what we kept. The
+    # server resumes via its dedupe record (original rseed + committed
+    # tokens as replay prefix) and ships ONLY the missing suffix.
+    s2, f2 = _connect(door)
+    rest = []
+    try:
+        _send(s2, {"op": "submit", "id": sid,
+                   "prompt": prompt.tolist(), "max_new_tokens": n_new,
+                   "have": len(got)})
+        while True:
+            ev = _recv(f2)
+            if ev.get("ev") == "tok" and ev.get("id") == sid:
+                rest.extend(int(t) for t in ev["toks"])
+            elif ev.get("ev") == "done" and ev.get("id") == sid:
+                rest.extend(int(t) for t in ev.get("toks", ()))
+                assert ev["n"] == len(got) + len(rest)
+                break
+            elif ev.get("ev") == "reject":
+                pytest.fail(f"resume rejected: {ev}")
+    finally:
+        s2.close()
+    total = got + rest
+    assert len(total) == n_new
+    ref = _reference_streams(model, [prompt], gc, n_new,
+                             _rseeds(door, [sid]))[0]
+    # zero duplicated, zero lost: prefix + resumed suffix IS the
+    # uninterrupted reference stream
+    np.testing.assert_array_equal(np.asarray(total), np.asarray(ref))
+    assert door.retries >= 1
+
+
+# -- typed refusals ---------------------------------------------------------
+
+def test_deadline_miss_rejected_typed(door_fab):
+    door, _ = door_fab
+    c = FabricClient(door.host, door.port, max_attempts=2)
+    with pytest.raises(DeadlineExceeded) as ei:
+        c.generate([1, 2, 3, 4, 5, 6], 8, deadline_ms=0.01,
+                   request_id="dl-1")
+    # terminal (budget spent), but still typed with a retry hint: 0 —
+    # the deadline clock restarts with any retry
+    assert ei.value.retry_after_ms is not None
+
+
+def test_overload_rejected_typed_with_retry_hint(model, gc):
+    reps = build_replicas(model, 1, page_size=PAGE, max_len=96,
+                          max_batch=1, generation_config=gc)
+    shed = LoadShedder(queue_depth_hi=2, queue_depth_lo=0, queue_cap=3,
+                       breach_ticks=1, retry_after_ms=123.0)
+    fab = ServingFabric(InProcTransport(reps), policy="round-robin",
+                        shedder=shed)
+    door = FrontDoor(fab).start()
+    s = None
+    try:
+        s, f = _connect(door)
+        for i in range(8):
+            _send(s, {"op": "submit", "id": f"ov-{i}",
+                      "prompt": [1] * 6, "max_new_tokens": 8})
+        reject = None
+        deadline = time.monotonic() + 120.0
+        while reject is None and time.monotonic() < deadline:
+            ev = _recv(f)
+            if ev.get("ev") == "reject":
+                reject = ev
+        assert reject is not None, "hard queue cap never shed"
+        assert reject["kind"] == "overloaded"
+        assert reject["retry_after_ms"] == 123.0
+        assert shed.stats()["shed"]            # ledger recorded it
+    finally:
+        if s is not None:
+            s.close()
+        door.stop()
+
+
+def test_shed_ladder_levels_and_brownout_defer():
+    sh = LoadShedder(queue_depth_hi=2, queue_depth_lo=0, queue_cap=None,
+                     breach_ticks=1, recover_ticks=1,
+                     cold_defer_tokens=64, retry_after_ms=50.0)
+    assert sh.observe(0) == 0
+    assert sh.observe(5) == 1                  # breach → shed
+    sh.admit("prod", 2.0, 0)                   # protected tier admitted
+    with pytest.raises(Overloaded) as ei:
+        sh.admit("bulk", 0.5, 0)               # low weight → shed, typed
+    assert ei.value.retry_after_ms == 50.0
+    assert ei.value.to_wire()["kind"] == "overloaded"
+    assert sh.observe(5) == 2                  # second breach → brownout
+    assert sh.defer_cold(256) and not sh.defer_cold(0)
+    assert sh.observe(0) == 1                  # drain → step back down
+    assert sh.observe(0) == 0
