@@ -143,6 +143,39 @@ def build_serving_tick() -> BuiltGraph:
         notes="decode_block=4 paged scan, spec off"), example_args=args)
 
 
+def build_serving_tick_quant() -> BuiltGraph:
+    """The quantized decode tick (ISSUE 17): int8 weights + int8 KV
+    pages. Beyond the plain tick's contract (pools donated, zero host
+    transfers), NO widened pool-shaped f32/bf16 buffer may materialize:
+    dequant must stay fused into the attention read — per-sequence
+    gather working sets are fine, a whole-pool dequant pass is the
+    regression the ban exists for. num_pages is deliberately NOT
+    max_batch*pages_per_seq so the pool shape cannot collide with the
+    legitimate gathered working set's dims."""
+    import jax.numpy as jnp
+
+    from ..inference.serving import ContinuousBatchingEngine
+    from ..quantization import quantize_model
+    model = quantize_model(_micro_model(), kv_dtype="int8")
+    eng = ContinuousBatchingEngine(model, max_batch=2, page_size=8,
+                                   max_len=64, num_pages=24)
+    eng._init_state(jnp.zeros((_VOCAB,), jnp.float32))
+    fn = eng._build_decode(4, any_sample=False, attn_impl="paged")
+    args = (eng._params, eng.pools, jnp.asarray(eng.tables),
+            eng._base_key, eng._state, eng._knobs)
+    compiled = fn.lower(*args).compile()
+    hkv, npages, ps, hd = eng.pools[0][0].shape
+    return BuiltGraph("serving_tick_quant", compiled, GraphContract(
+        "serving_tick_quant", require_aliased=("pools",),
+        max_host_transfers=0,
+        ban_rules=(BanRule(hd, hkv * npages * ps, label="f32-pool",
+                           dtype="f32"),
+                   BanRule(hd, hkv * npages * ps, label="bf16-pool",
+                           dtype="bf16")),
+        notes="decode_block=4 paged scan, int8 weights + int8 KV"),
+        example_args=args)
+
+
 def build_serving_tick_spec() -> BuiltGraph:
     """The speculative tick (draft + (k+1)-wide verify + commit): pools
     AND the [B, max_len] history carry donated — un-donating either is a
@@ -298,6 +331,7 @@ REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "train_step_k1": build_train_step_k1,
     "train_step_k4": build_train_step_k4,
     "serving_tick": build_serving_tick,
+    "serving_tick_quant": build_serving_tick_quant,
     "serving_tick_spec": build_serving_tick_spec,
     "prefix_admit": build_prefix_admit,
     "fused_ce": build_fused_ce,

@@ -39,14 +39,22 @@ _NO_BUFFER = {"parameter", "tuple", "get-tuple-element", "bitcast"}
 @dataclass(frozen=True)
 class BanRule:
     """Declarative buffer ban: an array whose LAST dim equals ``last_dim``
-    and whose remaining dims multiply to ``leading_product`` (dtype-blind)
-    — with ``last_dim=V`` and ``leading_product=B*S`` this is precisely
-    "a logits tensor materialized"."""
+    and whose remaining dims multiply to ``leading_product`` — with
+    ``last_dim=V`` and ``leading_product=B*S`` this is precisely "a
+    logits tensor materialized". Dtype-blind by default; an explicit
+    ``dtype`` (XLA primitive name, e.g. "f32") narrows the ban to that
+    element type — the int8-KV contract bans a *widened* pool-shaped
+    buffer while the legitimate int8 pool update shares its dims."""
     last_dim: int
     leading_product: int
     label: str = "banned"
+    dtype: Optional[str] = None
 
-    def matches(self, dims: Sequence[int]) -> bool:
+    def matches(self, dims: Sequence[int],
+                dtype: Optional[str] = None) -> bool:
+        if self.dtype is not None and dtype is not None \
+                and dtype != self.dtype:
+            return False
         if len(dims) < 2 or dims[-1] != self.last_dim:
             return False
         prod = 1
@@ -89,7 +97,7 @@ def banned_buffers(mod: HloModule, rules: Sequence[BanRule]
     seen = set()
     for ins, leaf in _buffers(mod):
         for rule in rules:
-            if rule.matches(leaf.dims):
+            if rule.matches(leaf.dims, leaf.dtype):
                 key = (str(leaf), ins.name)
                 if key in seen:
                     continue

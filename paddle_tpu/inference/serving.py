@@ -183,8 +183,25 @@ class _InflightBlock:
 
 
 # self-describing KV-page handoff payload format (serialize_pages /
-# adopt_pages); bump on any layout change — adoption REJECTS unknown fmts
-HANDOFF_FMT = "pt-kv-pages-v1"
+# adopt_pages); bump on any layout change — adoption REJECTS unknown fmts.
+# v2 (ISSUE 17) carries the pool dtype and, for int8 pools, the per-page
+# fp32 K/V scales. v1 payloads (scale-less) are still adopted by NATIVE
+# (bf16/f32) pools — a v1 emitter predates quantized pools, so its pages
+# are float and layout-compatible; an int8 pool REJECTS v1 (no scales to
+# dequant by), and the fabric's failed-handoff path falls back to a cold
+# prefill.
+HANDOFF_FMT = "pt-kv-pages-v2"
+HANDOFF_FMT_V1 = "pt-kv-pages-v1"
+
+
+def _entry_page_copy(entry, src, dst):
+    """Copy physical page ``src`` → ``dst`` within one per-layer pool
+    entry, generically over layout: 4-D pool arrays carry pages on axis
+    1, 1-D per-page scale arrays (int8 pools) on axis 0 — so COW, the
+    tail re-forward and page adoption move a page's scale with its
+    bytes for free."""
+    return tuple(a.at[:, dst].set(a[:, src]) if a.ndim == 4
+                 else a.at[dst].set(a[src]) for a in entry)
 
 
 class _PoolDry(Exception):
@@ -243,6 +260,12 @@ class ContinuousBatchingEngine:
         pools, _ = self.core.alloc_paged_caches(
             1, total * page_size, page_size)
         self.pools = pools
+        # int8 KV pages (ISSUE 17): a quantized pool's per-layer entry is
+        # the 4-tuple (kp, vp, kscale, vscale); everything below that
+        # moves pages (COW, handoff, adoption) is layout-generic, and the
+        # decode/prefill write paths quantize inside the model
+        self.kv_quant = len(pools[0]) == 4
+        self.kv_quant_ticks = 0             # decode ticks on an int8 pool
         self._total_pages = total - 1
         self._free: List[int] = list(range(total - 1, 0, -1))  # stack; 0 kept
         self.tables = np.zeros((max_batch, self.pages_per_seq), np.int32)
@@ -658,25 +681,38 @@ class ContinuousBatchingEngine:
         toks = toks[:len(ids) * self.page_size]
         if self._gather_fn is None:
             def run(pools, pids):
-                return jnp.stack(
-                    [jnp.stack([kp[:, pids], vp[:, pids]], axis=0)
-                     for kp, vp in pools], axis=0)
+                kv = jnp.stack(
+                    [jnp.stack([e[0][:, pids], e[1][:, pids]], axis=0)
+                     for e in pools], axis=0)
+                if self.kv_quant:        # [L, 2, n] per-page scales
+                    sc = jnp.stack(
+                        [jnp.stack([e[2][pids], e[3][pids]], axis=0)
+                         for e in pools], axis=0)
+                    return kv, sc
+                return kv, None
             self._gather_fn = jax.jit(run)
         # page count padded to a power-of-two bucket (extra rows read
         # the garbage page, sliced off below): the jit retraces per
         # page-count SHAPE, and unbucketed counts would pay a fresh
         # compile per distinct prompt length on the serving path
         b = self._handoff_bucket(len(ids))
-        kv = np.asarray(self._gather_fn(
+        kv, scales = self._gather_fn(
             self.pools,
-            jnp.asarray(ids + [0] * (b - len(ids)), jnp.int32)))
-        kv = np.ascontiguousarray(kv[:, :, :, :len(ids)])
+            jnp.asarray(ids + [0] * (b - len(ids)), jnp.int32))
+        kv = np.ascontiguousarray(np.asarray(kv)[:, :, :, :len(ids)])
         self.pages_exported += len(ids)
-        return {"fmt": HANDOFF_FMT, "page_size": self.page_size,
-                "tokens": toks, "kv": kv, "dtype": str(kv.dtype),
-                "shape": list(kv.shape),
-                "sha256": hashlib.sha256(toks.tobytes()
-                                         + kv.tobytes()).hexdigest()}
+        payload = {"fmt": HANDOFF_FMT, "page_size": self.page_size,
+                   "tokens": toks, "kv": kv, "dtype": str(kv.dtype),
+                   "shape": list(kv.shape)}
+        blob = toks.tobytes() + kv.tobytes()
+        if scales is not None:
+            sc = np.ascontiguousarray(
+                np.asarray(scales, np.float32)[:, :, :len(ids)])
+            payload["scales"] = sc
+            payload["scales_shape"] = list(sc.shape)
+            blob += sc.tobytes()
+        payload["sha256"] = hashlib.sha256(blob).hexdigest()
+        return payload
 
     def adopt_pages(self, payload) -> List[int]:
         """Adopt a :meth:`serialize_pages` payload into THIS engine's
@@ -692,9 +728,15 @@ class ContinuousBatchingEngine:
         mis-shaped payload raises ValueError before anything mutates."""
         if self._prefix is None:
             raise RuntimeError("adopt_pages needs prefix_cache=True")
-        if not isinstance(payload, dict) \
-                or payload.get("fmt") != HANDOFF_FMT:
+        fmt = payload.get("fmt") if isinstance(payload, dict) else None
+        if fmt not in (HANDOFF_FMT, HANDOFF_FMT_V1):
             raise ValueError("handoff payload: unknown format")
+        if fmt == HANDOFF_FMT_V1 and self.kv_quant:
+            # a v1 emitter has float pages and no scales — nothing to
+            # dequant by; the fabric treats this like any failed handoff
+            # and falls back to a cold prefill
+            raise ValueError("handoff payload: v1 (scale-less) payload "
+                             "cannot seed an int8 KV pool")
         if int(payload.get("page_size", -1)) != self.page_size:
             raise ValueError(
                 f"handoff payload: page_size {payload.get('page_size')} "
@@ -706,7 +748,7 @@ class ContinuousBatchingEngine:
             raise ValueError("handoff payload: token run is not a "
                              "whole-page multiple")
         n = len(toks) // ps
-        kp0, _ = self.pools[0]
+        kp0 = self.pools[0][0]
         want = (len(self.pools), 2, kp0.shape[0], n, ps, kp0.shape[3])
         if not isinstance(kv, np.ndarray) or kv.shape != want \
                 or list(kv.shape) != list(payload.get("shape", [])):
@@ -718,7 +760,24 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"handoff payload: dtype {payload.get('dtype')} != pool "
                 f"dtype {kp0.dtype}")
-        digest = hashlib.sha256(toks.tobytes() + kv.tobytes()).hexdigest()
+        scales = payload.get("scales")
+        blob = toks.tobytes() + kv.tobytes()
+        if self.kv_quant:
+            sc_want = (len(self.pools), 2, n)
+            if not isinstance(scales, np.ndarray) \
+                    or scales.shape != sc_want \
+                    or str(scales.dtype) != "float32" \
+                    or list(scales.shape) != list(
+                        payload.get("scales_shape", [])):
+                raise ValueError(
+                    f"handoff payload: scales shape "
+                    f"{getattr(scales, 'shape', None)} != expected "
+                    f"{sc_want} (int8 pool needs per-page fp32 scales)")
+            blob += scales.tobytes()
+        elif scales is not None:
+            raise ValueError("handoff payload: scales present but this "
+                             "engine's KV pool is not quantized")
+        digest = hashlib.sha256(blob).hexdigest()
         if digest != payload.get("sha256"):
             raise ValueError("handoff payload: checksum mismatch "
                              "(corrupt or truncated transfer)")
@@ -736,20 +795,31 @@ class ContinuousBatchingEngine:
                 f"adopt_pages: pool cannot hold {n - k} more pages "
                 f"even after tree eviction; raise num_pages")
         if self._scatter_fn is None:
-            def run(pools, pids, data):
-                return [(kp.at[:, pids].set(data[i, 0]),
-                         vp.at[:, pids].set(data[i, 1]))
-                        for i, (kp, vp) in enumerate(pools)]
+            def run(pools, pids, data, sc):
+                out = []
+                for i, e in enumerate(pools):
+                    ne = (e[0].at[:, pids].set(data[i, 0]),
+                          e[1].at[:, pids].set(data[i, 1]))
+                    if sc is not None:
+                        ne += (e[2].at[pids].set(sc[i, 0]),
+                               e[3].at[pids].set(sc[i, 1]))
+                    out.append(ne)
+                return out
             self._scatter_fn = jax.jit(run, donate_argnums=(0,))
         # same power-of-two bucketing as the gather: padded rows write
         # the garbage page (reserved junk — the designated sink)
         b = self._handoff_bucket(n - k)
         kv_pad = np.zeros(kv.shape[:3] + (b,) + kv.shape[4:], kv.dtype)
         kv_pad[:, :, :, :n - k] = kv[:, :, :, k:]
+        sc_pad = None
+        if self.kv_quant:
+            sc_pad = np.zeros(scales.shape[:2] + (b,), np.float32)
+            sc_pad[:, :, :n - k] = scales[:, :, k:]
+            sc_pad = jnp.asarray(sc_pad)
         self.pools = self._scatter_fn(
             self.pools,
             jnp.asarray(list(pages) + [0] * (b - (n - k)), jnp.int32),
-            jnp.asarray(kv_pad))
+            jnp.asarray(kv_pad), sc_pad)
         # insert walks the FULL run; the covered prefix needs page-id
         # placeholders that are never read (insert only consumes ids
         # from the first uncovered boundary on — and a coverage that
@@ -857,7 +927,9 @@ class ContinuousBatchingEngine:
                  self.prefix_hit_tokens,
                  "prompt tokens served from shared prefix pages"),
                 ("pt_serving_cow_copies_total", self.prefix_cow_copies,
-                 "shared pages copy-on-written at divergence")):
+                 "shared pages copy-on-written at divergence"),
+                ("pt_serving_kv_quant_ticks_total", self.kv_quant_ticks,
+                 "decode/verify ticks served from an int8 KV pool")):
             prev = self._published.get(name, 0)
             if val > prev:
                 _REG.counter(name, help).inc(val - prev, **lb)
@@ -874,6 +946,15 @@ class ContinuousBatchingEngine:
         if self._prefix is not None and self._prefix_prompt_tokens:
             self._g_prefix_hit.set(self.prefix_hit_tokens
                                    / self._prefix_prompt_tokens, **lb)
+        _REG.gauge("pt_serving_kv_quant_enabled",
+                   "1 when the KV page pool is int8 with per-page "
+                   "scales").set(float(self.kv_quant), **lb)
+        if self.kv_quant:
+            _REG.gauge("pt_serving_kv_quant_pool_bytes",
+                       "HBM bytes held by the int8 KV pool incl. scale "
+                       "arrays", "By").set(float(sum(
+                           a.size * a.dtype.itemsize
+                           for e in self.pools for a in e)), **lb)
         for key, metric in (("ttft", "pt_serving_ttft_seconds"),
                             ("latency", "pt_serving_latency_seconds"),
                             ("itl", "pt_serving_itl_seconds")):
@@ -1115,9 +1196,7 @@ class ContinuousBatchingEngine:
         diverging into a shared page."""
         if self._cow_fn is None:
             def run(pools, src, dst):
-                return [(kp.at[:, dst].set(kp[:, src]),
-                         vp.at[:, dst].set(vp[:, src]))
-                        for kp, vp in pools]
+                return [_entry_page_copy(e, src, dst) for e in pools]
             self._cow_fn = jax.jit(run, donate_argnums=(0,))
         self.pools = self._cow_fn(self.pools, jnp.int32(src),
                                   jnp.int32(dst))
@@ -1135,9 +1214,7 @@ class ContinuousBatchingEngine:
                 (lambda h: h)
 
             def run(params, tok, pos, pools, tables1, src, dst):
-                pools = [(kp.at[:, dst].set(kp[:, src]),
-                          vp.at[:, dst].set(vp[:, src]))
-                         for kp, vp in pools]
+                pools = [_entry_page_copy(e, src, dst) for e in pools]
                 ctx = model._bind(params) if hasattr(model, "_bind") \
                     else None
                 with ctx if ctx is not None else _null():
@@ -1677,17 +1754,23 @@ class ContinuousBatchingEngine:
         # crossover — short contexts keep the dense gather path's edge,
         # long contexts get the paged kernel's 1.45-3.6x win
         spec = bool(self.spec_k)
+        # kv_quant folds into the executable key (PR 5 stale-executable
+        # posture): pool layout is constructor-fixed today, but an engine
+        # whose pools are ever swapped (resharded resume, pool migration)
+        # must never reuse a tick compiled for the other layout
         if spec:
             # the verify forward has its own chunk attention (gathers the
             # paged history directly) — no dense/paged fork, so neither
             # the executable key nor attn_path_ticks may depend on it
-            fkey = ("spec", K, any_sample)
+            fkey = ("spec", K, any_sample, self.kv_quant)
         else:
             ctx_len = max(int(self._proj_pos[s]) for s, _ in parts) + K
             attn_impl = ("dense" if ctx_len <= self.attn_crossover
                          else "paged")
             self.attn_path_ticks[attn_impl] += 1
-            fkey = (K, any_sample, attn_impl)
+            fkey = (K, any_sample, attn_impl, self.kv_quant)
+        if self.kv_quant:
+            self.kv_quant_ticks += 1
         # tables upload BEFORE executable resolution: the cost-observatory
         # eager compile below lowers on the concrete args of this dispatch
         if self._tables_dirty:
@@ -1908,4 +1991,4 @@ class _null:
         return False
 
 
-__all__ = ["ContinuousBatchingEngine", "HANDOFF_FMT"]
+__all__ = ["ContinuousBatchingEngine", "HANDOFF_FMT", "HANDOFF_FMT_V1"]

@@ -68,6 +68,17 @@ class LlamaConfig:
     #   "naive" — materialize logits, then causal_lm_loss (the escape
     #             hatch; also forced by env PT_NAIVE_LOSS_HEAD=1)
     loss_impl: str = "fused"
+    # serving quantization (ISSUE 17):
+    #   weight_dtype "int8" — projections (qkv/o/gate_up/down/lm_head)
+    #     stored per-channel int8 [n, k] + fp32 scale [n]; every linear
+    #     dispatches through the ops-registry "int8_matmul" op (fused
+    #     Pallas dequant-matmul on TPU, XLA convert+scale elsewhere).
+    #     Serving-only: forward(labels=...) raises. Produce weights with
+    #     quantization.serving.quantize_model / tools/quantize_ckpt.py.
+    #   kv_dtype "int8" — paged KV pools allocate int8 with per-page fp32
+    #     scales riding alongside the page table (alloc_paged_caches).
+    weight_dtype: str = "native"
+    kv_dtype: str = "native"
 
     def __post_init__(self):
         if self.recompute not in ("none", "selective", "full"):
@@ -79,6 +90,12 @@ class LlamaConfig:
         if self.loss_impl not in ("fused", "naive"):
             raise ValueError(f"loss_impl must be 'fused'|'naive', "
                              f"got {self.loss_impl!r}")
+        if self.weight_dtype not in ("native", "int8"):
+            raise ValueError(f"weight_dtype must be 'native'|'int8', "
+                             f"got {self.weight_dtype!r}")
+        if self.kv_dtype not in ("native", "int8"):
+            raise ValueError(f"kv_dtype must be 'native'|'int8', "
+                             f"got {self.kv_dtype!r}")
         if self.hidden_size % self.num_attention_heads:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads:
@@ -114,6 +131,157 @@ class LlamaConfig:
 
 def _normal(std):
     return I.Normal(0.0, std)
+
+
+def _make_proj(layer, name, shape, cfg, sharding):
+    """Create a projection parameter in the layout ``cfg.weight_dtype``
+    demands. Native: float [k, n] (``shape``). int8: the transposed
+    reference layout — int8 [n, k] + per-out-channel fp32 ``<name>_scale``
+    [n] (weight_quantize's contract) — with the sharding tuple reversed
+    to match. Both stay trainable=True so raw_parameters() (the serving
+    engines' param pytree) carries them; training in int8 mode is
+    refused at the loss head instead."""
+    k, n = shape
+    if getattr(cfg, "weight_dtype", "native") == "int8":
+        setattr(layer, name, layer.create_parameter(
+            [n, k], dtype="int8", initializer=I.Constant(0),
+            sharding=(sharding[1], sharding[0])))
+        setattr(layer, name + "_scale", layer.create_parameter(
+            [n], dtype="float32", initializer=I.Constant(1.0),
+            sharding=(sharding[1],)))
+    else:
+        setattr(layer, name, layer.create_parameter(
+            shape, dtype=cfg.dtype,
+            initializer=_normal(cfg.initializer_range), sharding=sharding))
+
+
+def _proj(layer, x, name):
+    """The one weight-matmul every Llama linear routes through: native
+    weights do the plain dense matmul; int8 weights (detected by the
+    ``<name>_scale`` twin) dispatch through the ops-registry
+    "int8_matmul" op — fused Pallas dequant-in-VMEM on TPU gated by
+    TuneDB blocks + the lowering probe (the fused_vocab_ce pattern),
+    XLA convert+scale elsewhere, PT_DISABLE_PALLAS honored."""
+    scale = getattr(layer, name + "_scale", None)
+    if scale is not None:
+        wq = getattr(layer, name)
+        try:
+            from ..ops.pallas.int8_matmul import quantized_matmul
+        except ImportError:  # pragma: no cover - jaxlib without pallas
+            w = wq.astype(jnp.float32) * jnp.asarray(
+                scale, jnp.float32)[:, None]
+            return jnp.matmul(x, w.T.astype(x.dtype))
+        return quantized_matmul(x, wq, scale)
+    return jnp.matmul(x, getattr(layer, name).astype(x.dtype))
+
+
+# -- int8 paged-KV helpers (ISSUE 17) ----------------------------------------
+#
+# kv_dtype="int8" pools store K/V pages int8 with ONE fp32 absmax scale per
+# physical page (per layer, per K/V side): the per-layer pool entry becomes
+# the 4-tuple (kp, vp, kscale, vscale) — kscale/vscale are [num_pages] f32
+# arrays riding alongside the page table — instead of the native (kp, vp).
+# Page granularity is the sweet spot: per-tensor scales clip long-context
+# outliers, per-token scales bloat metadata and break the head-major page
+# stream; the page is the unit everything else already moves (COW, prefix
+# sharing, handoff, the Pallas block stream), so its scale travels for free.
+# Scales only GROW (monotone absmax): a token write that needs a bigger
+# scale branchlessly requantizes the page it lands in — old codes shift to
+# the new grid with one round per int8 element, bounding the error at half
+# a quantization step, and pages never thrash between scales.
+
+_KV_EPS = 1e-30      # scale==0 means "page all zeros"; guard the divides
+
+
+def _kv_quantized(kv) -> bool:
+    return len(kv) == 4
+
+
+def _kv_scatter_pages(kv, phys, k_tiles, v_tiles):
+    """Full-page write (prefill / chunked prefill): ``phys`` [P] physical
+    page ids, tiles [n_kv, P, page, hd] float. Quantized pools compute one
+    absmax scale per written page and REPLACE (page content is fully
+    rewritten, so no monotone constraint applies)."""
+    if not _kv_quantized(kv):
+        kp, vp = kv
+        return (kp.at[:, phys].set(k_tiles.astype(kp.dtype)),
+                vp.at[:, phys].set(v_tiles.astype(vp.dtype)))
+    kp, vp, ks, vs = kv
+
+    def one(pool, scale, tiles):
+        t = tiles.astype(jnp.float32)
+        s = jnp.max(jnp.abs(t), axis=(0, 2, 3)) / 127.0          # [P]
+        q = jnp.clip(jnp.round(t / jnp.maximum(s, _KV_EPS)[None, :, None,
+                                                           None]),
+                     -127, 127).astype(jnp.int8)
+        return (pool.at[:, phys].set(q),
+                scale.at[phys].set(s.astype(scale.dtype)))
+    kp, ks = one(kp, ks, k_tiles)
+    vp, vs = one(vp, vs, v_tiles)
+    return kp, vp, ks, vs
+
+
+def _kv_scatter_tokens(kv, phys, off, k_new, v_new):
+    """Token-slot write (decode / speculative verify): ``phys``/``off``
+    [...] (typically [b] or [b, T]) physical page + in-page offset per
+    token; ``k_new``/``v_new`` [n_kv, ..., hd] float. Quantized pools grow
+    the touched pages' scales monotonically (scatter-max makes duplicate
+    pages within one chunk agree on the final scale), requantize those
+    pages onto the new grid, then write the new codes."""
+    if not _kv_quantized(kv):
+        kp, vp = kv
+        return (kp.at[:, phys, off].set(k_new.astype(kp.dtype)),
+                vp.at[:, phys, off].set(v_new.astype(vp.dtype)))
+    kp, vp, ks, vs = kv
+
+    def one(pool, scale, new):
+        t = new.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(t), axis=(0, -1))                 # [...]
+        # per-page candidate via scatter-max: duplicates (several verify
+        # tokens landing in one page) all see the same final scale
+        s_new = jnp.maximum(
+            scale, jnp.zeros_like(scale).at[phys].max(amax / 127.0))
+        s_w = s_new[phys]                                        # [...]
+        factor = jnp.where(s_w > 0,
+                           scale[phys] / jnp.maximum(s_w, _KV_EPS), 0.0)
+        pages = pool[:, phys].astype(jnp.float32)  # [n_kv, ..., page, hd]
+        pool = pool.at[:, phys].set(
+            jnp.clip(jnp.round(pages * factor[None, ..., None, None]),
+                     -127, 127).astype(jnp.int8))
+        q = jnp.clip(jnp.round(t / jnp.maximum(s_w, _KV_EPS)[None, ...,
+                                                             None]),
+                     -127, 127).astype(jnp.int8)
+        return pool.at[:, phys, off].set(q), s_new
+    kp, ks = one(kp, ks, k_new)
+    vp, vs = one(vp, vs, v_new)
+    return kp, vp, ks, vs
+
+
+def _kv_gather_ctx(kv, tables):
+    """Whole-table gather for the context-attention read: returns
+    (k_ctx, v_ctx) [b, n_kv, S, hd] fp32, dequantized when the pool is
+    int8 (convert+scale — the XLA fallback shape of the fused kernel's
+    widen-in-VMEM)."""
+    tables_flat = tables.reshape(-1)
+    b, mp = tables.shape
+    if _kv_quantized(kv):
+        kp, vp, ks, vs = kv
+        n_kv, _, page, hd = kp.shape
+
+        def one(pool, scale):
+            ctx = pool[:, tables_flat].astype(jnp.float32)
+            ctx = ctx * scale[tables_flat][None, :, None, None]
+            ctx = ctx.reshape(n_kv, b, mp * page, hd)
+            return jnp.transpose(ctx, (1, 0, 2, 3))
+        return one(kp, ks), one(vp, vs)
+    kp, vp = kv
+    n_kv, _, page, hd = kp.shape
+
+    def one(pool):
+        ctx = pool[:, tables_flat].astype(jnp.float32)
+        ctx = ctx.reshape(n_kv, b, mp * page, hd)
+        return jnp.transpose(ctx, (1, 0, 2, 3))
+    return one(kp), one(vp)
 
 
 def _token_mean(nll, labels, ignore_index: int = -100):
@@ -185,15 +353,12 @@ class LlamaAttention(nn.Layer):
         self.cfg = cfg
         d, hd = cfg.hidden_size, cfg.head_dim
         n_h, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
-        std = cfg.initializer_range
         # fused QKV: [d, (n_h + 2*n_kv) * hd], column-parallel over tp
-        self.qkv_proj = self.create_parameter(
-            [d, (n_h + 2 * n_kv) * hd], dtype=cfg.dtype, initializer=_normal(std),
-            sharding=("fsdp", "tp"))
+        _make_proj(self, "qkv_proj", [d, (n_h + 2 * n_kv) * hd], cfg,
+                   sharding=("fsdp", "tp"))
         # output proj: row-parallel over tp
-        self.o_proj = self.create_parameter(
-            [n_h * hd, d], dtype=cfg.dtype, initializer=_normal(std),
-            sharding=("tp", "fsdp"))
+        _make_proj(self, "o_proj", [n_h * hd, d], cfg,
+                   sharding=("tp", "fsdp"))
 
     def _qkv_rope(self, x, cos, sin, position_ids=None):
         """Fused QKV projection + head split + rotary embedding — shared by
@@ -202,7 +367,7 @@ class LlamaAttention(nn.Layer):
         b, s, _ = x.shape
         n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                          cfg.head_dim)
-        qkv = jnp.matmul(x, self.qkv_proj.astype(x.dtype))
+        qkv = _proj(self, x, "qkv_proj")
         q, k, v = jnp.split(qkv, [n_h * hd, (n_h + n_kv) * hd], axis=-1)
         q = q.reshape(b, s, n_h, hd)
         k = k.reshape(b, s, n_kv, hd)
@@ -227,7 +392,7 @@ class LlamaAttention(nn.Layer):
                 out = _sdpa_xla(q, k, v, attn_mask=attn_mask, causal=True,
                                 segment_ids=segment_ids)
         out = out.reshape(b, s, n_h * hd)
-        return jnp.matmul(out, self.o_proj.astype(x.dtype))
+        return _proj(self, out, "o_proj")
 
     def _sp_attention(self, q, k, v, attn_mask, segment_ids=None):
         """Long-context path over the "sep" axis (SURVEY §5): the K/V ring
@@ -276,7 +441,7 @@ class LlamaAttention(nn.Layer):
         from ..ops.attention import _sdpa_xla
         out = _sdpa_xla(q, k, v, causal=True)
         out = out.reshape(b, s, n_h * hd)
-        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+        out = _proj(self, out, "o_proj")
         k_cache = jnp.zeros((b, max_len, n_kv, hd), k.dtype).at[:, :s].set(k)
         v_cache = jnp.zeros((b, max_len, n_kv, hd), v.dtype).at[:, :s].set(v)
         return out, (k_cache, v_cache)
@@ -307,42 +472,44 @@ class LlamaAttention(nn.Layer):
         p = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bht,bthd->bhd", p, v_full.astype(jnp.float32))
         out = out.astype(x.dtype).reshape(b, 1, n_h * hd)
-        return jnp.matmul(out, self.o_proj.astype(x.dtype)), (k_cache, v_cache)
+        return _proj(self, out, "o_proj"), (k_cache, v_cache)
 
 
     # -- paged-KV (vLLM-style) inference paths ------------------------------
 
-    def prefill_paged(self, x, cos, sin, k_pool, v_pool, tables):
+    def prefill_paged(self, x, cos, sin, kv, tables):
         """Prompt pass writing K/V into head-major page pools
         [H_kv, num_pages, page_size, hd] via ``tables`` [b, max_pages]
         (reference capability: block_multi_head_attention_kernel.cu's
-        prefill write path). Prompt length is padded up to a page multiple
+        prefill write path). ``kv`` is the per-layer pool entry —
+        (kp, vp) native or (kp, vp, kscale, vscale) int8 — and is
+        returned updated. Prompt length is padded up to a page multiple
         inside the pool; padded slots sit beyond seq_len and are never
         unmasked before being overwritten by decode steps."""
         cfg = self.cfg
         b, s, _ = x.shape
         n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                          cfg.head_dim)
-        page = k_pool.shape[2]
+        page = kv[0].shape[2]
         q, k, v = self._qkv_rope(x, cos[:s], sin[:s])
         from ..ops.attention import _sdpa_xla
         out = _sdpa_xla(q, k, v, causal=True)
         out = out.reshape(b, s, n_h * hd)
-        out = jnp.matmul(out, self.o_proj.astype(x.dtype))
+        out = _proj(self, out, "o_proj")
 
         np_ = -(-s // page)                       # pages holding the prompt
         pad = np_ * page - s
-        def scatter(pool, new):
+        def tiles(new):
             padded = jnp.pad(new, ((0, 0), (0, pad), (0, 0), (0, 0)))
             # [b, np_, page, n_kv, hd] -> [n_kv, b*np_, page, hd]
-            tiles = jnp.transpose(
+            return jnp.transpose(
                 padded.reshape(b, np_, page, n_kv, hd), (3, 0, 1, 2, 4)
             ).reshape(n_kv, b * np_, page, hd)
-            return pool.at[:, tables[:, :np_].reshape(-1)].set(
-                tiles.astype(pool.dtype))
-        return out, scatter(k_pool, k), scatter(v_pool, v)
+        kv = _kv_scatter_pages(kv, tables[:, :np_].reshape(-1),
+                               tiles(k), tiles(v))
+        return out, kv
 
-    def _paged_ctx_attention(self, q, positions, k_pool, v_pool, tables):
+    def _paged_ctx_attention(self, q, positions, kv, tables):
         """Full-table-span paged attention read: queries ``q``
         [b, C, n_h, hd] at absolute ``positions`` [b, C] gather the whole
         table (static shape: max_pages * page), GQA-expand, and attend
@@ -350,20 +517,14 @@ class LlamaAttention(nn.Layer):
         work order as one full-prompt pass. Shared by the chunked-prefill
         extend (shared page-aligned offset per row) and the speculative
         verify step (per-row positions); the causal mask is per row, which
-        reduces to the shared-offset mask when rows agree."""
+        reduces to the shared-offset mask when rows agree. Int8 pools are
+        dequantized in the gather (convert + per-page scale)."""
         cfg = self.cfg
         n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                          cfg.head_dim)
         b, C = positions.shape
-        page = k_pool.shape[2]
-        S = tables.shape[1] * page
-
-        def gather(pool):
-            ctx = pool[:, tables.reshape(-1)]        # [n_kv, b*mp, pg, hd]
-            ctx = ctx.reshape(n_kv, b, S, hd)
-            return jnp.transpose(ctx, (1, 0, 2, 3))  # [b, n_kv, S, hd]
-        k_ctx = gather(k_pool).astype(jnp.float32)
-        v_ctx = gather(v_pool).astype(jnp.float32)
+        k_ctx, v_ctx = _kv_gather_ctx(kv, tables)    # [b, n_kv, S, hd] f32
+        S = k_ctx.shape[2]
         rep = n_h // n_kv
         k_ctx = jnp.repeat(k_ctx, rep, axis=1)       # [b, n_h, S, hd]
         v_ctx = jnp.repeat(v_ctx, rep, axis=1)
@@ -375,8 +536,7 @@ class LlamaAttention(nn.Layer):
         out = jnp.einsum("bhcs,bhsd->bhcd", probs, v_ctx)
         return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, C, n_h * hd)
 
-    def prefill_chunk_paged(self, x, cos, sin, offset, k_pool, v_pool,
-                            tables):
+    def prefill_chunk_paged(self, x, cos, sin, offset, kv, tables):
         """Chunked-prefill step (Sarathi/vLLM-style prefill-extend): a
         C-token chunk at positions [offset, offset+C) writes its K/V
         pages and attends over the FULL paged history plus itself.
@@ -389,7 +549,7 @@ class LlamaAttention(nn.Layer):
         b, C, _ = x.shape
         n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                          cfg.head_dim)
-        page = k_pool.shape[2]
+        page = kv[0].shape[2]
         positions = offset + jnp.arange(C, dtype=jnp.int32)[None, :]
         q, k, v = self._qkv_rope(x, cos, sin,
                                  jnp.broadcast_to(positions, (b, C)))
@@ -406,22 +566,18 @@ class LlamaAttention(nn.Layer):
         phys = jnp.take(tables, jnp.minimum(pidx, max_pages - 1), axis=1)
         phys = jnp.where(valid[None, :], phys, 0)    # [b, npg]
 
-        def scatter(pool, new):
-            tiles = jnp.transpose(
+        def tiles(new):
+            return jnp.transpose(
                 new.reshape(b, npg, page, n_kv, hd), (3, 0, 1, 2, 4)
             ).reshape(n_kv, b * npg, page, hd)
-            return pool.at[:, phys.reshape(-1)].set(
-                tiles.astype(pool.dtype))
-        k_pool = scatter(k_pool, k)
-        v_pool = scatter(v_pool, v)
+        kv = _kv_scatter_pages(kv, phys.reshape(-1), tiles(k), tiles(v))
 
         out = self._paged_ctx_attention(
-            q, jnp.broadcast_to(positions, (b, C)), k_pool, v_pool,
+            q, jnp.broadcast_to(positions, (b, C)), kv,
             tables).astype(x.dtype)
-        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
-                k_pool, v_pool)
+        return _proj(self, out, "o_proj"), kv
 
-    def decode_paged(self, x, cos, sin, pos, k_pool, v_pool, tables):
+    def decode_paged(self, x, cos, sin, pos, kv, tables):
         """One-token step over the page pools: writes the new K/V into the
         page slot for position ``pos`` and attends via the Pallas paged
         kernel (XLA gather fallback off-TPU). A ``force_decode_impl``
@@ -437,27 +593,27 @@ class LlamaAttention(nn.Layer):
         b = x.shape[0]
         n_h, n_kv, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                          cfg.head_dim)
-        page = k_pool.shape[2]
+        page = kv[0].shape[2]
         q, k, v = self._qkv_rope(x, cos, sin, pos.reshape(b, 1))
         b_idx = jnp.arange(b)
         phys = tables[b_idx, pos // page]          # [b]
         off = pos % page
-        k_pool = k_pool.at[:, phys, off].set(
-            jnp.swapaxes(k[:, 0], 0, 1).astype(k_pool.dtype))
-        v_pool = v_pool.at[:, phys, off].set(
-            jnp.swapaxes(v[:, 0], 0, 1).astype(v_pool.dtype))
+        kv = _kv_scatter_tokens(kv, phys, off,
+                                jnp.swapaxes(k[:, 0], 0, 1),
+                                jnp.swapaxes(v[:, 0], 0, 1))
+        quant = _kv_quantized(kv)
+        scales = {"k_scales": kv[2], "v_scales": kv[3]} if quant else {}
         q2 = q[:, 0]                               # [b, n_h, hd]
         if (forced_decode_impl() != "dense" and backend_kind() == "tpu"
-                and paged_decode_supported(q2, k_pool)):
-            out = paged_decode_attention(q2, k_pool, v_pool, tables, pos)
+                and paged_decode_supported(q2, kv[0])):
+            out = paged_decode_attention(q2, kv[0], kv[1], tables, pos,
+                                         **scales)
         else:
-            out = paged_decode_xla(q2, k_pool, v_pool, tables, pos)
+            out = paged_decode_xla(q2, kv[0], kv[1], tables, pos, **scales)
         out = out.reshape(b, 1, n_h * hd).astype(x.dtype)
-        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
-                k_pool, v_pool)
+        return _proj(self, out, "o_proj"), kv
 
-    def decode_verify_paged(self, x, cos, sin, pos, k_pool, v_pool,
-                            tables):
+    def decode_verify_paged(self, x, cos, sin, pos, kv, tables):
         """Speculative-verify step: T tokens per row at PER-ROW positions
         ``pos[b] .. pos[b]+T-1`` (unlike ``prefill_chunk_paged``'s shared,
         page-aligned offset) — writes all T K/V slots, then attends
@@ -473,7 +629,7 @@ class LlamaAttention(nn.Layer):
         real pages by a rejected suffix is overwritten by the next verify
         chunk before anything attends to it — positions only advance by
         the committed prefix, and every chunk rewrites its own T slots."""
-        page = k_pool.shape[2]
+        page = kv[0].shape[2]
         T = x.shape[1]
         positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
         q, k, v = self._qkv_rope(x, cos, sin, positions)
@@ -485,35 +641,27 @@ class LlamaAttention(nn.Layer):
         phys = jnp.where(valid, phys, 0)                 # garbage page
         off = positions % page
 
-        def scatter(pool, new):                          # new [b, T, kv, hd]
-            return pool.at[:, phys, off].set(
-                jnp.transpose(new, (2, 0, 1, 3)).astype(pool.dtype))
-        k_pool = scatter(k_pool, k)
-        v_pool = scatter(v_pool, v)
-
-        out = self._paged_ctx_attention(q, positions, k_pool, v_pool,
+        kv = _kv_scatter_tokens(kv, phys, off,           # new [b, T, kv, hd]
+                                jnp.transpose(k, (2, 0, 1, 3)),
+                                jnp.transpose(v, (2, 0, 1, 3)))
+        out = self._paged_ctx_attention(q, positions, kv,
                                         tables).astype(x.dtype)
-        return (jnp.matmul(out, self.o_proj.astype(x.dtype)),
-                k_pool, v_pool)
+        return _proj(self, out, "o_proj"), kv
 
 
 class LlamaMLP(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         d, m = cfg.hidden_size, cfg.intermediate_size
-        std = cfg.initializer_range
         # fused gate+up: column-parallel; down: row-parallel
-        self.gate_up_proj = self.create_parameter(
-            [d, 2 * m], dtype=cfg.dtype, initializer=_normal(std),
-            sharding=("fsdp", "tp"))
-        self.down_proj = self.create_parameter(
-            [m, d], dtype=cfg.dtype, initializer=_normal(std),
-            sharding=("tp", "fsdp"))
+        _make_proj(self, "gate_up_proj", [d, 2 * m], cfg,
+                   sharding=("fsdp", "tp"))
+        _make_proj(self, "down_proj", [m, d], cfg, sharding=("tp", "fsdp"))
 
     def forward(self, x):
-        gu = jnp.matmul(x, self.gate_up_proj.astype(x.dtype))
+        gu = _proj(self, x, "gate_up_proj")
         g, u = jnp.split(gu, 2, axis=-1)
-        return jnp.matmul(F.silu(g) * u, self.down_proj.astype(x.dtype))
+        return _proj(self, F.silu(g) * u, "down_proj")
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -631,13 +779,22 @@ class LlamaModel(nn.Layer):
         cfg = self.cfg
         pages_per_seq = -(-max_len // page_size)
         num_pages = batch * pages_per_seq
-        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        pools = [
-            (jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
-                        cfg.head_dim), dt),
-             jnp.zeros((cfg.num_key_value_heads, num_pages, page_size,
-                        cfg.head_dim), dt))
-            for _ in range(cfg.num_hidden_layers)]
+        shape = (cfg.num_key_value_heads, num_pages, page_size,
+                 cfg.head_dim)
+        if getattr(cfg, "kv_dtype", "native") == "int8":
+            # int8 pages + one fp32 absmax scale per physical page, per
+            # K/V side (ISSUE 17). Scales start at 0 = "page holds
+            # nothing": dequant of an unwritten page is exactly the
+            # all-zeros page a native pool starts with.
+            pools = [
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.zeros((num_pages,), jnp.float32),
+                 jnp.zeros((num_pages,), jnp.float32))
+                for _ in range(cfg.num_hidden_layers)]
+        else:
+            dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+            pools = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                     for _ in range(cfg.num_hidden_layers)]
         tables = jnp.arange(num_pages, dtype=jnp.int32).reshape(
             batch, pages_per_seq)
         return pools, tables
@@ -645,37 +802,37 @@ class LlamaModel(nn.Layer):
     def prefill_paged(self, input_ids, pools, tables):
         x = jnp.take(self.embed_tokens, input_ids, axis=0)
         new_pools = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            a, kp, vp = layer.self_attn.prefill_paged(
+        for layer, kv in zip(self.layers, pools):
+            a, kv = layer.self_attn.prefill_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
-                kp, vp, tables)
+                kv, tables)
             h = x + a
             x = h + layer.mlp(layer.post_attention_layernorm(h))
-            new_pools.append((kp, vp))
+            new_pools.append(kv)
         return self.norm(x), new_pools
 
     def prefill_chunk_paged(self, input_ids, offset, pools, tables):
         x = jnp.take(self.embed_tokens, input_ids, axis=0)
         new_pools = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            a, kp, vp = layer.self_attn.prefill_chunk_paged(
+        for layer, kv in zip(self.layers, pools):
+            a, kv = layer.self_attn.prefill_chunk_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
-                offset, kp, vp, tables)
+                offset, kv, tables)
             h = x + a
             x = h + layer.mlp(layer.post_attention_layernorm(h))
-            new_pools.append((kp, vp))
+            new_pools.append(kv)
         return self.norm(x), new_pools
 
     def decode_step_paged(self, token_ids, pos, pools, tables):
         x = jnp.take(self.embed_tokens, token_ids[:, None], axis=0)
         new_pools = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            a, kp, vp = layer.self_attn.decode_paged(
+        for layer, kv in zip(self.layers, pools):
+            a, kv = layer.self_attn.decode_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
-                pos, kp, vp, tables)
+                pos, kv, tables)
             h = x + a
             x = h + layer.mlp(layer.post_attention_layernorm(h))
-            new_pools.append((kp, vp))
+            new_pools.append(kv)
         return self.norm(x), new_pools
 
     def decode_verify_paged(self, token_ids, pos, pools, tables):
@@ -685,13 +842,13 @@ class LlamaModel(nn.Layer):
         samples targets from every row to accept/reject drafts."""
         x = jnp.take(self.embed_tokens, token_ids, axis=0)
         new_pools = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            a, kp, vp = layer.self_attn.decode_verify_paged(
+        for layer, kv in zip(self.layers, pools):
+            a, kv = layer.self_attn.decode_verify_paged(
                 layer.input_layernorm(x), self.rope_cos, self.rope_sin,
-                pos, kp, vp, tables)
+                pos, kv, tables)
             h = x + a
             x = h + layer.mlp(layer.post_attention_layernorm(h))
-            new_pools.append((kp, vp))
+            new_pools.append(kv)
         return self.norm(x), new_pools
 
 
@@ -701,17 +858,23 @@ class LlamaForCausalLM(nn.Layer):
         self.cfg = cfg
         self.model = LlamaModel(cfg)
         if not cfg.tie_word_embeddings:
-            self.lm_head = self.create_parameter(
-                [cfg.hidden_size, cfg.vocab_size], dtype=cfg.dtype,
-                initializer=_normal(cfg.initializer_range),
-                sharding=("fsdp", "tp"))
+            _make_proj(self, "lm_head", [cfg.hidden_size, cfg.vocab_size],
+                       cfg, sharding=("fsdp", "tp"))
         else:
             self.add_parameter("lm_head", None)
 
     def logits(self, hidden):
-        w = (jnp.swapaxes(self.model.embed_tokens, 0, 1)
-             if self.cfg.tie_word_embeddings else self.lm_head)
-        return jnp.matmul(hidden, w.astype(hidden.dtype))
+        """Vocab projection. In weight_dtype='int8' mode (untied) this is
+        the fused dequant-matmul epilogue on the vocab head: the int8
+        [V, H] weight crosses HBM quantized and the registry's Pallas
+        kernel widens it in VMEM and scales the f32 accumulator blockwise
+        (the PR 5 fused-CE template — TuneDB blocks + lowering probe gate
+        it identically). Tied embeddings keep the float gather table, so
+        the tied head stays a dense matmul."""
+        if self.cfg.tie_word_embeddings:
+            w = jnp.swapaxes(self.model.embed_tokens, 0, 1)
+            return jnp.matmul(hidden, w.astype(hidden.dtype))
+        return _proj(self, hidden, "lm_head")
 
     def forward(self, input_ids, labels=None, position_ids=None,
                 attn_mask=None, segment_ids=None, return_logits=None):
@@ -730,6 +893,11 @@ class LlamaForCausalLM(nn.Layer):
         (pinned by the HLO guard in tests/test_fused_vocab_ce.py).
         ``return_logits=False`` skips even the traced projection and
         returns the scalar loss alone."""
+        if labels is not None and self.cfg.weight_dtype == "int8":
+            raise ValueError(
+                "weight_dtype='int8' is a serving-only layout (no float "
+                "master weights to train); quantize a trained checkpoint "
+                "with quantization.serving.quantize_model instead")
         hidden = self.model(input_ids, position_ids, attn_mask, segment_ids)
         if labels is None:
             return self.logits(hidden)

@@ -150,10 +150,17 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     wq = jnp.asarray(weight)
     out = None
     if weight_dtype == "int8" and group_size == -1 and weight_scale is not None:
-        # fused Pallas path: int8 weight crosses HBM quantized, dequant
-        # happens in VMEM inside the matmul (ops/pallas/int8_matmul.py);
-        # shape-gated, TPU-only, kill-switch honored
-        out = _try_pallas_weight_only(x, wq, weight_scale)
+        # registry-routed path (ISSUE 17 dedupe): the ONE "int8_matmul"
+        # op picks the fused Pallas kernel on TPU (TuneDB blocks +
+        # lowering probe + PT_DISABLE_PALLAS honored) or the XLA
+        # convert+scale composition everywhere else
+        scale = jnp.asarray(weight_scale, jnp.float32)
+        if scale.ndim == 1:
+            try:
+                from ..ops.registry import dispatch
+                out = dispatch("int8_matmul")(x, wq, scale)
+            except KeyError:  # pragma: no cover - jaxlib without pallas
+                out = None
     if out is None:
         w = _dequant(wq, weight_scale, algo, group_size, x.dtype)  # [n, k]
         out = jax.lax.dot_general(
@@ -162,36 +169,6 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     if bias is not None:
         out = out + jnp.asarray(bias, x.dtype)
     return out
-
-
-def _try_pallas_weight_only(x, wq, weight_scale):
-    """Run the fused kernel when eligible, else None (XLA fallback)."""
-    from ..ops import registry
-    from ..core.flags import flag
-    if (registry.pallas_disabled() or not flag("use_pallas_kernels")
-            or registry.backend_kind() != "tpu"):
-        return None
-    scale = jnp.asarray(weight_scale, jnp.float32)
-    if scale.ndim != 1:
-        return None
-    lead = x.shape[:-1]
-    m = 1
-    for d in lead:
-        m *= d
-    from ..ops.pallas import int8_matmul as im
-    if im.db_winner(m, wq.shape[0], x.shape[-1], x.dtype) == "xla":
-        return None  # measured on hardware: XLA path >= fused kernel here
-    bm, bn, bk = im.tuned_blocks(m, wq.shape[0], x.shape[-1], x.dtype)
-    if not im.shapes_supported((m, x.shape[-1]), tuple(wq.shape),
-                               block_m=bm, block_n=bn, block_k=bk,
-                               dtype=x.dtype):
-        return None
-    try:
-        y = im.int8_matmul_pallas(x.reshape(m, x.shape[-1]), wq, scale,
-                                  block_m=bm, block_n=bn, block_k=bk)
-    except Exception:
-        return None
-    return y.reshape(lead + (wq.shape[0],))
 
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,

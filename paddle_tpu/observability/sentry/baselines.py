@@ -131,6 +131,18 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("frontdoor_goodput_under_overload", "lower", band=0.4),
     RatioMetric("frontdoor_p99_ttft_with_breaker_ratio", "higher",
                 band=0.5),
+    # quantized serving (ISSUE 17): int8 ÷ bf16 engine tok/s at EQUAL
+    # HBM budget (interleaved min-of-rounds; the bf16 leg thrashes by
+    # design, so the ratio rides recompute scheduling — wide band), the
+    # max-resident-slots capacity ratio (integer slot counts over the
+    # engine's own preemption machinery — near-deterministic, tight
+    # band), the int8 leg's serving÷raw-kernel efficiency, and the
+    # greedy int8-vs-bf16 stream agreement (free-running, one near-tie
+    # flip cascades; the hard floor lives in the tests)
+    RatioMetric("quant_decode_speedup", "lower", band=0.4),
+    RatioMetric("quant_kv_capacity_ratio", "lower", band=0.15),
+    RatioMetric("quant_serving_decode_efficiency", "lower", band=0.35),
+    RatioMetric("quant_stream_agreement", "lower", band=0.4),
 ]}
 
 

@@ -18,5 +18,6 @@ try:
     from .pallas import flash_attention as _pallas_flash_attention  # noqa: F401
     from .pallas import fused_norm as _pallas_fused_norm  # noqa: F401
     from .pallas import fused_vocab_ce as _pallas_fused_vocab_ce  # noqa: F401
+    from .pallas import int8_matmul as _pallas_int8_matmul  # noqa: F401
 except ImportError:  # pragma: no cover — jaxlib without pallas
     pass

@@ -125,6 +125,104 @@ def shapes_supported(x_shape, w_shape, *, block_m=DEFAULT_BLOCK_M,
         and bk >= 128
 
 
+def xla_weight_only(x, wq, scale):
+    """XLA composition fallback: widen int8 to the activation dtype
+    (exact — ±127 is representable even in bf16) and apply the
+    per-channel scale to the f32 ACCUMULATOR, not the [n, k] weight.
+    At decode (m ≤ batch) an O(n·k) dequant pass per call would cost
+    more than the dot itself; the epilogue multiply is O(m·n) — the
+    same scale-the-accumulator contract the Pallas kernel uses.
+    x float [..., k]; wq int8 [n, k]; scale [n] or scalar fp32.
+    Returns [..., n] in x.dtype — the activation-dtype convention
+    every linear in the repo follows."""
+    n, k = wq.shape
+    scale = jnp.broadcast_to(
+        jnp.asarray(scale, jnp.float32).reshape(-1), (n,))
+    acc = jax.lax.dot_general(
+        x, wq.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * scale).astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_lowering_ok() -> bool:
+    """One-shot compile probe on the real backend (same rationale as
+    fused_vocab_ce: degrade to the XLA path on env drift instead of
+    poisoning every downstream jit)."""
+    from ..registry import backend_kind
+    if backend_kind() != "tpu":
+        return False
+    try:
+        x = jax.ShapeDtypeStruct((256, 512), jnp.bfloat16)
+        w = jax.ShapeDtypeStruct((256, 512), jnp.int8)
+        s = jax.ShapeDtypeStruct((256,), jnp.float32)
+
+        def probe(x, w, s):
+            return int8_matmul_pallas(x, w, s, block_m=256, block_n=256,
+                                      block_k=512)
+
+        jax.jit(probe).lower(x, w, s).compile()
+        return True
+    except Exception as e:  # pragma: no cover - only on env drift
+        import warnings
+        warnings.warn(f"Pallas int8 matmul failed TPU lowering; falling "
+                      f"back to the XLA dequant-matmul path: {e}")
+        return False
+
+
+def _tpu_weight_only(x, wq, scale):
+    """Registered TPU impl: the fused Pallas kernel when the shape/env
+    gates pass (TuneDB blocks + lowering probe, exactly the
+    fused_vocab_ce pattern), else the XLA composition."""
+    from ..registry import pallas_disabled
+    from ...core.flags import flag
+    scale = jnp.asarray(scale, jnp.float32)
+    lead, k = x.shape[:-1], x.shape[-1]
+    m = 1
+    for d in lead:
+        m *= d
+    n = wq.shape[0]
+    if (pallas_disabled() or not flag("use_pallas_kernels")
+            or scale.ndim > 1 or db_winner(m, n, k, x.dtype) == "xla"
+            or not _tpu_lowering_ok()):
+        return xla_weight_only(x, wq, scale)
+    bm, bn, bk = tuned_blocks(m, n, k, x.dtype)
+    if not shapes_supported((m, k), tuple(wq.shape), block_m=bm,
+                            block_n=bn, block_k=bk, dtype=x.dtype):
+        return xla_weight_only(x, wq, scale)
+    try:
+        y = int8_matmul_pallas(x.reshape(m, k),
+                               wq, jnp.broadcast_to(scale.reshape(-1),
+                                                    (n,)),
+                               block_m=bm, block_n=bn, block_k=bk)
+    except Exception:
+        return xla_weight_only(x, wq, scale)
+    return y.reshape(lead + (n,))
+
+
+def _register():
+    # THE one registry op both quantization/functional.int8_matmul and
+    # nn/quantized_linear.weight_only_linear resolve through (ISSUE 17
+    # dedupe): per-channel weight-only int8, x float [..., k] x wq int8
+    # [n, k] -> [..., n] in x.dtype.
+    from ..registry import register_kernel
+    register_kernel("int8_matmul", "tpu")(_tpu_weight_only)
+    register_kernel("int8_matmul", "any")(xla_weight_only)
+
+
+_register()
+
+
+def quantized_matmul(x, wq, scale):
+    """Dispatch-routed weight-only int8 matmul: the single entry every
+    int8 linear call site uses (model weight_dtype='int8' projections,
+    Int8Linear, functional.int8_matmul). TuneDB block configs and the
+    PT_DISABLE_PALLAS kill-switch apply uniformly because dispatch
+    happens here, not at the callers."""
+    from ..registry import dispatch
+    return dispatch("int8_matmul")(x, wq, scale)
+
+
 def _db_cfg(m, n, k, dtype):
     from .autotune import _DB
     import jax as _jax
@@ -162,4 +260,4 @@ def db_winner(m, n, k, dtype="bfloat16"):
 
 
 __all__ = ["int8_matmul_pallas", "shapes_supported", "tuned_blocks",
-           "db_winner"]
+           "db_winner", "quantized_matmul", "xla_weight_only"]

@@ -44,14 +44,28 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, page_size,
-                   group, n_fetch):
+def _decode_kernel(*args, scale, page_size, group, n_fetch, quant):
     """Grid (B, H_kv, max_pages // n_fetch); innermost sequential over page
     GROUPS. Each step streams ``n_fetch`` (possibly scattered) pages via
     n_fetch independent block specs — one page per spec, since a single
     BlockSpec can only address one pool offset — amortizing the per-step
     grid/DMA-issue overhead that made the one-page-per-step version
-    latency-bound (~8us/step measured on v5)."""
+    latency-bound (~8us/step measured on v5).
+
+    ``quant``: int8 pools with per-page fp32 scales (ISSUE 17). The scale
+    arrays ride in as two extra SCALAR-PREFETCH refs (SMEM, indexed by the
+    physical page id the table already prefetches); int8 K/V pages widen
+    to the query dtype in VMEM (int8 is exact in bf16) and the page's
+    scale multiplies the f32 scores / weighted-V accumulator — the same
+    epilogue placement as int8_matmul's _kernel, so the fused dequant
+    costs one scalar multiply per page, not a dequantized page in HBM."""
+    if quant:
+        tables_ref, lens_ref, kscale_ref, vscale_ref, q_ref = args[:5]
+        refs = args[5:]
+    else:
+        tables_ref, lens_ref, q_ref = args[:3]
+        refs = args[3:]
+        kscale_ref = vscale_ref = None
     k_refs = refs[:n_fetch]
     v_refs = refs[n_fetch:2 * n_fetch]
     o_ref = refs[2 * n_fetch]
@@ -75,9 +89,15 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, page_size,
             p = pg * n_fetch + i
             k = k_refs[i][0, 0, :, :]             # [page, d]
             v = v_refs[i][0, 0, :, :]
+            k_scale = scale
+            if quant:
+                pid = tables_ref[b, p]
+                k = k.astype(q.dtype)             # widen int8 in VMEM
+                v = v.astype(q.dtype)
+                k_scale = scale * kscale_ref[pid]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [group, page]
+                preferred_element_type=jnp.float32) * k_scale  # [grp, page]
             pos = p * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(pos <= seq_len, s, NEG_INF)
@@ -88,9 +108,12 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, page_size,
             l_scr[:] = jnp.broadcast_to(
                 alpha * l_scr[:, :1] + jnp.sum(pr, axis=-1, keepdims=True),
                 l_scr.shape)
-            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pv = jax.lax.dot_general(
                 pr.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if quant:
+                pv = pv * vscale_ref[pid]
+            acc_scr[:] = acc_scr[:] * alpha + pv
             m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(pg == npg - 1)
@@ -102,6 +125,7 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, *refs, scale, page_size,
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
                            scale: Optional[float] = None,
+                           k_scales=None, v_scales=None,
                            interpret: bool = False):
     """One decode step of attention over a paged KV cache.
 
@@ -109,6 +133,8 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     k/v_pages:    [H_kv, num_pages, page_size, D] head-major block pools
     block_tables: [B, max_pages] int32; logical page i -> pool id (-1 unused)
     seq_lens:     [B] int32 tokens already cached (new token at this offset)
+    k/v_scales:   [num_pages] fp32 per-page dequant scales for int8 pools
+                  (both or neither; ISSUE 17)
 
     Returns [B, H, D].
     """
@@ -117,42 +143,52 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens,
     max_pages = block_tables.shape[1]
     group = H // H_kv
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError("k_scales and v_scales must be given together")
     # pages streamed per grid step (divisor of max_pages)
     n_fetch = next((n for n in (8, 4, 2, 1) if max_pages % n == 0), 1)
 
     tables = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
     lens = jnp.asarray(seq_lens, jnp.int32)
     qg = q.reshape(B, H_kv, group, D)
+    n_pref = 4 if quant else 2
 
     def page_spec(i):
+        # index maps receive all scalar-prefetch refs after the grid ids;
+        # only the table is read (scales are consumed in the kernel body)
         return pl.BlockSpec(
             (1, 1, page_size, D),
-            lambda b, h, pg, tables, lens, i=i: (
+            lambda b, h, pg, tables, *rest, i=i: (
                 h, tables[b, pg * n_fetch + i], 0, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_pref,
         grid=(B, H_kv, max_pages // n_fetch),
         in_specs=[
             pl.BlockSpec((1, 1, group, D),
-                         lambda b, h, pg, tables, lens: (b, h, 0, 0)),
+                         lambda b, h, pg, *rest: (b, h, 0, 0)),
             *[page_spec(i) for i in range(n_fetch)],
             *[page_spec(i) for i in range(n_fetch)],
         ],
         out_specs=pl.BlockSpec((1, 1, group, D),
-                               lambda b, h, pg, tables, lens: (b, h, 0, 0)),
+                               lambda b, h, pg, *rest: (b, h, 0, 0)),
         scratch_shapes=[pltpu.VMEM((group, 128), jnp.float32),
                         pltpu.VMEM((group, 128), jnp.float32),
                         pltpu.VMEM((group, D), jnp.float32)],
     )
+    prefetch = (tables, lens)
+    if quant:
+        prefetch += (jnp.asarray(k_scales, jnp.float32),
+                     jnp.asarray(v_scales, jnp.float32))
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, page_size=page_size,
-                          group=group, n_fetch=n_fetch),
+                          group=group, n_fetch=n_fetch, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H_kv, group, D), q.dtype),
         compiler_params=_tpu_params(),
         interpret=interpret,
-    )(tables, lens, qg, *([k_pages] * n_fetch), *([v_pages] * n_fetch))
+    )(*prefetch, qg, *([k_pages] * n_fetch), *([v_pages] * n_fetch))
     return out.reshape(B, H, D)
 
 
@@ -164,16 +200,26 @@ def _tpu_params():
 
 
 def paged_decode_xla(q, k_pages, v_pages, block_tables, seq_lens,
-                     scale: Optional[float] = None):
+                     scale: Optional[float] = None,
+                     k_scales=None, v_scales=None):
     """XLA gather composition with identical semantics to the kernel —
-    the fallback for unsupported shapes/backends and the test oracle."""
+    the fallback for unsupported shapes/backends and the test oracle.
+    Int8 pools (``k_scales``/``v_scales`` [num_pages]) dequantize in the
+    gather: convert + per-page scale."""
     B, H, D = q.shape
     H_kv, _, page_size, _ = k_pages.shape
     T = block_tables.shape[1] * page_size
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     safe = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
-    ks = jnp.moveaxis(k_pages[:, safe].reshape(H_kv, B, T, D), 0, 2)
-    vs = jnp.moveaxis(v_pages[:, safe].reshape(H_kv, B, T, D), 0, 2)
+
+    def gather(pages, pscales):
+        g = pages[:, safe]                    # [H_kv, B, mp, page, D]
+        if pscales is not None:
+            g = (g.astype(jnp.float32)
+                 * pscales[safe][None, :, :, None, None])
+        return jnp.moveaxis(g.reshape(H_kv, B, T, D), 0, 2)
+    ks = gather(k_pages, k_scales)
+    vs = gather(v_pages, v_scales)
     ks = jnp.repeat(ks, H // H_kv, axis=2)
     vs = jnp.repeat(vs, H // H_kv, axis=2)
     lens = jnp.asarray(seq_lens, jnp.int32)
@@ -232,8 +278,10 @@ def paged_decode_supported(q, k_pages) -> bool:
     B, H, D = q.shape
     H_kv = k_pages.shape[0]
     page_size = k_pages.shape[2]
+    # int8 pages need the int8 sublane multiple (32); floats need 8
+    sublane = 32 if k_pages.dtype == jnp.int8 else 8
     return (H % H_kv == 0 and D in (32, 64, 128, 256)
-            and page_size % 8 == 0)
+            and page_size % sublane == 0)
 
 
 __all__ = ["paged_decode_attention", "paged_decode_supported",

@@ -18,6 +18,7 @@ from .quanters import (BaseQuanter, quanter, FakeQuanterWithAbsMax, FakeQuanterC
 from .qat import QAT, PTQ
 from .layers import QuantedLinear, QuantedConv2D, Int8Linear
 from .functional import quantize_linear, dequantize_linear, int8_matmul
+from .serving import quantize_state_dict, quantize_model, int8_config
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ",
@@ -27,4 +28,5 @@ __all__ = [
     "fake_quant", "quantize_absmax", "dequantize",
     "QuantedLinear", "QuantedConv2D", "Int8Linear",
     "quantize_linear", "dequantize_linear", "int8_matmul",
+    "quantize_state_dict", "quantize_model", "int8_config",
 ]

@@ -28,11 +28,30 @@ def dequantize_linear(q, scale, zero_point=0, axis=None, name=None):
     return (q.astype(jnp.float32) - zero_point) * scale
 
 
-def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=jnp.float32):
-    """int8 @ int8 → int32 accumulate → rescale to float.
+def int8_matmul(x_q, w_q, x_scale, w_scale, out_dtype=None):
+    """Quantized matmul, resolved through THE ops-registry "int8_matmul"
+    op (ISSUE 17 dedupe): the activation side is dequantized (one fused
+    convert+scale), the weight stays int8 across HBM, and the registry
+    picks the fused Pallas dequant-matmul on TPU (TuneDB blocks,
+    PT_DISABLE_PALLAS honored) or the XLA composition elsewhere.
 
-    On TPU this is one MXU pass at double bf16 throughput; XLA fuses the
-    trailing rescale. w_scale may be per-tensor or per-out-channel [N]."""
-    acc = jnp.dot(x_q.astype(jnp.int8), w_q.astype(jnp.int8),
-                  preferred_element_type=jnp.int32)
-    return (acc.astype(jnp.float32) * (x_scale * w_scale)).astype(out_dtype)
+    x_q int8 [..., k]; w_q int8 [k, n] ("x @ w" layout — transposed to
+    the registry's [n, k] weight layout at trace time, free under XLA);
+    w_scale per-tensor or per-out-channel [n]. ``out_dtype=None`` follows
+    the activation-dtype convention used everywhere else: the result
+    lands in the dequantized activation's dtype (``x_scale``'s floating
+    dtype; python-float scales mean fp32)."""
+    xs = jnp.asarray(x_scale)
+    act_dtype = xs.dtype if jnp.issubdtype(xs.dtype, jnp.floating) \
+        else jnp.float32
+    x = x_q.astype(act_dtype) * xs.astype(act_dtype)
+    try:
+        from ..ops.registry import dispatch
+        out = dispatch("int8_matmul")(
+            x, jnp.asarray(w_q, jnp.int8).T,
+            jnp.asarray(w_scale, jnp.float32))
+    except KeyError:  # pragma: no cover - jaxlib without pallas
+        acc = jnp.dot(x_q.astype(jnp.int8), w_q.astype(jnp.int8),
+                      preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (x_scale * w_scale)
+    return out.astype(act_dtype if out_dtype is None else out_dtype)

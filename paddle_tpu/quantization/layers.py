@@ -32,6 +32,20 @@ class QuantedLinear(Layer):
 
     def forward(self, x):
         x = self.activation_quanter(x, update=self.training)
+        if not self.training:
+            # eval/serving: the fake-quant weight grid IS an int8 grid, so
+            # express the matmul through the one registry "int8_matmul" op
+            # (tuned Pallas blocks + PT_DISABLE_PALLAS apply uniformly;
+            # ISSUE 17). Same values as F.linear(x, fake_quant(w)):
+            # round(w/s) lands exactly on the int grid the op dequants.
+            from ..ops.pallas.int8_matmul import quantized_matmul
+            s = self.weight_quanter.scales(self.weight)        # [1, n]
+            wq = jnp.clip(jnp.round(self.weight / s), -128, 127) \
+                .astype(jnp.int8)                              # [k, n]
+            out = quantized_matmul(x, wq.T, s.reshape(-1))
+            return out if self.bias is None else out + self.bias
+        # training keeps the straight-through fake-quant path: gradients
+        # must flow through the float master weight
         w = self.weight_quanter(self.weight)
         return F.linear(x, w, self.bias)
 
@@ -75,7 +89,7 @@ class Int8Linear(Layer):
         x_q = quantize_linear(x, self.act_scale, bit_length=self.quant_bits)
         shape = x_q.shape
         out = int8_matmul(x_q.reshape(-1, shape[-1]), self.weight_q,
-                          self.act_scale, self.w_scale, out_dtype=jnp.float32)
+                          self.act_scale, self.w_scale, out_dtype=x.dtype)
         out = out.reshape(*shape[:-1], -1)
         if self.bias is not None:
             out = out + self.bias

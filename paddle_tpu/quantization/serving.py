@@ -1,0 +1,81 @@
+"""Offline weight-only int8 conversion for serving (ISSUE 17).
+
+Takes a TRAINED Llama checkpoint (float state dict) and produces the
+serving layout ``LlamaConfig(weight_dtype="int8")`` expects: every dense
+projection (qkv_proj / o_proj / gate_up_proj / down_proj / lm_head)
+becomes a TRANSPOSED int8 ``[n, k]`` weight plus a per-out-channel fp32
+``<name>_scale`` ``[n]`` — exactly ``nn.quantized_linear.weight_quantize``'s
+contract, so the model's runtime dispatch (the one ops-registry
+"int8_matmul" op) dequantizes on the same grid the converter rounded to.
+
+Everything that is not a projection matmul stays float: embeddings (a
+gather table, not a matmul), RMSNorm gains (numerically sensitive, tiny),
+and rope caches. Tied-embedding models keep the float table as their
+vocab head — there is no separate lm_head to quantize.
+
+This is weight-only PTQ, not QAT and not activation quant: decode is
+HBM-bandwidth-bound, so shrinking the weights (and fusing the dequant
+into the matmul epilogue) is where the tok/s is; activations stay in the
+model dtype and no calibration pass is needed.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import replace
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from ..nn.quantized_linear import weight_quantize
+
+# final path component → quantize; everything else copies through
+PROJ_SUFFIXES = ("qkv_proj", "o_proj", "gate_up_proj", "down_proj",
+                 "lm_head")
+
+__all__ = ["PROJ_SUFFIXES", "quantize_state_dict", "quantize_model",
+           "int8_config"]
+
+
+def int8_config(cfg, kv_dtype: str | None = None):
+    """The serving twin of a training config: same architecture,
+    ``weight_dtype="int8"`` (and optionally int8 KV pages)."""
+    kw = {"weight_dtype": "int8"}
+    if kv_dtype is not None:
+        kw["kv_dtype"] = kv_dtype
+    return replace(cfg, **kw)
+
+
+def quantize_state_dict(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Float Llama state dict → int8 serving state dict.
+
+    Each ``...<proj>`` float ``[k, n]`` entry becomes ``...<proj>`` int8
+    ``[n, k]`` + ``...<proj>_scale`` fp32 ``[n]``; all other entries pass
+    through unchanged. Idempotent-hostile on purpose: re-quantizing an
+    already-int8 dict raises (the dtype check), rather than silently
+    double-scaling."""
+    out: Dict[str, Any] = OrderedDict()
+    for name, value in state_dict.items():
+        w = jnp.asarray(value)
+        if name.rsplit(".", 1)[-1] in PROJ_SUFFIXES and w.ndim == 2:
+            if w.dtype == jnp.int8:
+                raise ValueError(f"{name} is already int8 — refusing to "
+                                 f"quantize a quantized checkpoint")
+            wq, scale = weight_quantize(w, algo="weight_only_int8")
+            out[name] = wq                        # int8 [n, k]
+            out[name + "_scale"] = scale          # fp32 [n]
+        else:
+            out[name] = w
+    return out
+
+
+def quantize_model(model, kv_dtype: str | None = None):
+    """Trained ``LlamaForCausalLM`` → its int8 serving twin.
+
+    Builds a fresh model under ``weight_dtype="int8"`` (projections
+    allocated int8 + scale) and loads the quantized state dict into it.
+    The result is serving-only: ``forward(labels=...)`` refuses."""
+    from ..models.llama import LlamaForCausalLM
+    qmodel = LlamaForCausalLM(int8_config(model.cfg, kv_dtype))
+    qmodel.set_state_dict(quantize_state_dict(model.state_dict()))
+    return qmodel

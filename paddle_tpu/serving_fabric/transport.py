@@ -186,15 +186,24 @@ class InProcTransport(FabricTransport):
 def payload_to_wire(payload: dict) -> dict:
     """serialize_pages dict → JSON-safe dict (tokens as list, kv as
     base64 of the raw buffer; shape/dtype/sha256 ride along so the far
-    side validates END-TO-END, not per-hop)."""
+    side validates END-TO-END, not per-hop). v2 payloads from an int8
+    pool additionally carry the per-page fp32 scales (base64, fp32
+    little-endian) — the sha256 covers them, so tampered scales are
+    rejected exactly like tampered page bytes."""
     kv = payload["kv"]
-    return {"fmt": payload["fmt"], "page_size": payload["page_size"],
+    wire = {"fmt": payload["fmt"], "page_size": payload["page_size"],
             "tokens": np.asarray(payload["tokens"],
                                  np.int32).tolist(),
             "dtype": payload["dtype"], "shape": list(payload["shape"]),
             "sha256": payload["sha256"],
             "kv_b64": base64.b64encode(
                 np.ascontiguousarray(kv).tobytes()).decode("ascii")}
+    if payload.get("scales") is not None:
+        sc = np.ascontiguousarray(np.asarray(payload["scales"],
+                                             np.float32))
+        wire["scales_b64"] = base64.b64encode(sc.tobytes()).decode("ascii")
+        wire["scales_shape"] = list(payload["scales_shape"])
+    return wire
 
 
 def _np_dtype(name: str):
@@ -212,14 +221,23 @@ def payload_from_wire(wire: dict) -> dict:
         raw = base64.b64decode(wire["kv_b64"])
         kv = np.frombuffer(raw, dtype=_np_dtype(wire["dtype"])) \
             .reshape(wire["shape"])
+        scales = None
+        if wire.get("scales_b64") is not None:
+            scales = np.frombuffer(
+                base64.b64decode(wire["scales_b64"]),
+                dtype=np.float32).reshape(wire["scales_shape"])
     except Exception as e:
         raise ValueError(f"handoff payload: undecodable wire form "
                          f"({e})")
-    return {"fmt": wire.get("fmt"), "page_size": wire.get("page_size"),
-            "tokens": np.asarray(wire.get("tokens", ()), np.int32),
-            "kv": kv, "dtype": wire.get("dtype"),
-            "shape": list(wire.get("shape", ())),
-            "sha256": wire.get("sha256")}
+    out = {"fmt": wire.get("fmt"), "page_size": wire.get("page_size"),
+           "tokens": np.asarray(wire.get("tokens", ()), np.int32),
+           "kv": kv, "dtype": wire.get("dtype"),
+           "shape": list(wire.get("shape", ())),
+           "sha256": wire.get("sha256")}
+    if scales is not None:
+        out["scales"] = scales
+        out["scales_shape"] = list(wire.get("scales_shape", ()))
+    return out
 
 
 # ---------------------------------------------------------------------------

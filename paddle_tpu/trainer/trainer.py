@@ -348,6 +348,16 @@ class Trainer:
             "model_scalars": scalars(model),
             "config_scalars": (scalars(cfg) if cfg is not None
                                and hasattr(cfg, "__dict__") else ()),
+            # quantized layouts retrace the whole program with different
+            # param avals AND different traced ops (registry int8_matmul
+            # vs dense matmul) — config_scalars already covers the str
+            # fields, but the labeled entry makes a stale-artifact
+            # rejection render as "quantization.weight_dtype: native ->
+            # int8" instead of a config_scalars diff (ISSUE 17)
+            "quantization": {
+                "weight_dtype": getattr(cfg, "weight_dtype", "native"),
+                "kv_dtype": getattr(cfg, "kv_dtype", "native"),
+            },
             # trace-affecting env escapes: the loss-head override flips
             # which program gets traced with identical avals and cfg —
             # without this key a restart under PT_NAIVE_LOSS_HEAD=1 would

@@ -47,6 +47,38 @@ def tiny_llama():
     return LlamaForCausalLM(LlamaConfig.tiny())
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _shared_engine_executables():
+    """Tier-1 compile dedup: every ContinuousBatchingEngine instance
+    re-jits its decode/prefill executables, but those fns are
+    argument-pure by design (params/pools/tables/state/knobs are call
+    arguments — that's what lets the graph contracts lower them), and
+    the per-engine cache keys (`fkey`) already encode every knob that
+    changes the trace (spec_k, sampling, attn_impl, kv_quant; prefill
+    is keyed by page bucket). So engines over the same model with the
+    same pool geometry can share one cache. Dozens of tier-1 tests
+    build identically-shaped engines over the session ``tiny_llama``;
+    on a 1-core CI host the duplicate compiles are minutes of wall
+    time. A fresh key still compiles from scratch — the only
+    observable difference is wall time."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    orig = ContinuousBatchingEngine.__init__
+    cache = {}
+
+    def patched(self, model, *args, **kwargs):
+        orig(self, model, *args, **kwargs)
+        key = (id(model), repr(getattr(model, "cfg", None)),
+               self.max_batch, self.page_size, self.max_len,
+               self._total_pages, self.decode_block)
+        dec, pre = cache.setdefault(key, ({}, {}))
+        self._decode_fns = dec
+        self._prefill_cache = pre
+
+    ContinuousBatchingEngine.__init__ = patched
+    yield
+    ContinuousBatchingEngine.__init__ = orig
+
+
 @pytest.fixture
 def mesh8():
     """2x4 (dp, tp) mesh over the 8 virtual CPU devices."""

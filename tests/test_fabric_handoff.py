@@ -175,6 +175,105 @@ def test_serialize_unknown_prefix_returns_none(model, exporter):
         np.arange(2 * PAGE, dtype=np.int32) + 500) is None
 
 
+# -- int8 KV pages: v2 handoff chaos (ISSUE 17) -----------------------------
+#
+# The bit-exactness contract for quantized pools lives HERE, on the page
+# bytes: adopt → re-export reproduces kv + scales + sha identically.
+# (Stream identity across the handoff is NOT the contract: the adopter
+# attends over the quantized adopted pages where the source attended over
+# fresh float K/V during its own prefill.)
+
+@pytest.fixture(scope="module")
+def q_exporter(model):
+    """int8-KV exporter holding a 4-page run; scales ride the payload."""
+    from paddle_tpu.quantization import quantize_model
+    qm = quantize_model(model, kv_dtype="int8")
+    rs = np.random.RandomState(7)
+    run = rs.randint(0, 256, (4 * PAGE,)).astype(np.int32)
+    Q = _engine(qm, num_pages=14, max_batch=1)
+    _seed_tree(Q, run)
+    return Q, qm, run
+
+
+@pytest.fixture(scope="module")
+def q_adopter(q_exporter):
+    _, qm, _ = q_exporter
+    return _engine(qm)
+
+
+def test_int8_round_trip_bit_exact_with_scales(q_exporter, q_adopter):
+    Q, _, run = q_exporter
+    B = q_adopter
+    pay = Q.serialize_pages(run[:3 * PAGE])
+    assert pay is not None and pay["fmt"] == "pt-kv-pages-v2"
+    assert str(pay["kv"].dtype) == "int8"
+    assert pay["scales"].shape == tuple(pay["scales_shape"])
+    assert pay["scales"].shape[-1] == 3           # per-page K+V scales
+    assert len(B.adopt_pages(pay)) == 3
+    assert B._prefix.match(run, touch=False) == 3 * PAGE
+    pay2 = B.serialize_pages(run[:3 * PAGE])
+    assert pay2["sha256"] == pay["sha256"]
+    np.testing.assert_array_equal(pay2["kv"], pay["kv"])
+    np.testing.assert_array_equal(pay2["scales"], pay["scales"])
+    B._check_page_invariants()
+
+
+def test_int8_wire_codec_carries_scales(q_exporter, q_adopter):
+    """Scales survive the base64 wire form bit-for-bit; a tampered
+    scales blob fails the (scale-covering) checksum without mutation."""
+    Q, _, run = q_exporter
+    B = q_adopter
+    import json
+    pay = Q.serialize_pages(run)                  # all 4 pages
+    wire = json.loads(json.dumps(payload_to_wire(pay)))
+    assert "scales_b64" in wire
+    back = payload_from_wire(wire)
+    np.testing.assert_array_equal(back["scales"], pay["scales"])
+    assert back["sha256"] == pay["sha256"]
+    B.adopt_pages(back)                           # suffix page adopts
+    assert B._prefix.match(run, touch=False) == 4 * PAGE
+    # tamper: re-encode perturbed scales — sha256 covers them
+    import base64
+    sc = np.frombuffer(base64.b64decode(wire["scales_b64"]),
+                       dtype=np.float32).copy()
+    sc[0] *= 1.5
+    torn = dict(wire)
+    torn["scales_b64"] = base64.b64encode(sc.tobytes()).decode("ascii")
+    before = _pool_snapshot(B)
+    with pytest.raises(ValueError, match="checksum"):
+        B.adopt_pages(payload_from_wire(torn))
+    assert _pool_snapshot(B) == before
+    B._check_page_invariants()
+
+
+def test_int8_rejects_v1_and_native_rejects_scales(model, q_exporter,
+                                                   q_adopter,
+                                                   exporter, adopter):
+    """Version chaos both ways: a v1 (scale-less) payload cannot seed an
+    int8 pool, and a v2 scale-carrying payload cannot seed a native
+    pool — both fail validation-first (fabric falls back to cold
+    prefill), neither mutates either pool."""
+    Q, _, run = q_exporter
+    B = q_adopter
+    A, run_long, _ = exporter
+    # v1 → int8 pool: rejected on format before any byte checks
+    v1 = dict(A.serialize_pages(run_long[:2 * PAGE]))
+    v1["fmt"] = "pt-kv-pages-v1"
+    before = _pool_snapshot(B)
+    with pytest.raises(ValueError, match="v1"):
+        B.adopt_pages(v1)
+    assert _pool_snapshot(B) == before
+    # v2-with-scales → native pool: int8 bytes can't enter a float pool
+    N = adopter
+    qpay = Q.serialize_pages(run[:2 * PAGE])
+    before = _pool_snapshot(N)
+    with pytest.raises(ValueError):
+        N.adopt_pages(qpay)
+    assert _pool_snapshot(N) == before
+    B._check_page_invariants()
+    N._check_page_invariants()
+
+
 # -- serving-heavy legs (slow tier) -----------------------------------------
 
 @pytest.mark.slow

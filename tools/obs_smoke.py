@@ -154,6 +154,44 @@ def _serving_leg():
     return served, spec.spec_stats(), px.prefix_stats(), model
 
 
+def _quant_leg(errors: list, model) -> dict:
+    """Quantized-serving leg (ISSUE 17 satellite): an int8-weight,
+    int8-KV engine serves two requests; the ``pt_serving_kv_quant_*``
+    series must move and round-trip the exporters like every other
+    serving counter (main() checks the names below)."""
+    import numpy as np
+
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.observability.metrics import REGISTRY
+    from paddle_tpu.quantization import quantize_model
+
+    qmodel = quantize_model(model, kv_dtype="int8")
+    eng = ContinuousBatchingEngine(
+        qmodel, max_batch=2, page_size=8, max_len=32,
+        generation_config=GenerationConfig(max_new_tokens=8,
+                                           do_sample=False))
+    rs = np.random.RandomState(9)
+    for L in (6, 9):
+        eng.submit(rs.randint(0, 32, (L,)).astype(np.int32))
+    out = eng.run()
+    served = sum(len(v) for v in out.values())
+    if not eng.kv_quant:
+        errors.append("quant leg: engine did not detect int8 KV pool")
+    if eng.kv_quant_ticks <= 0:
+        errors.append("quant leg: kv_quant_ticks never moved")
+    ticks = REGISTRY.counter("pt_serving_kv_quant_ticks_total").value()
+    if ticks <= 0:
+        errors.append("quant leg: pt_serving_kv_quant_ticks_total "
+                      "never incremented")
+    pool_b = REGISTRY.gauge("pt_serving_kv_quant_pool_bytes").value()
+    if not pool_b or pool_b <= 0:
+        errors.append("quant leg: pt_serving_kv_quant_pool_bytes "
+                      "gauge empty")
+    return {"served": served, "kv_quant_ticks": int(eng.kv_quant_ticks),
+            "pool_bytes": int(pool_b or 0)}
+
+
 def _fabric_leg(out_dir: str, errors: list, model=None) -> dict:
     """Serving-fabric leg (ISSUE 12 satellite): route 4 requests across
     2 NAMED replicas — their engine series must land under distinct
@@ -325,6 +363,8 @@ def main(out_dir: str) -> dict:
     try:
         emissions = _train_leg()
         served, spec_stats, prefix_stats, smodel = _serving_leg()
+        quant = _quant_leg(errors, smodel)
+        served += quant["served"]
         fabric = _fabric_leg(out_dir, errors, model=smodel)
         cost = _cost_leg(out_dir, errors)
         sentry_out = _sentry_checks(out_dir, errors, sentry)
@@ -357,6 +397,9 @@ def main(out_dir: str) -> dict:
                      "pt_serving_cow_copies_total",
                      "pt_serving_prefix_shared_pages",
                      "pt_serving_prefix_hit_rate",
+                     "pt_serving_kv_quant_ticks_total",
+                     "pt_serving_kv_quant_enabled",
+                     "pt_serving_kv_quant_pool_bytes",
                      "pt_fabric_routed_total",
                      "pt_fabric_replicas_alive",
                      "pt_fabric_readmitted_total",
@@ -397,6 +440,7 @@ def main(out_dir: str) -> dict:
             "prefix_cow_copies": int(
                 prefix_stats.get("prefix_cow_copies", 0)),
             "cost": cost,
+            "quant": quant,
             "fabric": fabric,
             "sentry": sentry_out,
             "jsonl_records": len(records),

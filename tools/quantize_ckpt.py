@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Offline checkpoint quantizer: float Llama checkpoint -> int8 serving
+checkpoint (ISSUE 17 weight-only decode path).
+
+    python tools/quantize_ckpt.py --src ckpts/step_1000 --dst ckpts/int8 \
+        --config tiny
+
+Reads an orbax state-dict checkpoint written by checkpoint.save_state_dict
+(shapes taken from the named --config), quantizes every projection to the
+transposed int8 [n, k] + per-channel fp32 scale layout via
+quantization.serving.quantize_state_dict, and writes the result as a new
+state-dict checkpoint that LlamaForCausalLM(weight_dtype="int8") loads
+directly. Reports the HBM bytes saved. CPU-safe: runs under
+JAX_PLATFORMS=cpu (quantization is rounding, not kernels).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+
+def _nbytes(tree) -> int:
+    return sum(int(math.prod(v.shape)) * v.dtype.itemsize
+               for v in tree.values())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--src", required=True,
+                    help="source checkpoint dir (float state dict)")
+    ap.add_argument("--dst", required=True,
+                    help="destination checkpoint dir (int8 state dict)")
+    ap.add_argument("--config", default="tiny",
+                    help="model preset: tiny | llama3_8b | llama3_70b")
+    ap.add_argument("--dtype", default="float32",
+                    help="source model compute dtype (float32 | bfloat16)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.quantization.serving import quantize_state_dict
+
+    preset = getattr(LlamaConfig, args.config, None)
+    if preset is None:
+        print(f"unknown --config {args.config!r}", file=sys.stderr)
+        return 2
+    cfg = preset(dtype=args.dtype)
+    model = LlamaForCausalLM(cfg)
+    src = ckpt.load_state_dict(args.src, model.state_dict())
+    qsd = quantize_state_dict(src)
+    ckpt.save_state_dict(qsd, args.dst)
+
+    before, after = _nbytes(src), _nbytes(qsd)
+    nq = sum(1 for k in qsd if k.endswith("_scale"))
+    print(f"quantized {nq} projections: {before / 2**20:.1f} MiB -> "
+          f"{after / 2**20:.1f} MiB ({before / max(after, 1):.2f}x)")
+    print(f"wrote {args.dst} — serve with LlamaConfig."
+          f"{args.config}(weight_dtype='int8', dtype={args.dtype!r})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,6 +112,14 @@ def main(argv=None) -> dict:
     ap.add_argument("--itl-target-ms", type=float, default=None,
                     help="per-replica ITL p99 SLO driving affinity "
                          "hysteresis")
+    ap.add_argument("--weight-dtype", default="native",
+                    choices=["native", "int8"],
+                    help="int8: serve the weight-only quantized twin "
+                         "(offline PTQ, ISSUE 17)")
+    ap.add_argument("--kv-dtype", default="native",
+                    choices=["native", "int8"],
+                    help="int8: KV-cache pages quantize on write with "
+                         "per-page scales, dequant fused into decode")
     ap.add_argument("--seed", type=int, default=0)
     # synthesized-trace shape (ignored with --trace)
     ap.add_argument("--families", type=int, default=3)
@@ -150,6 +158,18 @@ def main(argv=None) -> dict:
 
     pt.seed(args.seed)
     model = LlamaForCausalLM(LlamaConfig.tiny())
+    if args.weight_dtype == "int8":
+        from paddle_tpu.quantization import quantize_model
+        model = quantize_model(
+            model, kv_dtype=(args.kv_dtype if args.kv_dtype != "native"
+                             else None))
+    elif args.kv_dtype == "int8":
+        # native weights over quantized KV pages: same arch, int8 pool
+        import dataclasses
+        sd = model.state_dict()
+        model = LlamaForCausalLM(
+            dataclasses.replace(model.cfg, kv_dtype="int8"))
+        model.set_state_dict(sd)
     roles = (["prefill"] * args.prefill_replicas
              + ["both"] * (args.replicas - args.prefill_replicas))
     reps = build_replicas(
@@ -182,6 +202,8 @@ def main(argv=None) -> dict:
         "rejected": {f: fabric.failed[f] for f in out if f not in
                      served},
         "policy": args.policy,
+        "quantization": {"weight_dtype": args.weight_dtype,
+                         "kv_dtype": args.kv_dtype},
         "replicas": args.replicas,
         "roles": roles,
         "requests": len(fids),
