@@ -1704,6 +1704,78 @@ def _planner_probe(on_tpu):
     return out
 
 
+def _fsdp_probe(on_tpu):
+    """ZeRO/FSDP rows (ISSUE 18): what the fsdp axis costs and buys at
+    EQUAL device count, on the micro model.
+
+    ``fsdp_step_overhead_ratio`` — measured fsdp4 ÷ dp4 step time over
+    4 devices (the gather/reduce-scatter tax; interleaved min-of-rounds
+    via the planner's own rank-order measurement). ``fsdp_hbm_ratio`` —
+    closed-form ``estimate_hbm`` total for the same pair (params+slots+
+    grads ÷4 plus the one-layer gather working set vs pure dp): the
+    memory the axis exists to save, deterministic arithmetic so a tight
+    band. With ≥4 local devices the A/B runs inline; otherwise two
+    ``tools/plan.py --config`` subprocesses on 4 virtual CPU devices —
+    ``fsdp_backend`` records which."""
+    out = {}
+    from paddle_tpu.distributed import auto_parallel as ap
+    from paddle_tpu.models import LlamaConfig
+    mcfg = LlamaConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128)
+    cfg_off = ap.ParallelConfig(dp=4)
+    cfg_on = ap.ParallelConfig(fsdp=4)
+    try:
+        m_off = ap.estimate_hbm(mcfg, cfg_off, global_batch=8, seq_len=64)
+        m_on = ap.estimate_hbm(mcfg, cfg_on, global_batch=8, seq_len=64)
+        out["fsdp_hbm_ratio"] = round(m_on.total_bytes
+                                      / m_off.total_bytes, 4)
+    except Exception as e:
+        out["fsdp_hbm_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    try:
+        import jax
+        meas = {}
+        if jax.device_count() >= 4:
+            _log("fsdp: A/B pricing dp4 vs fsdp4 on the local mesh")
+            rep = ap.plan(mcfg, n_devices=4, global_batch=8, seq_len=64,
+                          configs=[cfg_off, cfg_on], keep_builds=True,
+                          drift="ignore", model_name="llama-micro")
+            ap.validate_rank_order(rep)
+            for pc in rep.ranked:
+                meas[str(pc.config)] = pc.measured_step_s
+            out["fsdp_backend"] = "inline"
+        else:
+            import subprocess
+            _log("fsdp: A/B via plan.py on 4 virtual devices")
+            for cfg in (cfg_off, cfg_on):
+                env = dict(os.environ)
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+                cmd = [sys.executable,
+                       os.path.join(os.path.dirname(
+                           os.path.abspath(__file__)), "tools", "plan.py"),
+                       "--devices", "4", "--model", "llama-micro",
+                       "--batch", "8", "--seq", "64",
+                       "--config", str(cfg),
+                       "--validate", "--json", "--virtual-devices", "4"]
+                res = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=900, env=env)
+                if res.returncode != 0:
+                    raise RuntimeError(f"plan.py rc={res.returncode}: "
+                                       f"{res.stderr[-300:]}")
+                d = json.loads(res.stdout.strip().splitlines()[-1])
+                meas[d["chosen"]] = d["ranked"][0]["measured_step_s"]
+            out["fsdp_backend"] = "cpu-subprocess"
+        t_off = meas[str(cfg_off)]
+        t_on = meas[str(cfg_on)]
+        out["fsdp_step_overhead_ratio"] = round(t_on / t_off, 4)
+        out["fsdp_step_dp4_s"] = t_off
+        out["fsdp_step_fsdp4_s"] = t_on
+    except Exception as e:
+        out["fsdp_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    return out
+
+
 def _elastic_probe(on_tpu):
     """Elastic scale-in rows (ISSUE 15): a timed mini kill→reshard cycle
     on the micro model. ``elastic_reshard_seconds`` = wall time to
@@ -2002,6 +2074,7 @@ def _run(error_note):
     detail.update(_obs_probe(on_tpu))
     detail.update(_graph_contracts_probe(on_tpu))
     detail.update(_planner_probe(on_tpu))
+    detail.update(_fsdp_probe(on_tpu))
     detail.update(_elastic_probe(on_tpu))
     # noise-aware regression verdict vs the checked-in pinned baseline
     # (ISSUE 10): ratio metrics only, per the bench-variance policy —

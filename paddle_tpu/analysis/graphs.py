@@ -327,6 +327,62 @@ def build_planner() -> BuiltGraph:
         mesh=hm, example_args=args)
 
 
+def build_train_step_fsdp() -> BuiltGraph:
+    """The ZeRO-3 train step (ISSUE 18): price the fsdp2×tp2 micro
+    config, then compile the step THROUGH the emitted plan
+    (``Trainer.apply_plan``) and require the emitted census to EXACTLY
+    match the priced one (closed set) — the fsdp axis's param
+    all-gathers and grad reduce-scatters are part of that set, so a
+    refactor that drops the sharding (silently replicating params) or
+    doubles the gathers fails CI. The budget snapshot additionally pins
+    ``exposed_comm_fraction``/``min_overlap_distance`` over the gather
+    windows: a serialized all-gather regression is a budget diff, not a
+    silent 2× step-time tax."""
+    import jax
+
+    if jax.device_count() < 4:
+        raise GraphSkipped("needs >= 4 devices (fsdp=2 x tp=2 mesh); "
+                           "run under XLA_FLAGS=--xla_force_host_"
+                           "platform_device_count=8")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from ..distributed.auto_parallel import (ParallelConfig,
+                                             price_config)
+    from ..models import LlamaForCausalLM
+    from ..optimizer import AdamW
+    from ..trainer import Trainer
+
+    cfg = _micro_cfg()
+    priced = price_config(ParallelConfig(fsdp=2, tp=2), cfg,
+                          devices=jax.devices()[:4], global_batch=4,
+                          seq_len=32, check_memory=False)
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    tr = Trainer(model, AdamW(learning_rate=1e-3, parameters=model),
+                 donate=False)
+    hm = tr.apply_plan(priced.plan, devices=jax.devices()[:4])
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (4, 33))
+    with hm:
+        batch = priced.plan.shard_batch(
+            {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}, hm)
+        tr._ensure_built()
+        args = (tr.params, tr.opt_state, batch, tr._lr_scalar(),
+                tr._key_data())
+        compiled = tr._step_jit.lower(*args).compile()
+    return BuiltGraph("train_step_fsdp", compiled, GraphContract(
+        "train_step_fsdp",
+        expect_collectives=dict(priced.graph.census_counts),
+        max_host_transfers=0,
+        notes=f"emitted {priced.config} ZeRO-3 plan == priced census "
+              f"(closed set, gather windows budget-pinned)"),
+        mesh=hm, example_args=args)
+
+
 REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "train_step_k1": build_train_step_k1,
     "train_step_k4": build_train_step_k4,
@@ -337,6 +393,7 @@ REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "fused_ce": build_fused_ce,
     "tp_fused_ce": build_tp_fused_ce,
     "planner": build_planner,
+    "train_step_fsdp": build_train_step_fsdp,
 }
 
 

@@ -8,7 +8,8 @@ Reference: python/paddle/distributed/auto_parallel/ — two halves:
   reference path;
 * the **search half** (reference ``tuner``/``cost_model``: pick the
   hybrid-parallel placement for the user): :mod:`planner` — enumerate
-  legal 4D ``(dp, tp, pp, sep)`` configs over a declared mesh, prune
+  legal 5D ``(dp, fsdp, tp, pp, sep)`` configs over a declared mesh
+  (``fsdp`` is ZeRO-3 as pure PartitionSpecs, ISSUE 18), prune
   with the per-chip HBM model (:mod:`memory_model`), price survivors by
   compiling and attributing their real graphs (PR 8 collective census ×
   PR 9 ``attribute_costs``/``price_census``/``OpCostDB``), and emit the

@@ -181,7 +181,9 @@ def plan_for_config(model_cfg, config, devices=None) -> ShardingPlan:
         model = LlamaForCausalLM(mcfg)
     devices = (list(devices) if devices is not None
                else list(jax.devices()))[:config.size]
-    hm = HybridMesh.build(dp=int(config.dp), tp=int(config.tp),
+    hm = HybridMesh.build(dp=int(config.dp),
+                          fsdp=int(getattr(config, "fsdp", 1)),
+                          tp=int(config.tp),
                           pp=int(getattr(config, "pp", 1)), sep=sep,
                           devices=devices)
     return emit_plan(model, hm, config)

@@ -1,32 +1,45 @@
 """Per-config HBM model: the planner's feasibility gate.
 
-Before a candidate ``(dp, tp, pp, sep)`` config is worth a compile, it
-must FIT — params + optimizer state + gradients + activations under the
-model's remat policy, per chip. This module prices that closed-form from
-the ``LlamaConfig`` alone (no instantiation: pruning runs BEFORE the
-per-config compile the planner pays for survivors only).
+Before a candidate ``(dp, fsdp, tp, pp, sep)`` config is worth a
+compile, it must FIT — params + optimizer state + gradients +
+activations under the model's remat policy, per chip. This module
+prices that closed-form from the ``LlamaConfig`` alone (no
+instantiation: pruning runs BEFORE the per-config compile the planner
+pays for survivors only).
 
 Conventions, and why each term looks the way it does:
 
 * **params** — analytical count from the config (embedding + L decoder
-  layers + final norm + untied lm_head), divided by ``tp * pp``: tensor
-  parallelism shards every projection along exactly one axis
-  (models/llama.py ``sharding=("fsdp","tp")`` annotations) and the pipe
-  model stacks layers over ``pp``. Norm vectors are replicated over tp
-  but are O(H) — lost in the noise, deliberately not special-cased.
+  layers + final norm + untied lm_head), divided by ``fsdp * tp * pp``:
+  tensor parallelism shards every projection along exactly one axis and
+  fsdp (ZeRO-3, ISSUE 18) shards the hidden dimension of the same
+  matrices (models/llama.py ``sharding=("fsdp","tp")`` annotations);
+  the pipe model stacks layers over ``pp``. Norm vectors are replicated
+  over tp/fsdp but are O(H) — lost in the noise, deliberately not
+  special-cased.
 * **optimizer state** — slot count × fp32 per sharded param (AdamW: m+u,
   ``optimizer.py _init_slots``), sharded like the params
-  (``shard_optimizer_state`` places slots with the param's spec).
-* **gradients** — one param-dtype copy; XLA's donation keeps only one
-  live generation, which is what the train-step budget pins.
+  (``shard_optimizer_state`` places slots with the param's spec) — this
+  is the ZeRO lever: fsdp divides the 4-byte slots that dominate
+  large-model footprints.
+* **gradients** — one param-dtype copy sharded like the params (XLA
+  reduce-scatters into the fsdp-sharded layout); donation keeps only
+  one live generation, which is what the train-step budget pins.
+* **fsdp gather working set** — with ``fsdp > 1`` the compute of one
+  layer needs that layer's params all-gathered over the axis (still
+  tp/pp-sharded): one per-layer param block at full fsdp width is
+  transiently live. Without it the model would claim a 1-chip fsdp=64
+  config stores 1/64th of everything and never pays for the gathered
+  operand XLA actually materializes.
 * **activations** — boundary activations per layer are
-  ``B/dp × S/sep × H`` (batch sharded over dp, sequence over sep — the
-  ``_seq_shard`` constraint); with remat "full" only boundaries survive
-  the forward plus one layer's recompute working set, without remat every
-  layer keeps its internal intermediates (qkv + attn out + the two MLP
-  halves ≈ ``4H + 2M`` per token). The fused CE head (PR 5) means NO
-  ``B×S×V`` logits term — the planner would otherwise veto every config
-  on vocab-heavy models for a buffer the runtime never materializes.
+  ``B/(dp·fsdp) × S/sep × H`` (batch sharded over the ``("dp","fsdp")``
+  spec, sequence over sep — the ``_seq_shard`` constraint); with remat
+  "full" only boundaries survive the forward plus one layer's recompute
+  working set, without remat every layer keeps its internal
+  intermediates (qkv + attn out + the two MLP halves ≈ ``4H + 2M`` per
+  token). The fused CE head (PR 5) means NO ``B×S×V`` logits term — the
+  planner would otherwise veto every config on vocab-heavy models for a
+  buffer the runtime never materializes.
 
 The capacity table lives here (device_db carries bandwidths, not sizes)
 with the same public-spec sourcing discipline and a CPU tier so the
@@ -118,23 +131,25 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
                  utilization: float = 0.9) -> MemoryEstimate:
     """Price one config's per-chip HBM high-water.
 
-    ``config`` carries ``dp/tp/pp/sep`` degrees (a planner
-    ``ParallelConfig`` or anything duck-shaped like one). ``opt_slots``
-    is the optimizer's fp32 slot count per param (AdamW m+u = 2).
+    ``config`` carries ``dp/fsdp/tp/pp/sep`` degrees (a planner
+    ``ParallelConfig`` or anything duck-shaped like one; ``fsdp``
+    defaults to 1 for pre-ISSUE-18 duck shapes). ``opt_slots`` is the
+    optimizer's fp32 slot count per param (AdamW m+u = 2).
     ``budget_bytes`` overrides the device capacity lookup — the
     HBM-infeasibility tests pin tiny budgets through it.
     """
     dp, tp, pp, sep = config.dp, config.tp, config.pp, config.sep
+    fsdp = int(getattr(config, "fsdp", 1))
     dt = _DTYPE_BYTES.get(getattr(model_cfg, "dtype", "float32"), 4)
     H, M, L = (model_cfg.hidden_size, model_cfg.intermediate_size,
                model_cfg.num_hidden_layers)
 
-    shard = float(tp * pp)
+    shard = float(fsdp * tp * pp)
     params_b = _param_count(model_cfg) * dt / shard
     opt_b = _param_count(model_cfg) * 4.0 * opt_slots / shard
     grads_b = params_b
 
-    tokens_local = (global_batch / dp) * (seq_len / sep)
+    tokens_local = (global_batch / (dp * fsdp)) * (seq_len / sep)
     boundary = tokens_local * H * dt                  # one layer boundary
     remat = getattr(model_cfg, "recompute", "none") in ("full", "selective")
     layers_local = L / pp
@@ -145,6 +160,18 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
         # every layer keeps qkv/attn-out/gate/up intermediates
         acts_b = layers_local * (boundary + (4 * H + 2 * M) / H * boundary)
 
+    # fsdp gather working set: one decoder layer's params all-gathered
+    # over the axis for compute (still tp-sharded; counted inside
+    # acts_bytes because it is transient, not storage)
+    gather_b = 0.0
+    if fsdp > 1:
+        hd = H // model_cfg.num_attention_heads
+        qkv = H * (model_cfg.num_attention_heads
+                   + 2 * model_cfg.num_key_value_heads) * hd
+        per_layer = qkv + H * H + 3 * H * M
+        gather_b = per_layer * dt / float(tp)
+        acts_b += gather_b
+
     budget = budget_bytes if budget_bytes is not None else \
         hbm_capacity(device_kind) * utilization
     total = params_b + opt_b + grads_b + acts_b
@@ -153,4 +180,5 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
         acts_bytes=acts_b, budget_bytes=float(budget),
         feasible=total <= budget,
         detail={"tokens_local": tokens_local,
-                "layers_local": layers_local, "dtype_bytes": dt})
+                "layers_local": layers_local, "dtype_bytes": dt,
+                "fsdp_gather_bytes": gather_b})

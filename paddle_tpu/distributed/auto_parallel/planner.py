@@ -1,13 +1,16 @@
-"""Sharding planner: enumerate, price, and emit the fastest 4D config.
+"""Sharding planner: enumerate, price, and emit the fastest 5D config.
 
 The reference stack's ``auto_parallel`` layer picks hybrid-parallel
 placements for the user; this module is its TPU-native reproduction on
 top of the pricing stack PRs 8–9 built:
 
 1. **Enumerate** (:func:`enumerate_configs`) — every legal
-   ``(dp, tp, pp, sep)`` factorization of the declared device mesh,
-   legality meaning model divisibility (heads/layers/sequence/batch per
-   axis) rather than taste.
+   ``(dp, fsdp, tp, pp, sep)`` factorization of the declared device
+   mesh, legality meaning model divisibility (heads/layers/sequence/
+   batch/hidden per axis) rather than taste. ``fsdp`` is ZeRO-3 as
+   GSPMD specs (ISSUE 18): params + AdamW slots + grads sharded over
+   the axis, XLA inserting all-gather-on-use / reduce-scatter — no
+   reducer machinery.
 2. **Prune** — the closed-form per-chip HBM model
    (:mod:`memory_model`): params + optimizer slots + grads + activations
    under remat must fit BEFORE a config earns a compile.
@@ -84,32 +87,41 @@ class InfeasibleMeshError(RuntimeError):
 
 @dataclass(frozen=True)
 class ParallelConfig:
-    """One point in the 4D search space (axis vocabulary of
-    ``parallel/mesh.py AXES_ORDER``; fsdp rides dp for now — ROADMAP
-    items 3/4 grow ep/sep usage on this same vocabulary)."""
+    """One point in the 5D search space (axis vocabulary of
+    ``parallel/mesh.py AXES_ORDER``; ``fsdp`` is ZeRO-3 expressed as
+    GSPMD specs — params/slots/grads sharded over the axis, batch over
+    ``dp×fsdp`` — ROADMAP item 3 grows ep on this same vocabulary)."""
     dp: int = 1
     tp: int = 1
     pp: int = 1
     sep: int = 1
+    fsdp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.tp * self.pp * self.sep
+        return self.dp * self.fsdp * self.tp * self.pp * self.sep
 
     def axes(self) -> Dict[str, int]:
-        return {"dp": self.dp, "tp": self.tp, "pp": self.pp,
-                "sep": self.sep}
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                "pp": self.pp, "sep": self.sep}
 
     def __str__(self) -> str:
-        return f"dp{self.dp}_tp{self.tp}_pp{self.pp}_sep{self.sep}"
+        # the fsdp segment appears only when the axis is real — plan
+        # artifacts, graph-budget pins and elastic sidecars from before
+        # the axis existed keep parsing AND printing byte-identically
+        fs = f"fsdp{self.fsdp}_" if self.fsdp > 1 else ""
+        return f"dp{self.dp}_{fs}tp{self.tp}_pp{self.pp}_sep{self.sep}"
 
     @staticmethod
     def parse(s: str) -> "ParallelConfig":
         """Inverse of ``str()`` (also accepts ``dp2xtp2`` / ``dp=2,tp=2``
         forms so the CLI stays forgiving)."""
         import re
-        out = {"dp": 1, "tp": 1, "pp": 1, "sep": 1}
-        for m in re.finditer(r"(dp|tp|pp|sep)\s*=?\s*(\d+)", s.lower()):
+        out = {"dp": 1, "tp": 1, "pp": 1, "sep": 1, "fsdp": 1}
+        # the lookbehind keeps the 'dp' inside 'fsdp4' from matching as
+        # a dp degree
+        for m in re.finditer(r"(?<![a-z])(fsdp|dp|tp|pp|sep)\s*=?\s*(\d+)",
+                             s.lower()):
             out[m.group(1)] = int(m.group(2))
         return ParallelConfig(**out)
 
@@ -122,14 +134,19 @@ def enumerate_configs(n_devices: int, model_cfg=None, *,
                       global_batch: int = 8, seq_len: int = 32,
                       max_pp: Optional[int] = None,
                       include_sep: bool = True,
-                      include_pp: bool = True) -> List[ParallelConfig]:
-    """Every legal ``(dp, tp, pp, sep)`` with ``dp*tp*pp*sep ==
-    n_devices``. Legality against ``model_cfg`` (a LlamaConfig shape):
+                      include_pp: bool = True,
+                      include_fsdp: bool = True) -> List[ParallelConfig]:
+    """Every legal ``(dp, fsdp, tp, pp, sep)`` with
+    ``dp*fsdp*tp*pp*sep == n_devices``. Legality against ``model_cfg``
+    (a LlamaConfig shape):
 
     * ``tp`` divides attention heads, KV heads, intermediate and vocab
       (column/row-parallel projections + vocab-parallel CE);
+    * ``fsdp`` divides the hidden size (every projection/embedding is
+      annotated with the axis on its H dimension) and, jointly with
+      ``dp``, the global batch (batch spec is ``("dp","fsdp")``);
     * ``pp`` divides the layer count (stage stacking), and the
-      per-dp-rank batch must hold ≥2 microbatches;
+      per-data-rank batch must hold ≥2 microbatches;
     * ``sep`` divides the sequence (ring/GSPMD seq sharding) and the
       KV-head count (the ring exchanges head-sharded KV blocks);
     * ``dp`` divides the global batch.
@@ -141,25 +158,31 @@ def enumerate_configs(n_devices: int, model_cfg=None, *,
     for dp in _divisors(n_devices):
         if global_batch % dp:
             continue
-        rest1 = n_devices // dp
-        for tp in _divisors(rest1):
-            rest2 = rest1 // tp
-            for pp in _divisors(rest2):
-                if not include_pp and pp > 1:
-                    continue
-                if max_pp is not None and pp > max_pp:
-                    continue
-                sep = rest2 // pp
-                if sep > 1 and not include_sep:
-                    continue
-                cfg = ParallelConfig(dp=dp, tp=tp, pp=pp, sep=sep)
-                if model_cfg is not None and not _legal(cfg, model_cfg,
-                                                        global_batch,
-                                                        seq_len):
-                    continue
-                out.append(cfg)
+        rest0 = n_devices // dp
+        for fsdp in _divisors(rest0):
+            if fsdp > 1 and not include_fsdp:
+                continue
+            if global_batch % (dp * fsdp):
+                continue
+            rest1 = rest0 // fsdp
+            for tp in _divisors(rest1):
+                rest2 = rest1 // tp
+                for pp in _divisors(rest2):
+                    if not include_pp and pp > 1:
+                        continue
+                    if max_pp is not None and pp > max_pp:
+                        continue
+                    sep = rest2 // pp
+                    if sep > 1 and not include_sep:
+                        continue
+                    cfg = ParallelConfig(dp=dp, fsdp=fsdp, tp=tp, pp=pp,
+                                         sep=sep)
+                    if model_cfg is not None and not _legal(
+                            cfg, model_cfg, global_batch, seq_len):
+                        continue
+                    out.append(cfg)
     # stable, human-sensible order: least exotic first
-    out.sort(key=lambda c: (c.pp, c.sep, c.tp, c.dp))
+    out.sort(key=lambda c: (c.pp, c.sep, c.fsdp, c.tp, c.dp))
     return out
 
 
@@ -171,14 +194,22 @@ def _legal(cfg: ParallelConfig, m, global_batch: int,
                 or m.intermediate_size % cfg.tp
                 or m.vocab_size % cfg.tp):
             return False
+    if cfg.fsdp > 1:
+        # every fsdp annotation in models/llama.py lands on the hidden
+        # dimension (qkv/gate_up dim0, o/down/embed dim1, lm_head dim0),
+        # so H-divisibility is the whole sharding constraint; the batch
+        # constraint comes from the ("dp","fsdp") batch spec
+        if (m.hidden_size % cfg.fsdp
+                or global_batch % (cfg.dp * cfg.fsdp)):
+            return False
     if cfg.pp > 1:
         if m.num_hidden_layers % cfg.pp:
             return False
         # the pipe candidate compiles with num_microbatches=2, so the
-        # per-dp-rank batch must split into 2 microbatches exactly — a
-        # bare ">= 2" check admits configs whose build then fails and
-        # reads as a misleading "compile failed" prune
-        per_dp = global_batch // cfg.dp
+        # per-data-rank (dp×fsdp) batch must split into 2 microbatches
+        # exactly — a bare ">= 2" check admits configs whose build then
+        # fails and reads as a misleading "compile failed" prune
+        per_dp = global_batch // (cfg.dp * cfg.fsdp)
         if per_dp < 2 or per_dp % 2:
             return False
     if cfg.sep > 1:
@@ -381,7 +412,8 @@ def _build_candidate(model_cfg, cfg: ParallelConfig, devices,
                                      num_microbatches=2)
     else:
         model = LlamaForCausalLM(mcfg)
-    hm = HybridMesh.build(dp=cfg.dp, tp=cfg.tp, pp=cfg.pp, sep=cfg.sep,
+    hm = HybridMesh.build(dp=cfg.dp, fsdp=cfg.fsdp, tp=cfg.tp,
+                          pp=cfg.pp, sep=cfg.sep,
                           devices=list(devices)[:cfg.size])
     with hm:
         shard_layer(model)
@@ -640,8 +672,8 @@ def plan(model_cfg, *, n_devices: Optional[int] = None, devices=None,
         n, model_cfg, global_batch=global_batch, seq_len=seq_len)
     if not cand:
         raise InfeasibleMeshError(
-            f"no legal (dp,tp,pp,sep) factorization of {n} devices for "
-            f"this model/batch (global_batch={global_batch}, "
+            f"no legal (dp,fsdp,tp,pp,sep) factorization of {n} devices "
+            f"for this model/batch (global_batch={global_batch}, "
             f"seq_len={seq_len})")
 
     report = PlanReport(

@@ -114,6 +114,14 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("planner_top1_is_measured_top2", "lower", band=0.01),
     RatioMetric("planner_rank_agreement", "lower", band=0.3),
     RatioMetric("planner_predicted_mfu", "lower", cpu_band=0.45),
+    # ZeRO/FSDP axis (ISSUE 18): fsdp4 ÷ dp4 measured step time at
+    # equal devices (the gather/reduce-scatter tax — growth means the
+    # overlap contract stopped hiding the windows; rides host noise,
+    # wide band) and the same pair's closed-form HBM high-water ratio
+    # (deterministic arithmetic, tight band — a rise means the ZeRO
+    # sharding of params/slots/grads eroded)
+    RatioMetric("fsdp_step_overhead_ratio", "higher", band=0.5),
+    RatioMetric("fsdp_hbm_ratio", "higher", band=0.1),
     # latency-hiding contract (ISSUE 14): exposed (un-overlapped) comm
     # fraction of the dp2xtp2 canonical step — structural per build, a
     # GROWING fraction means a hiding window collapsed (higher=worse) —

@@ -157,6 +157,66 @@ def test_reshard_roundtrip_8_4_8_digest_exact(tmp_path, plans):
     assert tree8["params"][name].sharding.spec == plan8.param_specs[name]
 
 
+def test_reshard_fsdp_boundary_roundtrip_digest_exact(tmp_path):
+    """ISSUE 18: dp2×fsdp2 → dp4 → dp2×fsdp2 across 4 devices. A ZeRO
+    checkpoint (params AND AdamW slots fsdp-sharded) restores under a
+    pure-dp plan digest-exact — the fsdp axis rides the same _PLAN.json
+    sidecar machinery as every other axis — and comes back fsdp-sharded
+    on the return hop."""
+    plan_z = plan_for_config(micro_cfg(), ParallelConfig(dp=2, fsdp=2),
+                             devices=jax.devices()[:4])
+    plan_d = plan_for_config(micro_cfg(), ParallelConfig(dp=4),
+                             devices=jax.devices()[:4])
+    assert plan_z.axes.get("fsdp") == 2
+    tree, _hm = make_state(plan_z)
+    d0 = digest(tree)
+
+    root_a = str(tmp_path / "a")
+    CheckpointManager(root_a, save_interval_steps=1, plan=plan_z).save(
+        4, tree)
+    hmd = plan_d.build_mesh()
+    mgr_d = CheckpointManager(root_a, plan=plan_d, mesh=hmd.mesh)
+    s, tree_d = mgr_d.restore(tree)
+    assert s == 4 and digest(tree_d) == d0
+    # under pure dp the params replicate — no fsdp axis left in any spec
+    name = next(k for k, v in plan_z.param_specs.items()
+                if "fsdp" in str(v))
+    assert "fsdp" not in str(tree_d["params"][name].sharding.spec)
+
+    root_b = str(tmp_path / "b")
+    CheckpointManager(root_b, save_interval_steps=1, plan=plan_d).save(
+        4, tree_d)
+    hmz = plan_z.build_mesh()
+    mgr_z = CheckpointManager(root_b, plan=plan_z, mesh=hmz.mesh)
+    s, tree_z = mgr_z.restore(tree)
+    assert s == 4 and digest(tree_z) == d0
+    # params AND optimizer slots landed fsdp-sharded per the target plan
+    spec = plan_z.param_specs[name]
+    assert tree_z["params"][name].sharding.spec == spec
+    assert tree_z["opt_state"]["slots"][name]["m"].sharding.spec == spec
+
+
+def test_reshard_check_feasible_names_fsdp_on_indivisible_shrink(
+        tmp_path):
+    """An fsdp target that does not divide the hidden dim (64 % 3) is
+    rejected up front with ReshardError naming the fsdp axis and the
+    remainder — not a GSPMD crash after bytes moved."""
+    plan_z = plan_for_config(micro_cfg(), ParallelConfig(dp=2, fsdp=2),
+                             devices=jax.devices()[:4])
+    tree, _hm = make_state(plan_z)
+    mgr = CheckpointManager(str(tmp_path), save_interval_steps=1,
+                            plan=plan_z)
+    mgr.save(4, tree)
+
+    plan3 = plan_for_config(micro_cfg(), ParallelConfig(dp=1, fsdp=3),
+                            devices=jax.devices()[:3])
+    mgr3 = CheckpointManager(str(tmp_path), plan=plan3)
+    with pytest.raises(ReshardError) as ei:
+        mgr3.restore(tree)
+    msg = str(ei.value)
+    assert "fsdp=3" in msg and "remainder" in msg
+
+
 def test_reshard_rejects_uneven_axis_with_actionable_error(tmp_path, plans):
     """tp-shrink onto tp=3 (does not divide heads/hidden): ReshardError
     names the axis, the parameter, and the remainder — and does NOT fall

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Sharding-planner CLI (ISSUE 11): rank 4D parallel configs for a mesh.
+"""Sharding-planner CLI (ISSUE 11): rank 5D parallel configs for a mesh.
 
-Enumerates legal ``(dp, tp, pp, sep)`` configs over the declared mesh,
+Enumerates legal ``(dp, fsdp, tp, pp, sep)`` configs over the declared
+mesh (``fsdp`` = ZeRO-3 as GSPMD specs, ISSUE 18),
 prunes HBM-infeasible ones, prices each survivor by compiling and
 attributing its real train-step graph (``paddle_tpu.distributed.
 auto_parallel.planner``), and prints the ranked table — predicted step
@@ -16,6 +17,7 @@ Usage::
     python tools/plan.py --mesh 4x2 --validate          # measure + rank
     python tools/plan.py --mesh 4x2 --out plan.json     # plan artifact
     python tools/plan.py --mesh 4x2 --config dp2_tp2    # price one
+    python tools/plan.py --mesh 4x2 --config dp2_fsdp2_tp2  # ZeRO-3
     python tools/plan.py --mesh 2x2 --virtual-devices 8 # laptop smoke
 
 ``--validate`` additionally EXECUTES every ranked config (interleaved
@@ -84,8 +86,8 @@ def main(argv=None) -> int:
     ap_.add_argument("--top", type=int, default=5,
                      help="rows of the ranked table to print")
     ap_.add_argument("--config", default=None,
-                     help="price ONE config (e.g. dp2_tp2) instead of "
-                          "enumerating")
+                     help="price ONE config (e.g. dp2_tp2 or "
+                          "dp2_fsdp2_tp2) instead of enumerating")
     ap_.add_argument("--drift", default="warn",
                      choices=("warn", "refuse", "ignore"),
                      help="what to do when the cost-model drift gauge "
