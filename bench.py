@@ -934,6 +934,25 @@ def _decode_bench(cfg, on_tpu):
         out["frontdoor_error"] = f"{type(e).__name__}: {str(e)[:150]}"
 
     try:
+        # distributed request tracing (ISSUE 19): one fabric wave traced
+        # ÷ untraced, interleaved min-of-rounds on the same warmed
+        # replicas. Prices the span machinery (router queue/route/submit
+        # + engine queue/resident/prefill/decode spans, per-request) —
+        # healthy is ~1.0; a drift means a hot-path site stopped
+        # honoring the attribute-load-plus-branch disabled contract.
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import load_test as _lt2
+        _log("decode: request-tracing overhead (traced vs untraced wave)")
+        tr_leg = _lt2.trace_overhead_legs(dmodel)
+        out["trace_overhead_ratio"] = round(tr_leg["ratio"], 3)
+        out["trace_traced_wall_s"] = round(tr_leg["wall_on_s"], 4)
+        out["trace_untraced_wall_s"] = round(tr_leg["wall_off_s"], 4)
+        out["trace_complete_traces"] = tr_leg["traces"]
+    except Exception as e:
+        out["trace_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+
+    try:
         # quantized serving A/B (ISSUE 17): int8 weights + int8 KV pages
         # vs the bf16 engine — identical engines modulo the quant knobs,
         # interleaved min-of-rounds, RATIO rows (memory:

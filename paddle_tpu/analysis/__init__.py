@@ -23,12 +23,17 @@ to numerics tests. This subsystem makes it checkable:
                            K=1/K=4, serving tick spec on/off, prefix
                            admit, fused CE) the budgets pin;
 * :mod:`trace_lint`      — AST linter for retrace/host-sync hazards in
-                           jit-reachable python (waivable inline).
+                           jit-reachable python (waivable inline);
+* :mod:`critical_path`   — exclusive self-time per serving hop over the
+                           distributed request traces (ISSUE 19):
+                           TTFT/ITL attribution, per-hop tables,
+                           Perfetto export.
 
 CLI: ``python tools/graph_lint.py`` (tier-1 gated);
 ``--update-budgets`` re-pins tools/graph_budgets.json preserving waivers.
 """
 
+from . import critical_path as critical_path  # noqa: F401 (re-export)
 from .collectives import collective_census, mesh_axis_groups
 from .contracts import (BanRule, GraphContract, GraphReport, Violation,
                         analyze, check_budget, check_contract,
@@ -52,4 +57,5 @@ __all__ = [
     "host_transfer_report", "collective_census", "mesh_axis_groups",
     "OverlapWindow", "UnmatchedCollectiveError", "overlap_report",
     "REGISTRY", "BuiltGraph", "GraphSkipped", "build_graph", "graph_names",
+    "critical_path",
 ]

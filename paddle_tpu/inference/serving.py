@@ -126,6 +126,7 @@ import numpy as np
 
 from ..observability.metrics import REGISTRY as _REG
 from ..observability.sentry import sentry as _sentry
+from ..observability.tracing import TRACER as _TRACE
 from ..profiler import RecordEvent
 from .admission import AdmissionPolicy, VictimInfo
 from .generation import (GenerationConfig, decode_stop_update,
@@ -160,6 +161,10 @@ class _Request:
     itl_gaps: List[float] = field(default_factory=list)  # per-TICK gaps
     prefilled: int = 0                  # KV tokens written (chunked mode)
     prefill_target: int = 0             # prompt+replay length to prefill
+    # distributed tracing (ISSUE 19): {"tr": tracer, "parent": wire ctx,
+    # "queue"/"res": open spans, "last": decode-epoch wall stamp}. None
+    # when untraced — every tracing branch below is one attr test.
+    tspans: Optional[dict] = None
 
 
 @dataclass
@@ -243,6 +248,10 @@ class ContinuousBatchingEngine:
         self.name = name or ""
         self._mlabels: Dict[str, str] = ({"engine": self.name}
                                          if self.name else {})
+        # tracer override hook: tests inject a private Tracer so ONE
+        # process can play both sides of the TCP hop without the
+        # replica's spans landing in the router's singleton
+        self._tracer = None
         self.core = getattr(model, "model", model)
         if spec_k and not hasattr(self.core, "decode_verify_paged"):
             raise ValueError(
@@ -418,7 +427,7 @@ class ContinuousBatchingEngine:
     def submit(self, input_ids, max_new_tokens: Optional[int] = None,
                generation_config: Optional[GenerationConfig] = None,
                rseed: Optional[int] = None,
-               replay_prefix=None) -> int:
+               replay_prefix=None, trace=None) -> int:
         """Queue one request; returns its id.
 
         ``rseed`` overrides the sampling-stream identity folded into the
@@ -480,6 +489,17 @@ class ContinuousBatchingEngine:
                        rseed=None if rseed is None else int(rseed))
         req.generated = replay
         req.submit_t = time.perf_counter()
+        if trace is not None:
+            # ``trace`` is the wire TraceContext dict the fabric carried
+            # over the transport; spans minted here stitch under it
+            tr = self._tracer or _TRACE
+            if tr.enabled:
+                sp = tr.start("replica::queue", parent=trace,
+                              tags={"rid": req.rid,
+                                    "engine": self.name})
+                if sp is not None:
+                    req.tspans = {"tr": tr, "parent": trace,
+                                  "queue": sp}
         self._requests[req.rid] = req
         self._queue.append(req)
         return req.rid
@@ -642,6 +662,11 @@ class ContinuousBatchingEngine:
                 self._free_slot(slot, cache=True)
         if req.done:
             return False
+        if req.tspans is not None:
+            for k in ("queue", "res"):
+                sp = req.tspans.pop(k, None)
+                if sp is not None:
+                    sp.tag(outcome="cancelled").end()
         self._requests.pop(rid, None)
         self._price_cache.pop(rid, None)
         return True
@@ -1027,6 +1052,11 @@ class ContinuousBatchingEngine:
         if req is not None:
             req.slot = -1
             req.prefilled = 0     # freed pages took the written KV along
+            if req.tspans is not None:
+                rsp = req.tspans.pop("res", None)
+                if rsp is not None:
+                    rsp.tag(reason="done" if req.done else "preempt",
+                            n=len(req.generated)).end()
 
     # -- device-resident scheduler state ------------------------------------
 
@@ -1089,6 +1119,10 @@ class ContinuousBatchingEngine:
         self.pos[slot] = L
         self._proj_pos[slot] = L
         self._proj_gen[slot] = len(req.generated)
+        if req.tspans is not None:
+            # decode-epoch anchor: the first replica::decode span for
+            # this residency starts where activation finished
+            req.tspans["last"] = time.time()
         self._dosample[slot] = req.do_sample
         if self.spec_k:
             # device-resident token history for the draft proposer:
@@ -1310,6 +1344,17 @@ class ContinuousBatchingEngine:
             self._tables_dirty = True
             self._slots[slot] = req
             req.slot = slot
+            if req.tspans is not None:
+                ts = req.tspans
+                q = ts.pop("queue", None)
+                if q is not None:     # absent on preemption re-admits
+                    q.tag(outcome="admitted", slot=slot).end()
+                rsp = ts["tr"].start("replica::resident",
+                                     parent=ts["parent"],
+                                     tags={"slot": slot})
+                if rsp is not None:
+                    ts["res"] = rsp
+                ts["last"] = time.time()
             self._dosample[slot] = req.do_sample
             req.prefill_target = L
             if fast:
@@ -1320,6 +1365,7 @@ class ContinuousBatchingEngine:
                 src = self._prefix.page_at(toks, n_lock)
                 assert src is not None, "matched tail page vanished"
                 self.prefix_cow_copies += 1
+                psp = self._prefill_span(req, "cow")
                 with RecordEvent("serving::prefill"):
                     logits, self.pools = self._tail_logits_fn()(
                         self._params,
@@ -1327,6 +1373,8 @@ class ContinuousBatchingEngine:
                         jnp.full((1,), L - 1, jnp.int32), self.pools,
                         jnp.asarray(self.tables[slot:slot + 1]),
                         jnp.int32(src), jnp.int32(pages[0]))
+                if psp is not None:
+                    psp.end()
                 req.prefilled = L
                 self._activate(slot, req, logits)
                 self._insert_prefix(slot, req)
@@ -1342,6 +1390,7 @@ class ContinuousBatchingEngine:
             bucket = self._bucket(L)
             off = n_lock * self.page_size
             req.prefilled = L
+            psp = self._prefill_span(req, "suffix" if off else "full")
             with RecordEvent("serving::prefill"):
                 if off:
                     # suffix-only prefill from the page-aligned offset:
@@ -1367,9 +1416,22 @@ class ContinuousBatchingEngine:
                         self._params, jnp.asarray(ids), self.pools,
                         jnp.asarray(self.tables[slot:slot + 1]),
                         jnp.int32(L - 1))
+            if psp is not None:
+                psp.end()
             self._activate(slot, req, logits)
             if self._prefix is not None:
                 self._insert_prefix(slot, req)
+
+    @staticmethod
+    def _prefill_span(req: _Request, kind: str):
+        """Open a replica::prefill span under ``req``'s resident span
+        (None when untraced — callers guard the matching end)."""
+        ts = req.tspans
+        if ts is None:
+            return None
+        parent = ts.get("res") or ts["parent"]
+        return ts["tr"].start("replica::prefill", parent=parent,
+                              tags={"kind": kind})
 
     def _decode_ready(self, req) -> bool:
         return req is not None and req.prefilled >= req.prefill_target
@@ -1409,11 +1471,14 @@ class ContinuousBatchingEngine:
         if self._chunk_fn is None:
             self._chunk_fn = self._build_chunk_fn()
         last_idx = req.prefill_target - 1
+        psp = self._prefill_span(req, "chunk")
         with RecordEvent("serving::prefill"):
             logits, self.pools = self._chunk_fn(
                 self._params, jnp.asarray(ids), jnp.int32(off), self.pools,
                 jnp.asarray(self.tables[slot:slot + 1]),
                 jnp.int32(min(last_idx, off + C - 1)))
+        if psp is not None:
+            psp.tag(off=off).end()
         req.prefilled = min(off + C, self._bucket(req.prefill_target))
         if req.prefilled >= req.prefill_target:
             self._activate(slot, req, logits)
@@ -1879,6 +1944,19 @@ class ContinuousBatchingEngine:
                     gap = (now - req.last_emit_t) / nk
                     req.itl_gaps.extend([gap] * nk)
                 req.last_emit_t = now
+                if req.tspans is not None:
+                    # one replica::decode span per committing drain,
+                    # covering [previous commit -> this one]: ITL gap
+                    # attribution sees decode as contiguous ownership
+                    ts = req.tspans
+                    wnow = time.time()
+                    sp = ts["tr"].start(
+                        "replica::decode",
+                        parent=ts.get("res") or ts["parent"],
+                        start=ts.get("last", wnow), tags={"n": nk})
+                    if sp is not None:
+                        sp.end(wnow)
+                    ts["last"] = wnow
             if not active_after[slot]:
                 # the device's done flag: eos or budget hit inside this
                 # block. Tokens past the stop were masked on device and

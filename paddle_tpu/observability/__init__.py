@@ -36,11 +36,13 @@ from typing import List, Optional
 from . import exporters as exporters  # noqa: F401 (re-export module)
 from . import flight_recorder, goodput
 from . import sentry as sentry  # noqa: F401 (re-export module)
+from . import tracing as tracing  # noqa: F401 (re-export module)
 from .exporters import (ConsoleSummary, JSONLExporter, PrometheusExporter,
                         parse_prometheus, render_prometheus)
 from .goodput import GoodputLedger, ledger
 from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
                       enabled, registry)
+from .tracing import TRACER, Span, TraceContext, Tracer, tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
@@ -49,6 +51,7 @@ __all__ = [
     "sentry", "exporters", "JSONLExporter", "PrometheusExporter",
     "ConsoleSummary", "render_prometheus", "parse_prometheus",
     "observe_train_metrics",
+    "tracing", "TRACER", "Tracer", "Span", "TraceContext", "tracer",
 ]
 
 _EXPORTERS: List[object] = []

@@ -167,6 +167,14 @@ class FlightRecorder:
             goodput = ledger().totals()
         except Exception:
             goodput = {}
+        try:
+            # distributed tracing (ISSUE 19): the tracer's bounded ring
+            # of complete request traces rides into the dump — serving
+            # post-mortems carry request context, not just samples
+            from .tracing import TRACER
+            traces = TRACER.recent_traces() if TRACER.enabled else []
+        except Exception:
+            traces = []
         payload = {
             "reason": reason,
             "ts": time.time(),
@@ -175,6 +183,7 @@ class FlightRecorder:
             "metrics_snapshot": REGISTRY.collect(),
             "recent_samples": samples,
             "recent_spans": spans,
+            "recent_traces": traces,
             "extra": extra or {},
         }
         os.makedirs(self.dir, exist_ok=True)

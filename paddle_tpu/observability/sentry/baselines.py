@@ -87,6 +87,11 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("step_time_predicted_over_measured", "either", band=0.5),
     # observability overhead: metrics-on ÷ metrics-off, healthy ~1.0
     RatioMetric("obs_overhead_ratio", "higher", band=0.15),
+    # distributed tracing (ISSUE 19): traced ÷ untraced smoke load-test
+    # wall time, healthy ~1.0 — the zero-cost contract's bench gate.
+    # Same shape as obs_overhead_ratio but the smoke leg is a full
+    # serving stack (compiles amortized, still host-noisy): wider band
+    RatioMetric("trace_overhead_ratio", "higher", band=0.25),
     # serving efficiency and A/B speedups (interleaved min-of-rounds
     # ratios, but still rider on host noise — keep the wide default)
     RatioMetric("serving_decode_efficiency", "lower", band=0.35),

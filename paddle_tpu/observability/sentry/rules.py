@@ -38,7 +38,8 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
            "trainer_rules", "serving_rules", "fabric_rules",
-           "frontdoor_rules", "elastic_rules", "default_rules"]
+           "frontdoor_rules", "elastic_rules", "tracing_rules",
+           "default_rules"]
 
 
 class SloRule:
@@ -547,6 +548,44 @@ def elastic_rules(membership_changes_per_window: float = 2.0,
                         "the job can make progress on — scale the pod "
                         "back up or lower the floor deliberately"))
     return rules
+
+
+def tracing_rules(queue_frac_ceiling: float = 0.5,
+                  untracked_frac_ceiling: float = 0.1,
+                  breach_for: int = 3,
+                  cooldown_s: float = 300.0) -> List[SloRule]:
+    """The distributed-tracing pack (ISSUE 19), breaching on
+    ATTRIBUTION SHIFTS rather than totals: the tracer publishes
+    ``pt_trace_ttft_frac{hop=...}`` gauges per completed trace, and a
+    TTFT whose queue share climbs past the ceiling names the culprit
+    (admission backlog) before the aggregate p99 ceiling even moves.
+    The untracked ceiling is the instrumentation's own watchdog — a
+    residual past it means a hop lost its spans (the ≥95% attribution
+    contract the acceptance bound pins). Both series only exist while
+    tracing is enabled, so the pack is silent otherwise (the
+    missing-series skip contract)."""
+    return [
+        Threshold(
+            "trace_ttft_frac_queue", "pt_trace_ttft_frac",
+            labels={"hop": "queue"}, ceiling=queue_frac_ceiling,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="router-queue share of TTFT over the ceiling: "
+                        "requests spend their first-token budget "
+                        "waiting for dispatch — fair-admission backlog "
+                        "or no replica capacity; the attached traces "
+                        "name the hop"),
+        Threshold(
+            "trace_ttft_frac_untracked", "pt_trace_ttft_frac",
+            labels={"hop": "untracked"},
+            ceiling=untracked_frac_ceiling,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="untracked TTFT residual over the ceiling: a "
+                        "latency-owning hop is missing its spans "
+                        "(instrumentation regression) or a new hop "
+                        "appeared between instrumented ones"),
+    ]
 
 
 def default_rules() -> List[SloRule]:

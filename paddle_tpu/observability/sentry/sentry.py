@@ -245,12 +245,35 @@ class SloSentry:
                     context = self._context(by_name)
                 inc = Incident(rule, value, stats, st["streak"],
                                context, ts=time.time())
+                self._attach_traces(inc)
                 fired.append(inc)
             for inc in fired:
                 self._record(inc)
         return fired
 
     # -- incident sinks ------------------------------------------------------
+
+    @staticmethod
+    def _attach_traces(inc: Incident) -> None:
+        """Latency incidents carry their evidence (ISSUE 19): a TTFT or
+        ITL breach attaches the K worst complete request traces so the
+        post-mortem starts from the offending span trees, not just
+        percentiles. The shared per-tick context capture is copied
+        before mutation — other incidents this tick must not inherit
+        the traces."""
+        m = f"{inc.metric or ''} {inc.rule}"
+        if "ttft" not in m and "itl" not in m:
+            return
+        try:
+            from ..tracing import TRACER
+            if not TRACER.enabled:
+                return
+            worst = TRACER.worst_traces(3)
+        except Exception:
+            return
+        if worst:
+            inc.context = dict(inc.context or {})
+            inc.context["attached_traces"] = worst
 
     def _record(self, inc: Incident) -> None:
         self.incidents.append(inc)

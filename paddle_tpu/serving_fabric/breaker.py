@@ -42,6 +42,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..observability.metrics import REGISTRY as _REG
+from ..observability.tracing import TRACER as _TRACE
 from .transport import FabricTransport, ReplicaDown
 
 __all__ = ["BreakerTransport", "DEFAULT_OP_TIMEOUTS"]
@@ -239,8 +240,24 @@ class BreakerTransport(FabricTransport):
         return self.inner.replica_names()
 
     def submit(self, name, req):
-        return self._run(name, "submit",
-                         lambda: self.inner.submit(name, req))
+        # each breaker-mediated submit ATTEMPT is a sibling span under
+        # the request's trace (req carries the wire context) — retries
+        # and hedges show up side by side, tagged with their outcomes
+        sp = None
+        if _TRACE.enabled and isinstance(req, dict) and req.get("trace"):
+            sp = _TRACE.start("breaker::attempt", parent=req["trace"],
+                              tags={"replica": name, "op": "submit",
+                                    "mode": self._st(name).mode})
+        try:
+            out = self._run(name, "submit",
+                            lambda: self.inner.submit(name, req))
+        except BaseException as e:           # noqa: BLE001 — relayed
+            if sp is not None:
+                sp.tag(outcome=type(e).__name__).end()
+            raise
+        if sp is not None:
+            sp.tag(outcome="ok").end()
+        return out
 
     def poll(self, name):
         return self._run(name, "poll", lambda: self.inner.poll(name))

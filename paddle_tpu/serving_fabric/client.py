@@ -106,12 +106,14 @@ class FabricClient:
                  ttft_deadline_ms: Optional[float] = None,
                  deadline_ms: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 on_token: Optional[Callable[[int], None]] = None
-                 ) -> ClientResult:
+                 on_token: Optional[Callable[[int], None]] = None,
+                 trace_id: Optional[str] = None) -> ClientResult:
         """Run one streaming request to completion through every
         robustness path; returns the full token stream. Raises the
         typed rejection when attempts are exhausted or the refusal is
-        terminal (``deadline``)."""
+        terminal (``deadline``). ``trace_id`` joins this request to a
+        caller-owned distributed trace (the front door mints one per
+        request otherwise, when tracing is on)."""
         sid = request_id or f"c{os.getpid()}-{next(_uniq)}"
         toks: List[int] = []
         seq_next: Optional[int] = None
@@ -135,7 +137,8 @@ class FabricClient:
                     "max_new_tokens": int(max_new_tokens),
                     "tenant": tenant, "knobs": knobs,
                     "ttft_deadline_ms": ttft_deadline_ms,
-                    "deadline_ms": deadline_ms, "have": len(toks)})
+                    "deadline_ms": deadline_ms, "have": len(toks),
+                    "trace_id": trace_id})
                 seq_next = None
                 while True:
                     try:

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..observability.metrics import REGISTRY as _REG
+from ..observability.tracing import TRACER as _TRACE
 from .robust import FabricRejected
 from .router import ServingFabric
 
@@ -147,6 +148,10 @@ class _Stream:
         self.conn: Optional[_Conn] = None
         self.sent = 0                    # toks shipped to current conn
         self.error: Optional[dict] = None     # reject event body
+        # distributed-tracing root span (ISSUE 19): minted at submit
+        # when the tracer is live, ended once at done/failed. None on
+        # untraced streams — every tracing touch guards on this.
+        self.tspan = None
 
 
 class FrontDoor:
@@ -335,6 +340,19 @@ class FrontDoor:
             if st is not None:
                 self._resume(conn, st, have)
                 return
+            # the trace root is minted HERE — the FrontDoor edge — and
+            # its context rides the fabric request (explicit injection;
+            # contextvars would stop at the TCP hop). A client-supplied
+            # trace_id joins its trace to ours for end-to-end logs.
+            root = None
+            if _TRACE.enabled:
+                tid = msg.get("trace_id")
+                root = _TRACE.start(
+                    "frontdoor::request",
+                    trace_id=str(tid) if tid else None,
+                    tags={"id": sid,
+                          "tenant": str(msg.get("tenant", "default"))})
+                acc = _TRACE.start("frontdoor::submit", parent=root)
             try:
                 fid = self.fabric.submit(
                     np.asarray(msg["prompt"], np.int32),
@@ -342,10 +360,16 @@ class FrontDoor:
                     tenant=str(msg.get("tenant", "default")),
                     knobs=msg.get("knobs"),
                     ttft_deadline_ms=msg.get("ttft_deadline_ms"),
-                    deadline_ms=msg.get("deadline_ms"))
+                    deadline_ms=msg.get("deadline_ms"),
+                    trace=None if root is None else root.ctx)
             except FabricRejected as e:
+                if root is not None:
+                    acc.tag(outcome="rejected").end()
+                    root.tag(state="rejected").end()
                 conn.send({"ev": "reject", "id": sid, **e.to_wire()})
                 return
+            if root is not None:
+                acc.tag(outcome="ok").end()
             st = _Stream(sid, fid, rseed=fid, prompt=msg["prompt"],
                          max_new_tokens=int(msg["max_new_tokens"]),
                          tenant=str(msg.get("tenant", "default")),
@@ -353,6 +377,7 @@ class FrontDoor:
                          ttft_deadline_ms=msg.get("ttft_deadline_ms"),
                          deadline_ms=msg.get("deadline_ms"))
             st.conn = conn
+            st.tspan = root
             self._streams[sid] = st
             self._by_fid[fid] = st
             conn.ids.add(sid)
@@ -368,6 +393,13 @@ class FrontDoor:
         st.conn = conn
         st.sent = min(have, len(st.toks))
         conn.ids.add(st.sid)
+        # every dedupe attempt is a SIBLING span under the stream's
+        # root, tagged with its outcome — hedge-as-takeover is visible
+        # as resume(takeover) next to the still-running first attempt
+        rsp = None
+        if st.tspan is not None and _TRACE.enabled:
+            rsp = _TRACE.start("frontdoor::resume", parent=st.tspan,
+                               tags={"have": have})
         if prev is not None and prev is not conn:
             # hedge/takeover: exactly one attempt owns a stream
             prev.ids.discard(st.sid)
@@ -376,12 +408,16 @@ class FrontDoor:
             if st.state == "active":
                 # the old attempt's fabric request keeps running and
                 # this connection now receives it — nothing to resubmit
+                if rsp is not None:
+                    rsp.tag(outcome="takeover").end()
                 conn.send({"ev": "ack", "id": st.sid})
                 self._flush(st)
                 self.retries += 1
                 self._count_retry()
                 return
         if st.state in ("done", "failed"):
+            if rsp is not None:
+                rsp.tag(outcome="replayed").end()
             conn.send({"ev": "ack", "id": st.sid})
             self._flush(st)
             self._finish_events(st)
@@ -399,8 +435,12 @@ class FrontDoor:
                     tenant=st.tenant, knobs=st.knobs,
                     ttft_deadline_ms=st.ttft_deadline_ms,
                     deadline_ms=st.deadline_ms,
-                    rseed=st.rseed, replay=list(st.toks))
+                    rseed=st.rseed, replay=list(st.toks),
+                    trace=(None if st.tspan is None
+                           else st.tspan.ctx))
             except FabricRejected as e:
+                if rsp is not None:
+                    rsp.tag(outcome="rejected").end()
                 st.conn = None
                 conn.ids.discard(st.sid)
                 conn.send({"ev": "reject", "id": st.sid,
@@ -409,6 +449,12 @@ class FrontDoor:
             st.fid = fid
             st.state = "active"
             self._by_fid[fid] = st
+            if rsp is not None:
+                rsp.tag(outcome="resubmit", replay=len(st.toks))
+        if rsp is not None:
+            if "outcome" not in rsp.tags:
+                rsp.tag(outcome="reattach")
+            rsp.end()
         conn.send({"ev": "ack", "id": st.sid})
         self._flush(st)
         self.retries += 1
@@ -468,6 +514,10 @@ class FrontDoor:
             st = self._by_fid.get(fid)
             if st is None:
                 continue
+            if st.tspan is not None and not st.toks and _TRACE.enabled:
+                # the TTFT stamp the critical-path walk attributes:
+                # first token committed at the client-facing edge
+                st.tspan.event("first_tok")
             st.toks.extend(toks)
             self._flush(st)
         for fid, result in self.fabric.take_finished().items():
@@ -505,13 +555,27 @@ class FrontDoor:
                 time.monotonic() - since > self.write_stall_s:
             self._evict_slow(st, conn)
             return
+        dsp = None
+        if st.tspan is not None and _TRACE.enabled:
+            dsp = _TRACE.start("frontdoor::drain", parent=st.tspan)
         pend = st.toks[st.sent:]
         if conn.send({"ev": "tok", "id": st.sid, "toks": pend}):
             st.sent = len(st.toks)
+            if dsp is not None:
+                dsp.tag(n=len(pend)).end()
         else:
+            if dsp is not None:
+                dsp.tag(n=len(pend), outcome="slow_evict").end()
             self._evict_slow(st, conn)
 
     def _finish_events(self, st: _Stream) -> None:
+        root = st.tspan
+        if root is not None:
+            # root end assembles the trace: ingested replica spans,
+            # flagged orphans and all. Ended exactly once (replayed
+            # resumes re-enter here with tspan already cleared).
+            st.tspan = None
+            root.tag(state=st.state, n=len(st.toks)).end()
         conn = st.conn
         if conn is None:
             return

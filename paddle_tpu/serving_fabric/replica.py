@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..inference.generation import GenerationConfig
+from ..observability.tracing import TRACER as _TRACE
 from .digest import PrefixDigest
 
 __all__ = ["Replica", "build_replicas"]
@@ -72,7 +73,8 @@ class Replica:
             max_new_tokens=req.get("max_new_tokens"),
             generation_config=gc,
             rseed=req.get("rseed"),
-            replay_prefix=req.get("replay"))
+            replay_prefix=req.get("replay"),
+            trace=req.get("trace"))
 
     def poll(self) -> dict:
         """One scheduler tick + completions. Emissions are NEW tokens
@@ -85,9 +87,18 @@ class Replica:
             # drain boundary with retirements: refresh the replica's
             # registry series (per-engine labels) + sentry tick
             self.engine.publish_metrics()
-        return {"emitted": [[int(r), int(t)] for r, t in emitted],
-                "finished": {int(r): np.asarray(v).tolist()
-                             for r, v in finished.items()}}
+        out = {"emitted": [[int(r), int(t)] for r, t in emitted],
+               "finished": {int(r): np.asarray(v).tolist()
+                            for r, v in finished.items()}}
+        # piggyback finished replica-side spans: over TCP this replica
+        # never owns a trace root, so drain_for_wire ships them to the
+        # router for stitching; in-proc (shared tracer) it's a no-op
+        tr = getattr(self.engine, "_tracer", None) or _TRACE
+        if tr.enabled:
+            spans = tr.drain_for_wire()
+            if spans:
+                out["spans"] = spans
+        return out
 
     def status(self) -> dict:
         eng = self.engine

@@ -50,6 +50,7 @@ import numpy as np
 
 from ..observability.metrics import REGISTRY as _REG
 from ..observability.sentry import sentry as _sentry
+from ..observability.tracing import TRACER as _TRACE, TraceContext
 from .digest import PrefixDigest
 from .fair import TenantFairPolicy
 from .robust import AllReplicasDown, LoadShedder
@@ -85,6 +86,14 @@ class FabricRequest:
     last_emit_t: float = 0.0
     done_t: float = 0.0
     itl_gaps: List[float] = field(default_factory=list)
+    # distributed tracing (ISSUE 19): ``trace`` is the request's span
+    # (child of the frontdoor root, or itself a root on a bare fabric),
+    # ``tqueue`` the open queue-wait span, ``tctx`` the wire dict
+    # replica payloads carry. All None on untraced requests — every
+    # tracing touch on the hot path guards on that one attribute.
+    trace: Optional[object] = None
+    tqueue: Optional[object] = None
+    tctx: Optional[dict] = None
 
 
 class ServingFabric:
@@ -173,7 +182,8 @@ class ServingFabric:
                ttft_deadline_ms: Optional[float] = None,
                deadline_ms: Optional[float] = None,
                rseed: Optional[int] = None,
-               replay: Optional[List[int]] = None) -> int:
+               replay: Optional[List[int]] = None,
+               trace: Optional[TraceContext] = None) -> int:
         """Queue one request; returns its fabric id. ``knobs`` (optional
         dict of do_sample/temperature/top_k/top_p/eos_token_id)
         overrides the replica engines' default GenerationConfig. The
@@ -209,6 +219,18 @@ class ServingFabric:
         if replay:
             req.delivered = [int(t) for t in replay]
         req.submit_t = time.perf_counter()
+        if _TRACE.enabled:
+            # ``trace`` (the frontdoor root's context) parents this
+            # request's span; a bare fabric submit mints its own root.
+            # The queue span opens NOW: fair-admission wait is part of
+            # the queue hop, readmissions add sibling queue spans.
+            sp = _TRACE.start("fabric::request", parent=trace,
+                              tags={"fid": req.fid,
+                                    "tenant": req.tenant})
+            req.trace = sp
+            req.tctx = sp.ctx.to_wire()
+            req.tqueue = _TRACE.start("fabric::queue", parent=sp,
+                                      tags={"readmission": 0})
         self._fid += 1
         self._reqs[req.fid] = req
         self._queue.append(req)
@@ -237,7 +259,39 @@ class ServingFabric:
                 pass                # transport can't cancel: the slot
                                     # is reaped with the replica instead
         req.state, req.error = "failed", error
+        self._trace_done(req)
         return True
+
+    # -- tracing hooks (ISSUE 19) --------------------------------------------
+
+    @staticmethod
+    def _trace_done(req: FabricRequest, **tags) -> None:
+        """Terminal state: close the request's open spans exactly once.
+        Ending the span completes the trace when the fabric owns the
+        root (no frontdoor above)."""
+        sp = req.trace
+        if sp is None:
+            return
+        req.trace = None
+        q = req.tqueue
+        if q is not None:
+            req.tqueue = None
+            q.tag(outcome=req.state).end()
+        sp.tag(state=req.state,
+               error=req.error, readmissions=req.readmissions, **tags)
+        sp.end()
+
+    @staticmethod
+    def _trace_route(req: FabricRequest, t0: float, name: str,
+                     how: str) -> None:
+        """The route DECISION as a span: [dispatch-pass entry → submit
+        accepted], tagged with the policy's verdict (affinity hit /
+        spill / cold / rr / ll / prefill / disagg)."""
+        if req.trace is not None and _TRACE.enabled:
+            sp = _TRACE.start("fabric::route", parent=req.trace,
+                              start=t0, tags={"replica": name,
+                                              "how": how})
+            sp.end()
 
     def has_work(self) -> bool:
         return any(r.state not in ("done", "failed")
@@ -578,6 +632,7 @@ class ServingFabric:
     def _dispatch(self, req: FabricRequest) -> bool:
         """Route + submit ``req``; False when nothing can take it this
         pass (it stays queued)."""
+        t_route = time.time() if req.trace is not None else 0.0
         # brownout (shed level 2): cold expensive prefills WAIT — the
         # skip loop keeps cheap/warm requests flowing and running
         # decodes keep their ITL; fairness still orders the wait
@@ -607,6 +662,8 @@ class ServingFabric:
                 name = self._least_loaded(prefills)
                 if not self._submit_to(req, name, prefill=True):
                     return False
+                if req.state != "failed":
+                    self._trace_route(req, t_route, name, "prefill")
                 if req.state != "failed" and _REG.enabled:
                     _REG.counter("pt_fabric_routed_total",
                                  "requests routed to a replica").inc(
@@ -642,6 +699,7 @@ class ServingFabric:
             return False
         if req.state == "failed":
             return True              # rejected at submit: consumed
+        self._trace_route(req, t_route, name, how)
         if how == "affinity":
             self.affinity_hits += 1
         elif how == "spill":
@@ -664,9 +722,17 @@ class ServingFabric:
                    "knobs": req.knobs,
                    "replay": (None if prefill or not req.delivered
                               else list(req.delivered))}
+        asp = None
+        if req.trace is not None and _TRACE.enabled:
+            payload["trace"] = req.tctx
+            asp = _TRACE.start("fabric::submit", parent=req.trace,
+                               tags={"replica": name,
+                                     "attempt": req.readmissions})
         try:
             rid = self.transport.submit(name, payload)
         except ReplicaDown:
+            if asp is not None:
+                asp.tag(outcome="replica_down").end()
             self._on_replica_down(name)
             return False
         except (ValueError, RuntimeError) as e:
@@ -677,11 +743,20 @@ class ServingFabric:
             # surfaces through run()/stats(); the pass continues.
             req.state = "failed"
             req.error = f"{name}: {e}"
+            if asp is not None:
+                asp.tag(outcome="rejected").end()
+                self._trace_done(req)
             if _REG.enabled:
                 _REG.counter("pt_fabric_rejected_total",
                              "requests a replica rejected at submit"
                              ).inc(replica=name, **self._flabels)
             return True            # consumed: remove from the queue
+        if asp is not None:
+            asp.tag(outcome="ok", rid=int(rid)).end()
+            q = req.tqueue
+            if q is not None and not prefill:
+                req.tqueue = None
+                q.tag(outcome="admitted", replica=name).end()
         req.state = "prefill" if prefill else "decode"
         req.replica = name
         req.local_rid = int(rid)
@@ -707,6 +782,10 @@ class ServingFabric:
             except (ValueError, RuntimeError) as e:
                 self._app_error(name, "poll", e)
                 continue
+            if _TRACE.enabled and res.get("spans"):
+                # replica-side spans piggyback on poll responses; the
+                # router (which owns the roots) stitches them in
+                _TRACE.ingest(res["spans"])
             now = time.perf_counter()
             arrived: Dict[int, List[int]] = {}
             for rid, tok in res.get("emitted", ()):
@@ -721,6 +800,8 @@ class ServingFabric:
                 arrived.setdefault(fid, []).append(int(tok))
             for fid, toks in arrived.items():
                 req = self._reqs[fid]
+                if req.trace is not None:
+                    req.trace.event("tok", n=len(toks))
                 req.delivered.extend(toks)
                 if req.first_tok_t == 0.0:
                     req.first_tok_t = now
@@ -746,6 +827,7 @@ class ServingFabric:
                     req.delivered = [int(t) for t in toks]
                     req.state = "done"
                     req.done_t = now
+                    self._trace_done(req, replica=name)
                     self._latencies.append(
                         (req.first_tok_t - req.submit_t,
                          req.done_t - req.submit_t, len(toks)))
@@ -763,12 +845,18 @@ class ServingFabric:
         from them."""
         req.prefill_done = True
         payload = None
+        hsp = None
+        if req.trace is not None and _TRACE.enabled:
+            hsp = _TRACE.start("fabric::handoff_extract",
+                               parent=req.trace, tags={"src": src})
         try:
             payload = self.transport.extract(src, req.prompt)
         except ReplicaDown:
             self._on_replica_down(src)
         except ValueError:
             payload = None
+        if hsp is not None:
+            hsp.tag(ok=payload is not None).end()
         cands = [n for n in self._serving_names() if n != src] \
             or self._serving_names()
         if not cands:
@@ -779,6 +867,11 @@ class ServingFabric:
             return
         name, _how = self._pick(req, cands)
         if payload is not None:
+            adp = None
+            if req.trace is not None and _TRACE.enabled:
+                adp = _TRACE.start("fabric::handoff_adopt",
+                                   parent=req.trace,
+                                   tags={"src": src, "dst": name})
             try:
                 adopted = self.transport.adopt(name, payload)
                 self.handoffs += 1
@@ -786,6 +879,9 @@ class ServingFabric:
                           + np.asarray(payload["tokens"]).nbytes)
                 self.handoff_bytes += nbytes
                 req.handoff_pages = int(adopted)
+                if adp is not None:
+                    adp.tag(outcome="ok", pages=int(adopted),
+                            nbytes=nbytes).end()
                 if _REG.enabled:
                     _REG.counter("pt_fabric_handoffs_total",
                                  "prefill→decode KV-page handoffs").inc(
@@ -794,6 +890,8 @@ class ServingFabric:
                                  "KV bytes moved by handoffs").inc(
                         nbytes, src=src, dst=name, **self._flabels)
             except ReplicaDown:
+                if adp is not None:
+                    adp.tag(outcome="replica_down").end()
                 self._on_replica_down(name)
                 self.handoff_failures += 1
                 self._fail_handoff_counter()
@@ -804,6 +902,8 @@ class ServingFabric:
             except (ValueError, RuntimeError):
                 # corrupt transfer or a pool that can't hold the pages:
                 # serve COLD rather than stall the request
+                if adp is not None:
+                    adp.tag(outcome="failed").end()
                 self.handoff_failures += 1
                 self._fail_handoff_counter()
         else:
@@ -854,6 +954,12 @@ class ServingFabric:
             req.state, req.replica, req.local_rid = "queued", None, None
             req.readmissions += 1
             self.readmitted += 1
+            if req.trace is not None and _TRACE.enabled:
+                req.trace.event("replica_down")
+                if req.tqueue is None:   # sibling queue span: the wait
+                    req.tqueue = _TRACE.start(   # after re-admission
+                        "fabric::queue", parent=req.trace,
+                        tags={"readmission": req.readmissions})
             self._queue.appendleft(req)
             if _REG.enabled:
                 _REG.counter(

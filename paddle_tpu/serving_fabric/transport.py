@@ -53,12 +53,21 @@ class FabricTransport:
         raise NotImplementedError
 
     def submit(self, name: str, req: dict) -> int:
-        """Queue a request payload on ``name``; returns its local rid."""
+        """Queue a request payload on ``name``; returns its local rid.
+
+        Trace propagation contract (ISSUE 19): when distributed tracing
+        is on, ``req`` carries a JSON-safe ``"trace"`` key (the wire
+        form of :class:`~..observability.tracing.TraceContext`) that
+        every transport must deliver verbatim — explicit context
+        injection is what lets replica-side spans stitch under the
+        router's tree across a process boundary."""
         raise NotImplementedError
 
     def poll(self, name: str) -> dict:
         """Advance ``name`` one scheduler tick; returns
-        ``{"emitted": [[rid, tok], ...], "finished": {rid: [tokens]}}``."""
+        ``{"emitted": [[rid, tok], ...], "finished": {rid: [tokens]}}``
+        plus, when tracing is on, ``"spans"``: finished replica-side
+        span dicts piggybacking home for the router to ingest."""
         raise NotImplementedError
 
     def status(self, name: str) -> dict:

@@ -1,0 +1,436 @@
+"""Distributed request tracing (ISSUE 19): context propagation across
+the TCP transport, critical-path attribution, the zero-cost-when-
+disabled contract, chaos sibling/orphan spans, and the incident loop.
+
+Engine-free: a FakeReplica speaks the fabric verb set and emits
+replica-side spans from its OWN Tracer instance — over TCP that is a
+faithful stand-in for a remote process (the spans can only reach the
+router's tracer through the poll piggyback on the JSON wire). No model,
+no jit — the real-engine stitched trace runs in the load-test smoke."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.analysis import critical_path as cp
+from paddle_tpu.observability import tracing as tz
+from paddle_tpu.observability.tracing import TRACER, Tracer
+from paddle_tpu.serving_fabric import (BreakerTransport, InProcTransport,
+                                       ServingFabric)
+from paddle_tpu.serving_fabric.transport import (TcpReplicaServer,
+                                                 TcpTransport)
+from paddle_tpu.testing.chaos import kill_replica
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeReplica:
+    """The fabric verb set without an engine. ``tracer`` plays the
+    replica process's tracer: replica::queue/prefill/decode spans are
+    parented on the wire context from the payload's ``trace`` key and
+    shipped home via the poll piggyback — exactly Replica.poll's
+    contract. One token per poll keeps tok-event gaps real."""
+
+    def __init__(self, tracer, name):
+        self.tracer = tracer
+        self.name = name
+        self._rid = 0
+        self._live = {}
+
+    def submit(self, req):
+        self._rid += 1
+        rid = self._rid
+        ctx = req.get("trace")
+        if ctx is not None and self.tracer.enabled:
+            qsp = self.tracer.start("replica::queue", parent=ctx,
+                                    tags={"rid": rid})
+            qsp.tag(outcome="admitted").end()
+            psp = self.tracer.start("replica::prefill", parent=ctx,
+                                    tags={"kind": "full"})
+            time.sleep(0.002)
+            psp.end()
+        self._live[rid] = {"ctx": ctx, "left": int(req["max_new_tokens"]),
+                           "prompt": list(req["prompt"]), "out": [],
+                           "last": time.time()}
+        return rid
+
+    def poll(self):
+        emitted, finished = [], {}
+        for rid, st in list(self._live.items()):
+            time.sleep(0.001)
+            tok = 100 + len(st["out"])
+            st["out"].append(tok)
+            st["left"] -= 1
+            emitted.append([rid, tok])
+            if st["ctx"] is not None and self.tracer.enabled:
+                now = time.time()
+                sp = self.tracer.start("replica::decode",
+                                       parent=st["ctx"],
+                                       start=st["last"], tags={"n": 1})
+                sp.end(now)
+                st["last"] = now
+            if st["left"] <= 0:
+                finished[rid] = list(st["out"])
+                del self._live[rid]
+        out = {"emitted": emitted, "finished": finished}
+        if self.tracer.enabled:
+            spans = self.tracer.drain_for_wire()
+            if spans:
+                out["spans"] = spans
+        return out
+
+    def status(self):
+        return {"name": self.name, "role": "both", "max_batch": 4,
+                "active": len(self._live),
+                "free_slots": 4 - len(self._live), "queued": 0,
+                "free_pages": 64, "total_pages": 64, "itl_p99_s": None,
+                "ttft_p99_s": None, "prefix_hit_rate": None,
+                "digest": None}
+
+    def extract(self, tokens):
+        return None
+
+    def adopt(self, payload):
+        return 0
+
+    def cancel(self, rid):
+        self._live.pop(int(rid), None)
+        return True
+
+    def configure(self, knobs):
+        return {}
+
+
+@pytest.fixture
+def traced():
+    TRACER.enable()
+    yield TRACER
+    TRACER.disable()
+
+
+# -- stitching across the TCP transport --------------------------------------
+
+def test_tcp_transport_stitches_one_trace(traced):
+    """The acceptance path: trace context injected router-side crosses
+    the JSON wire in the payload, the replica's spans come back on the
+    poll piggyback, and the router assembles ONE trace whose span tree
+    covers both sides of the hop."""
+    remote = Tracer().enable()            # the "other process"
+    srv = TcpReplicaServer(FakeReplica(remote, "fr0")).start()
+    tr = TcpTransport({"fr0": ("127.0.0.1", srv.port)},
+                      connect_timeout_s=2.0, op_timeout_s=5.0)
+    try:
+        fab = ServingFabric(tr, policy="round-robin")
+        fids = [fab.submit([1, 2, 3], 4) for _ in range(2)]
+        out = fab.run()
+        assert all(len(out[f]) > 0 for f in fids)
+    finally:
+        tr.close()
+        srv.stop()
+    traces = TRACER.take_completed()
+    assert len(traces) == 2
+    for t in traces:
+        names = [s["name"] for s in t["spans"]]
+        # router-side spans
+        assert t["summary"]["name"] == "fabric::request"
+        assert "fabric::queue" in names and "fabric::submit" in names
+        assert "fabric::route" in names
+        # replica-side spans — only reachable via the wire piggyback
+        # (they were born in a DIFFERENT tracer instance)
+        assert "replica::queue" in names
+        assert "replica::prefill" in names
+        assert "replica::decode" in names
+        # one trace_id end to end, and the clean path flags nothing
+        assert {s["trace_id"] for s in t["spans"]} == {t["trace_id"]}
+        assert not any(s["tags"].get("unfinished") or
+                       s["tags"].get("orphan") for s in t["spans"])
+        # the queue span closed on admission, tagged with the replica
+        qs = [s for s in t["spans"] if s["name"] == "fabric::queue"]
+        assert qs[0]["tags"]["outcome"] == "admitted"
+        assert qs[0]["tags"]["replica"] == "fr0"
+        # TTFT measured (tok events) and attributed to real hops
+        att = cp.attribute_trace(t)
+        assert att["ttft_s"] and att["ttft_s"] > 0
+        assert "queue" in att["ttft_hops"]
+        assert {"admission", "prefill", "decode"} & set(att["ttft_hops"])
+    # the replica tracer shipped everything: no foreign residue
+    assert remote.stats()["active_traces"] == 0
+    assert remote.recent_traces() == []
+
+
+def test_full_tcp_path_frontdoor_to_replica(traced):
+    """The acceptance shape end to end with TCP at BOTH edges: a
+    streaming client hits the FrontDoor (TCP), the router reaches the
+    replica over TcpTransport (TCP), and one trace — joined to the
+    client-supplied trace_id — stitches accept → queue → dispatch →
+    replica admission/prefill/decode → stream drain, with >=95% of the
+    measured TTFT attributed to named hops."""
+    from paddle_tpu.serving_fabric import FabricClient, FrontDoor
+    remote = Tracer().enable()
+    srv = TcpReplicaServer(FakeReplica(remote, "fr0")).start()
+    tr = TcpTransport({"fr0": ("127.0.0.1", srv.port)},
+                      connect_timeout_s=2.0, op_timeout_s=5.0)
+    door = FrontDoor(ServingFabric(tr, policy="round-robin")).start()
+    try:
+        client = FabricClient("127.0.0.1", door.port)
+        res = client.generate([1, 2, 3], 6, trace_id="cafe0123cafe0123")
+        assert len(res.tokens) == 6
+        deadline = time.time() + 5.0
+        while not TRACER.recent_traces() and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        door.stop()
+        tr.close()
+        srv.stop()
+    [t] = TRACER.take_completed()
+    assert t["trace_id"] == "cafe0123cafe0123"   # client-owned join
+    names = [s["name"] for s in t["spans"]]
+    assert t["summary"]["name"] == "frontdoor::request"
+    for pref in ("frontdoor::submit", "fabric::request", "fabric::queue",
+                 "replica::queue", "replica::prefill", "replica::decode",
+                 "frontdoor::drain"):
+        assert any(n.startswith(pref) for n in names), f"missing {pref}"
+    att = cp.attribute_trace(t)
+    assert att["ttft_s"] and att["ttft_s"] > 0
+    named = 1.0 - att["ttft_frac"].get("untracked", 0.0)
+    assert named >= 0.95
+
+
+def test_trace_context_wire_roundtrip():
+    ctx = tz.TraceContext("abc123", "def456")
+    assert json.loads(json.dumps(ctx.to_wire())) == ctx.to_wire()
+    back = tz.TraceContext.from_wire(json.loads(json.dumps(
+        ctx.to_wire())))
+    assert (back.trace_id, back.span_id) == ("abc123", "def456")
+    # tolerant extraction: junk means "untraced", never an error
+    assert tz.TraceContext.from_wire(None) is None
+    assert tz.TraceContext.from_wire({"trace_id": ""}) is None
+    assert tz.TraceContext.from_wire("garbage") is None
+
+
+# -- orphans / unfinished flagged, not dropped -------------------------------
+
+def test_orphan_and_unfinished_spans_flagged(traced):
+    root = TRACER.start("frontdoor::request")
+    TRACER.start("fabric::submit", parent=root)      # never ended
+    # a crashed replica shipped a span whose PARENT died with it
+    TRACER.ingest([{"trace_id": root.trace_id, "span_id": "zz",
+                    "parent_id": "lost-with-the-replica",
+                    "name": "replica::decode", "start": root.start,
+                    "end": root.start + 0.01, "pid": 9999,
+                    "tags": {}, "events": []}])
+    root.end()
+    [t] = TRACER.take_completed()
+    by = {s["name"]: s for s in t["spans"]}
+    assert by["fabric::submit"]["tags"]["unfinished"] is True
+    assert by["fabric::submit"]["end"] is None
+    assert by["replica::decode"]["tags"]["orphan"] is True
+    # orphans attribute DEEPER than the root (depth 1), not nowhere
+    depths = cp.span_depths(t)
+    assert depths["zz"] == 1
+
+
+# -- chaos: failover sibling spans -------------------------------------------
+
+def test_failover_readmission_sibling_spans(traced):
+    """Kill a replica mid-decode: the lost request re-queues (sibling
+    fabric::queue span tagged with the readmission), resubmits through
+    the breaker (sibling breaker::attempt + fabric::submit spans), and
+    the completed trace carries the whole story."""
+    reps = [FakeReplica(TRACER, "c0"), FakeReplica(TRACER, "c1")]
+    br = BreakerTransport(InProcTransport(reps))
+    fab = ServingFabric(br, policy="round-robin")
+    fids = [fab.submit([1, 2, 3, 4], 6) for _ in range(4)]
+    fab.step()                            # admit everywhere, first toks
+    kill_replica(br, "c0")
+    out = fab.run()
+    assert all(len(out[f]) > 0 for f in fids)
+    traces = TRACER.take_completed()
+    assert len(traces) == 4
+    moved = [t for t in traces
+             if t["summary"]["tags"].get("readmissions", 0) >= 1]
+    assert moved, "no request was readmitted after the kill"
+    for t in moved:
+        names = [s["name"] for s in t["spans"]]
+        # sibling queue spans: original admission + the re-queue wait
+        qs = [s for s in t["spans"] if s["name"] == "fabric::queue"]
+        assert len(qs) >= 2
+        assert any(s["tags"].get("readmission", 0) >= 1 for s in qs)
+        # sibling attempt spans through the breaker, tagged per outcome
+        at = [s for s in t["spans"] if s["name"] == "breaker::attempt"]
+        assert len(at) >= 2
+        assert sum(s["tags"].get("outcome") == "ok" for s in at) >= 2
+        assert names.count("fabric::submit") >= 2
+        # the death itself is stamped on the request span
+        fr = [s for s in t["spans"] if s["name"] == "fabric::request"]
+        assert any(e[1] == "replica_down" for e in fr[0]["events"])
+
+
+# -- zero-cost when disabled -------------------------------------------------
+
+def test_zero_cost_when_disabled(monkeypatch):
+    """The regression gate counts Span CONSTRUCTIONS, not wall clock: a
+    full fabric wave with tracing off must allocate zero spans. The
+    same shim then proves the enabled path is what it counts."""
+    assert not TRACER.enabled
+    built = {"n": 0}
+    orig = tz.Span.__init__
+
+    def counting(self, *a, **kw):
+        built["n"] += 1
+        orig(self, *a, **kw)
+
+    monkeypatch.setattr(tz.Span, "__init__", counting)
+    rep = FakeReplica(TRACER, "z0")
+    fab = ServingFabric(InProcTransport([rep]), policy="round-robin")
+    fids = [fab.submit([1, 2, 3], 4) for _ in range(3)]
+    fab.run()
+    assert built["n"] == 0, "tracing-off hot path allocated Spans"
+    assert TRACER.start("x") is None      # the None-return contract
+    TRACER.enable()
+    try:
+        fids = [fab.submit([1, 2, 3], 4) for _ in range(2)]
+        fab.run()
+        assert built["n"] > 0             # the shim counts the real path
+        assert len(TRACER.take_completed()) == 2
+    finally:
+        TRACER.disable()
+
+
+# -- critical-path attribution (exact, synthetic timestamps) -----------------
+
+def _mk_trace(tr, t0, queue_s=0.60):
+    """One hand-timed trace: TTFT = 1.0s split queue/prefill/decode/
+    admission/dispatch with a known untracked residual of zero."""
+    root = tr.start("frontdoor::request", start=t0)
+    acc = tr.start("frontdoor::submit", parent=root, start=t0)
+    freq = tr.start("fabric::request", parent=root, start=t0 + 0.01)
+    q = tr.start("fabric::queue", parent=freq, start=t0 + 0.02)
+    sub = tr.start("fabric::submit", parent=freq, start=t0 + queue_s + 0.02)
+    rq = tr.start("replica::queue", parent=freq, start=t0 + queue_s + 0.04)
+    pf = tr.start("replica::prefill", parent=freq,
+                  start=t0 + queue_s + 0.08)
+    dec = tr.start("replica::decode", parent=freq,
+                   start=t0 + queue_s + 0.28)
+    acc.end(t0 + 0.02)
+    q.tag(outcome="admitted").end(t0 + queue_s + 0.02)
+    sub.end(t0 + queue_s + 0.04)
+    rq.end(t0 + queue_s + 0.08)
+    pf.end(t0 + queue_s + 0.28)
+    dec.end(t0 + queue_s + 0.39)
+    freq.event("tok", ts=t0 + 1.0, n=1)
+    freq.end(t0 + 1.1)
+    root.event("first_tok", ts=t0 + 1.0)
+    root.end(t0 + 1.2)
+    return root.trace_id
+
+
+def test_attribution_exact_and_95pct_named(traced):
+    t0 = time.time() - 60.0
+    _mk_trace(TRACER, t0)
+    [t] = TRACER.take_completed()
+    assert t["summary"]["ttft_s"] == pytest.approx(1.0)
+    att = cp.attribute_trace(t)
+    assert att["ttft_s"] == pytest.approx(1.0)
+    h = att["ttft_hops"]
+    assert h["queue"] == pytest.approx(0.60, abs=1e-6)
+    assert h["prefill"] == pytest.approx(0.20, abs=1e-6)
+    assert h["decode"] == pytest.approx(0.11, abs=1e-6)
+    assert h["admission"] == pytest.approx(0.04, abs=1e-6)
+    assert h["dispatch"] == pytest.approx(0.02, abs=1e-6)
+    # everything between named spans belongs to a named hop: the
+    # acceptance bound (>=95% of TTFT on named hops) holds with margin
+    named = 1.0 - att["ttft_frac"].get("untracked", 0.0)
+    assert named >= 0.95
+    assert sum(h.values()) == pytest.approx(1.0, abs=1e-6)
+    # rendering smoke: table + tree mention the hot hop
+    agg = cp.aggregate([t])
+    assert agg["queue"]["n"] == 1
+    assert "queue" in cp.format_table(agg)
+    assert "fabric::queue" in cp.format_span_tree(t)
+
+
+def test_trace_gauges_feed_sentry_incident_with_attached_trace():
+    """The closed loop: completing a queue-heavy trace publishes
+    pt_trace_ttft_frac{hop=queue}; the tracing rule pack breaches on
+    it; the incident carries the worst complete trace as evidence."""
+    from paddle_tpu.observability.metrics import REGISTRY
+    from paddle_tpu.observability.sentry import SloSentry
+    from paddle_tpu.observability.sentry.rules import tracing_rules
+    was = REGISTRY.enabled
+    REGISTRY.enable()
+    TRACER.enable()
+    try:
+        _mk_trace(TRACER, time.time() - 60.0, queue_s=0.60)
+        g = [e for e in REGISTRY.collect()
+             if e["name"] == "pt_trace_ttft_frac"
+             and e["labels"].get("hop") == "queue"]
+        assert g and g[0]["value"] == pytest.approx(0.60, abs=1e-6)
+        sentry = SloSentry(tracing_rules(queue_frac_ceiling=0.5,
+                                         breach_for=1, cooldown_s=0.0))
+        fired = sentry.tick()
+        assert [i.rule for i in fired] == ["trace_ttft_frac_queue"]
+        att = fired[0].context.get("attached_traces")
+        assert att and att[0]["summary"]["ttft_s"] == pytest.approx(1.0)
+        # non-latency incidents must NOT inherit the attachment (the
+        # shared per-tick context is copied before mutation)
+        assert "attached_traces" not in SloSentry._context({})
+    finally:
+        TRACER.disable()
+        REGISTRY.enabled = was
+
+
+# -- report CLI / exports ----------------------------------------------------
+
+def test_trace_report_cli_and_chrome_export(tmp_path, capsys):
+    d = str(tmp_path / "tr")
+    tr = Tracer().enable(dir=d)
+    t0 = time.time() - 120.0
+    _mk_trace(tr, t0, queue_s=0.60)
+    _mk_trace(tr, t0 + 10.0, queue_s=0.30)
+    assert os.path.exists(os.path.join(d, "traces.jsonl"))
+    # the loader round-trips what the tracer appended
+    traces = cp.load_trace_dir(d)
+    assert len(traces) == 2
+
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import trace_report
+        chrome = str(tmp_path / "worst.json")
+        assert trace_report.main([d, "--worst", "1",
+                                  "--chrome", chrome]) == 0
+        txt = capsys.readouterr().out
+        assert "queue" in txt and "trace " in txt
+        with open(chrome, "r", encoding="utf-8") as f:
+            ct = json.load(f)
+        assert ct["traceEvents"]
+        assert any(e["cat"] == "queue" for e in ct["traceEvents"])
+        assert all(e["ph"] == "X" for e in ct["traceEvents"])
+        # machine-readable mode parses and agrees on the hot hop
+        assert trace_report.main([d, "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["n_traces"] == 2
+        assert rep["worst"][0]["ttft_frac"]["queue"] == pytest.approx(
+            0.60, abs=1e-6)
+    finally:
+        sys.path.remove(tools)
+
+
+def test_flight_recorder_dump_carries_recent_traces(tmp_path):
+    from paddle_tpu.observability.flight_recorder import FlightRecorder
+    TRACER.enable()
+    try:
+        _mk_trace(TRACER, time.time() - 30.0)
+        rec = FlightRecorder(dir=str(tmp_path))
+        path = rec.dump("test")
+        with open(path, "r", encoding="utf-8") as f:
+            dump = json.load(f)
+        assert len(dump["recent_traces"]) == 1
+        assert dump["recent_traces"][0]["summary"]["n_spans"] == 8
+    finally:
+        TRACER.disable()

@@ -137,12 +137,14 @@ def _slow_loris(door, *, sid, n_tokens=48):
 def smoke(ttft_ceiling_s: float = 30.0) -> dict:
     from paddle_tpu.observability.metrics import REGISTRY
     from paddle_tpu.observability.sentry import SloSentry, frontdoor_rules
+    from paddle_tpu.observability.tracing import TRACER
     from paddle_tpu.serving_fabric import (FabricClient, LoadShedder,
                                            TenantFairPolicy, TenantSpec)
     from paddle_tpu.testing.chaos import hang_replica, unhang_replica
 
     was_enabled = REGISTRY.enabled
     REGISTRY.enable()
+    TRACER.enable()          # the smoke wave runs traced (ISSUE 19)
     errors = []
     model = _tiny_model()
     fair = TenantFairPolicy({"prod": TenantSpec(weight=2.0),
@@ -297,6 +299,43 @@ def smoke(ttft_ceiling_s: float = 30.0) -> dict:
         if ttft_inc:
             errors.append("frontdoor_ttft_p99_ceiling sentry fired")
 
+        # distributed tracing (ISSUE 19): the wave must leave complete
+        # stitched traces — frontdoor accept through replica
+        # prefill/decode to stream drain — with >=95% of some request's
+        # TTFT attributed to NAMED hops (the acceptance bound)
+        traces = TRACER.recent_traces()
+        trace_report = ""
+        named = []
+        if not traces:
+            errors.append("tracing produced no complete traces")
+        else:
+            from paddle_tpu.analysis import critical_path as cp
+            agg = cp.aggregate(traces)
+            for t in traces:
+                att = cp.attribute_trace(t)
+                if att["ttft_s"]:
+                    named.append(
+                        1.0 - att["ttft_frac"].get("untracked", 0.0))
+            full = max(traces,
+                       key=lambda t: len({s["name"].split("::")[0]
+                                          for s in t["spans"]}))
+            names = {s["name"] for s in full["spans"]}
+            for pref in ("frontdoor::request", "frontdoor::submit",
+                         "fabric::queue", "replica::queue",
+                         "replica::prefill", "replica::decode",
+                         "frontdoor::drain"):
+                if not any(n.startswith(pref) for n in names):
+                    errors.append(f"stitched trace missing {pref} spans")
+            if not named or max(named) < 0.95:
+                errors.append(
+                    f"TTFT attribution never reached 95% named hops "
+                    f"(best {max(named) if named else None})")
+            worst = max(traces,
+                        key=lambda t: t["summary"].get("ttft_s") or 0.0)
+            trace_report = (cp.format_table(agg) + "\n\n"
+                            + cp.format_span_tree(worst))
+            print(trace_report, file=sys.stderr)
+
         summary = {
             "ok": not errors,
             "completed": len(results),
@@ -308,10 +347,14 @@ def smoke(ttft_ceiling_s: float = 30.0) -> dict:
             "hang": hang_report,
             "ttft_p99_s": round(lat.get("ttft_p99_s", 0.0), 4),
             "ttft_ceiling_s": ttft_ceiling_s,
+            "traces": len(traces),
+            "trace_ttft_named_frac_best": (round(max(named), 4)
+                                           if named else None),
             "errors": errors,
         }
     finally:
         door.stop()
+        TRACER.disable()
         REGISTRY.enabled = was_enabled
     return summary
 
@@ -440,6 +483,52 @@ def hang_leg(model, *, poll_budget_s: float, n_requests: int = 4,
                 "trips": br.trips}
     finally:
         unhang_replica(br, victim)
+
+
+def trace_overhead_legs(model, *, rounds: int = 3, n_requests: int = 6,
+                        max_new: int = 8, seed: int = 13) -> dict:
+    """Wall time of one fabric wave with request tracing ON vs OFF,
+    interleaved min-of-rounds on the SAME warmed fabric (same discipline
+    as the bench's obs_overhead_ratio). The ratio prices the span
+    machinery end-to-end — router queue/route/submit spans, engine
+    queue/resident/prefill/decode spans — against the disabled path's
+    attribute-load-plus-branch contract."""
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.observability.tracing import TRACER
+    from paddle_tpu.serving_fabric import (InProcTransport, ServingFabric,
+                                           build_replicas)
+    reps = build_replicas(
+        model, 2, page_size=8, max_len=96, max_batch=2,
+        names=["tro0", "tro1"],
+        generation_config=GenerationConfig(max_new_tokens=max_new,
+                                           do_sample=False))
+    fab = ServingFabric(InProcTransport(reps), policy="round-robin")
+    prompts = _prompts(n_requests, seed=seed)
+
+    def wave():
+        fids = [fab.submit(p, max_new) for p in prompts]
+        got = fab.run()
+        assert all(len(got[f]) == max_new for f in fids)
+
+    wave()                                    # pay the jit compiles once
+    legs = {"off": float("inf"), "on": float("inf")}
+    n_traces = 0
+    try:
+        for _ in range(rounds):
+            TRACER.disable()
+            t0 = time.perf_counter()
+            wave()
+            legs["off"] = min(legs["off"], time.perf_counter() - t0)
+            TRACER.enable()
+            t0 = time.perf_counter()
+            wave()
+            legs["on"] = min(legs["on"], time.perf_counter() - t0)
+            n_traces += len(TRACER.take_completed())
+    finally:
+        TRACER.disable()
+    return {"wall_on_s": legs["on"], "wall_off_s": legs["off"],
+            "ratio": legs["on"] / max(legs["off"], 1e-9),
+            "traces": n_traces}
 
 
 # -- CLI ---------------------------------------------------------------------
