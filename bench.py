@@ -1795,6 +1795,109 @@ def _fsdp_probe(on_tpu):
     return out
 
 
+def _moe_ep_probe(on_tpu):
+    """Expert-parallelism rows (ISSUE 20), micro MoE model.
+
+    ``moe_ep_step_speedup`` — replicated-experts dp2 ÷ dp2_ep2 measured
+    step time at EQUAL devices and experts (interleaved min-of-rounds
+    via the planner's rank-order measurement; the ep leg pays the
+    all-to-all, buys per-rank expert HBM). ``moe_ep_a2a_pred_over_
+    measured`` — the priced census's per-a2a seconds ÷ a wall-clock
+    shard_map all-to-all of the same dispatch buffer on the same mesh
+    (cost-model drift for the NEW collective, healthy ~1.0 on TPU,
+    nominal on CPU). ``moe_grouped_matmul_speedup`` — XLA ragged_dot ÷
+    Pallas grouped-matmul wall time, interleaved min-of-rounds
+    (interpret mode off-TPU, so the CPU row only proves the kernel
+    path runs; the TPU row is the one the kernel must win)."""
+    out = {}
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import auto_parallel as ap
+    from paddle_tpu.models.moe_lm import MoEConfig
+    mcfg = MoEConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, num_experts=4,
+        num_experts_per_tok=2, num_shared_experts=1,
+        first_k_dense_replace=1, capacity_factor=None,
+        max_position_embeddings=128)
+    cfg_rep = ap.ParallelConfig(dp=2)
+    cfg_ep = ap.ParallelConfig(dp=2, ep=2)
+    try:
+        if jax.device_count() < 2:
+            raise RuntimeError("needs >= 2 devices for the ep=2 mesh")
+        _log("moe-ep: A/B pricing dp2 vs dp2_ep2 on the micro MoE")
+        rep = ap.plan(mcfg, n_devices=2, global_batch=8, seq_len=64,
+                      configs=[cfg_rep, cfg_ep], keep_builds=True,
+                      drift="ignore", model_name="moe-micro")
+        ap.validate_rank_order(rep)
+        meas = {str(pc.config): pc.measured_step_s for pc in rep.ranked}
+        out["moe_ep_step_speedup"] = round(
+            meas[str(cfg_rep)] / meas[str(cfg_ep)], 4)
+        out["moe_ep_step_dp2_s"] = meas[str(cfg_rep)]
+        out["moe_ep_step_ep2_s"] = meas[str(cfg_ep)]
+
+        pc_ep = next(pc for pc in rep.ranked if pc.config.ep > 1)
+        rows = [r for r in pc_ep.graph.priced_census["per_op"]
+                if r["opcode"] == "all-to-all"]
+        if rows and pc_ep.build is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            mesh_ = getattr(pc_ep.build.mesh, "mesh", pc_ep.build.mesh)
+            # the dropless dispatch buffer of THIS config: [e, t_local, d]
+            t_local = 8 * 64 // 2
+            buf = jnp.ones((mcfg.num_experts, t_local, mcfg.hidden_size),
+                           jnp.float32)
+            fn = jax.jit(shard_map(
+                lambda x: jax.lax.all_to_all(
+                    x, "ep", split_axis=0, concat_axis=1, tiled=True),
+                mesh=mesh_, axis_names=frozenset({"ep"}),
+                in_specs=P("ep", None, None),
+                out_specs=P("ep", None, None), check_vma=False))
+            fn(buf).block_until_ready()
+            t_meas = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                fn(buf).block_until_ready()
+                t_meas = min(t_meas, time.perf_counter() - t0)
+            pred_one = sum(r["seconds"] for r in rows) / len(rows)
+            if t_meas > 0:
+                out["moe_ep_a2a_pred_over_measured"] = round(
+                    pred_one / t_meas, 4)
+        out["moe_ep_backend"] = "inline"
+    except Exception as e:
+        out["moe_ep_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    try:
+        from paddle_tpu.ops.pallas import grouped_matmul as gmm
+        m, k, n, g = 512, 128, 128, 4
+        dt = jnp.bfloat16 if on_tpu else jnp.float32
+        rs = np.random.RandomState(0)
+        xs = jnp.asarray(rs.randn(m, k), dt)
+        w = jnp.asarray(rs.randn(g, k, n), dt)
+        gs = jnp.full((g,), m // g, jnp.int32)
+        xla_fn = jax.jit(gmm.xla_grouped_matmul)
+        interp = not on_tpu
+        pal_fn = jax.jit(lambda a, b, s: gmm.grouped_matmul_pallas(
+            a, b, s, interpret=interp))
+        xla_fn(xs, w, gs).block_until_ready()
+        pal_fn(xs, w, gs).block_until_ready()
+        t_xla, t_pal = float("inf"), float("inf")
+        for _ in range(5):       # interleaved min-of-rounds
+            t0 = time.perf_counter()
+            xla_fn(xs, w, gs).block_until_ready()
+            t_xla = min(t_xla, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            pal_fn(xs, w, gs).block_until_ready()
+            t_pal = min(t_pal, time.perf_counter() - t0)
+        out["moe_grouped_matmul_speedup"] = round(t_xla / t_pal, 4)
+        out["moe_grouped_matmul_backend"] = ("pallas-tpu" if on_tpu
+                                             else "pallas-interpret")
+    except Exception as e:
+        out["moe_gmm_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+    return out
+
+
 def _elastic_probe(on_tpu):
     """Elastic scale-in rows (ISSUE 15): a timed mini kill→reshard cycle
     on the micro model. ``elastic_reshard_seconds`` = wall time to
@@ -2094,6 +2197,7 @@ def _run(error_note):
     detail.update(_graph_contracts_probe(on_tpu))
     detail.update(_planner_probe(on_tpu))
     detail.update(_fsdp_probe(on_tpu))
+    detail.update(_moe_ep_probe(on_tpu))
     detail.update(_elastic_probe(on_tpu))
     # noise-aware regression verdict vs the checked-in pinned baseline
     # (ISSUE 10): ratio metrics only, per the bench-variance policy —

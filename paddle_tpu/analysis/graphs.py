@@ -383,6 +383,66 @@ def build_train_step_fsdp() -> BuiltGraph:
         mesh=hm, example_args=args)
 
 
+def build_train_step_moe_ep() -> BuiltGraph:
+    """The expert-parallel MoE train step (ISSUE 20): price the
+    ep-pure dp2_ep2 micro config — the shard_map dispatch path, so the
+    census carries the real ``all-to-all[ep]`` rows, not a GSPMD
+    approximation — compile the step THROUGH the emitted plan and
+    require the emitted census to EXACTLY match the priced one (closed
+    set). A refactor that drops the expert all-to-all (silently
+    replicating experts) or doubles it fails CI as a census diff."""
+    import jax
+
+    if jax.device_count() < 2:
+        raise GraphSkipped("needs >= 2 devices (dp=2/ep=2 subgroup "
+                           "mesh); run under XLA_FLAGS=--xla_force_"
+                           "host_platform_device_count=8")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from ..distributed.auto_parallel import (ParallelConfig,
+                                             price_config)
+    from ..models import MoEForCausalLM
+    from ..models.moe_lm import MoEConfig
+    from ..optimizer import AdamW
+    from ..trainer import Trainer
+
+    cfg = MoEConfig(vocab_size=_VOCAB, hidden_size=_HIDDEN,
+                    intermediate_size=96, moe_intermediate_size=48,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, num_experts=4,
+                    num_experts_per_tok=2, num_shared_experts=1,
+                    first_k_dense_replace=1, capacity_factor=None,
+                    max_position_embeddings=128)
+    priced = price_config(ParallelConfig(dp=2, ep=2), cfg,
+                          devices=jax.devices()[:2], global_batch=4,
+                          seq_len=32, check_memory=False)
+
+    pt.seed(0)
+    model = MoEForCausalLM(cfg)
+    tr = Trainer(model, AdamW(learning_rate=1e-3, parameters=model),
+                 donate=False)
+    hm = tr.apply_plan(priced.plan, devices=jax.devices()[:2])
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (4, 33))
+    with hm:
+        batch = priced.plan.shard_batch(
+            {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}, hm)
+        tr._ensure_built()
+        args = (tr.params, tr.opt_state, batch, tr._lr_scalar(),
+                tr._key_data())
+        compiled = tr._step_jit.lower(*args).compile()
+    return BuiltGraph("train_step_moe_ep", compiled, GraphContract(
+        "train_step_moe_ep",
+        expect_collectives=dict(priced.graph.census_counts),
+        max_host_transfers=0,
+        notes=f"emitted {priced.config} expert-parallel plan == priced "
+              f"census (closed set incl. all-to-all[ep])"),
+        mesh=hm, example_args=args)
+
+
 REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "train_step_k1": build_train_step_k1,
     "train_step_k4": build_train_step_k4,
@@ -394,6 +454,7 @@ REGISTRY: Dict[str, Callable[[], BuiltGraph]] = {
     "tp_fused_ce": build_tp_fused_ce,
     "planner": build_planner,
     "train_step_fsdp": build_train_step_fsdp,
+    "train_step_moe_ep": build_train_step_moe_ep,
 }
 
 

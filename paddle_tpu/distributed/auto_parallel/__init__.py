@@ -25,8 +25,8 @@ from ...parallel.api import (shard_tensor, reshard, shard_layer,
 # the planner surface (ISSUE 11)
 from .planner import (ParallelConfig, PricedConfig, PlanReport,
                       StaleCostModelError, InfeasibleMeshError,
-                      enumerate_configs, price_compiled, price_config,
-                      plan, rank_agreement, check_drift,
+                      enumerate_configs, ep_imbalance, price_compiled,
+                      price_config, plan, rank_agreement, check_drift,
                       validate_rank_order)
 from .memory_model import MemoryEstimate, estimate_hbm, hbm_capacity
 from .emit import ShardingPlan, emit_plan, plan_for_config
@@ -48,7 +48,7 @@ __all__ = ["ProcessMesh", "shard_tensor", "reshard", "shard_layer",
            # planner API
            "ParallelConfig", "PricedConfig", "PlanReport",
            "StaleCostModelError", "InfeasibleMeshError",
-           "enumerate_configs", "price_compiled", "price_config",
-           "plan", "rank_agreement", "check_drift",
+           "enumerate_configs", "ep_imbalance", "price_compiled",
+           "price_config", "plan", "rank_agreement", "check_drift",
            "validate_rank_order", "MemoryEstimate", "estimate_hbm",
            "hbm_capacity", "ShardingPlan", "emit_plan", "plan_for_config"]

@@ -61,10 +61,14 @@ class ShardingPlan:
             n *= v
         devices = (list(devices) if devices is not None
                    else list(jax.devices()))[:n]
+        # axes records MESH sizes: on an ep mesh the "dp" entry is the
+        # already-carved dp/ep, so the build degree is their product
+        ep = self.axes.get("ep", 1)
         return HybridMesh.build(
-            dp=self.axes.get("dp", 1), fsdp=self.axes.get("fsdp", 1),
+            dp=self.axes.get("dp", 1) * ep,
+            fsdp=self.axes.get("fsdp", 1),
             tp=self.axes.get("tp", 1), pp=self.axes.get("pp", 1),
-            sep=self.axes.get("sep", 1), devices=devices)
+            sep=self.axes.get("sep", 1), ep=ep, devices=devices)
 
     # -- application ---------------------------------------------------------
 
@@ -150,7 +154,9 @@ def emit_plan(model, mesh, config) -> ShardingPlan:
     from ...parallel.api import param_spec_tree, _clean_spec
     m = getattr(mesh, "mesh", mesh)
     axes = {name: int(m.shape[name]) for name in m.axis_names}
-    batch_spec = _clean_spec([("dp", "fsdp"), None], m)
+    # the batch spans the full data submesh; _clean_spec drops "ep" on
+    # ep==1 meshes so pre-EP plan artifacts stay byte-identical
+    batch_spec = _clean_spec([("dp", "ep", "fsdp"), None], m)
     return ShardingPlan(
         config_str=str(config),
         axes=axes,
@@ -168,15 +174,22 @@ def plan_for_config(model_cfg, config, devices=None) -> ShardingPlan:
     and only the spec table is needed."""
     import dataclasses
     import jax
-    from ...models import LlamaForCausalLM, LlamaForCausalLMPipe
+    from ...models import (LlamaForCausalLM, LlamaForCausalLMPipe,
+                           MoEForCausalLM)
     from ...parallel.mesh import HybridMesh
     import paddle_tpu as pt
     sep = int(getattr(config, "sep", 1))
-    mcfg = dataclasses.replace(model_cfg, sequence_parallel=sep > 1)
+    is_moe = bool(getattr(model_cfg, "num_experts", 0))
+    if is_moe:
+        mcfg = model_cfg
+    else:
+        mcfg = dataclasses.replace(model_cfg, sequence_parallel=sep > 1)
     pt.seed(0)
     if int(getattr(config, "pp", 1)) > 1:
         model = LlamaForCausalLMPipe(mcfg, num_stages=int(config.pp),
                                      num_microbatches=2)
+    elif is_moe:
+        model = MoEForCausalLM(mcfg)
     else:
         model = LlamaForCausalLM(mcfg)
     devices = (list(devices) if devices is not None
@@ -185,5 +198,6 @@ def plan_for_config(model_cfg, config, devices=None) -> ShardingPlan:
                           fsdp=int(getattr(config, "fsdp", 1)),
                           tp=int(config.tp),
                           pp=int(getattr(config, "pp", 1)), sep=sep,
+                          ep=int(getattr(config, "ep", 1)),
                           devices=devices)
     return emit_plan(model, hm, config)

@@ -112,17 +112,43 @@ class MemoryEstimate:
 
 
 def _param_count(cfg) -> float:
-    """Analytical parameter count of a LlamaConfig-shaped model (matches
-    ``LlamaForCausalLM.num_params`` to the norm vectors)."""
+    """Analytical parameter count of a LlamaConfig- or MoEConfig-shaped
+    model (matches ``LlamaForCausalLM.num_params`` to the norm vectors;
+    for MoE configs the routed/shared expert FFNs replace the dense MLP
+    on the non-dense layers)."""
     H, M, L, V = (cfg.hidden_size, cfg.intermediate_size,
                   cfg.num_hidden_layers, cfg.vocab_size)
     hd = H // cfg.num_attention_heads
     qkv = H * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * hd
-    per_layer = qkv + H * H + 3 * H * M + 2 * H      # attn + mlp + norms
+    attn_norms = qkv + H * H + 2 * H                  # attn + norms
+    n_exp = int(getattr(cfg, "num_experts", 0) or 0)
+    if n_exp:
+        k_dense = int(getattr(cfg, "first_k_dense_replace", 0))
+        shared_w = (int(getattr(cfg, "num_shared_experts", 0))
+                    * cfg.moe_intermediate_size)
+        per_moe = (n_exp * 3 * H * cfg.moe_intermediate_size
+                   + 3 * H * shared_w + H * n_exp)    # experts+shared+gate
+        n = (V * H + H * V + H                        # embed + head + norm
+             + k_dense * (attn_norms + 3 * H * M)
+             + (L - k_dense) * (attn_norms + per_moe))
+        return float(n)
+    per_layer = attn_norms + 3 * H * M                # attn + mlp + norms
     n = V * H + L * per_layer + H                     # embed + layers + norm
     if not getattr(cfg, "tie_word_embeddings", True):
         n += H * V
     return float(n)
+
+
+def _expert_param_count(cfg) -> float:
+    """ROUTED expert FFN params only — the slice the ep axis divides
+    (shared experts and the router gate replicate over ep)."""
+    n_exp = int(getattr(cfg, "num_experts", 0) or 0)
+    if not n_exp:
+        return 0.0
+    L = cfg.num_hidden_layers
+    k_dense = int(getattr(cfg, "first_k_dense_replace", 0))
+    per_expert = 3 * cfg.hidden_size * cfg.moe_intermediate_size
+    return float((L - k_dense) * n_exp * per_expert)
 
 
 def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
@@ -140,13 +166,20 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
     """
     dp, tp, pp, sep = config.dp, config.tp, config.pp, config.sep
     fsdp = int(getattr(config, "fsdp", 1))
+    ep = int(getattr(config, "ep", 1))
     dt = _DTYPE_BYTES.get(getattr(model_cfg, "dtype", "float32"), 4)
     H, M, L = (model_cfg.hidden_size, model_cfg.intermediate_size,
                model_cfg.num_hidden_layers)
 
     shard = float(fsdp * tp * pp)
-    params_b = _param_count(model_cfg) * dt / shard
-    opt_b = _param_count(model_cfg) * 4.0 * opt_slots / shard
+    # expert FFN params/slots/grads additionally divide by ep (each ep
+    # rank stores only its expert slice); everything else replicates
+    # over the ep subgroup exactly like plain dp
+    total_p = _param_count(model_cfg)
+    expert_p = _expert_param_count(model_cfg) if ep > 1 else 0.0
+    dense_p = total_p - expert_p
+    params_b = (dense_p + expert_p / ep) * dt / shard
+    opt_b = (dense_p + expert_p / ep) * 4.0 * opt_slots / shard
     grads_b = params_b
 
     tokens_local = (global_batch / (dp * fsdp)) * (seq_len / sep)
@@ -172,6 +205,15 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
         gather_b = per_layer * dt / float(tp)
         acts_b += gather_b
 
+    # expert a2a staging: dispatch + combine each materialize the
+    # routed slot buffer (tokens_local × top_k × H) once per moe layer's
+    # in-flight window — one layer at a time, so a single ×2 copy
+    a2a_b = 0.0
+    if ep > 1:
+        top_k = int(getattr(model_cfg, "num_experts_per_tok", 1))
+        a2a_b = 2.0 * tokens_local * top_k * H * dt
+        acts_b += a2a_b
+
     budget = budget_bytes if budget_bytes is not None else \
         hbm_capacity(device_kind) * utilization
     total = params_b + opt_b + grads_b + acts_b
@@ -181,4 +223,6 @@ def estimate_hbm(model_cfg, config, *, global_batch: int, seq_len: int,
         feasible=total <= budget,
         detail={"tokens_local": tokens_local,
                 "layers_local": layers_local, "dtype_bytes": dt,
-                "fsdp_gather_bytes": gather_b})
+                "fsdp_gather_bytes": gather_b,
+                "expert_params_bytes": expert_p * dt / (shard * ep),
+                "moe_a2a_staging_bytes": a2a_b})

@@ -54,6 +54,7 @@ __all__ = [
     "StaleCostModelError", "InfeasibleMeshError", "enumerate_configs",
     "price_compiled", "price_config", "plan", "rank_agreement",
     "check_drift", "measure_compiled", "validate_rank_order",
+    "ep_imbalance",
 ]
 
 # per-collective launch floor (seconds): tiny-payload collectives are
@@ -90,38 +91,44 @@ class ParallelConfig:
     """One point in the 5D search space (axis vocabulary of
     ``parallel/mesh.py AXES_ORDER``; ``fsdp`` is ZeRO-3 expressed as
     GSPMD specs — params/slots/grads sharded over the axis, batch over
-    ``dp×fsdp`` — ROADMAP item 3 grows ep on this same vocabulary)."""
+    ``dp×fsdp`` — ``ep`` (ISSUE 20) shards experts over a subgroup of
+    the data ranks: it divides ``dp`` rather than multiplying the device
+    count, so ``size`` is ep-invariant)."""
     dp: int = 1
     tp: int = 1
     pp: int = 1
     sep: int = 1
     fsdp: int = 1
+    ep: int = 1
 
     @property
     def size(self) -> int:
+        # ep carves a subgroup out of dp — it never adds devices
         return self.dp * self.fsdp * self.tp * self.pp * self.sep
 
     def axes(self) -> Dict[str, int]:
         return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
-                "pp": self.pp, "sep": self.sep}
+                "pp": self.pp, "sep": self.sep, "ep": self.ep}
 
     def __str__(self) -> str:
-        # the fsdp segment appears only when the axis is real — plan
+        # the fsdp/ep segments appear only when the axis is real — plan
         # artifacts, graph-budget pins and elastic sidecars from before
-        # the axis existed keep parsing AND printing byte-identically
+        # the axes existed keep parsing AND printing byte-identically
         fs = f"fsdp{self.fsdp}_" if self.fsdp > 1 else ""
-        return f"dp{self.dp}_{fs}tp{self.tp}_pp{self.pp}_sep{self.sep}"
+        e = f"ep{self.ep}_" if self.ep > 1 else ""
+        return f"dp{self.dp}_{fs}{e}tp{self.tp}_pp{self.pp}_sep{self.sep}"
 
     @staticmethod
     def parse(s: str) -> "ParallelConfig":
         """Inverse of ``str()`` (also accepts ``dp2xtp2`` / ``dp=2,tp=2``
         forms so the CLI stays forgiving)."""
         import re
-        out = {"dp": 1, "tp": 1, "pp": 1, "sep": 1, "fsdp": 1}
-        # the lookbehind keeps the 'dp' inside 'fsdp4' from matching as
-        # a dp degree
-        for m in re.finditer(r"(?<![a-z])(fsdp|dp|tp|pp|sep)\s*=?\s*(\d+)",
-                             s.lower()):
+        out = {"dp": 1, "tp": 1, "pp": 1, "sep": 1, "fsdp": 1, "ep": 1}
+        # the lookbehind keeps the 'dp' inside 'fsdp4' (and the 'ep'
+        # inside 'sep2') from matching as a degree of the shorter name
+        for m in re.finditer(
+                r"(?<![a-z])(fsdp|dp|tp|pp|sep|ep)\s*=?\s*(\d+)",
+                s.lower()):
             out[m.group(1)] = int(m.group(2))
         return ParallelConfig(**out)
 
@@ -135,8 +142,9 @@ def enumerate_configs(n_devices: int, model_cfg=None, *,
                       max_pp: Optional[int] = None,
                       include_sep: bool = True,
                       include_pp: bool = True,
-                      include_fsdp: bool = True) -> List[ParallelConfig]:
-    """Every legal ``(dp, fsdp, tp, pp, sep)`` with
+                      include_fsdp: bool = True,
+                      include_ep: bool = True) -> List[ParallelConfig]:
+    """Every legal ``(dp, fsdp, tp, pp, sep[, ep])`` with
     ``dp*fsdp*tp*pp*sep == n_devices``. Legality against ``model_cfg``
     (a LlamaConfig shape):
 
@@ -149,10 +157,16 @@ def enumerate_configs(n_devices: int, model_cfg=None, *,
       per-data-rank batch must hold ≥2 microbatches;
     * ``sep`` divides the sequence (ring/GSPMD seq sharding) and the
       KV-head count (the ring exchanges head-sharded KV blocks);
-    * ``dp`` divides the global batch.
+    * ``dp`` divides the global batch;
+    * ``ep`` (enumerated only for MoE models — ``model_cfg`` exposes
+      ``num_experts``) divides ``dp`` (the expert subgroup is carved out
+      of the data ranks, never extra devices) and the expert count, and
+      composes with neither ``pp`` nor ``sep`` yet (stated exclusions,
+      like pp×sep).
 
     Without a ``model_cfg`` only the factorization + batch constraints
-    apply (the CLI's ``--no-model`` exploration mode).
+    apply (the CLI's ``--no-model`` exploration mode); ep stays 1 there
+    because its legality is inherently a model property.
     """
     out: List[ParallelConfig] = []
     for dp in _divisors(n_devices):
@@ -181,8 +195,21 @@ def enumerate_configs(n_devices: int, model_cfg=None, *,
                             cfg, model_cfg, global_batch, seq_len):
                         continue
                     out.append(cfg)
+                    # ep variants: only meaningful for MoE models, and
+                    # only dp-divisor degrees — size is ep-invariant so
+                    # these share the same device factorization
+                    if (include_ep and model_cfg is not None
+                            and getattr(model_cfg, "num_experts", 0)):
+                        import dataclasses as _dc
+                        for ep in _divisors(dp):
+                            if ep == 1:
+                                continue
+                            cfg_ep = _dc.replace(cfg, ep=ep)
+                            if _legal(cfg_ep, model_cfg, global_batch,
+                                      seq_len):
+                                out.append(cfg_ep)
     # stable, human-sensible order: least exotic first
-    out.sort(key=lambda c: (c.pp, c.sep, c.fsdp, c.tp, c.dp))
+    out.sort(key=lambda c: (c.pp, c.sep, c.fsdp, c.tp, c.dp, c.ep))
     return out
 
 
@@ -220,12 +247,49 @@ def _legal(cfg: ParallelConfig, m, global_batch: int,
         # tested but their composition is not a supported scenario yet
         # (ROADMAP item 4) — don't emit plans we can't compile
         return False
+    if cfg.tp > 1 and getattr(m, "num_experts", 0):
+        # expert FFN weights carry the tp annotation on their
+        # moe_intermediate dimension
+        if getattr(m, "moe_intermediate_size", 0) % cfg.tp:
+            return False
+    if cfg.ep > 1:
+        n_exp = int(getattr(m, "num_experts", 0) or 0)
+        # the expert subgroup is carved out of the data ranks and must
+        # split the expert set evenly across its members
+        if not n_exp or n_exp % cfg.ep or cfg.dp % cfg.ep:
+            return False
+        # explicit composition exclusions, stated like pp×sep: neither
+        # the pipe stage stacker nor the sep ring carries the expert
+        # all-to-all yet
+        if cfg.pp > 1 or cfg.sep > 1:
+            return False
     return True
 
 
 # ---------------------------------------------------------------------------
 # pricing
 # ---------------------------------------------------------------------------
+
+def ep_imbalance(histogram, ep: int) -> float:
+    """Bottleneck factor for the expert all-to-all from a MEASURED
+    per-expert token histogram (ISSUE 20's routing-entropy term).
+
+    With tokens uniformly spread over source ranks, the *fraction* of
+    tokens crossing shards is 1−1/ep regardless of expert popularity —
+    skew shows up instead on the bottleneck link: a2a completion time is
+    set by the busiest destination shard. Group the histogram into
+    ``ep`` contiguous expert shards (the ep-axis layout of the expert
+    dimension); the factor is ``ep × max shard share`` — 1.0 when
+    routing is balanced, → ep when one shard absorbs everything.
+    Dividing the ep-axis bandwidth by this factor makes
+    :func:`price_census` charge the busiest link's bytes."""
+    import numpy as np
+    h = np.asarray(histogram, dtype=float).ravel()
+    if ep <= 1 or h.size == 0 or h.size % ep or h.sum() <= 0:
+        return 1.0
+    shard_share = h.reshape(ep, h.size // ep).sum(axis=1) / h.sum()
+    return float(max(ep * shard_share.max(), 1.0))
+
 
 @dataclass
 class PricedGraph:
@@ -396,7 +460,8 @@ def _build_candidate(model_cfg, cfg: ParallelConfig, devices,
     from jax.sharding import PartitionSpec as P
 
     import paddle_tpu as pt
-    from ...models import LlamaForCausalLM, LlamaForCausalLMPipe
+    from ...models import (LlamaForCausalLM, LlamaForCausalLMPipe,
+                           MoEForCausalLM)
     from ...optimizer import AdamW
     from ...parallel import (HybridMesh, shard_layer,
                              shard_optimizer_state, shard_tensor,
@@ -404,17 +469,28 @@ def _build_candidate(model_cfg, cfg: ParallelConfig, devices,
     from ...trainer import Trainer
 
     import dataclasses
-    mcfg = dataclasses.replace(model_cfg,
-                               sequence_parallel=cfg.sep > 1)
+    is_moe = bool(getattr(model_cfg, "num_experts", 0))
+    if is_moe:
+        mcfg = model_cfg
+    else:
+        mcfg = dataclasses.replace(model_cfg,
+                                   sequence_parallel=cfg.sep > 1)
     pt.seed(0)
     if cfg.pp > 1:
         model = LlamaForCausalLMPipe(mcfg, num_stages=cfg.pp,
                                      num_microbatches=2)
+    elif is_moe:
+        model = MoEForCausalLM(mcfg)
     else:
         model = LlamaForCausalLM(mcfg)
     hm = HybridMesh.build(dp=cfg.dp, fsdp=cfg.fsdp, tp=cfg.tp,
-                          pp=cfg.pp, sep=cfg.sep,
+                          pp=cfg.pp, sep=cfg.sep, ep=cfg.ep,
                           devices=list(devices)[:cfg.size])
+    # on an ep mesh the batch shards over the full data submesh
+    # dp×ep×fsdp (dp axis size is dp/ep there); ep==1 meshes have no
+    # "ep" axis, so the spec must not name it
+    data_axes = (("dp", "ep", "fsdp") if cfg.ep > 1
+                 else ("dp", "fsdp"))
     with hm:
         shard_layer(model)
         tr = Trainer(model, AdamW(learning_rate=1e-3, parameters=model),
@@ -424,9 +500,9 @@ def _build_candidate(model_cfg, cfg: ParallelConfig, devices,
         rs = np.random.RandomState(0)
         ids = rs.randint(0, mcfg.vocab_size, (global_batch, seq_len + 1))
         batch = {"input_ids": shard_tensor(jnp.asarray(ids[:, :-1]),
-                                           spec=P(("dp", "fsdp"), None)),
+                                           spec=P(data_axes, None)),
                  "labels": shard_tensor(jnp.asarray(ids[:, 1:]),
-                                        spec=P(("dp", "fsdp"), None))}
+                                        spec=P(data_axes, None))}
         tr._ensure_built()
         args = (tr.params, tr.opt_state, batch, tr._lr_scalar(),
                 tr._key_data())
@@ -443,15 +519,27 @@ def price_config(config: ParallelConfig, model_cfg, *, devices=None,
                  collective_floor_s: Optional[float] = None,
                  hbm_budget_bytes: Optional[float] = None,
                  keep_build: bool = False,
-                 check_memory: bool = True) -> PricedConfig:
+                 check_memory: bool = True,
+                 moe_histogram=None) -> PricedConfig:
     """Memory-gate, compile, attribute and price ONE config; emit its
-    GSPMD plan. Infeasible configs return without paying a compile."""
+    GSPMD plan. Infeasible configs return without paying a compile.
+
+    ``moe_histogram`` — measured per-expert token counts. For ep>1
+    configs the expert all-to-all is priced from it: the ep-axis
+    bandwidth fed to ``price_census`` is divided by
+    :func:`ep_imbalance`, so skewed routing raises the predicted price
+    (the compile-only census cannot see data-dependent skew)."""
     import jax
     from ...observability.costs import device_spec
     from .memory_model import estimate_hbm
     from .emit import emit_plan
 
     spec = spec or device_spec()
+    imb = 1.0
+    if moe_histogram is not None and config.ep > 1:
+        imb = ep_imbalance(moe_histogram, config.ep)
+        bandwidths = dict(bandwidths or {})
+        bandwidths["ep"] = bandwidths.get("ep", spec.link_bw) / imb
     mem = None
     if check_memory:
         mem = estimate_hbm(model_cfg, config, global_batch=global_batch,
@@ -478,6 +566,10 @@ def price_config(config: ParallelConfig, model_cfg, *, devices=None,
                            bandwidths=bandwidths, db=db,
                            dispatch_floor_s=dispatch_floor_s,
                            collective_floor_s=collective_floor_s)
+    if imb > 1.0:
+        graph.notes.append(
+            f"ep all-to-all priced from measured routing histogram: "
+            f"bottleneck imbalance ×{imb:.3f} on the ep axis")
     # MFU from the one model-flop definition (PaLM closed form is the
     # cross-paper headline; the planner's denominator is per-chip peak
     # over the WHOLE mesh for the global batch)
@@ -635,7 +727,8 @@ def plan(model_cfg, *, n_devices: Optional[int] = None, devices=None,
          dispatch_floor_s: Optional[float] = None,
          collective_floor_s: Optional[float] = None,
          keep_builds: bool = False,
-         model_name: str = "llama") -> PlanReport:
+         model_name: str = "llama",
+         moe_histogram=None) -> PlanReport:
     """Enumerate → prune → price → rank → emit.
 
     ``drift`` — "warn" (annotate + warnings.warn), "refuse" (raise
@@ -696,7 +789,8 @@ def plan(model_cfg, *, n_devices: Optional[int] = None, devices=None,
                 dispatch_floor_s=dispatch_floor_s,
                 collective_floor_s=collective_floor_s,
                 hbm_budget_bytes=hbm_budget_bytes,
-                keep_build=keep_builds)
+                keep_build=keep_builds,
+                moe_histogram=moe_histogram)
         except Exception as e:       # a config that can't compile is
             pc = PricedConfig(       # pruned evidence, not a crash
                 config=cfg, feasible=False,

@@ -28,14 +28,15 @@ from .baselines import (RATIO_METRICS, BenchDiff, RatioMetric, backend_of,
                         ratio_metrics_of)
 from .rules import (EwmaSpike, RatioBand, SloRule, Staleness, Threshold,
                     default_rules, elastic_rules, fabric_rules,
-                    frontdoor_rules, serving_rules, trainer_rules)
+                    frontdoor_rules, moe_rules, serving_rules,
+                    trainer_rules)
 from .sentry import (Incident, SloSentry, active, install, maybe_tick,
                      uninstall)
 
 __all__ = [
     "SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
     "trainer_rules", "serving_rules", "fabric_rules", "frontdoor_rules",
-    "elastic_rules", "default_rules",
+    "elastic_rules", "moe_rules", "default_rules",
     "Incident", "SloSentry", "install", "uninstall", "active",
     "maybe_tick",
     "baselines", "RatioMetric", "RATIO_METRICS", "BenchDiff",

@@ -156,6 +156,20 @@ RATIO_METRICS: Dict[str, RatioMetric] = {m.name: m for m in [
     RatioMetric("quant_kv_capacity_ratio", "lower", band=0.15),
     RatioMetric("quant_serving_decode_efficiency", "lower", band=0.35),
     RatioMetric("quant_stream_agreement", "lower", band=0.4),
+    # expert parallelism (ISSUE 20): replicated ÷ ep2 measured MoE step
+    # at equal devices/experts (interleaved min-of-rounds; the a2a tax
+    # vs the expert-HBM win — collapse means the dispatch path
+    # regressed; rides host noise, wide band), the priced-census
+    # per-a2a seconds ÷ a wall-clock shard_map all-to-all (cost-model
+    # drift for the NEW collective; CPU constants are nominal, so only
+    # the drift-of-the-ratio is gated, either direction), and XLA
+    # ragged_dot ÷ Pallas grouped matmul (within-run A/B; the CPU leg
+    # runs interpret mode — structurally stable but not a perf claim,
+    # hence the wider cpu band)
+    RatioMetric("moe_ep_step_speedup", "lower", band=0.35),
+    RatioMetric("moe_ep_a2a_pred_over_measured", "either", band=0.5),
+    RatioMetric("moe_grouped_matmul_speedup", "lower", band=0.35,
+                cpu_band=0.6),
 ]}
 
 

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["SloRule", "Threshold", "EwmaSpike", "RatioBand", "Staleness",
            "trainer_rules", "serving_rules", "fabric_rules",
            "frontdoor_rules", "elastic_rules", "tracing_rules",
-           "default_rules"]
+           "moe_rules", "default_rules"]
 
 
 class SloRule:
@@ -585,6 +585,71 @@ def tracing_rules(queue_frac_ceiling: float = 0.5,
                         "latency-owning hop is missing its spans "
                         "(instrumentation regression) or a new hop "
                         "appeared between instrumented ones"),
+    ]
+
+
+def moe_rules(imbalance_ceiling: float = 2.0,
+              aux_loss_floor: float = 0.5,
+              router_z_spike_ratio: float = 3.0,
+              exposed_comm_ceiling: float = 0.6,
+              breach_for: int = 3,
+              cooldown_s: float = 300.0) -> List[SloRule]:
+    """The expert-parallel MoE pack (ISSUE 20), watching the routing
+    health series ``publish_moe_metrics`` exports and the overlap gauge
+    the a2a lane shares with every other collective:
+
+    * ``pt_moe_load_imbalance`` is ``e × max expert share`` — exactly
+      the bottleneck statistic the planner's entropy-priced a2a divides
+      ep bandwidth by. Sustained past the ceiling means a hot expert is
+      serializing dispatch AND the plan was priced for a balance the
+      run no longer has — re-plan with the live histogram;
+    * the aux-loss floor is the estimator's own watchdog: the GShard
+      aux sits near 1.0 when balanced and RISES under skew, so a value
+      collapsing toward 0 means the me/ce inputs got misaligned
+      (a routing-pipeline regression), not a healthy router;
+    * a router-z spike vs its own EWMA — router logits blowing up
+      precedes routing collapse by many steps;
+    * exposed-comm over the band while the MoE series are live: the
+      dispatch/combine all-to-all stopped overlapping (a schedule or
+      flag regression on the ep lane).
+
+    Every series skips when missing (dense models, eval-only runs), so
+    the pack composes with ``trainer_rules`` without double-paging."""
+    return [
+        Threshold(
+            "moe_load_imbalance_ceiling", "pt_moe_load_imbalance",
+            ceiling=imbalance_ceiling, severity="warning",
+            breach_for=breach_for, cooldown_s=cooldown_s,
+            description="e x max expert share over the ceiling: a hot "
+                        "expert is the a2a bottleneck — the entropy "
+                        "pricing divisor the plan assumed no longer "
+                        "holds; re-plan with the live histogram or "
+                        "raise the aux-loss weight"),
+        Threshold(
+            "moe_aux_loss_floor", "pt_moe_aux_loss",
+            floor=aux_loss_floor, severity="warning",
+            breach_for=breach_for, cooldown_s=cooldown_s,
+            description="GShard aux loss collapsed toward 0: the "
+                        "estimator's me/ce inputs are misaligned "
+                        "(routing-pipeline regression) — balanced "
+                        "routing reads ~1.0, never ~0"),
+        EwmaSpike(
+            "moe_router_z_spike", "pt_moe_router_z",
+            spike_ratio=router_z_spike_ratio, alpha=0.3, warmup=3,
+            severity="warning", breach_for=2, cooldown_s=cooldown_s,
+            description="router z-loss spiked vs its own EWMA: gate "
+                        "logits are blowing up — routing collapse "
+                        "follows; check lr/init on the gate"),
+        RatioBand(
+            "moe_exposed_a2a", "pt_exposed_comm_fraction",
+            labels={"component": "train"}, baseline=1.0,
+            low=0.0, high=exposed_comm_ceiling,
+            severity="warning", breach_for=breach_for,
+            cooldown_s=cooldown_s,
+            description="exposed comm over the band on an MoE run: the "
+                        "dispatch/combine all-to-all stopped "
+                        "overlapping with expert compute (flag flip or "
+                        "schedule regression on the ep lane)"),
     ]
 
 

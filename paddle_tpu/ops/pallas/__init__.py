@@ -8,4 +8,5 @@ install_pallas_compat()    # pltpu.CompilerParams name on jax<0.6
 from . import flash_attention  # noqa: F401,E402
 from . import fused_norm  # noqa: F401
 from . import fused_vocab_ce  # noqa: F401
+from . import grouped_matmul  # noqa: F401
 from . import paged_attention  # noqa: F401

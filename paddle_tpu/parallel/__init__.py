@@ -11,7 +11,10 @@ from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         parallel_fused_linear_cross_entropy,
                         scatter_seq, gather_seq,
                         ColumnSequenceParallelLinear, RowSequenceParallelLinear)
-from .moe import MoELayer, MoEMLP, top_k_gating
+# top_k_gating is quarantined as the test oracle (ISSUE 20) — import it
+# from paddle_tpu.parallel.moe explicitly if you really want the O(t*e*c)
+# one-hot formulation; the package surface routes to the sort-based path.
+from .moe import MoELayer, MoEMLP, top_k_routing
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention, ulysses_supported
 from .pipeline import (LayerDesc, SharedLayerDesc, SegmentLayers,

@@ -22,6 +22,12 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AXES_ORDER = ("pp", "dp", "fsdp", "sep", "tp")  # outer→inner (DCN→ICI)
+# with expert parallelism the "ep" axis is carved OUT of dp (it is a
+# subgroup of the data ranks, not extra devices) and sits between dp and
+# fsdp so expert all-to-all rides the faster inner links than pure-dp
+# gradient traffic; ep==1 meshes keep the exact 5-axis shape above so
+# every pre-EP census/plan artifact stays byte-identical
+AXES_ORDER_EP = ("pp", "dp", "ep", "fsdp", "sep", "tp")
 
 _CURRENT: List["HybridMesh"] = []
 
@@ -32,7 +38,7 @@ class HybridMesh:
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
-        self.ep_degree = 1
+        self.ep_degree = int(mesh.shape.get("ep", 1))
 
     # -- construction -------------------------------------------------------
 
@@ -43,8 +49,12 @@ class HybridMesh:
 
         Mirrors fleet.init's strategy degrees (reference:
         fleet/base/topology.py:64 axis order) but expressed as one Mesh.
-        The "ep" axis, when used, aliases the fsdp×tp submesh the way the
-        reference reuses comm groups for expert parallel.
+        The "ep" axis is a SUBGROUP of the data ranks the way the
+        reference reuses comm groups for expert parallel: ``ep`` must
+        divide ``dp`` and does not change the device count. When ep>1
+        the mesh carries a real "ep" axis (AXES_ORDER_EP) with the dp
+        axis shrunk to ``dp // ep``; when ep==1 the 5-axis mesh is
+        byte-identical to the pre-EP shape.
         """
         devices = list(jax.devices()) if devices is None else list(devices)
         sizes = {"pp": pp, "dp": dp, "fsdp": fsdp, "sep": sep, "tp": tp}
@@ -53,13 +63,19 @@ class HybridMesh:
             raise ValueError(
                 f"mesh degrees {sizes} multiply to {total} but {len(devices)} "
                 f"devices are available")
-        if ep != 1 and (dp * fsdp) % ep != 0:
+        if ep != 1 and dp % ep != 0:
             raise ValueError(
-                f"ep={ep} must divide dp*fsdp={dp * fsdp}: expert parallelism "
-                f"reuses the data/sharding submesh (reference: fleet reuses "
-                f"comm groups for MoE's all-to-all)")
-        arr = np.array(devices).reshape([sizes[a] for a in AXES_ORDER])
-        mesh = Mesh(arr, AXES_ORDER)
+                f"ep={ep} must divide dp={dp}: expert parallelism carves an "
+                f"expert subgroup out of the data ranks (reference: fleet "
+                f"reuses comm groups for MoE's all-to-all)")
+        if ep != 1:
+            sizes = {"pp": pp, "dp": dp // ep, "ep": ep, "fsdp": fsdp,
+                     "sep": sep, "tp": tp}
+            axes = AXES_ORDER_EP
+        else:
+            axes = AXES_ORDER
+        arr = np.array(devices).reshape([sizes[a] for a in axes])
+        mesh = Mesh(arr, axes)
         hm = HybridMesh(mesh)
         hm.ep_degree = ep
         return hm
@@ -70,7 +86,9 @@ class HybridMesh:
         return self.mesh.shape.get(name, 1)
 
     def get_data_parallel_world_size(self) -> int:
-        return self.axis_size("dp") * self.axis_size("fsdp")
+        # the ep axis is carved out of dp, so data ranks span dp×ep×fsdp
+        return (self.axis_size("dp") * self.axis_size("ep")
+                * self.axis_size("fsdp"))
 
     def get_model_parallel_world_size(self) -> int:
         return self.axis_size("tp")
@@ -85,7 +103,7 @@ class HybridMesh:
         return self.axis_size("sep")
 
     def get_expert_parallel_world_size(self) -> int:
-        return self.ep_degree
+        return max(self.axis_size("ep"), self.ep_degree)
 
     @property
     def nproc(self) -> int:
@@ -164,7 +182,7 @@ def pod_bootstrap_env() -> Optional[dict]:
 
 
 def init_parallel_env(dp: int = 1, fsdp: int = 1, tp: int = 1, pp: int = 1,
-                      sep: int = 1) -> HybridMesh:
+                      sep: int = 1, ep: int = 1) -> HybridMesh:
     """Multi-host bootstrap + mesh creation.
 
     Reference analogue: paddle.distributed.init_parallel_env
@@ -198,4 +216,4 @@ def init_parallel_env(dp: int = 1, fsdp: int = 1, tp: int = 1, pp: int = 1,
             except RuntimeError as e:
                 if "already" not in str(e).lower():
                     raise
-    return HybridMesh.build(dp=dp, fsdp=fsdp, tp=tp, pp=pp, sep=sep)
+    return HybridMesh.build(dp=dp, fsdp=fsdp, tp=tp, pp=pp, sep=sep, ep=ep)

@@ -25,12 +25,20 @@ fallback for jax builds without ragged_dot); the capacity-factor dense
 path is ~4x faster still at this scale but DROPS overflow tokens — the
 measured trade is recorded in ops/pallas/tune_db.json (moe_grouped_mm).
 
-Expert weights are sharded over the ("dp","fsdp") submesh — the "ep" axis
-aliases the data-parallel devices the way the reference reuses comm groups
-(HybridMesh.build's ep degree) — and the dispatched [e, c, d] tensor is
-sharding-constrained to the same axes, so GSPMD materializes the
-global_scatter/global_gather all-to-alls between the token-sharded and
-expert-sharded layouts.
+Expert parallelism (ISSUE 20): expert weights shard their expert dim over
+the ("ep","dp","fsdp") submesh — "ep" is a REAL mesh axis carved out of
+the data ranks (HybridMesh.build's ep degree; _clean_spec drops it on
+ep==1 meshes so pre-EP plans stay byte-identical). On an ep>1 mesh the
+dispatch/combine run as a shard_map'd ``lax.all_to_all`` over the "ep"
+axis in both capacity and DROPLESS variants — dropless keeps the exact
+per-expert counts as the (logical) a2a split sizes inside a statically
+bounded slot buffer, since this jax ships no ragged_all_to_all. On
+jaxlib <0.6 HYBRID meshes, where manual-subgroup collectives abort in
+the partial-manual shard_map lowering (the ring-attention gate,
+parallel/ring_attention.py), the layer falls back to pure-GSPMD
+dispatch: the dispatched [e, c, d] tensor is sharding-constrained to the
+expert axes and XLA materializes the global_scatter/global_gather
+all-to-alls itself.
 """
 
 from __future__ import annotations
@@ -41,6 +49,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -54,6 +64,58 @@ def _aux_loss(probs, e):
     me = jnp.mean(probs, axis=0)
     ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
     return jnp.sum(me * ce) * e
+
+
+def routing_stats(gate_logits, k: int):
+    """(aux_loss, router_z, per-expert token counts) for one routing
+    batch. The counts vector is the MEASURED histogram the planner's
+    entropy-priced all-to-all consumes (``price_config(...,
+    moe_histogram=counts)``); router_z is the ST-MoE z-loss
+    ``mean(logsumexp(logits)^2)``."""
+    t, e = gate_logits.shape
+    logits = gate_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, ids = jax.lax.top_k(probs, k)
+    counts = jnp.bincount(ids.reshape(-1), length=e).astype(jnp.int32)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    return _aux_loss(probs, e), z, counts
+
+
+def publish_moe_metrics(aux_loss=None, router_z=None, expert_counts=None):
+    """Publish MoE routing health through the metrics registry (the PR 4
+    vocabulary): ``pt_moe_*`` counters for routed token assignments per
+    expert plus gauges for the aux loss, router z-loss and the
+    load-balance factor (``e × max expert share``; 1.0 = balanced — the
+    same bottleneck statistic the planner's a2a entropy pricing uses).
+
+    Host-side only: traced values are skipped silently, so call it from
+    the training loop with concrete step outputs (``routing_stats`` of a
+    logged step), never from inside jit."""
+    from ..observability.metrics import REGISTRY
+    if not REGISTRY.enabled:
+        return
+    tracer = lambda v: isinstance(v, jax.core.Tracer)
+    if aux_loss is not None and not tracer(aux_loss):
+        REGISTRY.gauge("pt_moe_aux_loss",
+                       "GShard load-balance aux loss").set(float(aux_loss))
+    if router_z is not None and not tracer(router_z):
+        REGISTRY.gauge("pt_moe_router_z",
+                       "router z-loss mean(logsumexp^2)").set(
+            float(router_z))
+    if expert_counts is not None and not tracer(expert_counts):
+        c = np.asarray(expert_counts, dtype=float).ravel()
+        tot = float(c.sum())
+        ctr = REGISTRY.counter("pt_moe_expert_tokens_total",
+                               "routed token assignments per expert")
+        for i, v in enumerate(c):
+            ctr.inc(float(v), expert=str(i))
+        REGISTRY.counter("pt_moe_dispatch_total",
+                         "MoE routing batches published").inc()
+        if tot > 0:
+            REGISTRY.gauge(
+                "pt_moe_load_imbalance",
+                "e * max expert share (1.0 = perfectly balanced)").set(
+                float(c.max() * c.size / tot))
 
 
 def top_k_routing(gate_logits, k: int, capacity: int,
@@ -170,12 +232,17 @@ class MoEMLP(Layer):
                  dtype=None):
         super().__init__()
         std = 0.02
+        # the expert dim shards over ep first (real expert parallelism),
+        # then the dp/fsdp data axes; _clean_spec drops "ep" on ep==1
+        # meshes so pre-EP placements stay byte-identical
         self.w_gate_up = self.create_parameter(
             [num_experts, hidden_size, 2 * ffn_size], dtype=dtype,
-            initializer=I.Normal(0.0, std), sharding=(("dp", "fsdp"), None, "tp"))
+            initializer=I.Normal(0.0, std),
+            sharding=(("ep", "dp", "fsdp"), None, "tp"))
         self.w_down = self.create_parameter(
             [num_experts, ffn_size, hidden_size], dtype=dtype,
-            initializer=I.Normal(0.0, std), sharding=(("dp", "fsdp"), "tp", None))
+            initializer=I.Normal(0.0, std),
+            sharding=(("ep", "dp", "fsdp"), "tp", None))
 
     def forward(self, x):
         # x: [e, c, d] -> [e, c, d]
@@ -186,13 +253,14 @@ class MoEMLP(Layer):
 
 
 def _constrain_experts(xe):
-    """Shard the [e, c, d] dispatched tensor's expert dim over the ep
-    (= dp×fsdp) submesh — this boundary is where GSPMD emits the
-    global_scatter/global_gather all-to-alls."""
+    """Shard the [e, c, d] dispatched tensor's expert dim over the
+    ep×dp×fsdp submesh — this boundary is where GSPMD emits the
+    global_scatter/global_gather all-to-alls (and the whole of the
+    pure-GSPMD ep fallback on legacy jaxlib hybrid meshes)."""
     hm = current_mesh()
     if hm is None or not isinstance(xe, jax.core.Tracer):
         return xe
-    axes = tuple(a for a in ("dp", "fsdp") if hm.axis_size(a) > 1)
+    axes = tuple(a for a in ("ep", "dp", "fsdp") if hm.axis_size(a) > 1)
     if not axes:
         return xe
     if xe.shape[0] % int(np.prod([hm.axis_size(a) for a in axes])) != 0:
@@ -204,28 +272,48 @@ def _constrain_experts(xe):
 
 def _grouped_matmul(xs, w, group_sizes):
     """Ragged grouped matmul: rows of ``xs`` [m, k] are split by
-    ``group_sizes`` [g] and each run multiplies its own ``w[g] `` [k, n].
+    ``group_sizes`` [g] and each run multiplies its own ``w[g]`` [k, n].
 
-    lax.ragged_dot when this jax ships it (XLA-native; the round-5 v5e
-    A/B measured it 1.7x faster than megablox gmm with max|diff|=0 at
-    e=64, d=2048, f=1408); otherwise the bundled megablox Pallas kernel
-    (interpret mode off-TPU)."""
-    if hasattr(jax.lax, "ragged_dot"):
-        return jax.lax.ragged_dot(xs, w, group_sizes,
-                                  preferred_element_type=jnp.float32)
-    from jax.experimental.pallas.ops.tpu.megablox import gmm
-    from ..ops.registry import backend_kind
+    This is the dispatch SEAM (ISSUE 20): ops/pallas/grouped_matmul
+    owns the implementation choice — the TuneDB-gated Pallas kernel on
+    TPU, XLA ``lax.ragged_dot`` (the round-5 v5e A/B measured it 1.7x
+    faster than megablox gmm with max|diff|=0 at e=64, d=2048, f=1408)
+    or megablox gmm elsewhere."""
+    from ..ops.pallas.grouped_matmul import grouped_matmul
+    return grouped_matmul(xs, w, group_sizes)
 
-    def tiling(m, kk, n):
-        # largest power-of-two tile <= 128 dividing each dim (gmm
-        # requires exact tiling; real configs are 128-multiples, tiny
-        # test shapes degrade gracefully)
-        g_ = lambda x: math.gcd(x, 128)
-        return (g_(m), g_(kk), g_(n))
 
-    return gmm(xs, w, group_sizes, preferred_element_type=jnp.float32,
-               tiling=tiling(xs.shape[0], w.shape[1], w.shape[2]),
-               interpret=backend_kind() != "tpu")
+def _expert_ffn(xe, w_gu, w_dn):
+    """The per-expert SwiGLU on a dense [e_local, slots, d] layout —
+    MoEMLP.forward's math on raw (shard_map-local) weight shards."""
+    gu = jnp.einsum("ecd,edf->ecf", xe, w_gu)
+    g, u = jnp.split(gu, 2, axis=-1)
+    return jnp.einsum("ecf,efd->ecd", F.silu(g) * u, w_dn)
+
+
+def _aux_loss_ep(probs, e):
+    """GShard aux loss inside an ep shard_map body: the two token-means
+    are pmean'd over the ranks BEFORE the product, which IS the global
+    estimator (mean of equal-sized shard means = global mean), so ep>1
+    training loss stays at parity with the replicated path — a
+    pmean-of-per-rank-aux would average products of local means
+    instead and drift by O(routing skew)."""
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jax.lax.pmean(jnp.mean(probs, axis=0), "ep")
+    ce = jax.lax.pmean(
+        jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0), "ep")
+    return jnp.sum(me * ce) * e
+
+
+def _ep_shard_map_ok(mesh) -> bool:
+    """Legacy jaxlib (< 0.6) cannot lower subgroup collectives inside a
+    partially-manual shard_map when ANOTHER mesh axis has size > 1 (the
+    ring-attention gate, parallel/ring_attention.py) — those hybrid
+    meshes take the pure-GSPMD dispatch instead."""
+    if jax.__version_info__ < (0, 6):
+        return not any(mesh.shape[a] > 1
+                       for a in mesh.axis_names if a != "ep")
+    return True
 
 
 class MoELayer(Layer):
@@ -251,11 +339,34 @@ class MoELayer(Layer):
             initializer=I.Normal(0.0, 0.02))
         self.experts = MoEMLP(num_experts, hidden_size, ffn_size, dtype=dtype)
 
+    def routing_histogram(self, x):
+        """Measured per-expert token counts for ``x`` — the histogram
+        the planner's entropy-priced all-to-all consumes
+        (``price_config(..., moe_histogram=...)``)."""
+        flat = x.reshape(-1, x.shape[-1])
+        logits = jnp.matmul(flat.astype(jnp.float32), self.gate_weight)
+        return routing_stats(logits, self.top_k)[2]
+
     def forward(self, x):
         b, s, d = x.shape
         t = b * s
         e = self.num_experts
         flat = x.reshape(t, d)
+
+        # expert-parallel path: a real "ep" mesh axis routes through the
+        # shard_map'd all-to-all when the lowering supports it (pure-ep
+        # mesh, or modern jax); legacy hybrid meshes and ep==1 fall
+        # through to the GSPMD paths below
+        hm = current_mesh()
+        ep = hm.axis_size("ep") if hm is not None else 1
+        if (ep > 1 and t % ep == 0 and e % ep == 0 and (t // ep) > 0
+                and _ep_shard_map_ok(hm.mesh)):
+            if self.capacity_factor is None:
+                out, aux = self._forward_dropless_ep(flat, hm.mesh, ep)
+            else:
+                out, aux = self._forward_capacity_ep(flat, hm.mesh, ep)
+            return out.reshape(b, s, d), aux
+
         logits = jnp.matmul(flat.astype(jnp.float32), self.gate_weight)
 
         if self.capacity_factor is None:
@@ -271,6 +382,109 @@ class MoELayer(Layer):
         out = combine_tokens(ye, slot, gates,
                              renormalize=self.top_k > 1)
         return out.reshape(b, s, d), aux
+
+    def _forward_capacity_ep(self, flat, mesh_, ep: int):
+        """shard_map'd expert-parallel capacity routing. Each ep rank
+        routes its LOCAL tokens into the full [e, c_local, d] slot
+        layout; the tiled all-to-all splits the expert dim over ranks
+        while concatenating every rank's slot block, local expert
+        shards run one dense SwiGLU over [e/ep, c_local*ep, d], and the
+        reverse all-to-all returns each rank's slots for the local
+        combine. The aux loss is the pmean over ranks (same estimator
+        as dp-averaged gradients)."""
+        t, d = flat.shape
+        e, k = self.num_experts, self.top_k
+        t_l = t // ep
+        cap = int(math.ceil(t_l * k / e * self.capacity_factor))
+        renorm = k > 1
+        gw = self.gate_weight.astype(jnp.float32)
+        w_gu = self.experts.w_gate_up.astype(flat.dtype)
+        w_dn = self.experts.w_down.astype(flat.dtype)
+
+        def body(xl, gw_, wgu, wdn):
+            logits = jnp.matmul(xl.astype(jnp.float32), gw_)
+            slot, gates, _ = top_k_routing(logits, k, cap)
+            aux = _aux_loss_ep(jax.nn.softmax(logits, axis=-1), e)
+            xe = dispatch_tokens(xl, slot, e, cap)            # [e, c, d]
+            xe = jax.lax.all_to_all(xe, "ep", split_axis=0,
+                                    concat_axis=1, tiled=True)
+            ye = _expert_ffn(xe, wgu, wdn)                # [e/ep, c*ep, d]
+            ye = jax.lax.all_to_all(ye, "ep", split_axis=1,
+                                    concat_axis=0, tiled=True)
+            out = combine_tokens(ye, slot, gates, renormalize=renorm)
+            return out, aux
+
+        fn = shard_map(body, mesh=mesh_, axis_names=frozenset({"ep"}),
+                       in_specs=(P("ep", None), P(None, None),
+                                 P("ep", None, None),
+                                 P("ep", None, None)),
+                       out_specs=(P("ep", None), P()),
+                       check_vma=False)
+        return fn(flat, gw, w_gu, w_dn)
+
+    def _forward_dropless_ep(self, flat, mesh_, ep: int):
+        """shard_map'd DROPLESS expert parallelism. Each rank sorts its
+        local assignments by expert and scatters them into a
+        statically-bounded [e, t_local, d] slot buffer (an expert can
+        receive at most t_local distinct local tokens, so nothing is
+        ever dropped); the exact per-expert counts are the a2a split
+        sizes in the logical sense — they define slot occupancy inside
+        the bound, because this jax ships no ragged_all_to_all. The
+        grouped matmul then runs over the received slot blocks through
+        the ops/pallas seam, and the reverse all-to-all + unsort
+        restores token order."""
+        t, d = flat.shape
+        e, k = self.num_experts, self.top_k
+        t_l = t // ep
+        e_l = e // ep
+        cap = t_l          # static per-(rank, expert) bound: top_k ids
+        renorm = k > 1     # are distinct, so counts[e] <= t_local
+        gw = self.gate_weight.astype(jnp.float32)
+        w_gu = self.experts.w_gate_up.astype(flat.dtype)
+        w_dn = self.experts.w_down.astype(flat.dtype)
+
+        def body(xl, gw_, wgu, wdn):
+            logits = jnp.matmul(xl.astype(jnp.float32), gw_)
+            probs = jax.nn.softmax(logits, axis=-1)
+            gates, ids = jax.lax.top_k(probs, k)              # [t_l, k]
+            flat_e = ids.T.reshape(-1)                        # [k*t_l]
+            order = jnp.argsort(flat_e, stable=True)
+            sorted_e = flat_e[order]
+            starts = jnp.searchsorted(sorted_e, jnp.arange(e))
+            pos = jnp.arange(k * t_l, dtype=jnp.int32) - starts[sorted_e]
+            dest = sorted_e * cap + pos                       # exact, no drop
+            xs = xl[order % t_l]
+            buf = jnp.zeros((e * cap, d), xl.dtype).at[dest].set(xs)
+            buf = jax.lax.all_to_all(buf.reshape(e, cap, d), "ep",
+                                     split_axis=0, concat_axis=1,
+                                     tiled=True)          # [e_l, ep*cap, d]
+            rows = buf.reshape(e_l * ep * cap, d)
+            gsz = jnp.full((e_l,), ep * cap, jnp.int32)
+            gu = _grouped_matmul(rows, wgu, gsz).astype(xl.dtype)
+            g, u = jnp.split(gu, 2, axis=-1)
+            ys = _grouped_matmul(F.silu(g) * u, wdn,
+                                 gsz).astype(xl.dtype)
+            ybuf = jax.lax.all_to_all(ys.reshape(e_l, ep * cap, d), "ep",
+                                      split_axis=1, concat_axis=0,
+                                      tiled=True)             # [e, cap, d]
+            ysr = ybuf.reshape(e * cap, d)[dest]              # sorted order
+            y_cm = jnp.zeros_like(ysr).at[order].set(ysr).reshape(
+                k, t_l, d)
+            g_km = gates.T                                    # [k, t_l]
+            if renorm:
+                g_km = g_km / jnp.maximum(
+                    jnp.sum(g_km, 0, keepdims=True), 1e-9)
+            out = jnp.sum(g_km[..., None].astype(ysr.dtype) * y_cm,
+                          axis=0)
+            return out, _aux_loss_ep(probs, e)
+
+        fn = shard_map(body, mesh=mesh_, axis_names=frozenset({"ep"}),
+                       in_specs=(P("ep", None), P(None, None),
+                                 P("ep", None, None),
+                                 P("ep", None, None)),
+                       out_specs=(P("ep", None), P()),
+                       check_vma=False)
+        return fn(flat, gw, w_gu, w_dn)
 
     def _forward_dropless(self, flat, logits):
         """Grouped-matmul experts over exact per-expert counts — the

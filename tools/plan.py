@@ -36,7 +36,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-MODELS = ("llama-micro", "llama-tiny")
+MODELS = ("llama-micro", "llama-tiny", "moe-micro")
 
 
 def _model_cfg(name: str):
@@ -50,6 +50,17 @@ def _model_cfg(name: str):
                            max_position_embeddings=128)
     if name == "llama-tiny":
         return LlamaConfig.tiny()
+    if name == "moe-micro":
+        # the MoE canonical-graph size: unlocks the ep axis (ISSUE 20)
+        # in enumeration and accepts epN --config segments
+        from paddle_tpu.models.moe_lm import MoEConfig
+        return MoEConfig(vocab_size=320, hidden_size=64,
+                         intermediate_size=96, moe_intermediate_size=48,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, num_shared_experts=1,
+                         first_k_dense_replace=1, capacity_factor=None,
+                         max_position_embeddings=128)
     raise SystemExit(f"plan: unknown --model {name!r}; known: "
                      f"{', '.join(MODELS)}")
 
